@@ -1,0 +1,32 @@
+"""parquet_tpu — a TPU-native Apache Parquet framework.
+
+A brand-new implementation of the capability set of fraugster/parquet-go
+(see SURVEY.md), designed TPU-first: file I/O, Thrift metadata, block
+decompression, and record assembly run on the host; the column-decode hot path
+(RLE/bit-packing hybrid, dictionary lookup, delta-binary-packed) runs as batched
+JAX/Pallas kernels behind a pluggable decoder backend.
+
+Layout:
+  meta/      Thrift compact protocol + parquet-format metadata model
+  ops/       host (NumPy-vectorized) encoders/decoders — the correctness oracle
+  kernels/   Pallas TPU kernels + the batched page-decode pipeline
+  core/      pages, chunks, column stores, schema tree, FileReader/FileWriter
+  schema/    textual schema DSL (parser/validator) + autoschema from dataclasses
+  floor/     high-level record marshal/unmarshal (the reference's floor analogue)
+  parallel/  shard_map/mesh scale-out over pages, columns, and row groups
+  tools/     parquet-tool and csv2parquet CLI equivalents
+  utils/     shared helpers (varints, buffered IO, hashing)
+"""
+
+__version__ = "0.1.0"
+
+from .meta import (  # noqa: F401
+    CompressionCodec,
+    ConvertedType,
+    Encoding,
+    FieldRepetitionType,
+    LogicalType,
+    PageType,
+    Type,
+    read_file_metadata,
+)

@@ -1,0 +1,160 @@
+"""Thrift compact protocol + footer metadata tests.
+
+Oracle: pyarrow-written files (cross-implementation, like the reference's
+parquet-mr compatibility harness, reference: compatibility/run_tests.bash).
+"""
+
+import io
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.meta import (
+    CompactReader,
+    CompactWriter,
+    Encoding,
+    FileMetaData,
+    ParquetFileError,
+    SchemaElement,
+    Statistics,
+    Type,
+    read_file_metadata,
+    serialize_footer,
+)
+from parquet_tpu.meta.thrift import ThriftError
+
+
+def _pa_file(table, **kw) -> io.BytesIO:
+    buf = io.BytesIO()
+    pq.write_table(table, buf, **kw)
+    buf.seek(0)
+    return buf
+
+
+class TestVarints:
+    def test_uvarint_roundtrip(self):
+        for v in [0, 1, 127, 128, 300, 2**31, 2**63 - 1, 2**64 - 1]:
+            w = CompactWriter()
+            w.write_uvarint(v)
+            r = CompactReader(w.getvalue())
+            assert r.read_uvarint() == v
+
+    def test_zigzag_roundtrip(self):
+        for v in [0, -1, 1, -64, 63, 2**31 - 1, -(2**31), 2**63 - 1, -(2**63)]:
+            w = CompactWriter()
+            w.write_zigzag(v)
+            r = CompactReader(w.getvalue())
+            assert r.read_zigzag() == v
+
+    def test_truncated_varint_raises(self):
+        with pytest.raises(ThriftError):
+            CompactReader(b"\x80\x80").read_uvarint()
+
+
+class TestStructRoundtrip:
+    def test_schema_element(self):
+        se = SchemaElement(type=int(Type.INT64), name="col", repetition_type=1, num_children=None)
+        se2 = SchemaElement.loads(se.dumps())
+        assert se2.type == int(Type.INT64)
+        assert se2.name == "col"
+        assert se2.repetition_type == 1
+        assert se2.num_children is None
+
+    def test_statistics_binary(self):
+        st = Statistics(min_value=b"\x00\x01", max_value=b"\xff\xfe", null_count=3)
+        st2 = Statistics.loads(st.dumps())
+        assert st2.min_value == b"\x00\x01"
+        assert st2.max_value == b"\xff\xfe"
+        assert st2.null_count == 3
+
+    def test_unknown_fields_skipped(self):
+        # A struct with an extra field id 200 must parse (forward compat).
+        w = CompactWriter()
+        w.write_byte(0x15)  # field 1, i32
+        w.write_zigzag(42)
+        w.write_byte(0x05)  # long-form field header, i32
+        w.write_zigzag(200)
+        w.write_zigzag(7)
+        w.write_byte(0x00)
+        se = SchemaElement.loads(w.getvalue())
+        assert se.type == 42
+
+    def test_large_field_id_delta(self):
+        st = Statistics(null_count=5)  # field 3 written with delta 3
+        data = st.dumps()
+        assert Statistics.loads(data).null_count == 5
+
+
+class TestFooter:
+    def test_pyarrow_footer_parses(self):
+        t = pa.table(
+            {
+                "i64": pa.array([1, 2, None], pa.int64()),
+                "f64": pa.array([1.5, 2.5, 3.5]),
+                "s": pa.array(["a", "bb", "ccc"]),
+                "b": pa.array([True, False, None]),
+            }
+        )
+        m = read_file_metadata(_pa_file(t, compression="snappy"))
+        assert m.num_rows == 3
+        leaf_types = {
+            tuple(c.meta_data.path_in_schema): Type(c.meta_data.type)
+            for c in m.row_groups[0].columns
+        }
+        assert leaf_types[("i64",)] == Type.INT64
+        assert leaf_types[("f64",)] == Type.DOUBLE
+        assert leaf_types[("s",)] == Type.BYTE_ARRAY
+        assert leaf_types[("b",)] == Type.BOOLEAN
+
+    def test_nested_schema_parses(self):
+        t = pa.table({"l": pa.array([[1, 2], None, [3]], pa.list_(pa.int32()))})
+        m = read_file_metadata(_pa_file(t))
+        names = [se.name for se in m.schema]
+        assert "l" in names
+        assert any(se.num_children for se in m.schema[1:])
+
+    def test_footer_reserialize_reparses(self):
+        t = pa.table({"x": pa.array(range(100), pa.int64())})
+        m = read_file_metadata(_pa_file(t))
+        m2 = FileMetaData.loads(m.dumps())
+        assert m2.num_rows == m.num_rows
+        assert [se.name for se in m2.schema] == [se.name for se in m.schema]
+        c = m.row_groups[0].columns[0].meta_data
+        c2 = m2.row_groups[0].columns[0].meta_data
+        assert c2.data_page_offset == c.data_page_offset
+        assert c2.encodings == c.encodings
+
+    def test_serialize_footer_shape(self):
+        m = FileMetaData(
+            version=1,
+            schema=[SchemaElement(name="root", num_children=0)],
+            num_rows=0,
+            row_groups=[],
+        )
+        raw = serialize_footer(m)
+        assert raw.endswith(b"PAR1")
+        f = io.BytesIO(b"PAR1" + raw)
+        m2 = read_file_metadata(f)
+        assert m2.num_rows == 0
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ParquetFileError):
+            read_file_metadata(io.BytesIO(b"NOPE" + b"\x00" * 16 + b"NOPE"))
+
+    def test_too_small_rejected(self):
+        with pytest.raises(ParquetFileError):
+            read_file_metadata(io.BytesIO(b"PAR1PAR1"))
+
+    def test_bad_footer_length_rejected(self):
+        bad = b"PAR1" + b"\x00" * 8 + b"\xff\xff\xff\x7f" + b"PAR1"
+        with pytest.raises(ParquetFileError):
+            read_file_metadata(io.BytesIO(bad))
+
+
+class TestEnums:
+    def test_encoding_values_match_spec(self):
+        assert Encoding.PLAIN == 0
+        assert Encoding.RLE == 3
+        assert Encoding.DELTA_BINARY_PACKED == 5
+        assert Encoding.RLE_DICTIONARY == 8
