@@ -1,0 +1,66 @@
+"""Columnar value containers.
+
+The reference moves decoded values as `[]interface{}` — one heap-boxed value
+per cell (reference: interfaces.go:29-52, SURVEY §7.1 'invert the execution
+model'). Here every column is a typed array end-to-end:
+
+  - numeric/boolean columns: NumPy arrays (bit-exact views of the wire bytes)
+  - BYTE_ARRAY columns: Arrow-style (offsets, flat byte buffer) — no per-string
+    materialization (SURVEY §7.3 hard-part #3)
+  - INT96: (n, 12) uint8 rows (legacy Impala timestamps)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["ByteArrayData"]
+
+
+@dataclass
+class ByteArrayData:
+    """Variable-length binary column: values[i] = data[offsets[i]:offsets[i+1]]."""
+
+    offsets: np.ndarray  # int64, length n+1, offsets[0] == 0
+    data: bytes
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, i: int) -> bytes:
+        return self.data[self.offsets[i] : self.offsets[i + 1]]
+
+    def to_list(self) -> list[bytes]:
+        o = self.offsets
+        d = self.data
+        return [d[o[i] : o[i + 1]] for i in range(len(o) - 1)]
+
+    @classmethod
+    def from_list(cls, items) -> "ByteArrayData":
+        lengths = np.fromiter((len(x) for x in items), dtype=np.int64, count=len(items))
+        offsets = np.zeros(len(items) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        return cls(offsets=offsets, data=b"".join(items))
+
+    def take(self, indices: np.ndarray) -> "ByteArrayData":
+        """Gather rows by index (dictionary expansion)."""
+        o = self.offsets
+        lengths = (o[1:] - o[:-1])[indices]
+        new_off = np.zeros(len(indices) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=new_off[1:])
+        src = np.frombuffer(self.data, dtype=np.uint8)
+        out = np.empty(int(new_off[-1]), dtype=np.uint8)
+        starts = o[:-1][indices]
+        for k in range(len(indices)):
+            ln = int(lengths[k])
+            out[new_off[k] : new_off[k] + ln] = src[starts[k] : starts[k] + ln]
+        return ByteArrayData(offsets=new_off, data=out.tobytes())
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, ByteArrayData):
+            return NotImplemented
+        return (
+            np.array_equal(self.offsets, other.offsets) and self.data == other.data
+        )

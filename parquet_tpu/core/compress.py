@@ -1,0 +1,176 @@
+"""Block compression registry.
+
+Pluggable codec registry mirroring the reference's BlockCompressor model
+(reference: compress.go:16-157): UNCOMPRESSED/GZIP/SNAPPY built in, others
+registered at import or by the user via register_codec (the reference's public
+RegisterBlockCompressor, compress.go:131-136). Decompressed output is validated
+against the expected size before use (reference: compress.go:102-123).
+
+SNAPPY resolution order: the native C++ codec (native/, loaded via ctypes) if
+built, else pyarrow's bundled snappy. ZSTD comes from the zstandard module when
+present; BROTLI/LZO/LZ4 raise a clear 'codec not registered' error unless the
+user registers an implementation.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+from ..meta.parquet_types import CompressionCodec
+
+__all__ = [
+    "compress_block",
+    "decompress_block",
+    "register_codec",
+    "codec_supported",
+    "CompressionError",
+]
+
+
+class CompressionError(ValueError):
+    pass
+
+
+class _Codec:
+    name = "?"
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        raise NotImplementedError
+
+
+class _Uncompressed(_Codec):
+    name = "UNCOMPRESSED"
+
+    def compress(self, data):
+        return bytes(data)
+
+    def decompress(self, data, uncompressed_size):
+        return bytes(data)
+
+
+class _Gzip(_Codec):
+    name = "GZIP"
+
+    def compress(self, data):
+        c = zlib.compressobj(wbits=31)  # gzip container
+        return c.compress(bytes(data)) + c.flush()
+
+    def decompress(self, data, uncompressed_size):
+        # wbits=47: auto-detect gzip or zlib headers.
+        return zlib.decompress(bytes(data), wbits=47)
+
+
+class _PyArrowSnappy(_Codec):
+    name = "SNAPPY"
+
+    def __init__(self):
+        import pyarrow as pa
+
+        self._codec = pa.Codec("snappy")
+
+    def compress(self, data):
+        return self._codec.compress(bytes(data)).to_pybytes()
+
+    def decompress(self, data, uncompressed_size):
+        return self._codec.decompress(
+            bytes(data), decompressed_size=uncompressed_size
+        ).to_pybytes()
+
+
+class _NativeSnappy(_Codec):
+    name = "SNAPPY"
+
+    def __init__(self):
+        from ..utils.native import get_native
+
+        self._lib = get_native()
+        if self._lib is None or not self._lib.has_snappy:
+            raise ImportError("native snappy not built")
+
+    def compress(self, data):
+        return self._lib.snappy_compress(bytes(data))
+
+    def decompress(self, data, uncompressed_size):
+        return self._lib.snappy_decompress(bytes(data), uncompressed_size)
+
+
+class _Zstd(_Codec):
+    name = "ZSTD"
+
+    def __init__(self):
+        import zstandard
+
+        self._c = zstandard.ZstdCompressor()
+        self._d = zstandard.ZstdDecompressor()
+
+    def compress(self, data):
+        return self._c.compress(bytes(data))
+
+    def decompress(self, data, uncompressed_size):
+        return self._d.decompress(bytes(data), max_output_size=max(uncompressed_size, 1))
+
+
+_REGISTRY: dict[int, _Codec] = {}
+
+
+def register_codec(codec: CompressionCodec, impl) -> None:
+    """Register/override a codec implementation (objects with .compress(bytes)
+    and .decompress(bytes, uncompressed_size))."""
+    _REGISTRY[int(codec)] = impl
+
+
+def codec_supported(codec: CompressionCodec) -> bool:
+    return int(codec) in _REGISTRY
+
+
+def _get(codec) -> _Codec:
+    impl = _REGISTRY.get(int(codec))
+    if impl is None:
+        try:
+            name = CompressionCodec(codec).name
+        except ValueError:
+            name = str(codec)
+        raise CompressionError(
+            f"compression codec {name} not registered "
+            "(use parquet_tpu.core.compress.register_codec)"
+        )
+    return impl
+
+
+def compress_block(data: bytes, codec) -> bytes:
+    return _get(codec).compress(data)
+
+
+def decompress_block(data: bytes, codec, uncompressed_size: int) -> bytes:
+    """Decompress and validate the advertised uncompressed size
+    (reference: compress.go:107-120)."""
+    if uncompressed_size < 0:
+        raise CompressionError(f"invalid uncompressed size {uncompressed_size}")
+    out = _get(codec).decompress(data, uncompressed_size)
+    if len(out) != uncompressed_size:
+        raise CompressionError(
+            f"decompressed size {len(out)} != advertised {uncompressed_size}"
+        )
+    return out
+
+
+def _init_registry() -> None:
+    _REGISTRY[int(CompressionCodec.UNCOMPRESSED)] = _Uncompressed()
+    _REGISTRY[int(CompressionCodec.GZIP)] = _Gzip()
+    try:
+        _REGISTRY[int(CompressionCodec.SNAPPY)] = _NativeSnappy()
+    except Exception:
+        try:
+            _REGISTRY[int(CompressionCodec.SNAPPY)] = _PyArrowSnappy()
+        except Exception:
+            pass
+    try:
+        _REGISTRY[int(CompressionCodec.ZSTD)] = _Zstd()
+    except Exception:
+        pass
+
+
+_init_registry()

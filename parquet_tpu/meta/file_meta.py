@@ -47,11 +47,11 @@ def read_file_metadata(f) -> FileMetaData:
         raise ParquetFileError("parquet: truncated footer")
     try:
         meta = FileMetaData.read(CompactReader(footer))
-    except ThriftError as e:
+    except (ThriftError, RecursionError) as e:
         # Internal decode errors are converted at the API boundary, the way the
         # reference recovers panics into errors (reference: file_reader.go:177-184).
         raise ParquetFileError(f"parquet: corrupt footer: {e}") from e
-    if meta.schema is None or not meta.schema:
+    if not meta.schema:
         raise ParquetFileError("parquet: footer has no schema")
     return meta
 

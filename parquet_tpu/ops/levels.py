@@ -1,0 +1,73 @@
+"""Repetition/definition level codec (host path).
+
+Levels are RLE-encoded (hybrid) at width bit_length(max_level). V1 data pages
+prefix the level stream with a 4-byte LE length (reference:
+hybrid_decoder.go:56-66); V2 pages store levels raw, sizes in the page header
+(reference: page_v2.go:79-131). max_level == 0 means the stream is absent and
+all levels are 0 (reference: helpers.go:210-231 constDecoder).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from .bitpack import bit_width
+from .rle_hybrid import decode_hybrid, encode_hybrid
+
+__all__ = [
+    "decode_levels_v1",
+    "decode_levels_v2",
+    "encode_levels_v1",
+    "encode_levels_v2",
+    "LevelError",
+]
+
+
+class LevelError(ValueError):
+    pass
+
+
+def decode_levels_v1(data, num_values: int, max_level: int) -> tuple[np.ndarray, int]:
+    """Returns (levels, total bytes consumed incl. the 4-byte size prefix)."""
+    if max_level == 0:
+        return np.zeros(num_values, dtype=np.uint16), 0
+    buf = memoryview(data) if not isinstance(data, memoryview) else data
+    if len(buf) < 4:
+        raise LevelError("levels: truncated v1 size prefix")
+    (size,) = struct.unpack_from("<I", buf, 0)
+    if 4 + size > len(buf):
+        raise LevelError(f"levels: v1 stream size {size} exceeds page")
+    levels = decode_hybrid(buf[4 : 4 + size], num_values, bit_width(max_level), dtype=np.uint16)
+    _check(levels, max_level)
+    return levels, 4 + size
+
+
+def decode_levels_v2(data, num_values: int, max_level: int) -> np.ndarray:
+    """V2: `data` is exactly the level stream (length from the page header)."""
+    if max_level == 0:
+        return np.zeros(num_values, dtype=np.uint16)
+    levels = decode_hybrid(data, num_values, bit_width(max_level), dtype=np.uint16)
+    _check(levels, max_level)
+    return levels
+
+
+def encode_levels_v1(levels, max_level: int) -> bytes:
+    if max_level == 0:
+        return b""
+    stream = encode_hybrid(np.asarray(levels), bit_width(max_level))
+    return struct.pack("<I", len(stream)) + stream
+
+
+def encode_levels_v2(levels, max_level: int) -> bytes:
+    if max_level == 0:
+        return b""
+    return encode_hybrid(np.asarray(levels), bit_width(max_level))
+
+
+def _check(levels: np.ndarray, max_level: int) -> None:
+    if levels.size and int(levels.max()) > max_level:
+        raise LevelError(
+            f"levels: value {int(levels.max())} exceeds max level {max_level}"
+        )

@@ -1,0 +1,247 @@
+"""RLE / bit-packing hybrid codec (host path) + run-table prescan for the TPU path.
+
+Wire format (parquet-format Encodings.md, same semantics as the reference's
+hybrid_decoder.go:81-165): a sequence of runs, each introduced by a ULEB128
+header. Low bit 0 → RLE run of (header >> 1) copies of one value stored in
+ceil(width/8) little-endian bytes. Low bit 1 → bit-packed run of (header >> 1)
+groups of 8 values at `width` bits, LSB-first.
+
+The reference decodes this one value per virtual call (hybrid_decoder.go:81-113,
+the hottest loop in the library, SURVEY §3.1). Here decode is two phases:
+
+  1. `prescan` — a cheap sequential byte-level walk of the run *headers* only,
+     producing a run table (kind, count, value, payload offset). This touches a
+     tiny fraction of the data and is the only inherently sequential part
+     (SURVEY §7.3 hard-part #1).
+  2. expansion — fully vectorized/parallel: RLE runs become broadcasts,
+     bit-packed runs become one batched unpack. On host this is NumPy; on TPU
+     the same run table drives the Pallas expansion kernel (kernels/rle_tpu.py).
+
+Encoding: unlike the reference, which only ever emits bit-packed runs
+(reference: hybrid_encoder.go:55-70, README.md:42), `encode_hybrid` emits RLE
+runs for 8-aligned stretches of repeated values — strictly smaller output for
+level streams and low-cardinality dictionaries, still spec-conformant.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .bitpack import pack_bits, unpack_bits
+
+__all__ = [
+    "RunTable",
+    "prescan_hybrid",
+    "decode_hybrid",
+    "expand_runs",
+    "encode_hybrid",
+]
+
+
+class HybridError(ValueError):
+    pass
+
+
+@dataclass
+class RunTable:
+    """Prescanned hybrid stream: one row per run.
+
+    is_rle[i]       True for RLE runs
+    counts[i]       number of values produced by run i (bit-packed: groups*8)
+    rle_values[i]   the repeated value (0 for bit-packed runs)
+    bp_offsets[i]   byte offset of run i's packed payload within `packed` (RLE: 0)
+    packed          all bit-packed payload bytes, concatenated
+    consumed        bytes of the input stream consumed (headers + payloads)
+    """
+
+    is_rle: np.ndarray
+    counts: np.ndarray
+    rle_values: np.ndarray
+    bp_offsets: np.ndarray
+    packed: bytes
+    consumed: int
+
+    @property
+    def total_values(self) -> int:
+        return int(self.counts.sum())
+
+
+def _read_uvarint(buf, pos: int, end: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        if pos >= end:
+            raise HybridError("hybrid: truncated run header")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise HybridError("hybrid: run header varint too long")
+
+
+def prescan_hybrid(data, num_values: int, width: int) -> RunTable:
+    """Walk run headers until `num_values` values are covered.
+
+    Validates every count and payload size before accepting it, per the
+    reference's validation-before-allocation discipline (reference:
+    hybrid_decoder.go:126-129, SURVEY §5 failure handling).
+    """
+    if width < 0 or width > 64:
+        raise HybridError(f"hybrid: invalid bit width {width}")
+    buf = memoryview(data) if not isinstance(data, memoryview) else data
+    end = len(buf)
+    vbytes = (width + 7) // 8
+    pos = 0
+    produced = 0
+    kinds: list[bool] = []
+    counts: list[int] = []
+    values: list[int] = []
+    offsets: list[int] = []
+    packed_parts: list[bytes] = []
+    packed_len = 0
+    while produced < num_values:
+        header, pos = _read_uvarint(buf, pos, end)
+        if header & 1:
+            groups = header >> 1
+            count = groups * 8
+            nbytes = groups * width
+            if count == 0:
+                raise HybridError("hybrid: empty bit-packed run")
+            if pos + nbytes > end:
+                raise HybridError("hybrid: bit-packed payload exceeds buffer")
+            kinds.append(False)
+            counts.append(count)
+            values.append(0)
+            offsets.append(packed_len)
+            packed_parts.append(bytes(buf[pos : pos + nbytes]))
+            packed_len += nbytes
+            pos += nbytes
+        else:
+            count = header >> 1
+            if count == 0:
+                raise HybridError("hybrid: empty RLE run")
+            if pos + vbytes > end:
+                raise HybridError("hybrid: RLE value exceeds buffer")
+            v = int.from_bytes(buf[pos : pos + vbytes], "little")
+            if width < 64 and v >= (1 << width):
+                raise HybridError(
+                    f"hybrid: RLE value {v} does not fit bit width {width}"
+                )
+            pos += vbytes
+            kinds.append(True)
+            counts.append(count)
+            values.append(v)
+            offsets.append(0)
+        produced += count
+    return RunTable(
+        is_rle=np.array(kinds, dtype=bool),
+        counts=np.array(counts, dtype=np.int64),
+        rle_values=np.array(values, dtype=np.uint64),
+        bp_offsets=np.array(offsets, dtype=np.int64),
+        packed=b"".join(packed_parts),
+        consumed=pos,
+    )
+
+
+def expand_runs(table: RunTable, num_values: int, width: int, dtype=np.uint32) -> np.ndarray:
+    """Vectorized expansion of a prescanned run table into a value array."""
+    out = np.empty(num_values, dtype=dtype)
+    pos = 0
+    n_runs = len(table.counts)
+    for i in range(n_runs):
+        count = int(table.counts[i])
+        take = min(count, num_values - pos)
+        if take <= 0:
+            break
+        if table.is_rle[i]:
+            out[pos : pos + take] = dtype(table.rle_values[i])
+        else:
+            off = int(table.bp_offsets[i])
+            vals = unpack_bits(
+                table.packed[off : off + (count // 8) * width], take, width, dtype=dtype
+            )
+            out[pos : pos + take] = vals
+        pos += take
+    if pos < num_values:
+        raise HybridError(
+            f"hybrid: stream produced {pos} values, expected {num_values}"
+        )
+    return out
+
+
+def decode_hybrid(data, num_values: int, width: int, dtype=np.uint32) -> np.ndarray:
+    """One-shot host decode: prescan + expand."""
+    if num_values == 0:
+        return np.empty(0, dtype=dtype)
+    table = prescan_hybrid(data, num_values, width)
+    return expand_runs(table, num_values, width, dtype=dtype)
+
+
+def encode_hybrid(values, width: int) -> bytes:
+    """Encode values as a hybrid stream.
+
+    8-aligned stretches of ≥8 identical values become RLE runs; everything else
+    is bit-packed in groups of 8 (the trailing partial group is zero-padded,
+    which the decoder discards — padding only ever appears at stream end).
+    """
+    v = np.asarray(values)
+    n = len(v)
+    if n == 0:
+        return b""
+    if width == 0:
+        # Single RLE run covering everything; value occupies 0 bytes.
+        out = bytearray()
+        _emit_uvarint(out, n << 1)
+        return bytes(out)
+    v64 = v.astype(np.uint64, copy=False)
+    run_starts = np.nonzero(np.concatenate(([True], v64[1:] != v64[:-1])))[0]
+    run_lengths = np.diff(np.append(run_starts, n))
+    out = bytearray()
+    vbytes = (width + 7) // 8
+    pos = 0
+    for start, length in zip(run_starts, run_lengths):
+        if length < 8:
+            continue
+        # 8-align the RLE window so surrounding bit-packed segments stay
+        # multiples of 8 values (mid-stream padding would shift the stream).
+        rle_start = (int(start) + 7) & ~7
+        rle_end = (int(start) + int(length)) & ~7
+        if rle_end - rle_start < 8:
+            continue
+        if rle_start > pos:
+            _emit_bitpacked(out, v64[pos:rle_start], width)
+        _emit_uvarint(out, (rle_end - rle_start) << 1)
+        out += int(v64[start]).to_bytes(vbytes, "little")
+        pos = rle_end
+    if pos < n:
+        _emit_bitpacked(out, v64[pos:n], width, pad=True)
+    return bytes(out)
+
+
+def _emit_uvarint(out: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _emit_bitpacked(out: bytearray, vals: np.ndarray, width: int, pad: bool = False) -> None:
+    n = len(vals)
+    if n == 0:
+        return
+    if n % 8:
+        if not pad:
+            raise HybridError("hybrid: internal — unaligned bit-packed segment")
+        vals = np.concatenate([vals, np.zeros(8 - n % 8, dtype=vals.dtype)])
+    groups = len(vals) // 8
+    _emit_uvarint(out, (groups << 1) | 1)
+    out += pack_bits(vals, width)

@@ -1,0 +1,85 @@
+"""ctypes loader for the optional native C++ helper library (native/).
+
+The native library accelerates the host-side scalar hot spots that neither
+NumPy nor the TPU can absorb: snappy (de)compression, PLAIN byte_array offset
+scans, and hybrid/delta run-header prescans. Everything degrades gracefully to
+the pure-Python implementations when the library is not built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+_SO_NAMES = ("libparquet_tpu_native.so",)
+_cached = None
+_probed = False
+
+
+class NativeLib:
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self.has_snappy = hasattr(lib, "ptq_snappy_compress")
+        if self.has_snappy:
+            lib.ptq_snappy_max_compressed_length.restype = ctypes.c_size_t
+            lib.ptq_snappy_max_compressed_length.argtypes = [ctypes.c_size_t]
+            lib.ptq_snappy_compress.restype = ctypes.c_ssize_t
+            lib.ptq_snappy_compress.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+            lib.ptq_snappy_decompress.restype = ctypes.c_ssize_t
+            lib.ptq_snappy_decompress.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+        self.has_byte_array_scan = hasattr(lib, "ptq_scan_byte_array_offsets")
+        if self.has_byte_array_scan:
+            lib.ptq_scan_byte_array_offsets.restype = ctypes.c_ssize_t
+            lib.ptq_scan_byte_array_offsets.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+
+    def snappy_compress(self, data: bytes) -> bytes:
+        cap = self._lib.ptq_snappy_max_compressed_length(len(data))
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.ptq_snappy_compress(data, len(data), out, cap)
+        if n < 0:
+            raise ValueError("native snappy: compression failed")
+        return out.raw[:n]
+
+    def snappy_decompress(self, data: bytes, uncompressed_size: int) -> bytes:
+        out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+        n = self._lib.ptq_snappy_decompress(data, len(data), out, uncompressed_size)
+        if n < 0:
+            raise ValueError("native snappy: corrupt input")
+        return out.raw[:n]
+
+
+def get_native() -> NativeLib | None:
+    """Load the native helper library, or None if not built/loadable."""
+    global _cached, _probed
+    if _probed:
+        return _cached
+    _probed = True
+    root = Path(__file__).resolve().parent.parent.parent
+    candidates = [root / "native" / "build" / name for name in _SO_NAMES]
+    env = os.environ.get("PARQUET_TPU_NATIVE")
+    if env:
+        candidates.insert(0, Path(env))
+    for cand in candidates:
+        if cand.exists():
+            try:
+                _cached = NativeLib(ctypes.CDLL(str(cand)))
+                break
+            except OSError:
+                continue
+    return _cached
