@@ -1,0 +1,45 @@
+"""Decoded-memory ceiling against adversarial files.
+
+Analogue of the reference's allocTracker (reference: alloc.go:10-89,
+WithMaximumMemorySize file_reader.go:144-149): advertised uncompressed sizes
+are *checked* before decompression and *registered* after, raising a clean
+error past the ceiling instead of OOMing on decompression bombs. Python's GC
+replaces the reference's finalizer-based deregistration: a row group's budget
+is released when the reader moves on (release()).
+"""
+
+from __future__ import annotations
+
+__all__ = ["AllocTracker", "AllocError"]
+
+
+class AllocError(MemoryError):
+    pass
+
+
+class AllocTracker:
+    def __init__(self, max_bytes: int):
+        if max_bytes <= 0:
+            raise ValueError("alloc: ceiling must be positive")
+        self.max_bytes = max_bytes
+        self.used = 0
+
+    def check(self, size: int) -> None:
+        """Pre-check an advertised allocation (reference: alloc.go test())."""
+        if size < 0:
+            raise AllocError("alloc: negative advertised size")
+        if self.used + size > self.max_bytes:
+            raise AllocError(
+                f"alloc: would exceed memory ceiling "
+                f"({self.used} + {size} > {self.max_bytes})"
+            )
+
+    def register(self, size: int) -> None:
+        self.check(size)
+        self.used += size
+
+    def release(self, size: int | None = None) -> None:
+        if size is None:
+            self.used = 0
+        else:
+            self.used = max(0, self.used - size)

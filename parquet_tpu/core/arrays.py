@@ -45,18 +45,28 @@ class ByteArrayData:
         return cls(offsets=offsets, data=b"".join(items))
 
     def take(self, indices: np.ndarray) -> "ByteArrayData":
-        """Gather rows by index (dictionary expansion)."""
+        """Gather rows by index (dictionary expansion), fully vectorized.
+
+        Builds one fancy-index over the source buffer: for output row k the
+        source positions are starts[k] + [0, len_k); expressed as
+        arange(total) - repeat(out_starts) + repeat(src_starts).
+        """
+        indices = np.asarray(indices, dtype=np.int64)
         o = self.offsets
         lengths = (o[1:] - o[:-1])[indices]
         new_off = np.zeros(len(indices) + 1, dtype=np.int64)
         np.cumsum(lengths, out=new_off[1:])
+        total = int(new_off[-1])
+        if total == 0:
+            return ByteArrayData(offsets=new_off, data=b"")
         src = np.frombuffer(self.data, dtype=np.uint8)
-        out = np.empty(int(new_off[-1]), dtype=np.uint8)
         starts = o[:-1][indices]
-        for k in range(len(indices)):
-            ln = int(lengths[k])
-            out[new_off[k] : new_off[k] + ln] = src[starts[k] : starts[k] + ln]
-        return ByteArrayData(offsets=new_off, data=out.tobytes())
+        gather = (
+            np.arange(total, dtype=np.int64)
+            - np.repeat(new_off[:-1], lengths)
+            + np.repeat(starts, lengths)
+        )
+        return ByteArrayData(offsets=new_off, data=src[gather].tobytes())
 
     def __eq__(self, other) -> bool:
         if not isinstance(other, ByteArrayData):

@@ -1,0 +1,264 @@
+"""Column-chunk read/write: the page walk.
+
+Read side mirrors the reference's chunk_reader.go: seek to the dictionary (or
+first data) page offset, walk Thrift page headers until TotalCompressedSize is
+consumed (:187-190), at most one dictionary page (:196-228), CRC validation
+opt-in (:161-180), every size validated before allocation. Decoded pages are
+concatenated into one ChunkData of typed arrays.
+
+Write side mirrors chunk_writer.go: build a dictionary over the whole chunk
+with the <= 32767-unique cutoff (:174-209), then emit [dict page] + data pages,
+and assemble ColumnMetaData with encodings, stats and offsets (:264-314).
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..meta.parquet_types import (
+    ColumnChunk,
+    ColumnMetaData,
+    Encoding,
+    PageHeader,
+    PageType,
+)
+from ..meta.thrift import CompactReader, ThriftError
+from .arrays import ByteArrayData
+from .compress import decompress_block
+from .page import (
+    DecodedPage,
+    PageError,
+    decode_data_page_v1,
+    decode_data_page_v2,
+    decode_dict_page,
+)
+from .schema import Column
+
+__all__ = ["ChunkData", "ChunkError", "read_chunk", "RawPage", "iter_chunk_pages"]
+
+# Page headers are small; cap how much we peek per header read.
+_HEADER_PEEK = 1 << 16
+
+
+class ChunkError(ValueError):
+    pass
+
+
+@dataclass
+class ChunkData:
+    """All values of one column chunk, concatenated across pages."""
+
+    column: Column
+    num_values: int  # level entries incl. nulls
+    values: object  # ndarray | ByteArrayData (non-null cells only)
+    def_levels: np.ndarray | None
+    rep_levels: np.ndarray | None
+    dictionary: object | None = None  # decoded dict page values, if any
+
+
+@dataclass
+class RawPage:
+    """A page as stored: parsed header + undecoded (still-compressed) payload.
+
+    This is the unit the TPU pipeline batches: headers/offsets on host, payload
+    decode on device.
+    """
+
+    header: PageHeader
+    payload: bytes
+    offset: int  # absolute file offset of the page header
+
+
+def _read_page_header(f) -> PageHeader:
+    """Decode one page header from the stream, consuming exactly its bytes.
+
+    Thrift needs lookahead but over-reading would swallow page data (the
+    reference solves this with an unbuffered reader, helpers.go:104-106); here
+    we peek a bounded window, decode, and seek back to the consumed position.
+    """
+    start = f.tell()
+    window = f.read(_HEADER_PEEK)
+    if not window:
+        raise ChunkError("chunk: eof reading page header")
+    r = CompactReader(window)
+    try:
+        header = PageHeader.read(r)
+    except ThriftError as e:
+        raise ChunkError(f"chunk: corrupt page header: {e}") from e
+    f.seek(start + r.pos)
+    return header
+
+
+def iter_chunk_pages(f, chunk: ColumnChunk):
+    """Yield RawPage for every page of a chunk (dictionary page first if any)."""
+    md: ColumnMetaData = chunk.meta_data
+    if md is None:
+        raise ChunkError("chunk: missing metadata")
+    if chunk.file_path:
+        raise ChunkError("chunk: external column chunks not supported")
+    total = md.total_compressed_size
+    if total is None or total < 0:
+        raise ChunkError("chunk: invalid total_compressed_size")
+    offset = md.data_page_offset
+    if md.dictionary_page_offset is not None and md.dictionary_page_offset > 0:
+        # Chunk starts at the dictionary page when present (reference:
+        # chunk_reader.go:317-323). Some writers (pyarrow, empty row groups)
+        # leave data_page_offset at 0, which would point at the file magic.
+        if offset is None or offset <= 0 or md.dictionary_page_offset < offset:
+            offset = md.dictionary_page_offset
+    if offset is None or offset <= 0:
+        raise ChunkError(f"chunk: invalid page offset {offset}")
+    f.seek(offset)
+    consumed = 0
+    while consumed < total:
+        page_start = f.tell()
+        header = _read_page_header(f)
+        size = header.compressed_page_size
+        if size is None or size < 0:
+            raise ChunkError(f"chunk: invalid compressed page size {size}")
+        payload = f.read(size)
+        if len(payload) != size:
+            raise ChunkError("chunk: truncated page payload")
+        yield RawPage(header=header, payload=payload, offset=page_start)
+        consumed += (f.tell() - page_start)
+
+
+def _check_crc(header: PageHeader, payload: bytes) -> None:
+    if header.crc is None:
+        return
+    actual = zlib.crc32(payload) & 0xFFFFFFFF
+    expected = header.crc & 0xFFFFFFFF
+    if actual != expected:
+        raise ChunkError(
+            f"chunk: page CRC mismatch (stored {expected:#x}, computed {actual:#x})"
+        )
+
+
+def read_chunk(
+    f,
+    chunk: ColumnChunk,
+    column: Column,
+    validate_crc: bool = False,
+    alloc=None,
+) -> ChunkData:
+    """Read and decode all pages of one column chunk (host path)."""
+    md = chunk.meta_data
+    codec = md.codec or 0
+    dictionary = None
+    pages: list[DecodedPage] = []
+    seen_data_values = 0
+    expected = md.num_values or 0
+    for raw in iter_chunk_pages(f, chunk):
+        header = raw.header
+        if alloc is not None:
+            alloc.check(header.uncompressed_page_size or 0)
+        ptype = header.type
+        if ptype == int(PageType.DICTIONARY_PAGE):
+            if dictionary is not None:
+                raise ChunkError("chunk: more than one dictionary page")
+            if pages:
+                raise ChunkError("chunk: dictionary page after data pages")
+            if validate_crc:
+                _check_crc(header, raw.payload)
+            block = decompress_block(
+                raw.payload, codec, header.uncompressed_page_size or 0
+            )
+            dictionary = decode_dict_page(header, block, column)
+        elif ptype == int(PageType.DATA_PAGE):
+            if validate_crc:
+                _check_crc(header, raw.payload)
+            block = decompress_block(
+                raw.payload, codec, header.uncompressed_page_size or 0
+            )
+            dict_size = len(dictionary) if dictionary is not None else None
+            page = decode_data_page_v1(header, block, column, dict_size)
+            page.materialize(dictionary)
+            pages.append(page)
+            seen_data_values += page.num_values
+        elif ptype == int(PageType.DATA_PAGE_V2):
+            if validate_crc:
+                _check_crc(header, raw.payload)
+            dict_size = len(dictionary) if dictionary is not None else None
+            page = decode_data_page_v2(header, raw.payload, column, dict_size, codec)
+            page.materialize(dictionary)
+            pages.append(page)
+            seen_data_values += page.num_values
+        elif ptype == int(PageType.INDEX_PAGE):
+            continue  # skip, like the reference
+        else:
+            raise ChunkError(f"chunk: unknown page type {ptype}")
+        if alloc is not None:
+            alloc.register(header.uncompressed_page_size or 0)
+    if seen_data_values != expected:
+        raise ChunkError(
+            f"chunk: pages hold {seen_data_values} values, metadata says {expected}"
+        )
+    return _concat_pages(column, pages, dictionary)
+
+
+def _concat_pages(
+    column: Column, pages: list[DecodedPage], dictionary
+) -> ChunkData:
+    num_values = sum(p.num_values for p in pages)
+    def_levels = None
+    rep_levels = None
+    if column.max_def > 0:
+        def_levels = _concat([p.def_levels for p in pages], np.uint16)
+    if column.max_rep > 0:
+        rep_levels = _concat([p.rep_levels for p in pages], np.uint16)
+    from ..meta.parquet_types import Type
+
+    value_parts = [p.values for p in pages]
+    if any(isinstance(v, ByteArrayData) for v in value_parts):
+        values = _concat_byte_arrays([v for v in value_parts if v is not None])
+    else:
+        arrs = [np.asarray(v) for v in value_parts if v is not None and len(v)]
+        if arrs:
+            values = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+        elif column.type == Type.BYTE_ARRAY:
+            values = ByteArrayData(offsets=np.zeros(1, dtype=np.int64), data=b"")
+        else:
+            values = np.empty(0, dtype=_empty_dtype(column))
+    return ChunkData(
+        column=column,
+        num_values=num_values,
+        values=values,
+        def_levels=def_levels,
+        rep_levels=rep_levels,
+        dictionary=dictionary,
+    )
+
+
+def _concat(parts, dtype):
+    arrs = [p for p in parts if p is not None]
+    if not arrs:
+        return np.empty(0, dtype=dtype)
+    return np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+
+
+def _concat_byte_arrays(parts: list) -> ByteArrayData:
+    if len(parts) == 1:
+        return parts[0]
+    datas = []
+    offsets = [np.zeros(1, dtype=np.int64)]
+    base = 0
+    for p in parts:
+        datas.append(p.data)
+        offsets.append(p.offsets[1:] + base)
+        base += len(p.data)
+    return ByteArrayData(offsets=np.concatenate(offsets), data=b"".join(datas))
+
+
+def _empty_dtype(column: Column):
+    from ..meta.parquet_types import Type
+
+    return {
+        Type.BOOLEAN: np.bool_,
+        Type.INT32: np.int32,
+        Type.INT64: np.int64,
+        Type.FLOAT: np.float32,
+        Type.DOUBLE: np.float64,
+    }.get(column.type, np.uint8)

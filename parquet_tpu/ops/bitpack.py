@@ -66,6 +66,10 @@ def pack_bits(values: np.ndarray, width: int) -> bytes:
     if width > 64:
         raise ValueError(f"bitpack: unsupported width {width}")
     v = np.asarray(values).astype(np.uint64, copy=False)
+    if width < 64 and v.size and int(v.max()) >= (1 << width):
+        raise ValueError(
+            f"bitpack: value {int(v.max())} does not fit in {width} bits"
+        )
     shifts = np.arange(width, dtype=np.uint64)
     bits = ((v[:, None] >> shifts[None, :]) & np.uint64(1)).astype(np.uint8)
     return np.packbits(bits.reshape(-1), bitorder="little").tobytes()

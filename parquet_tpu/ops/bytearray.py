@@ -33,7 +33,7 @@ class ByteArrayError(ValueError):
 
 def decode_delta_length_byte_array(data, num_values: int) -> tuple[ByteArrayData, int]:
     buf = memoryview(data) if not isinstance(data, memoryview) else data
-    lengths, consumed = decode_delta(buf, 32)
+    lengths, consumed = decode_delta(buf, 32, max_total=num_values)
     if len(lengths) < num_values:
         raise ByteArrayError(
             f"delta-length: stream has {len(lengths)} lengths, need {num_values}"
@@ -57,7 +57,7 @@ def encode_delta_length_byte_array(values: ByteArrayData) -> bytes:
 
 def decode_delta_byte_array(data, num_values: int) -> tuple[ByteArrayData, int]:
     buf = memoryview(data) if not isinstance(data, memoryview) else data
-    prefixes, consumed = decode_delta(buf, 32)
+    prefixes, consumed = decode_delta(buf, 32, max_total=num_values)
     if len(prefixes) < num_values:
         raise ByteArrayError("delta-byte-array: prefix stream too short")
     prefixes = prefixes[:num_values].astype(np.int64)

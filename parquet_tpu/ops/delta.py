@@ -12,9 +12,10 @@ Semantics reproduced from the reference (SURVEY §7.3 hard-part #2):
   - a miniblock that holds >=1 value always carries its full payload,
     (miniblock_len/8)*width bytes, zero-padded (reference: deltabp_decoder.go
     buf construction in flush());
-  - unused trailing miniblocks get width byte 0 and no payload, but readers
-    tolerate arbitrary widths there by skipping the advertised payload
-    (reference: deltabp_decoder.go:145-164).
+  - unused trailing miniblocks carry a width byte but NO payload; writers
+    should set those widths to 0 but readers must accept arbitrary values
+    (parquet-format Encodings.md; the reference writes 0-width there,
+    deltabp_encoder.go flush()).
 
 The reference decodes one value per call through a virtual unpacker table
 (deltabp_decoder.go:113-174); here the whole stream becomes one concatenated
@@ -29,6 +30,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .bitpack import pack_bits, unpack_bits
+from .varint import emit_uvarint as _emit_uvarint_impl, emit_zigzag as _emit_zigzag_impl, read_uvarint, read_zigzag
 
 __all__ = [
     "DeltaError",
@@ -47,25 +49,6 @@ class DeltaError(ValueError):
     pass
 
 
-def _read_uvarint(buf, pos: int, end: int) -> tuple[int, int]:
-    result = 0
-    shift = 0
-    while True:
-        if pos >= end:
-            raise DeltaError("delta: truncated varint")
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not (b & 0x80):
-            return result, pos
-        shift += 7
-        if shift > 63:
-            raise DeltaError("delta: varint too long")
-
-
-def _read_zigzag(buf, pos: int, end: int) -> tuple[int, int]:
-    n, pos = _read_uvarint(buf, pos, end)
-    return (n >> 1) ^ -(n & 1), pos
 
 
 @dataclass
@@ -85,11 +68,13 @@ class DeltaTable:
     consumed: int
 
 
-def prescan_delta(data, nbits: int) -> DeltaTable:
+def prescan_delta(data, nbits: int, max_total: int | None = None) -> DeltaTable:
     """Parse headers + unpack miniblocks into a flat modular-delta vector.
 
     The header walk is sequential but touches only varints and width bytes; the
-    miniblock unpacking is vectorized per miniblock.
+    miniblock unpacking is vectorized per miniblock. `max_total` bounds the
+    header's value count before any allocation (validation-before-allocation,
+    reference: SURVEY §5) — callers pass the page/chunk value count.
     """
     if nbits not in (32, 64):
         raise DeltaError(f"delta: unsupported type width {nbits}")
@@ -97,39 +82,49 @@ def prescan_delta(data, nbits: int) -> DeltaTable:
     buf = memoryview(data) if not isinstance(data, memoryview) else data
     end = len(buf)
     pos = 0
-    block_size, pos = _read_uvarint(buf, pos, end)
-    mini_count, pos = _read_uvarint(buf, pos, end)
-    total, pos = _read_uvarint(buf, pos, end)
-    first, pos = _read_zigzag(buf, pos, end)
-    if block_size <= 0 or block_size % 128 != 0:
+    block_size, pos = read_uvarint(buf, pos, end, DeltaError)
+    mini_count, pos = read_uvarint(buf, pos, end, DeltaError)
+    total, pos = read_uvarint(buf, pos, end, DeltaError)
+    first, pos = read_zigzag(buf, pos, end, DeltaError)
+    if block_size <= 0 or block_size % 128 != 0 or block_size > (1 << 20):
         raise DeltaError(f"delta: invalid block size {block_size}")
-    if mini_count <= 0 or block_size % mini_count != 0:
+    if mini_count <= 0 or mini_count > 512 or block_size % mini_count != 0:
         raise DeltaError(f"delta: invalid miniblock count {mini_count}")
     mini_len = block_size // mini_count
     if mini_len % 8 != 0:
         raise DeltaError(f"delta: miniblock length {mini_len} not a multiple of 8")
-    if total > (1 << 40):
-        raise DeltaError(f"delta: implausible value count {total}")
+    if max_total is not None and total > max(max_total, 0):
+        raise DeltaError(
+            f"delta: stream claims {total} values, caller expects at most {max_total}"
+        )
+    # Absolute backstop: a tiny stream must not drive a huge allocation. Every
+    # block needs at least 1 min-delta byte + mini_count width bytes, and
+    # covers block_size values, so `end` bytes cannot encode more than:
+    plausible = 1 + (end // (1 + mini_count) + 1) * block_size
+    if total > plausible:
+        raise DeltaError(
+            f"delta: implausible value count {total} for {end}-byte stream"
+        )
 
     n_deltas = max(total - 1, 0)
     parts: list[np.ndarray] = []
     produced = 0
     while produced < n_deltas:
-        min_delta, pos = _read_zigzag(buf, pos, end)
+        min_delta, pos = read_zigzag(buf, pos, end, DeltaError)
         if pos + mini_count > end:
             raise DeltaError("delta: truncated miniblock widths")
         widths = bytes(buf[pos : pos + mini_count])
         pos += mini_count
         md = np.uint64(min_delta & mask)
         for w in widths:
+            remaining = n_deltas - produced
+            if remaining <= 0:
+                # Unused trailing miniblock: no payload on the wire; the width
+                # byte may hold any value (Encodings.md).
+                continue
             if w > nbits:
                 raise DeltaError(f"delta: miniblock width {w} exceeds type width")
             payload = (mini_len // 8) * w
-            remaining = n_deltas - produced
-            if remaining <= 0:
-                # Unused trailing miniblock: skip its advertised payload.
-                pos += payload
-                continue
             if pos + payload > end:
                 raise DeltaError("delta: miniblock payload exceeds buffer")
             take = min(mini_len, remaining)
@@ -155,13 +150,14 @@ def prescan_delta(data, nbits: int) -> DeltaTable:
     )
 
 
-def decode_delta(data, nbits: int) -> tuple[np.ndarray, int]:
+def decode_delta(data, nbits: int, max_total: int | None = None) -> tuple[np.ndarray, int]:
     """Decode a full DELTA_BINARY_PACKED stream.
 
     Returns (values as int32/int64 ndarray, bytes consumed). The count comes
-    from the stream header; callers cross-check against the page header.
+    from the stream header; `max_total` (the page/chunk value count) bounds it
+    before allocation.
     """
-    t = prescan_delta(data, nbits)
+    t = prescan_delta(data, nbits, max_total)
     if nbits == 32:
         seq = np.empty(t.total, dtype=np.uint32)
         if t.total:
@@ -232,22 +228,15 @@ def encode_delta(
     return bytes(out)
 
 
+_emit_uvarint = _emit_uvarint_impl
+_emit_zigzag = _emit_zigzag_impl
+
+
 def _to_signed(v: int, nbits: int) -> int:
     if v >= 1 << (nbits - 1):
         v -= 1 << nbits
     return v
 
 
-def _emit_uvarint(out: bytearray, v: int) -> None:
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return
 
 
-def _emit_zigzag(out: bytearray, v: int) -> None:
-    _emit_uvarint(out, (v << 1) ^ (v >> 63))

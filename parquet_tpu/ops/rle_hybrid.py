@@ -30,6 +30,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .bitpack import pack_bits, unpack_bits
+from .varint import emit_uvarint as _emit_uvarint, read_uvarint
 
 __all__ = [
     "RunTable",
@@ -68,22 +69,6 @@ class RunTable:
         return int(self.counts.sum())
 
 
-def _read_uvarint(buf, pos: int, end: int) -> tuple[int, int]:
-    result = 0
-    shift = 0
-    while True:
-        if pos >= end:
-            raise HybridError("hybrid: truncated run header")
-        b = buf[pos]
-        pos += 1
-        result |= (b & 0x7F) << shift
-        if not (b & 0x80):
-            return result, pos
-        shift += 7
-        if shift > 63:
-            raise HybridError("hybrid: run header varint too long")
-
-
 def prescan_hybrid(data, num_values: int, width: int) -> RunTable:
     """Walk run headers until `num_values` values are covered.
 
@@ -105,7 +90,7 @@ def prescan_hybrid(data, num_values: int, width: int) -> RunTable:
     packed_parts: list[bytes] = []
     packed_len = 0
     while produced < num_values:
-        header, pos = _read_uvarint(buf, pos, end)
+        header, pos = read_uvarint(buf, pos, end, HybridError)
         if header & 1:
             groups = header >> 1
             count = groups * 8
@@ -221,17 +206,6 @@ def encode_hybrid(values, width: int) -> bytes:
     if pos < n:
         _emit_bitpacked(out, v64[pos:n], width, pad=True)
     return bytes(out)
-
-
-def _emit_uvarint(out: bytearray, v: int) -> None:
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return
 
 
 def _emit_bitpacked(out: bytearray, vals: np.ndarray, width: int, pad: bool = False) -> None:
