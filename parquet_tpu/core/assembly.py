@@ -17,8 +17,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
-from .arrays import ByteArrayData
+from ..meta.parquet_types import ConvertedType, FieldRepetitionType
 from .chunk import ChunkData
 from .schema import Column, Schema
 
@@ -55,13 +54,10 @@ class _LeafCursor:
         self.pos += 1
 
     def pop_value(self):
-        v = self.chunk.values
         i = self.vpos
         self.vpos += 1
         self.pos += 1
-        if isinstance(v, ByteArrayData):
-            return v[i]
-        return v[i]
+        return self.chunk.values[i]
 
 
 class RecordAssembler:
@@ -73,25 +69,37 @@ class RecordAssembler:
         self.cursors: dict[tuple, _LeafCursor] = {
             path: _LeafCursor(c) for path, c in chunks.items()
         }
+        # Static per-node caches (hot path: consulted per field per row).
+        self._covered_cache: dict[tuple, bool] = {}
+        self._first_leaf_cache: dict[tuple, _LeafCursor] = {}
+        self._build_caches(schema.root)
         # Only assemble the subtree covered by the provided chunks (projection).
         self.selected_roots = [
-            child
-            for child in schema.root.children
-            if self._covered(child)
+            child for child in schema.root.children if self._covered(child)
         ]
 
-    def _covered(self, node: Column) -> bool:
+    def _build_caches(self, node: Column) -> None:
         if node.is_leaf:
-            return node.path in self.cursors
-        return any(self._covered(c) for c in node.children)
+            covered = node.path in self.cursors
+            if covered:
+                self._first_leaf_cache[node.path] = self.cursors[node.path]
+        else:
+            covered = False
+            for c in node.children:
+                self._build_caches(c)
+                if self._covered_cache[c.path] and not covered:
+                    covered = True
+                    self._first_leaf_cache[node.path] = self._first_leaf_cache[c.path]
+        self._covered_cache[node.path] = covered
+
+    def _covered(self, node: Column) -> bool:
+        return self._covered_cache[node.path]
 
     def _first_leaf(self, node: Column) -> _LeafCursor:
-        if node.is_leaf:
-            return self.cursors[node.path]
-        for c in node.children:
-            if self._covered(c):
-                return self._first_leaf(c)
-        raise AssemblyError(f"assembly: no selected leaf under {node.path_str}")
+        cur = self._first_leaf_cache.get(node.path)
+        if cur is None:
+            raise AssemblyError(f"assembly: no selected leaf under {node.path_str}")
+        return cur
 
     def _advance_subtree_null(self, node: Column) -> None:
         if node.is_leaf:

@@ -30,7 +30,6 @@ from .arrays import ByteArrayData
 from .compress import decompress_block
 from .page import (
     DecodedPage,
-    PageError,
     decode_data_page_v1,
     decode_data_page_v2,
     decode_dict_page,
@@ -39,8 +38,10 @@ from .schema import Column
 
 __all__ = ["ChunkData", "ChunkError", "read_chunk", "RawPage", "iter_chunk_pages"]
 
-# Page headers are small; cap how much we peek per header read.
+# Page headers are small; peek a bounded window per header read, growing up to
+# the max for headers with embedded wide statistics.
 _HEADER_PEEK = 1 << 16
+_HEADER_PEEK_MAX = 1 << 24
 
 
 class ChunkError(ValueError):
@@ -80,16 +81,24 @@ def _read_page_header(f) -> PageHeader:
     we peek a bounded window, decode, and seek back to the consumed position.
     """
     start = f.tell()
-    window = f.read(_HEADER_PEEK)
-    if not window:
-        raise ChunkError("chunk: eof reading page header")
-    r = CompactReader(window)
-    try:
-        header = PageHeader.read(r)
-    except ThriftError as e:
-        raise ChunkError(f"chunk: corrupt page header: {e}") from e
-    f.seek(start + r.pos)
-    return header
+    peek = _HEADER_PEEK
+    while True:
+        f.seek(start)
+        window = f.read(peek)
+        if not window:
+            raise ChunkError("chunk: eof reading page header")
+        r = CompactReader(window)
+        try:
+            header = PageHeader.read(r)
+        except ThriftError as e:
+            # A truncated window is indistinguishable from corruption; if the
+            # window wasn't exhausted (or can't grow), it really is corrupt.
+            if len(window) == peek and peek < _HEADER_PEEK_MAX:
+                peek *= 8
+                continue
+            raise ChunkError(f"chunk: corrupt page header: {e}") from e
+        f.seek(start + r.pos)
+        return header
 
 
 def iter_chunk_pages(f, chunk: ColumnChunk):

@@ -209,7 +209,12 @@ def decode_dict_page(header: PageHeader, block: bytes, column: Column):
     if enc not in (int(Encoding.PLAIN), int(Encoding.PLAIN_DICTIONARY)):
         raise PageError(f"page: dictionary page encoding {enc} unsupported")
     values, consumed = plain_ops.decode_plain(block, n, column.type, column.type_length)
-    # Strict full decode (reference: page_dict.go:35-72)
+    if consumed != len(block):
+        # Strict full decode (reference: page_dict.go:35-72): trailing bytes
+        # mean the header lied about num_values or the page is corrupt.
+        raise PageError(
+            f"page: dictionary page has {len(block) - consumed} trailing bytes"
+        )
     return values
 
 

@@ -53,12 +53,19 @@ class FileReader:
         else:
             self._f = source
             self._owns_file = False
-        self.metadata = metadata if metadata is not None else read_file_metadata(self._f)
-        self.schema = Schema.from_thrift(self.metadata.schema)
-        self.validate_crc = validate_crc
-        self.alloc = AllocTracker(max_memory) if max_memory else None
-        self.backend = backend
-        self._selected = self._resolve_columns(columns)
+        try:
+            self.metadata = (
+                metadata if metadata is not None else read_file_metadata(self._f)
+            )
+            self.schema = Schema.from_thrift(self.metadata.schema)
+            self.validate_crc = validate_crc
+            self.alloc = AllocTracker(max_memory) if max_memory else None
+            self.backend = backend
+            self._selected = self._resolve_columns(columns)
+        except BaseException:
+            if self._owns_file:
+                self._f.close()
+            raise
 
     # -- properties ------------------------------------------------------------
 

@@ -145,14 +145,17 @@ class Schema:
             nonlocal pos
             node = Column(element=elem)
             n = elem.num_children or 0
-            if n < 0 or n > len(elements) - pos:
-                raise SchemaError(
-                    f"schema: element {elem.name!r} claims {n} children, "
-                    f"only {len(elements) - pos} remain"
-                )
+            if n < 0:
+                raise SchemaError(f"schema: element {elem.name!r} claims {n} children")
             if n == 0 and elem.type is None:
                 raise SchemaError(f"schema: group {elem.name!r} has no children and no type")
             for _ in range(n):
+                # Re-check per child: earlier siblings' subtrees consume elements.
+                if pos >= len(elements):
+                    raise SchemaError(
+                        f"schema: element {elem.name!r} claims {n} children "
+                        "but the element list is exhausted"
+                    )
                 child_elem = elements[pos]
                 pos += 1
                 node.children.append(read_node(child_elem))

@@ -1,0 +1,146 @@
+"""Record shredding: nested rows -> per-leaf (value, def, rep) streams.
+
+Write-side Dremel, the inverse of assembly.py — the semantics of the
+reference's recursiveAddColumnData/nil-propagation (reference:
+schema.go:837-891, :802-819) with one addition: ergonomic input. The reference
+only accepts raw nested maps ({"list": [{"element": v}]}); here LIST-annotated
+groups also accept plain Python lists and MAP-annotated groups plain dicts,
+mirroring the reader's raw/ergonomic duality.
+"""
+
+from __future__ import annotations
+
+from ..meta.parquet_types import ConvertedType, FieldRepetitionType
+from .schema import Column, Schema
+
+__all__ = ["Shredder", "ShredError"]
+
+
+class ShredError(ValueError):
+    pass
+
+
+class _LeafBuffer:
+    __slots__ = ("values", "def_levels", "rep_levels")
+
+    def __init__(self):
+        self.values: list = []
+        self.def_levels: list[int] = []
+        self.rep_levels: list[int] = []
+
+
+class Shredder:
+    """Accumulates shredded rows for all leaves of a schema."""
+
+    def __init__(self, schema: Schema):
+        self.schema = schema
+        self.buffers: dict[tuple, _LeafBuffer] = {
+            leaf.path: _LeafBuffer() for leaf in schema.leaves
+        }
+        self.num_rows = 0
+
+    def add_row(self, row: dict) -> None:
+        if not isinstance(row, dict):
+            raise ShredError(f"shred: row must be a dict, got {type(row).__name__}")
+        for child in self.schema.root.children:
+            self._shred(child, row.get(child.name), 0, 0)
+        self.num_rows += 1
+
+    # -- core recursion --------------------------------------------------------
+
+    def _shred(self, node: Column, value, rep: int, parent_def: int) -> None:
+        r = node.repetition
+        if r == FieldRepetitionType.REPEATED:
+            items = self._as_repeated(node, value)
+            if not items:
+                self._null_subtree(node, rep, parent_def)
+                return
+            for i, item in enumerate(items):
+                self._present(node, item, rep if i == 0 else node.max_rep)
+            return
+        if value is None:
+            if r == FieldRepetitionType.REQUIRED:
+                raise ShredError(f"shred: required field {node.path_str} is None")
+            self._null_subtree(node, rep, parent_def)
+            return
+        self._present(node, value, rep)
+
+    def _present(self, node: Column, value, rep: int) -> None:
+        if node.is_leaf:
+            buf = self.buffers[node.path]
+            buf.values.append(value)
+            buf.def_levels.append(node.max_def)
+            buf.rep_levels.append(rep)
+            return
+        value = self._normalize_group(node, value)
+        if not isinstance(value, dict):
+            raise ShredError(
+                f"shred: group {node.path_str} expects a dict, got {type(value).__name__}"
+            )
+        for child in node.children:
+            self._shred(child, value.get(child.name), rep, node.max_def)
+
+    def _null_subtree(self, node: Column, rep: int, def_level: int) -> None:
+        """One absent entry for every leaf beneath `node`
+        (reference: schema.go:802-819 nil-propagation)."""
+        if node.is_leaf:
+            buf = self.buffers[node.path]
+            buf.values.append(None)
+            buf.def_levels.append(def_level)
+            buf.rep_levels.append(rep)
+            return
+        for child in node.children:
+            self._null_subtree(child, rep, def_level)
+
+    # -- ergonomic sugar -------------------------------------------------------
+
+    def _as_repeated(self, node: Column, value) -> list:
+        if value is None:
+            return []
+        if isinstance(value, (list, tuple)):
+            return list(value)
+        raise ShredError(
+            f"shred: repeated field {node.path_str} expects a list, "
+            f"got {type(value).__name__}"
+        )
+
+    def _normalize_group(self, node: Column, value):
+        """Accept plain lists for LIST groups and dicts for MAP groups."""
+        ct = node.converted_type
+        lt = node.logical_type
+        is_list = ct == ConvertedType.LIST or (lt is not None and lt.LIST is not None)
+        is_map = ct in (ConvertedType.MAP, ConvertedType.MAP_KEY_VALUE) or (
+            lt is not None and lt.MAP is not None
+        )
+        if is_list and isinstance(value, (list, tuple)) and len(node.children) == 1:
+            mid = node.children[0]
+            if mid.repetition == FieldRepetitionType.REPEATED:
+                if mid.is_leaf or len(mid.children) != 1:
+                    return {mid.name: list(value)}
+                elem = mid.children[0]
+                return {mid.name: [{elem.name: v} for v in value]}
+        if is_map and isinstance(value, dict) and len(node.children) == 1:
+            kv = node.children[0]
+            if (
+                kv.repetition == FieldRepetitionType.REPEATED
+                and not kv.is_leaf
+                and len(kv.children) == 2
+                and set(value.keys()) != {kv.name}  # raw form passes through
+            ):
+                kname = kv.children[0].name
+                vname = kv.children[1].name
+                return {kv.name: [{kname: k, vname: v} for k, v in value.items()]}
+        return value
+
+    # -- draining --------------------------------------------------------------
+
+    def drain(self):
+        """Return and reset the accumulated per-leaf buffers."""
+        out = {
+            path: (b.values, b.def_levels, b.rep_levels)
+            for path, b in self.buffers.items()
+        }
+        self.buffers = {leaf.path: _LeafBuffer() for leaf in self.schema.leaves}
+        n = self.num_rows
+        self.num_rows = 0
+        return out, n

@@ -1,0 +1,77 @@
+"""Column statistics: min/max/null-count tracking for chunks and pages.
+
+Equivalent of the reference's stats.go (typed min/max for int32/int64/float/
+double, lexicographic bytes, nil-stats for boolean) computed vectorized over
+page/chunk arrays instead of per-value updates. Written into both the legacy
+(min/max) and modern (min_value/max_value) Statistics fields, matching what
+current writers emit for TypeDefinedOrder columns.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..meta.parquet_types import Statistics, Type
+from .arrays import ByteArrayData
+
+__all__ = ["compute_statistics"]
+
+_PACK = {
+    Type.INT32: struct.Struct("<i"),
+    Type.INT64: struct.Struct("<q"),
+    Type.FLOAT: struct.Struct("<f"),
+    Type.DOUBLE: struct.Struct("<d"),
+}
+
+# Cap stored min/max byte length, as modern writers do for wide binary values.
+_MAX_STAT_BYTES = 64
+
+
+def compute_statistics(ptype: Type, values, null_count: int) -> Statistics:
+    """Build Statistics for one page or chunk. `values` holds non-null cells."""
+    st = Statistics(null_count=null_count)
+    n = len(values) if values is not None else 0
+    if n == 0:
+        return st
+    if ptype in _PACK:
+        arr = np.asarray(values)
+        if ptype in (Type.FLOAT, Type.DOUBLE):
+            finite = arr[~np.isnan(arr)]
+            if finite.size == 0:
+                return st  # all-NaN: no stats (NaN order undefined)
+            mn, mx = finite.min(), finite.max()
+            # ±0.0 normalization like modern writers: report min as -0.0 and
+            # max as +0.0 so either sign of zero is covered by the range.
+            if mn == 0.0:
+                mn = arr.dtype.type(-0.0)
+            if mx == 0.0:
+                mx = arr.dtype.type(0.0)
+        else:
+            mn, mx = arr.min(), arr.max()
+        pk = _PACK[ptype]
+        st.min_value = pk.pack(mn)
+        st.max_value = pk.pack(mx)
+    elif ptype == Type.BOOLEAN:
+        arr = np.asarray(values, dtype=bool)
+        st.min_value = bytes([int(arr.min())])
+        st.max_value = bytes([int(arr.max())])
+    elif ptype in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+        if isinstance(values, ByteArrayData):
+            items = values.to_list()
+        elif isinstance(values, np.ndarray) and values.ndim == 2:
+            items = [v.tobytes() for v in values]
+        else:
+            items = [bytes(v) for v in values]
+        mn = min(items)
+        mx = max(items)
+        if len(mn) <= _MAX_STAT_BYTES and len(mx) <= _MAX_STAT_BYTES:
+            st.min_value = mn
+            st.max_value = mx
+    else:
+        return st  # INT96: no meaningful order (reference nilStats analogue)
+    # Legacy fields mirror the modern ones (TypeDefinedOrder).
+    st.min = st.min_value
+    st.max = st.max_value
+    return st

@@ -1,0 +1,176 @@
+"""Programmatic schema construction.
+
+Equivalent of the reference's public schema builders (reference:
+schema.go:572-647 NewDataColumn/NewListColumn/NewMapColumn,
+ColumnParameters :561-568): compose Column trees without writing DSL text.
+
+    schema = message(
+        required("id", Type.INT64),
+        optional("name", string()),
+        list_of("tags", optional_elem=optional("element", string())),
+        map_of("attrs", key=required("key", string()),
+                        value=optional("value", Type.INT32)),
+    )
+"""
+
+from __future__ import annotations
+
+from ..core.schema import Column, Schema
+from ..meta.parquet_types import (
+    ConvertedType,
+    FieldRepetitionType,
+    IntType,
+    ListType,
+    LogicalType,
+    MapType,
+    SchemaElement,
+    StringType,
+    TimestampType,
+    TimeUnit,
+    Type,
+)
+
+__all__ = [
+    "message",
+    "required",
+    "optional",
+    "repeated",
+    "group",
+    "list_of",
+    "map_of",
+    "string",
+    "timestamp",
+    "int_type",
+]
+
+
+class _TypeSpec:
+    """Physical type + annotations bundle usable in place of a bare Type."""
+
+    def __init__(self, ptype: Type, converted=None, logical=None, type_length=None,
+                 scale=None, precision=None):
+        self.ptype = ptype
+        self.converted = converted
+        self.logical = logical
+        self.type_length = type_length
+        self.scale = scale
+        self.precision = precision
+
+
+def string() -> _TypeSpec:
+    return _TypeSpec(
+        Type.BYTE_ARRAY,
+        converted=ConvertedType.UTF8,
+        logical=LogicalType(STRING=StringType()),
+    )
+
+
+def timestamp(unit: str = "micros", utc: bool = True) -> _TypeSpec:
+    units = {"millis": TimeUnit.millis, "micros": TimeUnit.micros, "nanos": TimeUnit.nanos}
+    conv = {
+        "millis": ConvertedType.TIMESTAMP_MILLIS,
+        "micros": ConvertedType.TIMESTAMP_MICROS,
+        "nanos": None,
+    }[unit]
+    return _TypeSpec(
+        Type.INT64,
+        converted=conv,
+        logical=LogicalType(
+            TIMESTAMP=TimestampType(isAdjustedToUTC=utc, unit=units[unit]())
+        ),
+    )
+
+
+def int_type(bits: int, signed: bool = True) -> _TypeSpec:
+    ptype = Type.INT64 if bits == 64 else Type.INT32
+    conv_name = f"{'INT' if signed else 'UINT'}_{bits}"
+    return _TypeSpec(
+        ptype,
+        converted=ConvertedType[conv_name],
+        logical=LogicalType(INTEGER=IntType(bitWidth=bits, isSigned=signed)),
+    )
+
+
+def _field(name: str, spec, repetition: FieldRepetitionType) -> Column:
+    if isinstance(spec, Column):
+        # wrap an existing group/leaf with a new name/repetition
+        spec.element.name = name
+        spec.element.repetition_type = int(repetition)
+        return spec
+    if isinstance(spec, Type):
+        spec = _TypeSpec(spec)
+    elem = SchemaElement(
+        type=int(spec.ptype),
+        name=name,
+        repetition_type=int(repetition),
+        converted_type=int(spec.converted) if spec.converted is not None else None,
+        logicalType=spec.logical,
+        type_length=spec.type_length,
+        scale=spec.scale,
+        precision=spec.precision,
+    )
+    return Column(element=elem)
+
+
+def required(name: str, spec) -> Column:
+    return _field(name, spec, FieldRepetitionType.REQUIRED)
+
+
+def optional(name: str, spec) -> Column:
+    return _field(name, spec, FieldRepetitionType.OPTIONAL)
+
+
+def repeated(name: str, spec) -> Column:
+    return _field(name, spec, FieldRepetitionType.REPEATED)
+
+
+def group(name: str, *children: Column, repetition=FieldRepetitionType.OPTIONAL,
+          converted=None, logical=None) -> Column:
+    elem = SchemaElement(
+        name=name,
+        repetition_type=int(repetition),
+        num_children=len(children),
+        converted_type=int(converted) if converted is not None else None,
+        logicalType=logical,
+    )
+    return Column(element=elem, children=list(children))
+
+
+def list_of(name: str, element: Column, required_list: bool = False) -> Column:
+    """Standard 3-level LIST: <name> (LIST) { repeated group list { element } }."""
+    element.element.name = "element"
+    mid = group("list", element, repetition=FieldRepetitionType.REPEATED)
+    return group(
+        name,
+        mid,
+        repetition=(
+            FieldRepetitionType.REQUIRED if required_list else FieldRepetitionType.OPTIONAL
+        ),
+        converted=ConvertedType.LIST,
+        logical=LogicalType(LIST=ListType()),
+    )
+
+
+def map_of(name: str, key: Column, value: Column, required_map: bool = False) -> Column:
+    key.element.name = "key"
+    key.element.repetition_type = int(FieldRepetitionType.REQUIRED)
+    value.element.name = "value"
+    kv = group("key_value", key, value, repetition=FieldRepetitionType.REPEATED,
+               converted=ConvertedType.MAP_KEY_VALUE)
+    return group(
+        name,
+        kv,
+        repetition=(
+            FieldRepetitionType.REQUIRED if required_map else FieldRepetitionType.OPTIONAL
+        ),
+        converted=ConvertedType.MAP,
+        logical=LogicalType(MAP=MapType()),
+    )
+
+
+def message(*fields: Column, name: str = "schema") -> Schema:
+    root = Column(
+        element=SchemaElement(name=name, num_children=len(fields)),
+        children=list(fields),
+    )
+    return Schema(root)
