@@ -1,0 +1,154 @@
+"""Benchmark: rows/sec decoded on the TPU backend vs the host baseline.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": N}
+
+Shape follows the north star (BASELINE.json): a NYC-taxi-like file with an
+int64 id column and a dictionary-encoded string column (plus a delta-encoded
+int64 timestamp column), decoded columnar (no row assembly) with
+FileReader(backend="tpu") on the real chip. Decoded output is verified
+byte-identical to the host path before timing counts.
+
+vs_baseline: the Go reference cannot run in this image (no Go toolchain;
+BASELINE.md notes the reference publishes no numbers), so the baseline is this
+framework's own vectorized host (NumPy) decode path — the stand-in for the
+"pure host decode" the north star compares against. Details go to stderr; the
+JSON line stays one line.
+
+Env knobs: PQT_BENCH_ROWS (default 2_000_000), PQT_BENCH_REPEATS (default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+import numpy as np
+
+ROWS = int(os.environ.get("PQT_BENCH_ROWS", 2_000_000))
+REPEATS = int(os.environ.get("PQT_BENCH_REPEATS", 3))
+CACHE = Path(f"/tmp/pqt_bench_{ROWS}.parquet")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_file() -> Path:
+    if CACHE.exists():
+        return CACHE
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    log(f"bench: generating {ROWS:,}-row taxi-like file at {CACHE}")
+    rng = np.random.default_rng(42)
+    vendors = np.array([f"vendor_{i:03d}" for i in range(200)])
+    t = pa.table(
+        {
+            "trip_id": pa.array(np.arange(ROWS, dtype=np.int64)),
+            "vendor": pa.array(vendors[rng.integers(0, len(vendors), ROWS)]),
+            "ts": pa.array(
+                (1_600_000_000_000_000 + np.cumsum(rng.integers(0, 1000, ROWS))).astype(
+                    np.int64
+                )
+            ),
+        }
+    )
+    pq.write_table(
+        t,
+        CACHE,
+        compression="snappy",
+        row_group_size=1 << 20,
+        use_dictionary=["vendor"],
+        column_encoding={"trip_id": "PLAIN", "ts": "DELTA_BINARY_PACKED"},
+    )
+    log(f"bench: file size {CACHE.stat().st_size / 1e6:.1f} MB")
+    return CACHE
+
+
+def decode_all(path, backend: str):
+    from parquet_tpu.core.reader import FileReader
+
+    with FileReader(path, backend=backend) as r:
+        out = [r.read_row_group(i) for i in range(r.num_row_groups)]
+    return out
+
+
+def verify_identical(host, tpu) -> None:
+    from parquet_tpu.core.arrays import ByteArrayData
+
+    for rg_h, rg_t in zip(host, tpu):
+        assert rg_h.keys() == rg_t.keys()
+        for path in rg_h:
+            a, b = rg_h[path].values, rg_t[path].values
+            if isinstance(a, ByteArrayData):
+                assert isinstance(b, ByteArrayData)
+                assert np.array_equal(a.offsets, b.offsets) and a.data == b.data, path
+            else:
+                av, bv = np.asarray(a), np.asarray(b)
+                assert av.dtype == bv.dtype, (path, av.dtype, bv.dtype)
+                assert np.array_equal(
+                    av.view((np.uint8, av.dtype.itemsize)),
+                    bv.view((np.uint8, bv.dtype.itemsize)),
+                ), path
+    log("bench: byte-identical host vs tpu ✓")
+
+
+def timed(fn, repeats: int) -> float:
+    best = float("inf")
+    for i in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        log(f"bench:   run {i + 1}/{repeats}: {dt:.3f}s ({ROWS / dt / 1e6:.2f} M rows/s)")
+        best = min(best, dt)
+    return best
+
+
+def main() -> None:
+    path = build_file()
+    import jax
+
+    platform = jax.devices()[0].platform
+    log(f"bench: jax default platform = {platform}")
+
+    # warmup (compile) + verification
+    log("bench: warmup + parity check")
+    host = decode_all(path, "host")
+    tpu = decode_all(path, "tpu")
+    verify_identical(host, tpu)
+    del host, tpu
+
+    log("bench: timing host baseline")
+    t_host = timed(lambda: decode_all(path, "host"), REPEATS)
+    log("bench: timing tpu backend")
+    t_tpu = timed(lambda: decode_all(path, "tpu"), REPEATS)
+
+    rate = ROWS / t_tpu
+    vs = t_host / t_tpu
+    log(
+        f"bench: host {ROWS / t_host / 1e6:.2f} M rows/s | "
+        f"tpu {rate / 1e6:.2f} M rows/s | speedup {vs:.2f}x"
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "rows/sec decoded, NYC-taxi-like file "
+                    "(int64 + dict-string + delta-ts cols), TPU decode backend"
+                ),
+                "value": round(rate, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

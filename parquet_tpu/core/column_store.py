@@ -119,12 +119,16 @@ class ColumnChunkBuilder:
             arr = np.asarray(v)
             want = _NUMERIC[ptype]
             if arr.dtype != want:
-                cast = arr.astype(want)
-                if np.issubdtype(arr.dtype, np.integer) and not np.array_equal(
-                    cast.astype(arr.dtype), arr
-                ):
+                with np.errstate(invalid="ignore"):
+                    cast = arr.astype(want)
+                # Any implicit cast must round-trip exactly (catches integer
+                # overflow, fractional floats into int columns, NaN into ints,
+                # and lossy f64 -> f32).
+                both_float = arr.dtype.kind == "f" and np.dtype(want).kind == "f"
+                if not np.array_equal(cast.astype(arr.dtype), arr, equal_nan=both_float):
                     raise StoreError(
-                        f"store: values overflow {ptype.name} in {self.column.path_str}"
+                        f"store: values do not fit {ptype.name} exactly in "
+                        f"{self.column.path_str} (dtype {arr.dtype})"
                     )
                 arr = cast
             return arr

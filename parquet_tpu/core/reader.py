@@ -132,7 +132,7 @@ class FileReader:
             if selected is not None and path not in selected:
                 continue  # skipChunk (reference: chunk_reader.go:271)
             column = self.schema.column(path)
-            out[path] = read_chunk(
+            out[path] = self._read_chunk_fn()(
                 self._f,
                 cc,
                 column,
@@ -140,6 +140,13 @@ class FileReader:
                 alloc=self.alloc,
             )
         return out
+
+    def _read_chunk_fn(self):
+        if self.backend == "tpu":
+            from ..kernels.pipeline import read_chunk_tpu
+
+            return read_chunk_tpu
+        return read_chunk
 
     # -- record iteration ------------------------------------------------------
 

@@ -67,6 +67,13 @@ class Shredder:
 
     def _present(self, node: Column, value, rep: int) -> None:
         if node.is_leaf:
+            if value is None:
+                # Only reachable for REPEATED leaves: a bare repeated field has
+                # no definition level to express a null element.
+                raise ShredError(
+                    f"shred: null element in repeated field {node.path_str} "
+                    "(wrap the element in an optional group to store nulls)"
+                )
             buf = self.buffers[node.path]
             buf.values.append(value)
             buf.def_levels.append(node.max_def)
@@ -125,7 +132,13 @@ class Shredder:
                 kv.repetition == FieldRepetitionType.REPEATED
                 and not kv.is_leaf
                 and len(kv.children) == 2
-                and set(value.keys()) != {kv.name}  # raw form passes through
+                # Raw nested form is {"key_value": [...]} — require the value
+                # to be a list so a real map entry whose key happens to be
+                # "key_value" still takes the ergonomic path.
+                and not (
+                    set(value.keys()) == {kv.name}
+                    and isinstance(value.get(kv.name), (list, tuple, type(None)))
+                )
             ):
                 kname = kv.children[0].name
                 vname = kv.children[1].name

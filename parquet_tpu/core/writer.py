@@ -204,13 +204,22 @@ class FileWriter:
             chunks.append(cc)
             total_bytes += cc.meta_data.total_uncompressed_size
             total_compressed += cc.meta_data.total_compressed_size
+        first_md = chunks[0].meta_data if chunks else None
+        first_page_offset = None
+        if first_md is not None:
+            # file_offset = first page of the group, dictionary page included.
+            first_page_offset = (
+                first_md.dictionary_page_offset
+                if first_md.dictionary_page_offset is not None
+                else first_md.data_page_offset
+            )
         self._row_groups.append(
             RowGroup(
                 columns=chunks,
                 total_byte_size=total_bytes,
                 total_compressed_size=total_compressed,
                 num_rows=n_rows,
-                file_offset=chunks[0].meta_data.data_page_offset if chunks else None,
+                file_offset=first_page_offset,
                 ordinal=len(self._row_groups),
             )
         )
@@ -291,8 +300,6 @@ class FileWriter:
                     column, v_slice, d_slice, r_slice, value_encoding,
                     int(self.codec), dict_size, self.with_crc,
                 )
-            if header.data_page_header is not None:
-                header.data_page_header.statistics = None
             self._write_page(header, block)
             n_pages += 1
         page_type = (

@@ -1,0 +1,2 @@
+from .api import Reader, Writer  # noqa: F401
+from .autoschema import AutoSchemaError, schema_from_dataclass  # noqa: F401

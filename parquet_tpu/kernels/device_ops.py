@@ -1,0 +1,170 @@
+"""Device-side (JAX/XLA) batched decode primitives.
+
+These are the TPU formulations of the ops/ host codecs, written as jittable
+functions over fixed-shape tensors (XLA: traced once, no data-dependent
+shapes). The sequential run/block structure of the wire format is dissolved on
+the host into flat tables (ops/rle_hybrid.py prescan, ops/delta.py prescan);
+everything here is gathers, shifts, segment-broadcasts and scans — the shapes
+TPU executes well (SURVEY §7.2 M3).
+
+Key formulation — bit-unpack without byte loops: value i of width W occupies
+bits [i*W, (i+1)*W) of the LSB-first stream. Load the stream as uint32 words;
+then val = (words[b>>5] >> (b&31)) | (words[b>>5+1] << (32-(b&31))), masked to
+W bits: two gathers + two shifts per value, fully vectorized. 64-bit widths use
+the same two-gather trick on uint64 words.
+
+int64 support requires jax_enable_x64; enabled at import (documented in the
+package README).
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+__all__ = [
+    "bytes_to_words32",
+    "bytes_to_words64",
+    "unpack_bits_device",
+    "expand_hybrid_device",
+    "delta_decode_device",
+    "dict_gather_device",
+]
+
+
+def bytes_to_words32(data: bytes) -> np.ndarray:
+    """Pad bytes to a uint32 LE word array (+1 guard word for the hi gather)."""
+    pad = (-len(data)) % 4
+    buf = data + b"\x00" * (pad + 4)
+    return np.frombuffer(buf, dtype="<u4")
+
+
+def bytes_to_words64(data: bytes) -> np.ndarray:
+    pad = (-len(data)) % 8
+    buf = data + b"\x00" * (pad + 8)
+    return np.frombuffer(buf, dtype="<u8")
+
+
+@partial(jax.jit, static_argnames=("width", "num_values"))
+def unpack_bits_device(words: jnp.ndarray, width: int, num_values: int) -> jnp.ndarray:
+    """Unpack `num_values` LSB-first `width`-bit values from uint32 words.
+
+    Returns uint32 (width <= 32). The two-word gather handles values straddling
+    word boundaries; shift-by-32 is avoided with a where on s == 0.
+    """
+    assert 0 < width <= 32
+    i = jnp.arange(num_values, dtype=jnp.int64)
+    bitpos = i * width
+    w0 = (bitpos >> 5).astype(jnp.int32)
+    s = (bitpos & 31).astype(jnp.uint32)
+    lo = words[w0] >> s
+    hi = jnp.where(s == 0, jnp.uint32(0), words[w0 + 1] << ((32 - s) & 31))
+    mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
+    return (lo | hi) & mask
+
+
+@partial(jax.jit, static_argnames=("width", "num_values"))
+def unpack_bits_device64(words: jnp.ndarray, width: int, num_values: int) -> jnp.ndarray:
+    """64-bit variant: unpack from uint64 words, return uint64 (width <= 64)."""
+    assert 0 < width <= 64
+    i = jnp.arange(num_values, dtype=jnp.int64)
+    bitpos = i * width
+    w0 = (bitpos >> 6).astype(jnp.int32)
+    s = (bitpos & 63).astype(jnp.uint64)
+    lo = words[w0] >> s
+    hi = jnp.where(s == 0, jnp.uint64(0), words[w0 + 1] << ((64 - s) & 63))
+    mask = (
+        jnp.uint64((1 << width) - 1)
+        if width < 64
+        else jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    )
+    return (lo | hi) & mask
+
+
+@partial(jax.jit, static_argnames=("width", "num_values"))
+def expand_hybrid_device(
+    packed_words: jnp.ndarray,
+    run_is_rle: jnp.ndarray,  # (R,) bool
+    run_out_start: jnp.ndarray,  # (R,) int64 exclusive cumsum of counts
+    run_rle_value: jnp.ndarray,  # (R,) uint32
+    run_bp_bit_start: jnp.ndarray,  # (R,) int64 bit offset of run payload
+    width: int,
+    num_values: int,
+) -> jnp.ndarray:
+    """Expand a prescanned hybrid RLE/bit-packed stream on device.
+
+    For output index i: its run r = searchsorted(run_out_start, i, 'right')-1.
+    RLE runs broadcast their value; bit-packed runs extract bits at
+    run_bp_bit_start[r] + (i - run_out_start[r]) * width.
+    """
+    i = jnp.arange(num_values, dtype=jnp.int64)
+    r = jnp.searchsorted(run_out_start, i, side="right") - 1
+    within = i - run_out_start[r]
+    if width == 0:
+        return jnp.zeros(num_values, dtype=jnp.uint32)
+    bitpos = run_bp_bit_start[r] + within * width
+    w0 = (bitpos >> 5).astype(jnp.int32)
+    s = (bitpos & 31).astype(jnp.uint32)
+    lo = packed_words[w0] >> s
+    hi = jnp.where(s == 0, jnp.uint32(0), packed_words[w0 + 1] << ((32 - s) & 31))
+    mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
+    bp_vals = (lo | hi) & mask
+    return jnp.where(run_is_rle[r], run_rle_value[r], bp_vals)
+
+
+@partial(jax.jit, static_argnames=("nbits", "num_values", "width"))
+def _unpack_miniblocks(words, mb_bit_start, mb_out_start, width, nbits, num_values):
+    """Unpack all miniblocks of one distinct width into their delta positions."""
+    # Done per distinct width by the host driver; indexes like expand_hybrid.
+    i = jnp.arange(num_values, dtype=jnp.int64)
+    m = jnp.searchsorted(mb_out_start, i, side="right") - 1
+    within = i - mb_out_start[m]
+    if nbits == 32:
+        bitpos = mb_bit_start[m] + within * width
+        w0 = (bitpos >> 5).astype(jnp.int32)
+        s = (bitpos & 31).astype(jnp.uint32)
+        lo = words[w0] >> s
+        hi = jnp.where(s == 0, jnp.uint32(0), words[w0 + 1] << ((32 - s) & 31))
+        mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
+        return (lo | hi) & mask
+    bitpos = mb_bit_start[m] + within * width
+    w0 = (bitpos >> 6).astype(jnp.int32)
+    s = (bitpos & 63).astype(jnp.uint64)
+    lo = words[w0] >> s
+    hi = jnp.where(s == 0, jnp.uint64(0), words[w0 + 1] << ((64 - s) & 63))
+    mask = (
+        jnp.uint64((1 << width) - 1) if width < 64 else jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    )
+    return (lo | hi) & mask
+
+
+@partial(jax.jit, static_argnames=("nbits", "num_values"))
+def delta_decode_device(
+    deltas_plus_min: jnp.ndarray,  # (num_values-1,) unsigned, already + min_delta
+    first_value,  # scalar unsigned
+    nbits: int,
+    num_values: int,
+) -> jnp.ndarray:
+    """Wrapping prefix-sum: values[k] = first + sum(deltas[:k]) mod 2**nbits.
+
+    The cumulative sum is an associative scan — XLA lowers it to a logarithmic
+    tree, the TPU-friendly inversion of the reference's one-value-at-a-time
+    loop (deltabp_decoder.go:113-174, SURVEY §7.2 M3c).
+    """
+    ud = jnp.uint32 if nbits == 32 else jnp.uint64
+    sd = jnp.int32 if nbits == 32 else jnp.int64
+    first = jnp.asarray(first_value, dtype=ud)
+    body = jnp.cumsum(deltas_plus_min.astype(ud), dtype=ud) + first
+    out = jnp.concatenate([first[None], body])
+    return jax.lax.bitcast_convert_type(out, sd)
+
+
+@jax.jit
+def dict_gather_device(dictionary: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """Dictionary expansion: one gather (reference: type_dict.go lookup loop)."""
+    return dictionary[indices]

@@ -1,0 +1,132 @@
+"""Pallas TPU kernels for the decode hot path.
+
+The device_ops.py formulations compile well under bare XLA, but the fused
+hybrid-expansion kernel here keeps the whole run-table expansion (searchsorted
+replacement + bit extraction + RLE select) in VMEM with explicit blocking,
+avoiding materializing the per-value run-index and bit-position tensors in HBM
+(they are 3x the output size for 32-bit data — HBM bandwidth is the bottleneck,
+not FLOPs).
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+  - grid over output blocks of BLOCK values; all inputs stay whole in VMEM
+    (run tables are tiny; packed words are bounded by page-batch size)
+  - run lookup: instead of a per-value binary search, each output value finds
+    its run with a vectorized comparison against the (small) run-start vector:
+    r = sum(run_out_start <= i) - 1 — a (BLOCK, R) compare + row-sum that maps
+    onto the VPU; R (runs per batch) is capped by the host driver
+  - bit extraction: same two-word gather as device_ops
+  - 2D iota per guide (1D iota fails on TPU)
+
+On CPU (tests) the kernels run with interpret=True; on TPU they compile with
+Mosaic. Output is bit-identical to the host path either way.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pltpu import works on CPU too (for interpret mode / shapes)
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["hybrid_expand_pallas", "HYBRID_BLOCK"]
+
+HYBRID_BLOCK = 4096  # output values per grid step
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _hybrid_kernel(words_ref, starts_ref, rle_ref, values_ref, bits_ref, out_ref,
+                   *, width: int, block: int, n_runs: int):
+    """One grid step: expand `block` output values.
+
+    words_ref: (W32,) uint32 packed payload words (whole, VMEM)
+    starts_ref: (R,) int32 run output starts (exclusive cumsum)
+    rle_ref: (R,) int32 1 if run is RLE
+    values_ref: (R,) uint32 RLE value per run
+    bits_ref: (R,) int32 payload bit start per run
+    out_ref: (block,) uint32
+    """
+    step = pl.program_id(0)
+    base = step * block
+    # (block, 1) output indices — 2D iota per TPU requirement
+    i = jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0) + base
+    # run index: count of run starts <= i, minus 1. starts is (R,) -> (1, R)
+    starts = starts_ref[:].reshape(1, n_runs)
+    r = jnp.sum((starts <= i).astype(jnp.int32), axis=1, keepdims=True) - 1
+    r = jnp.clip(r, 0, n_runs - 1)
+    run_start = jnp.take_along_axis(
+        jnp.broadcast_to(starts, (block, n_runs)), r, axis=1
+    )
+    within = i - run_start
+    bit_start = jnp.take_along_axis(
+        jnp.broadcast_to(bits_ref[:].reshape(1, n_runs), (block, n_runs)), r, axis=1
+    )
+    bitpos = bit_start + within * width
+    w0 = (bitpos >> 5).reshape(block)
+    s = (bitpos & 31).astype(jnp.uint32).reshape(block)
+    words = words_ref[:]
+    lo = words[w0] >> s
+    hi = jnp.where(s == 0, jnp.uint32(0), words[jnp.minimum(w0 + 1, words.shape[0] - 1)] << ((32 - s) & 31))
+    mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
+    bp_vals = (lo | hi) & mask
+    is_rle = jnp.take_along_axis(
+        jnp.broadcast_to(rle_ref[:].reshape(1, n_runs), (block, n_runs)), r, axis=1
+    ).reshape(block)
+    rle_val = jnp.take_along_axis(
+        jnp.broadcast_to(values_ref[:].reshape(1, n_runs), (block, n_runs)), r, axis=1
+    ).reshape(block)
+    out_ref[:] = jnp.where(is_rle == 1, rle_val, bp_vals)
+
+
+@partial(jax.jit, static_argnames=("width", "num_values", "n_runs", "interpret"))
+def hybrid_expand_pallas(
+    words: jnp.ndarray,
+    run_out_start: jnp.ndarray,  # (R,) int32
+    run_is_rle: jnp.ndarray,  # (R,) int32
+    run_rle_value: jnp.ndarray,  # (R,) uint32
+    run_bp_bit_start: jnp.ndarray,  # (R,) int32
+    width: int,
+    num_values: int,
+    n_runs: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas fused hybrid expansion. num_values must be a multiple of
+    HYBRID_BLOCK (host driver pads; trailing values are discarded)."""
+    assert num_values % HYBRID_BLOCK == 0
+    grid = (num_values // HYBRID_BLOCK,)
+    kernel = partial(
+        _hybrid_kernel, width=width, block=HYBRID_BLOCK, n_runs=n_runs
+    )
+    in_specs = (
+        [pl.BlockSpec(memory_space=pltpu.VMEM)] * 5
+        if _HAS_PLTPU
+        else [pl.BlockSpec()] * 5
+    )
+    out_spec = (
+        pl.BlockSpec((HYBRID_BLOCK,), lambda i: (i,), memory_space=pltpu.VMEM)
+        if _HAS_PLTPU
+        else pl.BlockSpec((HYBRID_BLOCK,), lambda i: (i,))
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        out_shape=jax.ShapeDtypeStruct((num_values,), jnp.uint32),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        interpret=interpret,
+    )(words, run_out_start, run_is_rle, run_rle_value, run_bp_bit_start)

@@ -1,0 +1,445 @@
+"""Batched TPU page-decode pipeline — the pluggable decoder backend.
+
+The north-star architecture (BASELINE.json): the host walks pages, parses
+Thrift headers, decompresses blocks and decodes R/D levels; the *value* streams
+of a whole chunk are fused into one batch of device tensors and decoded by the
+kernels in device_ops.py / pallas_ops.py. Users opt in per reader:
+FileReader(..., backend="tpu") — the WithDecoderBackend(TPU) analogue.
+
+Batching model per chunk:
+  RLE_DICTIONARY  all pages' run tables concatenate into one table (bit
+                  offsets rebased into one packed buffer, output starts into
+                  one output index space) -> ONE device expansion for the whole
+                  chunk, then one device gather against the dictionary.
+  DELTA_BP        all pages' delta vectors concatenate; a single wrapping
+                  cumsum decodes every page at once — per-page starts are
+                  restored by subtracting the running sum at each page start
+                  (valid in modular arithmetic).
+  PLAIN           raw little-endian bytes upload + device bitcast.
+
+All shapes are padded to power-of-two buckets so XLA compiles each kernel a
+bounded number of times (static shapes, SURVEY §7.1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..meta.parquet_types import Encoding, PageType, Type
+from ..core.arrays import ByteArrayData
+from ..core.chunk import ChunkData, ChunkError, iter_chunk_pages, _check_crc
+from ..core.compress import decompress_block
+from ..core.page import PageError, decode_dict_page
+from ..core.schema import Column
+from ..ops.bitpack import bit_width
+from ..ops.levels import decode_levels_v1, decode_levels_v2
+from ..ops.rle_hybrid import prescan_hybrid
+from ..ops.delta import prescan_delta
+from .device_ops import (
+    bytes_to_words32,
+    delta_decode_device,
+    dict_gather_device,
+    expand_hybrid_device,
+)
+
+__all__ = ["read_chunk_tpu", "TpuDecodeStats"]
+
+
+def _bucket(n: int, floor: int = 1024) -> int:
+    """Next power-of-two bucket >= n (>= floor)."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclass
+class TpuDecodeStats:
+    pages: int = 0
+    device_values: int = 0
+    host_fallback_pages: int = 0
+
+
+# -- per-chunk batch assembly --------------------------------------------------
+
+
+class _HybridBatch:
+    """Concatenated run tables of all dict-encoded pages of a chunk."""
+
+    def __init__(self):
+        self.is_rle: list[np.ndarray] = []
+        self.counts: list[np.ndarray] = []
+        self.values: list[np.ndarray] = []
+        self.bit_starts: list[np.ndarray] = []
+        self.packed: list[bytes] = []
+        self.packed_bits = 0
+        self.out_count = 0
+        self.width: int | None = None
+
+    def add_page(self, table, take: int, width: int):
+        if self.width is None:
+            self.width = width
+        elif self.width != width:
+            return False  # width changed mid-chunk: caller falls back per-page
+        self.is_rle.append(table.is_rle)
+        self.counts.append(table.counts)
+        self.values.append(table.rle_values)
+        self.bit_starts.append(table.bp_offsets * 8 + self.packed_bits)
+        self.packed.append(table.packed)
+        self.packed_bits += len(table.packed) * 8
+        self.out_count += take
+        return True
+
+
+def _expand_hybrid_batch(batch: _HybridBatch, per_page_take: list[int]) -> np.ndarray:
+    """One device expansion for a whole chunk's worth of runs.
+
+    Pages may carry padding values in their final bit-packed group; output
+    index space is built per page with that padding included, then the real
+    values are sliced out per page.
+    """
+    width = batch.width or 0
+    counts = np.concatenate(batch.counts) if batch.counts else np.zeros(0, np.int64)
+    # output start of each run, with page boundaries padded to full run counts
+    out_start = np.zeros(len(counts), dtype=np.int64)
+    np.cumsum(counts[:-1], out=out_start[1:])
+    total = int(counts.sum())
+    n_pad = _bucket(max(total, 1))
+    run_pad = _bucket(len(counts), 64)
+    is_rle = np.zeros(run_pad, dtype=bool)
+    values = np.zeros(run_pad, dtype=np.uint32)
+    bit_starts = np.zeros(run_pad, dtype=np.int64)
+    starts = np.full(run_pad, n_pad + 1, dtype=np.int64)
+    if len(counts):
+        is_rle[: len(counts)] = np.concatenate(batch.is_rle)
+        values[: len(counts)] = np.concatenate(batch.values).astype(np.uint32)
+        bit_starts[: len(counts)] = np.concatenate(batch.bit_starts)
+        starts[: len(counts)] = out_start
+    # RLE-pad the tail so padded output indices hit a dummy run
+    packed = b"".join(batch.packed)
+    words = bytes_to_words32(packed)
+    w_pad = _bucket(len(words), 1024)
+    words_p = np.zeros(w_pad, dtype=np.uint32)
+    words_p[: len(words)] = words
+    dev = expand_hybrid_device(
+        jnp.asarray(words_p),
+        jnp.asarray(is_rle),
+        jnp.asarray(starts),
+        jnp.asarray(values),
+        jnp.asarray(bit_starts),
+        width,
+        n_pad,
+    )
+    flat = np.asarray(dev[:total])
+    # slice out real values per page (drop per-page bit-pack padding)
+    out = np.empty(sum(per_page_take), dtype=np.uint32)
+    pos_in = 0
+    pos_out = 0
+    for page_counts, take in zip(batch.counts, per_page_take):
+        page_total = int(page_counts.sum())
+        out[pos_out : pos_out + take] = flat[pos_in : pos_in + take]
+        pos_in += page_total
+        pos_out += take
+    return out
+
+
+class _DeltaBatch:
+    def __init__(self, nbits: int):
+        self.nbits = nbits
+        self.deltas: list[np.ndarray] = []
+        self.firsts: list[int] = []
+        self.totals: list[int] = []
+
+    def add_page(self, table):
+        if table.total == 0:
+            return  # no values: nothing to contribute to the stream
+        self.deltas.append(table.deltas_plus_min)
+        self.firsts.append(table.first_value)
+        self.totals.append(table.total)
+
+
+def _expand_delta_batch(batch: _DeltaBatch) -> np.ndarray:
+    """Decode all pages with one device cumsum.
+
+    Concatenate deltas of all pages; the global wrapping cumsum S satisfies,
+    for value k of page p with delta-range [a_p, b_p):
+        value = first_p + (S[k] - S[a_p - 1])  (mod 2**nbits)
+    which we realize by injecting a correction delta at each page boundary.
+    """
+    nbits = batch.nbits
+    ud = np.uint32 if nbits == 32 else np.uint64
+    mask = (1 << nbits) - 1
+    parts = []
+    prev_end_value = 0  # running value of the previous page's end (mod)
+    # Build one delta stream where each page's first value appears as a delta
+    # from the previous page's last value: cumsum then yields every value.
+    for deltas, first in zip(batch.deltas, batch.firsts):
+        start_delta = (first - prev_end_value) & mask
+        parts.append(np.array([start_delta], dtype=ud))
+        parts.append(deltas.astype(ud))
+        prev_end_value = (first + int(deltas.astype(ud).sum(dtype=ud))) & mask
+    if not parts:
+        sd = np.int32 if nbits == 32 else np.int64
+        return np.zeros(0, dtype=sd)
+    stream = np.concatenate(parts)
+    n = len(stream)
+    n_pad = _bucket(n)
+    stream_p = np.zeros(n_pad, dtype=ud)
+    stream_p[:n] = stream
+    dev = delta_decode_device(jnp.asarray(stream_p[1:]), int(stream_p[0]), nbits, n_pad)
+    return np.asarray(dev[:n])
+
+
+# -- the chunk decoder ---------------------------------------------------------
+
+
+def read_chunk_tpu(
+    f,
+    chunk,
+    column: Column,
+    validate_crc: bool = False,
+    alloc=None,
+    stats: TpuDecodeStats | None = None,
+) -> ChunkData:
+    """TPU-backend chunk decode: levels on host, values on device.
+
+    Byte-identical to core.chunk.read_chunk (the M1 oracle) — enforced by
+    tests/test_tpu_backend.py on every supported shape.
+    """
+    md = chunk.meta_data
+    codec = md.codec or 0
+    dictionary = None
+    dict_dev = None
+    expected = md.num_values or 0
+
+    page_infos = []  # (num_values, def, rep, kind, payload-specific)
+    hybrid_batch = _HybridBatch()
+    hybrid_takes: list[int] = []
+    delta_batch: _DeltaBatch | None = None
+    ptype = column.type
+
+    for raw in iter_chunk_pages(f, chunk):
+        header = raw.header
+        if alloc is not None:
+            alloc.check(header.uncompressed_page_size or 0)
+        pt = header.type
+        if pt == int(PageType.DICTIONARY_PAGE):
+            if dictionary is not None:
+                raise ChunkError("chunk: more than one dictionary page")
+            if validate_crc:
+                _check_crc(header, raw.payload)
+            block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
+            dictionary = decode_dict_page(header, block, column)
+            if isinstance(dictionary, np.ndarray) and dictionary.ndim == 1:
+                # Floats travel as bit patterns: TPU f64 transfer is not
+                # bit-exact (observed 1-ulp corruption through the axon
+                # runtime), and a gather is dtype-agnostic anyway.
+                if dictionary.dtype.kind == "f":
+                    u = np.uint32 if dictionary.dtype.itemsize == 4 else np.uint64
+                    dict_dev = jnp.asarray(dictionary.view(u))
+                else:
+                    dict_dev = jnp.asarray(dictionary)
+            continue
+        if pt == int(PageType.INDEX_PAGE):
+            continue
+        if pt not in (int(PageType.DATA_PAGE), int(PageType.DATA_PAGE_V2)):
+            raise ChunkError(f"chunk: unknown page type {pt}")
+        if validate_crc:
+            _check_crc(header, raw.payload)
+
+        # -- split levels (host) from values (device) --------------------------
+        if pt == int(PageType.DATA_PAGE):
+            h = header.data_page_header
+            n = h.num_values or 0
+            block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
+            buf = memoryview(block)
+            pos = 0
+            rep = None
+            if column.max_rep > 0:
+                rep, used = decode_levels_v1(buf, n, column.max_rep)
+                pos += used
+            dfl = None
+            non_null = n
+            if column.max_def > 0:
+                dfl, used = decode_levels_v1(buf[pos:], n, column.max_def)
+                pos += used
+                non_null = int((dfl == column.max_def).sum())
+            enc = h.encoding
+            values_buf = bytes(buf[pos:])
+        else:
+            h = header.data_page_header_v2
+            n = h.num_values or 0
+            rep_len = h.repetition_levels_byte_length or 0
+            def_len = h.definition_levels_byte_length or 0
+            buf = memoryview(raw.payload)
+            if rep_len + def_len > len(buf):
+                raise ChunkError("chunk: v2 level sizes exceed page")
+            rep = (
+                decode_levels_v2(buf[:rep_len], n, column.max_rep)
+                if column.max_rep > 0
+                else None
+            )
+            dfl = None
+            non_null = n
+            if column.max_def > 0:
+                dfl = decode_levels_v2(buf[rep_len : rep_len + def_len], n, column.max_def)
+                non_null = int((dfl == column.max_def).sum())
+            values_buf = bytes(buf[rep_len + def_len :])
+            if h.is_compressed is None or h.is_compressed:
+                un = (header.uncompressed_page_size or 0) - rep_len - def_len
+                values_buf = decompress_block(values_buf, codec, max(un, 0))
+            enc = h.encoding
+
+        if stats is not None:
+            stats.pages += 1
+
+        # -- route the value stream --------------------------------------------
+        if enc in (int(Encoding.RLE_DICTIONARY), int(Encoding.PLAIN_DICTIONARY)):
+            if dictionary is None:
+                raise PageError("page: dictionary encoding without dictionary")
+            if non_null == 0:
+                page_infos.append((n, dfl, rep, "empty", None))
+                continue
+            width = values_buf[0] if values_buf else 0
+            if width > 32:
+                raise PageError(f"page: invalid dict index width {width}")
+            table = prescan_hybrid(values_buf[1:], non_null, width)
+            if hybrid_batch.add_page(table, non_null, width):
+                hybrid_takes.append(non_null)
+                page_infos.append((n, dfl, rep, "dict", None))
+            else:  # width changed mid-chunk — rare; decode alone
+                from ..ops.rle_hybrid import expand_runs
+
+                idx = expand_runs(table, non_null, width, np.uint32)
+                page_infos.append((n, dfl, rep, "indices", idx))
+                if stats is not None:
+                    stats.host_fallback_pages += 1
+        elif enc == int(Encoding.DELTA_BINARY_PACKED) and ptype in (Type.INT32, Type.INT64):
+            nbits = 32 if ptype == Type.INT32 else 64
+            if delta_batch is None:
+                delta_batch = _DeltaBatch(nbits)
+            table = prescan_delta(values_buf, nbits, max_total=non_null)
+            delta_batch.add_page(table)
+            page_infos.append((n, dfl, rep, "delta", table.total))
+        elif enc == int(Encoding.PLAIN) and ptype in (
+            Type.INT32,
+            Type.INT64,
+            Type.FLOAT,
+            Type.DOUBLE,
+        ):
+            dt = {
+                Type.INT32: np.int32,
+                Type.INT64: np.int64,
+                Type.FLOAT: np.float32,
+                Type.DOUBLE: np.float64,
+            }[ptype]
+            need = non_null * np.dtype(dt).itemsize
+            if len(values_buf) < need:
+                raise PageError("page: plain payload too short")
+            vals = np.frombuffer(values_buf, dtype=dt, count=non_null)
+            page_infos.append((n, dfl, rep, "values", vals))
+        else:
+            # Anything else (byte arrays, boolean, deltas on other types):
+            # host decode for this page.
+            from ..core.page import _decode_values
+
+            dict_size = len(dictionary) if dictionary is not None else None
+            values, indices = _decode_values(values_buf, non_null, enc, column, dict_size)
+            if indices is not None:
+                page_infos.append((n, dfl, rep, "indices", indices))
+            else:
+                page_infos.append((n, dfl, rep, "values", values))
+            if stats is not None:
+                stats.host_fallback_pages += 1
+
+    # -- device execution ------------------------------------------------------
+    dict_indices_flat = None
+    if hybrid_takes:
+        dict_indices_flat = _expand_hybrid_batch(hybrid_batch, hybrid_takes)
+        if stats is not None:
+            stats.device_values += len(dict_indices_flat)
+    delta_flat = None
+    if delta_batch is not None:
+        delta_flat = _expand_delta_batch(delta_batch)
+        if stats is not None:
+            stats.device_values += len(delta_flat)
+
+    # -- reassemble per-page values in order -----------------------------------
+    pages_values = []
+    all_def: list[np.ndarray] = []
+    all_rep: list[np.ndarray] = []
+    take_iter = iter(hybrid_takes)
+    hpos = 0
+    dpos = 0
+    num_values_total = 0
+    for n, dfl, rep, kind, payload in page_infos:
+        num_values_total += n
+        if dfl is not None:
+            all_def.append(dfl)
+        if rep is not None:
+            all_rep.append(rep)
+        if kind == "dict":
+            take = next(take_iter)
+            idx = dict_indices_flat[hpos : hpos + take]
+            hpos += take
+            pages_values.append(_materialize(dictionary, dict_dev, idx))
+        elif kind == "indices":
+            pages_values.append(_materialize(dictionary, dict_dev, payload))
+        elif kind == "delta":
+            total = payload
+            vals = delta_flat[dpos : dpos + total]
+            dpos += total
+            pages_values.append(vals)
+        elif kind == "values":
+            pages_values.append(payload)
+        elif kind == "empty":
+            pass
+
+    if num_values_total != expected:
+        raise ChunkError(
+            f"chunk: pages hold {num_values_total} values, metadata says {expected}"
+        )
+
+    values = _concat_values(pages_values, column)
+    def_levels = np.concatenate(all_def) if all_def else None
+    rep_levels = np.concatenate(all_rep) if all_rep else None
+    return ChunkData(
+        column=column,
+        num_values=num_values_total,
+        values=values,
+        def_levels=def_levels,
+        rep_levels=rep_levels,
+        dictionary=dictionary,
+    )
+
+
+def _materialize(dictionary, dict_dev, indices: np.ndarray):
+    if isinstance(dictionary, ByteArrayData):
+        return dictionary.take(np.asarray(indices, dtype=np.int64))
+    if dict_dev is not None:
+        out = np.asarray(dict_gather_device(dict_dev, jnp.asarray(indices)))
+        if dictionary.dtype.kind == "f":  # gathered as bit patterns; view back
+            out = out.view(dictionary.dtype)
+        return out
+    return np.asarray(dictionary)[np.asarray(indices)]
+
+
+def _concat_values(parts, column: Column):
+    parts = [p for p in parts if p is not None]
+    if any(isinstance(p, ByteArrayData) for p in parts):
+        from ..core.chunk import _concat_byte_arrays
+
+        return _concat_byte_arrays(parts)
+    arrs = [np.asarray(p) for p in parts if len(p)]
+    if arrs:
+        return np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+    from ..core.chunk import _empty_dtype
+
+    if column.type == Type.BYTE_ARRAY:
+        return ByteArrayData(offsets=np.zeros(1, dtype=np.int64), data=b"")
+    return np.empty(0, dtype=_empty_dtype(column))

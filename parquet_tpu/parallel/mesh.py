@@ -1,0 +1,167 @@
+"""Multi-chip scale-out: sharded page-batch decode over a device mesh.
+
+The reference is single-process and decodes columns sequentially
+(reference: chunk_reader.go:375-404, SURVEY §2.5 'no parallelism anywhere');
+the natural parallel axes of the workload are pages x columns x row groups.
+Here those axes map onto a jax.sharding.Mesh:
+
+  axis "pages"  data-parallel over page batches (the bulk axis; scales with
+                file size, rides ICI for stat reductions only)
+  axis "cols"   parallel over columns of a row group (embarrassingly parallel)
+
+The decode step is a shard_map: each device expands its shard of the page grid
+locally (same kernels as kernels/device_ops.py), then per-column statistics
+(min/max/null-count — the write-side stats of stats.py) reduce across the
+"pages" axis with psum/pmin/pmax over ICI. Output stays device-sharded for
+downstream consumers; only stats and counts cross chips.
+
+The page grid is a fixed-shape padded layout: P pages x R runs x W words x N
+output values per page — static shapes so the whole step jits once (XLA,
+SURVEY §7.1).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # int64 columns are first-class
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["PageGrid", "make_decode_mesh", "sharded_decode_step", "build_page_grid"]
+
+
+class PageGrid:
+    """Host-side padded page batch: one column's pages as fixed-shape arrays."""
+
+    def __init__(self, words, starts, is_rle, values, bit_starts, counts, width: int):
+        self.words = words  # (P, W) uint32
+        self.starts = starts  # (P, R) int32 run output starts (pad: big)
+        self.is_rle = is_rle  # (P, R) int32
+        self.values = values  # (P, R) uint32
+        self.bit_starts = bit_starts  # (P, R) int32
+        self.counts = counts  # (P,) int32 real values per page
+        self.width = width
+
+    @property
+    def num_pages(self) -> int:
+        return self.words.shape[0]
+
+
+def build_page_grid(tables, takes, width: int, out_per_page: int) -> PageGrid:
+    """Pad per-page run tables (ops/rle_hybrid.py prescan) into a grid."""
+    n_pages = len(tables)
+    max_runs = max((len(t.counts) for t in tables), default=1)
+    max_words = max((len(t.packed) + 7) // 4 + 1 for t in tables)
+    words = np.zeros((n_pages, max_words), dtype=np.uint32)
+    starts = np.full((n_pages, max_runs), out_per_page + 1, dtype=np.int32)
+    is_rle = np.zeros((n_pages, max_runs), dtype=np.int32)
+    values = np.zeros((n_pages, max_runs), dtype=np.uint32)
+    bit_starts = np.zeros((n_pages, max_runs), dtype=np.int32)
+    counts = np.zeros(n_pages, dtype=np.int32)
+    for p, (t, take) in enumerate(zip(tables, takes)):
+        w = np.frombuffer(t.packed + b"\x00" * ((-len(t.packed)) % 4 + 4), dtype="<u4")
+        words[p, : len(w)] = w
+        r = len(t.counts)
+        out_start = np.zeros(r, dtype=np.int64)
+        np.cumsum(t.counts[:-1], out=out_start[1:])
+        starts[p, :r] = out_start
+        is_rle[p, :r] = t.is_rle
+        values[p, :r] = t.rle_values.astype(np.uint32)
+        bit_starts[p, :r] = t.bp_offsets * 8
+        counts[p] = take
+    return PageGrid(words, starts, is_rle, values, bit_starts, counts, width)
+
+
+def make_decode_mesh(devices=None, pages_axis: int | None = None) -> Mesh:
+    """1-D decode mesh over the "pages" axis (the bulk data-parallel axis)."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices) if pages_axis is None else pages_axis
+    return Mesh(np.array(devices[:n]).reshape(n), ("pages",))
+
+
+def _expand_one_page(words, starts, is_rle, values, bit_starts, width: int, n_out: int):
+    """Expand one padded page (same math as kernels/device_ops.py)."""
+    i = jnp.arange(n_out, dtype=jnp.int32).reshape(n_out, 1)
+    r = jnp.sum((starts.reshape(1, -1) <= i).astype(jnp.int32), axis=1) - 1
+    r = jnp.clip(r, 0, starts.shape[0] - 1)
+    within = i.reshape(n_out) - starts[r]
+    bitpos = bit_starts[r] + within * width
+    w0 = bitpos >> 5
+    s = (bitpos & 31).astype(jnp.uint32)
+    lo = words[w0] >> s
+    hi = jnp.where(
+        s == 0,
+        jnp.uint32(0),
+        words[jnp.minimum(w0 + 1, words.shape[0] - 1)] << ((32 - s) & 31),
+    )
+    mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
+    bp = (lo | hi) & mask
+    return jnp.where(is_rle[r] == 1, values[r], bp)
+
+
+def sharded_decode_step(mesh: Mesh, grid: PageGrid, dictionary, n_out: int):
+    """One sharded decode step: expand pages + dict gather + global stats.
+
+    Returns (decoded (P, n_out) sharded over "pages", stats dict reduced over
+    the mesh). This is the 'training step' shape of this framework: bulk
+    compute stays sharded; only scalar stats cross ICI.
+    """
+    width = grid.width
+    n_dev = mesh.devices.size
+
+    def step(words, starts, is_rle, values, bit_starts, counts, dict_dev):
+        expand = jax.vmap(
+            partial(_expand_one_page, width=width, n_out=n_out)
+        )
+        idx = expand(words, starts, is_rle, values, bit_starts)
+        decoded = dict_dev[idx]  # gather per device shard
+        # mask padding beyond each page's real count
+        valid = (
+            jnp.arange(n_out, dtype=jnp.int32).reshape(1, n_out)
+            < counts.reshape(-1, 1)
+        )
+        big = jnp.iinfo(decoded.dtype).max if decoded.dtype.kind == "i" else jnp.inf
+        masked_min = jnp.where(valid, decoded, big).min()
+        masked_max = jnp.where(valid, decoded, -big).max()
+        count = jnp.sum(valid.astype(jnp.int64))
+        # cross-chip reduction over the pages axis (ICI collectives)
+        gmin = jax.lax.pmin(masked_min, "pages")
+        gmax = jax.lax.pmax(masked_max, "pages")
+        gcount = jax.lax.psum(count, "pages")
+        return decoded, {"min": gmin, "max": gmax, "count": gcount}
+
+    pspec = P("pages")
+    shard_step = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(pspec, pspec, pspec, pspec, pspec, pspec, P()),
+        out_specs=(pspec, P()),
+    )
+    # pad page axis to a multiple of the mesh size
+    pad_pages = (-grid.num_pages) % n_dev
+    def pad(a):
+        if pad_pages == 0:
+            return a
+        widths = [(0, pad_pages)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+    args = (
+        pad(grid.words),
+        pad(grid.starts),
+        pad(grid.is_rle),
+        pad(grid.values),
+        pad(grid.bit_starts),
+        pad(grid.counts),
+        np.asarray(dictionary),
+    )
+    sharded = [
+        jax.device_put(a, NamedSharding(mesh, pspec)) for a in args[:-1]
+    ]
+    dict_dev = jax.device_put(args[-1], NamedSharding(mesh, P()))
+    return jax.jit(shard_step)(*sharded, dict_dev)

@@ -91,9 +91,21 @@ def int_type(bits: int, signed: bool = True) -> _TypeSpec:
     )
 
 
+def _clone_column(col: Column) -> Column:
+    """Deep-copy a Column so builder helpers never mutate caller objects."""
+    elem = SchemaElement(
+        **{
+            fname: getattr(col.element, fname)
+            for fname, _ft, _spec in SchemaElement.FIELDS.values()
+        }
+    )
+    return Column(element=elem, children=[_clone_column(c) for c in col.children])
+
+
 def _field(name: str, spec, repetition: FieldRepetitionType) -> Column:
     if isinstance(spec, Column):
-        # wrap an existing group/leaf with a new name/repetition
+        # wrap a copy of an existing group/leaf with a new name/repetition
+        spec = _clone_column(spec)
         spec.element.name = name
         spec.element.repetition_type = int(repetition)
         return spec
@@ -138,6 +150,7 @@ def group(name: str, *children: Column, repetition=FieldRepetitionType.OPTIONAL,
 
 def list_of(name: str, element: Column, required_list: bool = False) -> Column:
     """Standard 3-level LIST: <name> (LIST) { repeated group list { element } }."""
+    element = _clone_column(element)
     element.element.name = "element"
     mid = group("list", element, repetition=FieldRepetitionType.REPEATED)
     return group(
@@ -152,6 +165,8 @@ def list_of(name: str, element: Column, required_list: bool = False) -> Column:
 
 
 def map_of(name: str, key: Column, value: Column, required_map: bool = False) -> Column:
+    key = _clone_column(key)
+    value = _clone_column(value)
     key.element.name = "key"
     key.element.repetition_type = int(FieldRepetitionType.REQUIRED)
     value.element.name = "value"

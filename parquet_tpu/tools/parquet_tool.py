@@ -1,0 +1,173 @@
+"""parquet-tool: cat / head / meta / schema / rowcount / split.
+
+Equivalent of the reference's cobra CLI (reference: cmd/parquet-tool/cmds —
+cat.go:14, head.go:17, meta.go:14, schema.go:16, rowcount.go:16, split.go:31).
+
+    python -m parquet_tpu.tools.parquet_tool cat file.parquet
+    python -m parquet_tpu.tools.parquet_tool head -n 5 file.parquet
+    python -m parquet_tpu.tools.parquet_tool meta file.parquet
+    python -m parquet_tpu.tools.parquet_tool schema file.parquet
+    python -m parquet_tpu.tools.parquet_tool rowcount file.parquet
+    python -m parquet_tpu.tools.parquet_tool split -n 100000 src.parquet out_%d.parquet
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..core.reader import FileReader
+from ..core.writer import FileWriter
+from ..meta.parquet_types import CompressionCodec, Encoding, Type
+from ..schema.dsl import schema_to_string
+
+__all__ = ["main"]
+
+
+def _json_default(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    return str(v)
+
+
+def cmd_cat(args) -> int:
+    with FileReader(args.file) as r:
+        for row in r.iter_rows(raw=args.raw):
+            print(json.dumps(row, default=_json_default))
+    return 0
+
+
+def cmd_head(args) -> int:
+    n = args.n
+    with FileReader(args.file) as r:
+        for i, row in enumerate(r.iter_rows(raw=args.raw)):
+            if i >= n:
+                break
+            print(json.dumps(row, default=_json_default))
+    return 0
+
+
+def cmd_rowcount(args) -> int:
+    with FileReader(args.file) as r:
+        print(r.num_rows)
+    return 0
+
+
+def cmd_schema(args) -> int:
+    with FileReader(args.file) as r:
+        print(schema_to_string(r.schema))
+    return 0
+
+
+def cmd_meta(args) -> int:
+    """Flat per-column metadata incl. max R/D levels
+    (reference: cmds/readfile.go:110-142 printFlatSchema)."""
+    with FileReader(args.file) as r:
+        m = r.metadata
+        print(f"version: {m.version}")
+        print(f"created by: {m.created_by}")
+        print(f"rows: {m.num_rows}")
+        print(f"row groups: {len(m.row_groups or [])}")
+        for kv in m.key_value_metadata or []:
+            print(f"kv: {kv.key} = {kv.value}")
+        for gi, rg in enumerate(m.row_groups or []):
+            print(f"row group {gi}: rows={rg.num_rows} bytes={rg.total_byte_size}")
+            for cc in rg.columns or []:
+                md = cc.meta_data
+                leaf = r.schema.column(tuple(md.path_in_schema))
+                try:
+                    codec = CompressionCodec(md.codec).name
+                except ValueError:
+                    codec = str(md.codec)
+                encs = ",".join(
+                    Encoding(e).name if e in set(Encoding) else str(e)
+                    for e in (md.encodings or [])
+                )
+                stats = ""
+                if md.statistics is not None and md.statistics.null_count is not None:
+                    stats = f" nulls={md.statistics.null_count}"
+                print(
+                    f"  {'.'.join(md.path_in_schema)}: {Type(md.type).name} "
+                    f"maxR={leaf.max_rep} maxD={leaf.max_def} values={md.num_values} "
+                    f"codec={codec} encodings=[{encs}]{stats}"
+                )
+    return 0
+
+
+def cmd_split(args) -> int:
+    """Re-shard into parts of ~n rows each (reference: cmds/split.go:31-117
+    splits by target file size; rows are the stable unit here)."""
+    pattern = args.out
+    if "%d" not in pattern:
+        print("split: output pattern must contain %d", file=sys.stderr)
+        return 2
+    with FileReader(args.file) as r:
+        schema = r.schema
+        codec = args.codec
+        part = 0
+        rows_in_part = 0
+        writer = None
+        try:
+            for row in r.iter_rows(raw=True):
+                if writer is None:
+                    writer = FileWriter(pattern % part, schema, codec=codec)
+                writer.write_row(row)
+                rows_in_part += 1
+                if rows_in_part >= args.n:
+                    writer.close()
+                    writer = None
+                    part += 1
+                    rows_in_part = 0
+            if writer is not None:
+                writer.close()
+        finally:
+            pass
+    print(f"wrote {part + (1 if rows_in_part else 0)} parts")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="parquet-tool", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pc = sub.add_parser("cat", help="print all rows as JSON lines")
+    pc.add_argument("file")
+    pc.add_argument("--raw", action="store_true", help="raw nested-map row shape")
+    pc.set_defaults(fn=cmd_cat)
+
+    ph = sub.add_parser("head", help="print the first N rows")
+    ph.add_argument("-n", type=int, default=5)
+    ph.add_argument("file")
+    ph.add_argument("--raw", action="store_true")
+    ph.set_defaults(fn=cmd_head)
+
+    pm = sub.add_parser("meta", help="print file + column metadata")
+    pm.add_argument("file")
+    pm.set_defaults(fn=cmd_meta)
+
+    ps = sub.add_parser("schema", help="print the schema DSL")
+    ps.add_argument("file")
+    ps.set_defaults(fn=cmd_schema)
+
+    pr = sub.add_parser("rowcount", help="print the number of rows")
+    pr.add_argument("file")
+    pr.set_defaults(fn=cmd_rowcount)
+
+    pp = sub.add_parser("split", help="split into parts of N rows")
+    pp.add_argument("-n", type=int, required=True, help="rows per part")
+    pp.add_argument("--codec", default="snappy")
+    pp.add_argument("file")
+    pp.add_argument("out", help="output pattern containing %%d")
+    pp.set_defaults(fn=cmd_split)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (ValueError, OSError) as e:
+        print(f"parquet-tool: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,17 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Real-TPU execution is exercised by bench.py; tests validate kernels in
-interpret/CPU mode and shardings on the virtual mesh, per the build brief.
+The axon TPU plugin in this image ignores the JAX_PLATFORMS environment
+variable, so the platform is forced via jax.config (verified to work) before
+any test imports jax. Real-TPU execution is exercised by bench.py; tests
+validate kernels and shardings on the virtual mesh, per the build brief.
 """
+
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

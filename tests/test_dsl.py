@@ -1,0 +1,173 @@
+"""Schema DSL parser/printer/validator tests (reference:
+parquetschema/schema_parser_test.go table tests, SURVEY §2.2)."""
+
+import pytest
+
+from parquet_tpu.core.schema import SchemaError
+from parquet_tpu.meta.parquet_types import ConvertedType, Type
+from parquet_tpu.schema.dsl import (
+    SchemaParseError,
+    parse_schema,
+    schema_to_string,
+    validate,
+    validate_strict,
+)
+
+BIG = """
+message taxi {
+  required int64 trip_id;
+  optional binary vendor (STRING);
+  optional double fare;
+  optional boolean flagged;
+  optional int32 day (DATE);
+  optional int64 ts (TIMESTAMP(MICROS,true));
+  optional int32 small (INT(8,false));
+  optional fixed_len_byte_array(16) uid (UUID);
+  optional int32 price (DECIMAL(9,2));
+  optional group tags (LIST) {
+    repeated group list {
+      optional binary element (STRING);
+    }
+  }
+  optional group attrs (MAP) {
+    repeated group key_value {
+      required binary key (STRING);
+      optional int64 value;
+    }
+  }
+  required group pos {
+    required double lat;
+    required double lon;
+  }
+}
+"""
+
+
+class TestParse:
+    def test_full_schema_parses(self):
+        s = parse_schema(BIG)
+        assert s.root.name == "taxi"
+        assert len(s.leaves) == 14
+        assert s.column("trip_id").type == Type.INT64
+        assert s.column("tags.list.element").is_string()
+        assert s.column("attrs.key_value.key").max_def == 2
+        assert s.column("tags.list.element").max_rep == 1
+
+    def test_roundtrip_through_printer(self):
+        s = parse_schema(BIG)
+        text = schema_to_string(s)
+        s2 = parse_schema(text)
+        assert schema_to_string(s2) == text
+        assert [l.path for l in s2.leaves] == [l.path for l in s.leaves]
+
+    def test_field_ids(self):
+        s = parse_schema("message m { required int32 a = 7; }")
+        assert s.column("a").element.field_id == 7
+
+    def test_decimal_params(self):
+        s = parse_schema("message m { optional int64 d (DECIMAL(18,4)); }")
+        c = s.column("d")
+        assert c.element.precision == 18
+        assert c.element.scale == 4
+        assert c.converted_type == ConvertedType.DECIMAL
+
+    def test_legacy_converted_names(self):
+        s = parse_schema(
+            "message m { optional binary s (UTF8); optional int64 t (TIMESTAMP_MILLIS); }"
+        )
+        assert s.column("s").is_string()
+        assert s.column("t").converted_type == ConvertedType.TIMESTAMP_MILLIS
+
+    def test_used_with_writer_reader(self, tmp_path):
+        import pyarrow.parquet as pq
+
+        from parquet_tpu.core.reader import FileReader
+        from parquet_tpu.core.writer import FileWriter
+
+        s = parse_schema(
+            "message m { required int64 id; optional group l (LIST) "
+            "{ repeated group list { optional int32 element; } } }"
+        )
+        path = str(tmp_path / "dsl.parquet")
+        with FileWriter(path, s) as w:
+            w.write_rows([{"id": 1, "l": [1, 2]}, {"id": 2, "l": None}])
+        assert pq.read_table(path).to_pylist() == [
+            {"id": 1, "l": [1, 2]},
+            {"id": 2, "l": None},
+        ]
+        assert list(FileReader(path).iter_rows()) == [
+            {"id": 1, "l": [1, 2]},
+            {"id": 2, "l": None},
+        ]
+
+
+class TestParseErrors:
+    @pytest.mark.parametrize(
+        "text,frag",
+        [
+            ("msg m { }", "message"),
+            ("message m { required int32 a }", ";"),
+            ("message m { int32 a; }", "repetition"),
+            ("message m { required badtype a; }", "unknown type"),
+            ("message m { required int32 a (WHAT); }", "unknown annotation"),
+            ("message m { required fixed_len_byte_array(0) a; }", "fixed length"),
+            ("message m { required group g (LIST) { } }", "no children"),
+            ("message m { required int32 a (DECIMAL(0)); }", "precision"),
+            ("message m { required int32 a (INT(7,true)); }", "bit width"),
+            ("message m { required int64 t (TIME(WEEKS,true)); }", "unit"),
+            ("message m {", "expected"),
+        ],
+    )
+    def test_bad_inputs(self, text, frag):
+        with pytest.raises(SchemaParseError) as ei:
+            parse_schema(text)
+        assert frag.lower() in str(ei.value).lower()
+
+
+class TestValidate:
+    def test_valid_schema_passes_strict(self):
+        validate_strict(parse_schema(BIG))
+
+    def test_list_not_group_rejected(self):
+        s = parse_schema("message m { optional binary l (LIST); }")
+        with pytest.raises(SchemaError):
+            validate(s)
+
+    def test_list_child_not_repeated_rejected(self):
+        s = parse_schema(
+            "message m { optional group l (LIST) { optional int32 list; } }"
+        )
+        with pytest.raises(SchemaError):
+            validate(s)
+
+    def test_athena_bag_ok_lenient_rejected_strict(self):
+        s = parse_schema(
+            "message m { optional group l (LIST) { repeated group bag "
+            "{ optional int32 array_element; } } }"
+        )
+        validate(s)  # lenient ok (reference: schema_parser.go:776-833)
+        with pytest.raises(SchemaError):
+            validate_strict(s)
+
+    def test_map_shape_rejected(self):
+        s = parse_schema(
+            "message m { optional group mp (MAP) { repeated group key_value "
+            "{ required binary key; } } }"
+        )
+        with pytest.raises(SchemaError):
+            validate(s)
+
+    def test_utf8_on_int_rejected(self):
+        s = parse_schema("message m { optional int32 s (UTF8); }")
+        with pytest.raises(SchemaError):
+            validate(s)
+
+    def test_decimal_too_wide_rejected(self):
+        s = parse_schema("message m { optional int32 d (DECIMAL(10,2)); }")
+        with pytest.raises(SchemaError):
+            validate(s)
+
+    def test_uuid_wrong_length_rejected(self):
+        s = parse_schema("message m { optional fixed_len_byte_array(8) u (UUID); }")
+        with pytest.raises(SchemaError):
+            validate(s)

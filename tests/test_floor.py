@@ -1,0 +1,154 @@
+"""floor high-level API + autoschema tests (reference: floor/writeread_test.go,
+autoschema/gen_test.go)."""
+
+import dataclasses
+import datetime as dt
+from dataclasses import dataclass, field
+from typing import Optional
+
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import floor
+from parquet_tpu.floor.autoschema import AutoSchemaError, schema_from_dataclass
+from parquet_tpu.meta.parquet_types import Type
+from parquet_tpu.schema.dsl import schema_to_string, validate_strict
+
+
+@dataclass
+class Pos:
+    lat: float
+    lon: float
+
+
+@dataclass
+class Trip:
+    id: int
+    vendor: Optional[str]
+    ts: dt.datetime
+    day: dt.date
+    pickup: dt.time
+    tags: list[str]
+    attrs: dict[str, Optional[int]]
+    pos: Optional[Pos]
+    renamed: int = field(default=0, metadata={"parquet": "other_name"})
+
+
+TRIPS = [
+    Trip(
+        1,
+        "CMT",
+        dt.datetime(2024, 5, 1, 12, 30, tzinfo=dt.timezone.utc),
+        dt.date(2024, 5, 1),
+        dt.time(12, 30, 5, 123),
+        ["a", "b"],
+        {"k": 1, "n": None},
+        Pos(40.7, -74.0),
+        9,
+    ),
+    Trip(
+        2,
+        None,
+        dt.datetime(2024, 5, 2, 9, 0, tzinfo=dt.timezone.utc),
+        dt.date(2024, 5, 2),
+        dt.time(0, 0),
+        [],
+        {},
+        None,
+        0,
+    ),
+]
+
+
+class TestAutoschema:
+    def test_schema_shape(self):
+        s = schema_from_dataclass(Trip)
+        assert s.column("id").type == Type.INT64
+        assert s.column("vendor").is_string()
+        assert s.column("ts").logical_type.TIMESTAMP is not None
+        assert s.column("day").type == Type.INT32
+        assert "tags.list.element" in s
+        assert "attrs.key_value.value" in s
+        assert s.column("pos.lat").type == Type.DOUBLE
+        assert "other_name" in s  # metadata rename
+        validate_strict(s)
+
+    def test_roundtrips_through_dsl(self):
+        s = schema_from_dataclass(Trip)
+        from parquet_tpu.schema.dsl import parse_schema
+
+        s2 = parse_schema(schema_to_string(s))
+        assert [l.path for l in s2.leaves] == [l.path for l in s.leaves]
+
+    def test_non_dataclass_rejected(self):
+        with pytest.raises(AutoSchemaError):
+            schema_from_dataclass(dict)
+
+    def test_unsupported_type_rejected(self):
+        @dataclass
+        class Bad:
+            x: complex
+
+        with pytest.raises(AutoSchemaError):
+            schema_from_dataclass(Bad)
+
+
+class TestFloorRoundtrip:
+    def test_dataclass_roundtrip(self, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        with floor.Writer(path, Trip, codec="snappy") as w:
+            w.write_all(TRIPS)
+        assert list(floor.Reader(path, Trip)) == TRIPS
+
+    def test_pyarrow_reads_floor_files(self, tmp_path):
+        path = str(tmp_path / "t.parquet")
+        with floor.Writer(path, Trip) as w:
+            w.write_all(TRIPS)
+        t = pq.read_table(path)
+        assert t.num_rows == 2
+        assert t.column("id").to_pylist() == [1, 2]
+        assert str(t.schema.field("ts").type).startswith("timestamp[us")
+
+    def test_dict_rows_without_record_type(self, tmp_path):
+        path = str(tmp_path / "d.parquet")
+        with floor.Writer(path, Trip) as w:
+            w.write({"id": 3, "vendor": "VTS", "ts": dt.datetime(2024, 1, 1, tzinfo=dt.timezone.utc),
+                     "day": dt.date(2024, 1, 1), "pickup": dt.time(1, 2, 3),
+                     "tags": ["z"], "attrs": {}, "pos": None, "other_name": 1})
+        rows = list(floor.Reader(path))  # no record type: plain dicts
+        assert rows[0]["id"] == 3
+        assert rows[0]["vendor"] == "VTS"
+
+    def test_marshaller_hooks(self, tmp_path):
+        @dataclass
+        class Custom:
+            a: int
+
+            def to_parquet(self):
+                return {"a": self.a * 10}
+
+            @classmethod
+            def from_parquet(cls, row):
+                return cls(a=row["a"] // 10)
+
+        path = str(tmp_path / "c.parquet")
+        with floor.Writer(path, Custom) as w:
+            w.write(Custom(a=5))
+        assert list(floor.Reader(path, Custom)) == [Custom(a=5)]
+
+    def test_naive_datetime_treated_as_utc(self, tmp_path):
+        @dataclass
+        class R:
+            ts: dt.datetime
+
+        path = str(tmp_path / "n.parquet")
+        with floor.Writer(path, R) as w:
+            w.write(R(ts=dt.datetime(2020, 6, 1, 12, 0)))
+        (back,) = list(floor.Reader(path, R))
+        assert back.ts == dt.datetime(2020, 6, 1, 12, 0, tzinfo=dt.timezone.utc)
+
+    def test_wrong_object_type_rejected(self, tmp_path):
+        path = str(tmp_path / "w.parquet")
+        w = floor.Writer(path, Trip)
+        with pytest.raises(TypeError):
+            w.write(42)

@@ -1,0 +1,108 @@
+"""CLI tool tests (reference: cmds/helpers_test.go, cmd/csv2parquet/main_test.go)."""
+
+import json
+
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.reader import FileReader
+from parquet_tpu.core.writer import FileWriter
+from parquet_tpu.meta.parquet_types import Type
+from parquet_tpu.schema.builder import message, optional, required, string
+from parquet_tpu.tools.csv2parquet import main as csv_main, parse_type_hints
+from parquet_tpu.tools.parquet_tool import main as tool_main
+
+
+@pytest.fixture
+def sample(tmp_path):
+    path = str(tmp_path / "s.parquet")
+    schema = message(required("id", Type.INT64), optional("name", string()))
+    with FileWriter(path, schema, codec="snappy") as w:
+        w.write_rows([{"id": i, "name": f"n{i}" if i % 3 else None} for i in range(20)])
+    return path
+
+
+class TestParquetTool:
+    def test_rowcount(self, sample, capsys):
+        assert tool_main(["rowcount", sample]) == 0
+        assert capsys.readouterr().out.strip() == "20"
+
+    def test_cat(self, sample, capsys):
+        assert tool_main(["cat", sample]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 20
+        assert json.loads(lines[0]) == {"id": 0, "name": None}
+        assert json.loads(lines[1]) == {"id": 1, "name": "n1"}
+
+    def test_head(self, sample, capsys):
+        assert tool_main(["head", "-n", "3", sample]) == 0
+        assert len(capsys.readouterr().out.strip().splitlines()) == 3
+
+    def test_schema(self, sample, capsys):
+        assert tool_main(["schema", sample]) == 0
+        out = capsys.readouterr().out
+        assert "required int64 id;" in out
+        assert "optional binary name (STRING);" in out
+
+    def test_meta(self, sample, capsys):
+        assert tool_main(["meta", sample]) == 0
+        out = capsys.readouterr().out
+        assert "rows: 20" in out
+        assert "maxR=0 maxD=1" in out
+        assert "codec=SNAPPY" in out
+
+    def test_split(self, sample, tmp_path, capsys):
+        out_pattern = str(tmp_path / "part_%d.parquet")
+        assert tool_main(["split", "-n", "8", sample, out_pattern]) == 0
+        sizes = [
+            FileReader(str(tmp_path / f"part_{i}.parquet")).num_rows for i in range(3)
+        ]
+        assert sizes == [8, 8, 4]
+        # parts readable by pyarrow too
+        assert pq.read_table(str(tmp_path / "part_0.parquet")).num_rows == 8
+
+    def test_missing_file_clean_error(self, capsys):
+        assert tool_main(["rowcount", "/nonexistent.parquet"]) == 1
+        assert "parquet-tool:" in capsys.readouterr().err
+
+
+class TestCsv2Parquet:
+    def test_type_hints_parse(self):
+        assert parse_type_hints("a=int64, b=double") == {"a": "int64", "b": "double"}
+        with pytest.raises(ValueError):
+            parse_type_hints("a:int64")
+        with pytest.raises(ValueError):
+            parse_type_hints("a=quaternion")
+
+    def test_conversion(self, tmp_path, capsys):
+        src = tmp_path / "in.csv"
+        src.write_text("id,name,score,ok\n1,alice,9.5,true\n2,bob,,false\n3,,7.5,\n")
+        out = str(tmp_path / "out.parquet")
+        rc = csv_main(["-o", out, "-typehints", "id=int64,score=double,ok=boolean", str(src)])
+        assert rc == 0
+        rows = list(FileReader(out).iter_rows())
+        assert rows == [
+            {"id": 1, "name": "alice", "score": 9.5, "ok": True},
+            {"id": 2, "name": "bob", "score": None, "ok": False},
+            {"id": 3, "name": None, "score": 7.5, "ok": None},
+        ]
+        assert pq.read_table(out).num_rows == 3
+
+    def test_bad_value_reports_line(self, tmp_path, capsys):
+        src = tmp_path / "in.csv"
+        src.write_text("id\n1\nnope\n")
+        rc = csv_main(["-o", str(tmp_path / "o.parquet"), "-typehints", "id=int64", str(src)])
+        assert rc == 1
+        assert "line 3" in capsys.readouterr().err
+
+    def test_unknown_hint_column(self, tmp_path, capsys):
+        src = tmp_path / "in.csv"
+        src.write_text("a\n1\n")
+        rc = csv_main(["-o", str(tmp_path / "o.parquet"), "-typehints", "zz=int64", str(src)])
+        assert rc == 2
+
+    def test_ragged_row_rejected(self, tmp_path, capsys):
+        src = tmp_path / "in.csv"
+        src.write_text("a,b\n1,2\n3\n")
+        rc = csv_main(["-o", str(tmp_path / "o.parquet"), str(src)])
+        assert rc == 1

@@ -1,0 +1,162 @@
+"""TPU decoder backend: byte-identical parity vs the host path.
+
+The write-side oracle of the north star (BASELINE.json): for every supported
+shape, FileReader(backend="tpu") must produce byte-identical ChunkData to the
+host path. On CPU the device ops run through the same XLA code path (jit on the
+cpu backend); bench.py exercises the same code on the real chip.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.arrays import ByteArrayData
+from parquet_tpu.core.reader import FileReader
+
+
+def assert_chunks_identical(a, b):
+    assert a.num_values == b.num_values
+    if isinstance(a.values, ByteArrayData) or isinstance(b.values, ByteArrayData):
+        assert isinstance(a.values, ByteArrayData) and isinstance(b.values, ByteArrayData)
+        np.testing.assert_array_equal(a.values.offsets, b.values.offsets)
+        assert a.values.data == b.values.data
+    else:
+        av, bv = np.asarray(a.values), np.asarray(b.values)
+        assert av.dtype == bv.dtype
+        if av.dtype.kind == "f":
+            np.testing.assert_array_equal(
+                av.view(np.uint32 if av.itemsize == 4 else np.uint64),
+                bv.view(np.uint32 if bv.itemsize == 4 else np.uint64),
+            )
+        else:
+            np.testing.assert_array_equal(av, bv)
+    for lv in ("def_levels", "rep_levels"):
+        la, lb = getattr(a, lv), getattr(b, lv)
+        assert (la is None) == (lb is None)
+        if la is not None:
+            np.testing.assert_array_equal(la, lb)
+
+
+def both_backends(path):
+    with FileReader(path, backend="host") as r:
+        host = {i: r.read_row_group(i) for i in range(r.num_row_groups)}
+    with FileReader(path, backend="tpu") as r:
+        tpu = {i: r.read_row_group(i) for i in range(r.num_row_groups)}
+    assert host.keys() == tpu.keys()
+    for i in host:
+        assert host[i].keys() == tpu[i].keys()
+        for col_path in host[i]:
+            assert_chunks_identical(host[i][col_path], tpu[i][col_path])
+    return host
+
+
+rng = np.random.default_rng(11)
+
+
+class TestTpuParity:
+    def test_plain_int64(self, tmp_path):
+        # BASELINE config 1: PLAIN int64 flat, uncompressed, V1
+        t = pa.table({"x": pa.array(rng.integers(-(2**62), 2**62, 20_000), pa.int64())})
+        path = str(tmp_path / "c1.parquet")
+        pq.write_table(t, path, use_dictionary=False, compression="none")
+        both_backends(path)
+
+    def test_dict_int32_snappy_v2(self, tmp_path):
+        # BASELINE config 2 shape: hybrid int32, SNAPPY, V2 pages
+        t = pa.table({"x": pa.array(rng.integers(0, 1000, 50_000).astype(np.int32))})
+        path = str(tmp_path / "c2.parquet")
+        pq.write_table(t, path, compression="snappy", data_page_version="2.0")
+        both_backends(path)
+
+    def test_dict_strings_100k(self, tmp_path):
+        # BASELINE config 3 shape: dictionary strings
+        keys = [f"key_{i:05d}" for i in range(5000)]
+        vals = [keys[i % 5000] for i in range(60_000)]
+        t = pa.table({"s": pa.array(vals)})
+        path = str(tmp_path / "c3.parquet")
+        pq.write_table(t, path, compression="snappy")
+        both_backends(path)
+
+    def test_delta_int64_gzip(self, tmp_path):
+        # BASELINE config 4: delta-bp int64 timestamps, GZIP
+        ts = (1_600_000_000_000_000 + np.cumsum(rng.integers(0, 1000, 30_000))).astype(np.int64)
+        t = pa.table({"ts": pa.array(ts)})
+        path = str(tmp_path / "c4.parquet")
+        pq.write_table(
+            t, path, compression="gzip", use_dictionary=False,
+            column_encoding={"ts": "DELTA_BINARY_PACKED"},
+        )
+        both_backends(path)
+
+    def test_nested_list_levels(self, tmp_path):
+        # BASELINE config 5: nested LIST<int32> with R/D levels
+        data = [list(range(i % 6)) if i % 7 else None for i in range(5000)]
+        t = pa.table({"l": pa.array(data, pa.list_(pa.int32()))})
+        path = str(tmp_path / "c5.parquet")
+        pq.write_table(t, path, compression="snappy")
+        both_backends(path)
+
+    def test_nullable_dict_column(self, tmp_path):
+        vals = [f"v{i % 50}" if i % 3 else None for i in range(10_000)]
+        t = pa.table({"s": pa.array(vals)})
+        path = str(tmp_path / "nd.parquet")
+        pq.write_table(t, path)
+        both_backends(path)
+
+    def test_multi_page_dict(self, tmp_path):
+        t = pa.table({"x": pa.array(rng.integers(0, 100, 40_000).astype(np.int64))})
+        path = str(tmp_path / "mp.parquet")
+        pq.write_table(t, path, data_page_size=2048)
+        both_backends(path)
+
+    def test_multi_row_group(self, tmp_path):
+        t = pa.table({"x": pa.array(rng.integers(0, 30, 10_000).astype(np.int64)),
+                      "y": pa.array(rng.standard_normal(10_000))})
+        path = str(tmp_path / "mrg.parquet")
+        pq.write_table(t, path, row_group_size=1111)
+        both_backends(path)
+
+    def test_plain_doubles_floats(self, tmp_path):
+        t = pa.table({
+            "f": pa.array(rng.standard_normal(8000).astype(np.float32)),
+            "d": pa.array(np.concatenate([rng.standard_normal(7999), [np.nan]])),
+        })
+        path = str(tmp_path / "fd.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        both_backends(path)
+
+    def test_byte_arrays_fall_back_to_host(self, tmp_path):
+        # plain (non-dict) strings: host fallback path inside tpu backend
+        t = pa.table({"s": pa.array([f"unique_{i}" for i in range(40_000)])})
+        path = str(tmp_path / "ba.parquet")
+        pq.write_table(t, path)  # 40k uniques > dict? pyarrow spills to plain
+        both_backends(path)
+
+    def test_empty_and_all_null(self, tmp_path):
+        t = pa.table({"x": pa.array([None] * 100, pa.int64())})
+        path = str(tmp_path / "an.parquet")
+        pq.write_table(t, path)
+        both_backends(path)
+
+    def test_rows_match_through_assembly(self, tmp_path):
+        t = pa.table({
+            "id": pa.array(range(5000), pa.int64()),
+            "cat": pa.array([f"c{i%7}" for i in range(5000)]),
+        })
+        path = str(tmp_path / "rows.parquet")
+        pq.write_table(t, path, compression="snappy")
+        with FileReader(path, backend="tpu") as r:
+            rows = list(r.iter_rows())
+        assert rows == t.to_pylist()
+
+
+class TestDeviceOpBuckets:
+    def test_bucket_reuse_avoids_recompiles(self, tmp_path):
+        # different data sizes should land in a bounded set of compiled shapes
+        from parquet_tpu.kernels.pipeline import _bucket
+
+        assert _bucket(1000) == 1024
+        assert _bucket(1024) == 1024
+        assert _bucket(1025) == 2048
+        assert _bucket(3) == 1024
