@@ -1,0 +1,299 @@
+// Native host-side helpers for parquet_tpu.
+//
+// The TPU absorbs the bulk value decode (kernels/), but three host-side scalar
+// walks remain on the critical path and are too branchy for NumPy:
+//   1. snappy block (de)compression   (the reference links a Go snappy lib;
+//      this implements the public snappy block format from its spec)
+//   2. PLAIN byte_array offset scan   (data-dependent 4-byte length chain,
+//      reference: type_bytearray.go:24-45)
+//   3. hybrid RLE/bit-pack run-header prescan
+//      (reference: hybrid_decoder.go:142-165; feeds the device run table)
+//
+// Exposed as a plain C ABI consumed via ctypes (utils/native.py). All
+// functions validate sizes before writing and return -1 on corrupt input.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+#include <sys/types.h>  // ssize_t
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// snappy block format
+// ---------------------------------------------------------------------------
+
+size_t ptq_snappy_max_compressed_length(size_t n) {
+  // Worst case: all literals (header <= 5 bytes per element, one element) plus
+  // copies that are only emitted when profitable (see emit rules), + varint.
+  return 32 + n + n / 6;
+}
+
+ssize_t ptq_snappy_decompress(const char* src_c, size_t src_len,
+                              char* dst, size_t dst_cap) {
+  const uint8_t* src = reinterpret_cast<const uint8_t*>(src_c);
+  size_t pos = 0;
+  uint64_t expect = 0;
+  int shift = 0;
+  // preamble: uncompressed length varint
+  for (;;) {
+    if (pos >= src_len || shift > 63) return -1;
+    uint8_t b = src[pos++];
+    expect |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  if (expect > dst_cap) return -1;
+  size_t out = 0;
+  while (pos < src_len) {
+    uint8_t tag = src[pos++];
+    uint32_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      uint32_t len = tag >> 2;
+      if (len >= 60) {
+        uint32_t extra = len - 59;  // 1..4 length bytes
+        if (pos + extra > src_len) return -1;
+        len = 0;
+        for (uint32_t i = 0; i < extra; i++) len |= static_cast<uint32_t>(src[pos + i]) << (8 * i);
+        pos += extra;
+      }
+      uint64_t n = static_cast<uint64_t>(len) + 1;
+      if (pos + n > src_len || out + n > expect) return -1;
+      std::memcpy(dst + out, src + pos, n);
+      out += n;
+      pos += n;
+    } else {
+      uint32_t length, offset;
+      if (kind == 1) {
+        if (pos + 1 > src_len) return -1;
+        length = ((tag >> 2) & 7) + 4;
+        offset = (static_cast<uint32_t>(tag >> 5) << 8) | src[pos];
+        pos += 1;
+      } else if (kind == 2) {
+        if (pos + 2 > src_len) return -1;
+        length = (tag >> 2) + 1;
+        offset = static_cast<uint32_t>(src[pos]) | (static_cast<uint32_t>(src[pos + 1]) << 8);
+        pos += 2;
+      } else {
+        if (pos + 4 > src_len) return -1;
+        length = (tag >> 2) + 1;
+        offset = static_cast<uint32_t>(src[pos]) | (static_cast<uint32_t>(src[pos + 1]) << 8) |
+                 (static_cast<uint32_t>(src[pos + 2]) << 16) | (static_cast<uint32_t>(src[pos + 3]) << 24);
+        pos += 4;
+      }
+      if (offset == 0 || offset > out || out + length > expect) return -1;
+      // overlapping copy must run forward byte-by-byte (RLE-style matches)
+      const char* from = dst + out - offset;
+      for (uint32_t i = 0; i < length; i++) dst[out + i] = from[i];
+      out += length;
+    }
+  }
+  return out == expect ? static_cast<ssize_t>(out) : -1;
+}
+
+static inline uint32_t snappy_hash(uint32_t v) {
+  return (v * 0x1e35a7bdu) >> 18;  // 14-bit table
+}
+
+// Emits one literal element (callers never pass len >= 2^32). Returns false on
+// insufficient space in dst.
+static bool emit_literal(const uint8_t* src, size_t from, size_t len,
+                         char* dst, size_t dst_cap, size_t* out) {
+  if (len == 0) return true;
+  if (*out + 5 + len > dst_cap) return false;
+  size_t n = len - 1;
+  if (n < 60) {
+    dst[(*out)++] = static_cast<char>(n << 2);
+  } else if (n < (1u << 8)) {
+    dst[(*out)++] = static_cast<char>(60 << 2);
+    dst[(*out)++] = static_cast<char>(n);
+  } else if (n < (1u << 16)) {
+    dst[(*out)++] = static_cast<char>(61 << 2);
+    dst[(*out)++] = static_cast<char>(n);
+    dst[(*out)++] = static_cast<char>(n >> 8);
+  } else if (n < (1u << 24)) {
+    dst[(*out)++] = static_cast<char>(62 << 2);
+    dst[(*out)++] = static_cast<char>(n);
+    dst[(*out)++] = static_cast<char>(n >> 8);
+    dst[(*out)++] = static_cast<char>(n >> 16);
+  } else {
+    dst[(*out)++] = static_cast<char>(63 << 2);
+    dst[(*out)++] = static_cast<char>(n);
+    dst[(*out)++] = static_cast<char>(n >> 8);
+    dst[(*out)++] = static_cast<char>(n >> 16);
+    dst[(*out)++] = static_cast<char>(n >> 24);
+  }
+  std::memcpy(dst + *out, src + from, len);
+  *out += len;
+  return true;
+}
+
+static bool emit_copy(size_t offset, size_t len, char* dst, size_t dst_cap,
+                      size_t* out) {
+  while (len > 0) {
+    size_t chunk = len > 64 ? 64 : len;
+    // keep the final chunk >= 4 (canonical decoders may reject shorter copies)
+    if (chunk == 64 && len - chunk > 0 && len - chunk < 4) chunk = 60;
+    if (*out + 5 > dst_cap) return false;
+    if (chunk >= 4 && chunk <= 11 && offset < 2048) {
+      dst[(*out)++] = static_cast<char>(((offset >> 8) << 5) | ((chunk - 4) << 2) | 1);
+      dst[(*out)++] = static_cast<char>(offset & 0xff);
+    } else if (offset < (1u << 16)) {
+      dst[(*out)++] = static_cast<char>(((chunk - 1) << 2) | 2);
+      dst[(*out)++] = static_cast<char>(offset & 0xff);
+      dst[(*out)++] = static_cast<char>(offset >> 8);
+    } else {
+      dst[(*out)++] = static_cast<char>(((chunk - 1) << 2) | 3);
+      dst[(*out)++] = static_cast<char>(offset & 0xff);
+      dst[(*out)++] = static_cast<char>((offset >> 8) & 0xff);
+      dst[(*out)++] = static_cast<char>((offset >> 16) & 0xff);
+      dst[(*out)++] = static_cast<char>((offset >> 24) & 0xff);
+    }
+    len -= chunk;
+  }
+  return true;
+}
+
+ssize_t ptq_snappy_compress(const char* src_c, size_t src_len,
+                            char* dst, size_t dst_cap) {
+  if (dst_cap < ptq_snappy_max_compressed_length(src_len)) return -1;
+  const uint8_t* src = reinterpret_cast<const uint8_t*>(src_c);
+  size_t out = 0;
+  // preamble
+  {
+    uint64_t v = src_len;
+    while (v >= 0x80) { dst[out++] = static_cast<char>(v | 0x80); v >>= 7; }
+    dst[out++] = static_cast<char>(v);
+  }
+  if (src_len == 0) return static_cast<ssize_t>(out);
+  constexpr size_t kTableSize = 1 << 14;
+  static thread_local uint32_t table[kTableSize];
+  std::memset(table, 0, sizeof(table));
+  size_t lit_start = 0;
+  size_t pos = 0;
+  if (src_len >= 8) {
+    const size_t limit = src_len - 4;
+    while (pos < limit) {
+      uint32_t cur;
+      std::memcpy(&cur, src + pos, 4);
+      uint32_t h = snappy_hash(cur);
+      size_t cand = table[h];
+      table[h] = static_cast<uint32_t>(pos);
+      uint32_t cv;
+      if (cand < pos && pos - cand < (1ull << 32) &&
+          (std::memcpy(&cv, src + cand, 4), cv == cur)) {
+        // extend match
+        size_t len = 4;
+        while (pos + len < src_len && src[cand + len] == src[pos + len]) len++;
+        size_t offset = pos - cand;
+        // Profitability: a far copy costs 5 bytes; only take it when it beats
+        // the literal it replaces, which also keeps the advertised
+        // max_compressed_length bound valid (no expanding elements).
+        if (offset >= (1u << 16) && len < 8) {
+          pos++;
+          continue;
+        }
+        if (pos > lit_start &&
+            !emit_literal(src, lit_start, pos - lit_start, dst, dst_cap, &out))
+          return -1;
+        if (!emit_copy(offset, len, dst, dst_cap, &out)) return -1;
+        pos += len;
+        lit_start = pos;
+      } else {
+        pos++;
+      }
+    }
+  }
+  if (lit_start < src_len &&
+      !emit_literal(src, lit_start, src_len - lit_start, dst, dst_cap, &out))
+    return -1;
+  return static_cast<ssize_t>(out);
+}
+
+// ---------------------------------------------------------------------------
+// PLAIN byte_array scan: 4-byte LE length + payload, repeated
+// ---------------------------------------------------------------------------
+
+// Fills offsets[0..num_values] (compacted) and copies payloads into data_out.
+// Returns bytes consumed from src, or -1 on corrupt input / overflow.
+ssize_t ptq_byte_array_gather(const char* src, size_t src_len, int64_t num_values,
+                              int64_t* offsets, char* data_out, size_t data_cap) {
+  size_t pos = 0;
+  int64_t total = 0;
+  offsets[0] = 0;
+  for (int64_t i = 0; i < num_values; i++) {
+    if (pos + 4 > src_len) return -1;
+    uint32_t len;
+    std::memcpy(&len, src + pos, 4);  // little-endian hosts only (x86/arm64)
+    pos += 4;
+    if (pos + len > src_len) return -1;
+    if (static_cast<size_t>(total) + len > data_cap) return -1;
+    std::memcpy(data_out + total, src + pos, len);
+    pos += len;
+    total += len;
+    offsets[i + 1] = total;
+  }
+  return static_cast<ssize_t>(pos);
+}
+
+// ---------------------------------------------------------------------------
+// hybrid RLE/bit-pack run-header prescan
+// ---------------------------------------------------------------------------
+
+// Outputs one row per run. bp_offsets are ABSOLUTE byte offsets into src
+// (the caller uses src itself as the packed buffer). Returns the number of
+// runs, or -1 on corrupt input, or -2 if max_runs is too small.
+ssize_t ptq_prescan_hybrid(const uint8_t* src, size_t src_len, int64_t num_values,
+                           int width, uint8_t* is_rle, int64_t* counts,
+                           uint64_t* values, int64_t* bp_offsets,
+                           size_t max_runs, int64_t* consumed) {
+  if (width < 0 || width > 64) return -1;
+  const size_t vbytes = (width + 7) / 8;
+  size_t pos = 0;
+  int64_t produced = 0;
+  size_t runs = 0;
+  while (produced < num_values) {
+    uint64_t header = 0;
+    int shift = 0;
+    for (;;) {
+      if (pos >= src_len || shift > 63) return -1;
+      uint8_t b = src[pos++];
+      header |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    if (runs >= max_runs) return -2;
+    if (header & 1) {
+      uint64_t groups = header >> 1;
+      // overflow guards before any multiply (the Python fallback rejects these
+      // via arbitrary-precision arithmetic; keep parity)
+      if (groups == 0 || groups > (1ull << 40)) return -1;
+      uint64_t count = groups * 8;
+      uint64_t nbytes = groups * static_cast<uint64_t>(width);
+      if (pos + nbytes > src_len) return -1;
+      is_rle[runs] = 0;
+      counts[runs] = static_cast<int64_t>(count);
+      values[runs] = 0;
+      bp_offsets[runs] = static_cast<int64_t>(pos);
+      pos += nbytes;
+      produced += static_cast<int64_t>(count);
+    } else {
+      uint64_t count = header >> 1;
+      if (count == 0 || count > (1ull << 40) || pos + vbytes > src_len) return -1;
+      uint64_t v = 0;
+      for (size_t i = 0; i < vbytes; i++) v |= static_cast<uint64_t>(src[pos + i]) << (8 * i);
+      if (width < 64 && v >= (1ull << width)) return -1;
+      pos += vbytes;
+      is_rle[runs] = 1;
+      counts[runs] = static_cast<int64_t>(count);
+      values[runs] = v;
+      bp_offsets[runs] = 0;
+      produced += static_cast<int64_t>(count);
+    }
+    runs++;
+  }
+  *consumed = static_cast<int64_t>(pos);
+  return static_cast<ssize_t>(runs);
+}
+
+}  // extern "C"

@@ -149,7 +149,12 @@ def decompress_block(data: bytes, codec, uncompressed_size: int) -> bytes:
     (reference: compress.go:107-120)."""
     if uncompressed_size < 0:
         raise CompressionError(f"invalid uncompressed size {uncompressed_size}")
-    out = _get(codec).decompress(data, uncompressed_size)
+    try:
+        out = _get(codec).decompress(data, uncompressed_size)
+    except CompressionError:
+        raise
+    except Exception as e:
+        raise CompressionError(f"decompression failed: {e}") from e
     if len(out) != uncompressed_size:
         raise CompressionError(
             f"decompressed size {len(out)} != advertised {uncompressed_size}"

@@ -75,8 +75,17 @@ def decode_plain(data, num_values: int, ptype: Type, type_length: int | None = N
 
 def _decode_plain_byte_array(buf: memoryview, num_values: int):
     # Inline 4-byte LE length before each value (reference: type_bytearray.go:24-45).
-    # The offset chain is data-dependent; this scalar walk is the part the native
-    # C++ helper accelerates (native/).
+    # The offset chain is data-dependent; the native C++ helper does the walk at
+    # memcpy speed, with a pure-Python fallback.
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is not None and lib.has_byte_array_scan and num_values > 0:
+        try:
+            offsets, flat, consumed = lib.byte_array_gather(bytes(buf), num_values)
+        except ValueError as e:
+            raise PlainError(str(e)) from e
+        return ByteArrayData(offsets=offsets, data=flat), consumed
     end = len(buf)
     offsets = np.empty(num_values + 1, dtype=np.int64)
     offsets[0] = 0

@@ -78,6 +78,37 @@ def prescan_hybrid(data, num_values: int, width: int) -> RunTable:
     """
     if width < 0 or width > 64:
         raise HybridError(f"hybrid: invalid bit width {width}")
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is not None and lib.has_prescan_hybrid and num_values > 0:
+        raw = bytes(data)
+        try:
+            is_rle, counts, values, offsets, consumed = lib.prescan_hybrid(
+                raw, num_values, width
+            )
+        except ValueError as e:
+            raise HybridError(f"hybrid: {e}") from e
+        # Compact the packed buffer to just the bit-packed payloads so device
+        # buffers sized by len(packed) don't scale with RLE-heavy streams.
+        parts = []
+        new_offsets = np.zeros(len(counts), dtype=np.int64)
+        packed_len = 0
+        for i in range(len(counts)):
+            if not is_rle[i]:
+                nbytes = (int(counts[i]) // 8) * width
+                off = int(offsets[i])
+                parts.append(raw[off : off + nbytes])
+                new_offsets[i] = packed_len
+                packed_len += nbytes
+        return RunTable(
+            is_rle=is_rle,
+            counts=counts,
+            rle_values=values,
+            bp_offsets=new_offsets,
+            packed=b"".join(parts),
+            consumed=consumed,
+        )
     buf = memoryview(data) if not isinstance(data, memoryview) else data
     end = len(buf)
     vbytes = (width + 7) // 8

@@ -38,14 +38,31 @@ class NativeLib:
                 ctypes.c_char_p,
                 ctypes.c_size_t,
             ]
-        self.has_byte_array_scan = hasattr(lib, "ptq_scan_byte_array_offsets")
+        self.has_byte_array_scan = hasattr(lib, "ptq_byte_array_gather")
         if self.has_byte_array_scan:
-            lib.ptq_scan_byte_array_offsets.restype = ctypes.c_ssize_t
-            lib.ptq_scan_byte_array_offsets.argtypes = [
+            lib.ptq_byte_array_gather.restype = ctypes.c_ssize_t
+            lib.ptq_byte_array_gather.argtypes = [
                 ctypes.c_char_p,
                 ctypes.c_size_t,
                 ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
+        self.has_prescan_hybrid = hasattr(lib, "ptq_prescan_hybrid")
+        if self.has_prescan_hybrid:
+            lib.ptq_prescan_hybrid.restype = ctypes.c_ssize_t
+            lib.ptq_prescan_hybrid.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
             ]
 
     def snappy_compress(self, data: bytes) -> bytes:
@@ -62,6 +79,64 @@ class NativeLib:
         if n < 0:
             raise ValueError("native snappy: corrupt input")
         return out.raw[:n]
+
+    def byte_array_gather(self, data: bytes, num_values: int):
+        """PLAIN byte_array scan: returns (offsets int64[n+1], flat bytes, consumed)."""
+        import numpy as np
+
+        offsets = np.empty(num_values + 1, dtype=np.int64)
+        out = ctypes.create_string_buffer(max(len(data), 1))
+        consumed = self._lib.ptq_byte_array_gather(
+            data,
+            len(data),
+            num_values,
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            out,
+            len(data),
+        )
+        if consumed < 0:
+            raise ValueError("native: corrupt byte_array stream")
+        # single copy of exactly the payload (out.raw would copy the whole cap)
+        flat = ctypes.string_at(out, int(offsets[-1]))
+        return offsets, flat, int(consumed)
+
+    def prescan_hybrid(self, data: bytes, num_values: int, width: int):
+        """Run-header prescan: returns (is_rle, counts, values, bp_offsets, consumed)
+        with bp_offsets absolute into `data`, or None if the run table overflows."""
+        import numpy as np
+
+        max_runs = 4096
+        while True:
+            is_rle = np.empty(max_runs, dtype=np.uint8)
+            counts = np.empty(max_runs, dtype=np.int64)
+            values = np.empty(max_runs, dtype=np.uint64)
+            offsets = np.empty(max_runs, dtype=np.int64)
+            consumed = np.zeros(1, dtype=np.int64)
+            n = self._lib.ptq_prescan_hybrid(
+                data,
+                len(data),
+                num_values,
+                width,
+                is_rle.ctypes.data_as(ctypes.c_void_p),
+                counts.ctypes.data_as(ctypes.c_void_p),
+                values.ctypes.data_as(ctypes.c_void_p),
+                offsets.ctypes.data_as(ctypes.c_void_p),
+                max_runs,
+                consumed.ctypes.data_as(ctypes.c_void_p),
+            )
+            if n == -2:
+                max_runs *= 8
+                continue
+            if n < 0:
+                raise ValueError("native: corrupt hybrid stream")
+            n = int(n)
+            return (
+                is_rle[:n].astype(bool),
+                counts[:n],
+                values[:n],
+                offsets[:n],
+                int(consumed[0]),
+            )
 
 
 def get_native() -> NativeLib | None:
