@@ -110,12 +110,55 @@ def timed(fn, repeats: int) -> float:
     return best
 
 
+def _device_ready(timeout_s: float = 240.0) -> bool:
+    """Probe the accelerator with a tiny jit under a watchdog; the axon tunnel
+    can wedge (observed), and a hung bench is worse than a host-only result."""
+    import threading
+
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            # jax.devices() itself initializes the backend and can wedge —
+            # keep every backend-touching call inside the watchdogged thread.
+            result["platform"] = jax.devices()[0].platform
+            r = jax.jit(lambda x: x * 2)(jnp.arange(128, dtype=jnp.int32))
+            r.block_until_ready()
+            result["ok"] = True
+        except Exception as e:  # pragma: no cover
+            result["err"] = str(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if result.get("ok"):
+        return True
+    log(f"bench: device probe failed ({result.get('err', 'timed out')})")
+    return False
+
+
 def main() -> None:
     path = build_file()
-    import jax
-
-    platform = jax.devices()[0].platform
-    log(f"bench: jax default platform = {platform}")
+    if not _device_ready():
+        log("bench: accelerator unavailable — reporting host path only")
+        t_host = timed(lambda: decode_all(path, "host"), REPEATS)
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        "rows/sec decoded, NYC-taxi-like file (int64 + dict-string "
+                        "+ delta-ts cols), HOST fallback (accelerator unreachable)"
+                    ),
+                    "value": round(ROWS / t_host, 1),
+                    "unit": "rows/s",
+                    "vs_baseline": 1.0,
+                }
+            )
+        )
+        return
 
     # warmup (compile) + verification
     log("bench: warmup + parity check")

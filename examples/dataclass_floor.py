@@ -1,0 +1,23 @@
+"""High-level dataclass mapping (the analogue of the reference's floor examples)."""
+
+import datetime as dt
+from dataclasses import dataclass
+from typing import Optional
+
+from parquet_tpu import floor
+
+
+@dataclass
+class Trip:
+    id: int
+    vendor: Optional[str]
+    ts: dt.datetime
+    tags: list[str]
+
+
+with floor.Writer("trips.parquet", Trip, codec="snappy") as w:
+    w.write(Trip(1, "CMT", dt.datetime.now(dt.timezone.utc), ["fast"]))
+    w.write(Trip(2, None, dt.datetime.now(dt.timezone.utc), []))
+
+for trip in floor.Reader("trips.parquet", Trip):
+    print(trip)

@@ -1,0 +1,15 @@
+"""Low-level columnar read (the analogue of the reference's
+examples/read-low-level): open a file, walk row groups, get typed arrays."""
+
+import sys
+
+import parquet_tpu as pq
+
+path = sys.argv[1] if len(sys.argv) > 1 else "example.parquet"
+with pq.FileReader(path) as r:  # backend="tpu" for device decode
+    print(f"{r.num_rows} rows, {r.num_row_groups} row groups")
+    for i in range(r.num_row_groups):
+        chunks = r.read_row_group(i)
+        for col_path, chunk in chunks.items():
+            print(f"  rg{i} {'.'.join(col_path)}: {len(chunk.values)} values, "
+                  f"{type(chunk.values).__name__}")

@@ -1,0 +1,21 @@
+"""Low-level write with the schema DSL (the analogue of the reference's
+examples/write-low-level)."""
+
+import parquet_tpu as pq
+
+schema = pq.parse_schema("""
+message example {
+  required int64 id;
+  optional binary name (STRING);
+  optional group scores (LIST) {
+    repeated group list {
+      optional double element;
+    }
+  }
+}
+""")
+
+with pq.FileWriter("example.parquet", schema, codec="snappy") as w:
+    w.write_row({"id": 1, "name": "alice", "scores": [9.5, 8.0]})
+    w.write_row({"id": 2, "name": None, "scores": []})
+print("wrote example.parquet")

@@ -4,22 +4,47 @@ A brand-new implementation of the capability set of fraugster/parquet-go
 (see SURVEY.md), designed TPU-first: file I/O, Thrift metadata, block
 decompression, and record assembly run on the host; the column-decode hot path
 (RLE/bit-packing hybrid, dictionary lookup, delta-binary-packed) runs as batched
-JAX/Pallas kernels behind a pluggable decoder backend.
+JAX/Pallas kernels behind a pluggable decoder backend
+(FileReader(..., backend="tpu")).
+
+Quick start:
+
+    import parquet_tpu as pq
+
+    # read
+    with pq.FileReader("f.parquet") as r:           # or backend="tpu"
+        cols = r.read_row_group(0)                  # columnar arrays
+        rows = list(r.iter_rows())                  # assembled records
+
+    # write
+    schema = pq.parse_schema("message m { required int64 id; }")
+    with pq.FileWriter("out.parquet", schema, codec="snappy") as w:
+        w.write_row({"id": 1})
+
+    # high-level dataclass mapping
+    from parquet_tpu import floor
 
 Layout:
   meta/      Thrift compact protocol + parquet-format metadata model
   ops/       host (NumPy-vectorized) encoders/decoders — the correctness oracle
-  kernels/   Pallas TPU kernels + the batched page-decode pipeline
+  kernels/   device (JAX/XLA + Pallas) decode ops + the batched page pipeline
   core/      pages, chunks, column stores, schema tree, FileReader/FileWriter
-  schema/    textual schema DSL (parser/validator) + autoschema from dataclasses
-  floor/     high-level record marshal/unmarshal (the reference's floor analogue)
+  schema/    textual schema DSL (parser/printer/validator) + builder API
+  floor/     high-level record marshal/unmarshal + dataclass autoschema
   parallel/  shard_map/mesh scale-out over pages, columns, and row groups
-  tools/     parquet-tool and csv2parquet CLI equivalents
-  utils/     shared helpers (varints, buffered IO, hashing)
+  tools/     parquet-tool and csv2parquet CLIs
+  utils/     native C++ helpers (snappy, scans), varints, INT96 time
+  native/    the C++ helper library (build with `make -C native`)
 """
 
 __version__ = "0.1.0"
 
+from .core.reader import FileReader  # noqa: F401
+from .core.writer import FileWriter, WriterError  # noqa: F401
+from .core.schema import Column, Schema, SchemaError  # noqa: F401
+from .core.arrays import ByteArrayData  # noqa: F401
+from .core.alloc import AllocError  # noqa: F401
+from .core.compress import register_codec, CompressionError  # noqa: F401
 from .meta import (  # noqa: F401
     CompressionCodec,
     ConvertedType,
@@ -27,6 +52,16 @@ from .meta import (  # noqa: F401
     FieldRepetitionType,
     LogicalType,
     PageType,
+    ParquetFileError,
     Type,
     read_file_metadata,
 )
+from .schema.dsl import (  # noqa: F401
+    SchemaParseError,
+    parse_schema,
+    schema_to_string,
+    validate,
+    validate_strict,
+)
+from .schema import builder  # noqa: F401
+from . import floor  # noqa: F401

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..meta.parquet_types import ConvertedType, FieldRepetitionType
+from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
+from .arrays import ByteArrayData
 from .chunk import ChunkData
 from .schema import Column, Schema
 
@@ -58,6 +59,91 @@ class _LeafCursor:
         self.vpos += 1
         self.pos += 1
         return self.chunk.values[i]
+
+
+def fast_flat_rows(chunks: dict[tuple, ChunkData], raw: bool):
+    """Vectorized row assembly for flat schemas (no groups, no repetition).
+
+    The recursive assembler costs ~14 us/row in Python; for the common flat
+    case rows are just per-column null-expansion + zip, which runs at C speed
+    via ndarray.tolist(). Returns None when the shape needs the full Dremel
+    walk.
+    """
+    cols = []
+    for path, chunk in chunks.items():
+        node = chunk.column
+        if len(path) != 1 or not node.is_leaf or node.max_rep > 0 or node.max_def > 1:
+            return None
+        cols.append((node, chunk))
+    n = None
+    for _node, chunk in cols:
+        if n is None:
+            n = chunk.num_values
+        elif n != chunk.num_values:
+            return None
+    if n is None:
+        return []
+    columns_as_lists = []
+    for node, chunk in cols:
+        v = chunk.values
+        if isinstance(v, ByteArrayData):
+            vals = v.to_list()
+            if not raw and node.is_string():
+                vals = [b.decode("utf-8", errors="replace") for b in vals]
+        else:
+            arr = np.asarray(v)
+            if arr.ndim == 2:  # int96 / fixed rows -> bytes
+                vals = [r.tobytes() for r in arr]
+            else:
+                vals = arr.tolist()
+        if not raw and logical_kind(node) is not None:
+            conv = convert_logical
+            vals = [conv(node, x) for x in vals]
+        if node.max_def == 1 and chunk.def_levels is not None:
+            mask = chunk.def_levels == 1
+            full = [None] * n
+            it = iter(vals)
+            for idx in np.nonzero(mask)[0]:
+                full[idx] = next(it)
+            vals = full
+        columns_as_lists.append((node.name, vals))
+    names = [name for name, _ in columns_as_lists]
+    return [
+        dict(zip(names, row)) for row in zip(*(vals for _, vals in columns_as_lists))
+    ]
+
+
+def logical_kind(node: Column):
+    """The single dispatch point for value-level logical conversions.
+
+    Returns one of None | 'int96' | 'decimal' | 'date' | ('timestamp', unit,
+    utc) | ('time', unit). Both convert_logical and the flat fast path consult
+    this, so a new conversion cannot silently diverge between the two paths.
+    """
+    ct = node.converted_type
+    lt = node.logical_type
+    if node.type == Type.INT96:
+        return "int96"
+    if ct == ConvertedType.DECIMAL or (lt is not None and lt.DECIMAL is not None):
+        return "decimal"
+    if ct == ConvertedType.DATE or (lt is not None and lt.DATE is not None):
+        return "date"
+    if lt is not None and lt.TIMESTAMP is not None:
+        u = lt.TIMESTAMP.unit
+        return ("timestamp", u.unit_name() if u is not None else "MICROS",
+                bool(lt.TIMESTAMP.isAdjustedToUTC))
+    if ct == ConvertedType.TIMESTAMP_MILLIS:
+        return ("timestamp", "MILLIS", True)
+    if ct == ConvertedType.TIMESTAMP_MICROS:
+        return ("timestamp", "MICROS", True)
+    if lt is not None and lt.TIME is not None:
+        u = lt.TIME.unit
+        return ("time", u.unit_name() if u is not None else "MICROS")
+    if ct == ConvertedType.TIME_MILLIS:
+        return ("time", "MILLIS")
+    if ct == ConvertedType.TIME_MICROS:
+        return ("time", "MICROS")
+    return None
 
 
 class RecordAssembler:
@@ -249,13 +335,66 @@ class RecordAssembler:
             if isinstance(v, np.ndarray):  # int96 / fixed rows
                 return v.tobytes()
             return v
-        if isinstance(v, bytes) and node.is_string():
-            return v.decode("utf-8", errors="replace")
-        if isinstance(v, np.generic):
-            return v.item()
-        if isinstance(v, np.ndarray):
-            return v.tobytes()
+        return convert_logical(node, v)
+
+
+def _to_micros(v: int, unit: str) -> int:
+    if unit == "MILLIS":
+        return v * 1000
+    if unit == "NANOS":
+        return v // 1000
+    return v
+
+
+def convert_logical(node: Column, v):
+    """Storage value -> ergonomic Python value by logical type, matching
+    pyarrow's to_pylist() conventions (DATE -> date, TIMESTAMP -> datetime,
+    TIME -> time, DECIMAL -> Decimal, INT96 -> datetime, UTF8 -> str).
+    Dispatch comes from logical_kind() — the shared table with the flat path."""
+    import datetime as dt
+    import decimal
+
+    if isinstance(v, bytes) and node.is_string():
+        return v.decode("utf-8", errors="replace")
+    kind = logical_kind(node)
+    if kind == "int96" and isinstance(v, (np.ndarray, bytes)):
+        from ..utils.int96 import int96_to_datetime
+
+        return int96_to_datetime(bytes(v))
+    if isinstance(v, np.ndarray):
+        v = v.tobytes()
+    if isinstance(v, np.generic):
+        v = v.item()
+    if kind is None:
         return v
+    if kind == "decimal":
+        lt = node.logical_type
+        scale = node.element.scale
+        if scale is None and lt is not None and lt.DECIMAL is not None:
+            scale = lt.DECIMAL.scale
+        scale = scale or 0
+        if isinstance(v, bytes):
+            unscaled = int.from_bytes(v, "big", signed=True) if v else 0
+        else:
+            unscaled = int(v)
+        return decimal.Decimal(unscaled).scaleb(-scale)
+    if kind == "date":
+        return dt.date(1970, 1, 1) + dt.timedelta(days=int(v))
+    if kind[0] == "timestamp":
+        _, unit, utc = kind
+        tz = dt.timezone.utc if utc else None
+        return dt.datetime(1970, 1, 1, tzinfo=tz) + dt.timedelta(
+            microseconds=_to_micros(int(v), unit)
+        )
+    if kind[0] == "time":
+        micros = _to_micros(int(v), kind[1])
+        return dt.time(
+            hour=micros // 3_600_000_000,
+            minute=(micros // 60_000_000) % 60,
+            second=(micros // 1_000_000) % 60,
+            microsecond=micros % 1_000_000,
+        )
+    return v
 
 
 class _Absent:

@@ -26,6 +26,7 @@ from ..meta.parquet_types import (
     PageType,
 )
 from ..meta.thrift import CompactReader, ThriftError
+from ..utils.trace import stage
 from .arrays import ByteArrayData
 from .compress import decompress_block
 from .page import (
@@ -128,7 +129,8 @@ def iter_chunk_pages(f, chunk: ColumnChunk):
         size = header.compressed_page_size
         if size is None or size < 0:
             raise ChunkError(f"chunk: invalid compressed page size {size}")
-        payload = f.read(size)
+        with stage("io", size):
+            payload = f.read(size)
         if len(payload) != size:
             raise ChunkError("chunk: truncated page payload")
         yield RawPage(header=header, payload=payload, offset=page_start)
@@ -179,11 +181,13 @@ def read_chunk(
         elif ptype == int(PageType.DATA_PAGE):
             if validate_crc:
                 _check_crc(header, raw.payload)
-            block = decompress_block(
-                raw.payload, codec, header.uncompressed_page_size or 0
-            )
+            with stage("decompress", len(raw.payload)):
+                block = decompress_block(
+                    raw.payload, codec, header.uncompressed_page_size or 0
+                )
             dict_size = len(dictionary) if dictionary is not None else None
-            page = decode_data_page_v1(header, block, column, dict_size)
+            with stage("decode", len(block)):
+                page = decode_data_page_v1(header, block, column, dict_size)
             page.materialize(dictionary)
             pages.append(page)
             seen_data_values += page.num_values
@@ -191,7 +195,8 @@ def read_chunk(
             if validate_crc:
                 _check_crc(header, raw.payload)
             dict_size = len(dictionary) if dictionary is not None else None
-            page = decode_data_page_v2(header, raw.payload, column, dict_size, codec)
+            with stage("decode", header.uncompressed_page_size or 0):
+                page = decode_data_page_v2(header, raw.payload, column, dict_size, codec)
             page.materialize(dictionary)
             pages.append(page)
             seen_data_values += page.num_values

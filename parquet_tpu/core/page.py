@@ -42,6 +42,7 @@ from ..ops.levels import (
 from .arrays import ByteArrayData
 from .compress import compress_block, decompress_block
 from .schema import Column
+from ..utils.trace import stage
 
 __all__ = ["DecodedPage", "PageError", "decode_data_page_v1", "decode_data_page_v2",
            "decode_dict_page", "encode_data_page_v1", "encode_data_page_v2",
@@ -191,7 +192,8 @@ def decode_data_page_v2(
     values_block = bytes(buf[rep_len + def_len :])
     if h.is_compressed is None or h.is_compressed:
         uncompressed = (header.uncompressed_page_size or 0) - rep_len - def_len
-        values_block = decompress_block(values_block, codec, max(uncompressed, 0))
+        with stage("decompress", len(values_block)):
+            values_block = decompress_block(values_block, codec, max(uncompressed, 0))
     values, indices = _decode_values(values_block, non_null, h.encoding, column, dict_size)
     return DecodedPage(
         num_values=n, def_levels=dfl, rep_levels=rep, values=values, indices=indices

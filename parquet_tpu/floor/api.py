@@ -181,11 +181,18 @@ def _from_storage(hint, v):
         return {k: _from_storage(vh, x) if vh else x for k, x in v.items()}
     if dataclasses.is_dataclass(hint):
         return _build(hint, v)
+    # The assembler's ergonomic mode may already have produced rich values.
     if hint is dt.datetime:
+        if isinstance(v, dt.datetime):
+            return v
         return _EPOCH_DT + dt.timedelta(microseconds=int(v))
     if hint is dt.date:
+        if isinstance(v, dt.date):
+            return v
         return _EPOCH_DATE + dt.timedelta(days=int(v))
     if hint is dt.time:
+        if isinstance(v, dt.time):
+            return v
         micros = int(v)
         return dt.time(
             hour=micros // 3_600_000_000,

@@ -1,0 +1,97 @@
+"""Per-stage decode instrumentation + JAX profiler integration.
+
+The reference has no observability at all (SURVEY §5: 'no pprof hooks, no
+timing instrumentation'); this module adds the per-stage counters the survey
+calls for. Zero overhead when no trace is active (one global check).
+
+    from parquet_tpu.utils.trace import decode_trace
+
+    with decode_trace() as t:
+        reader.read_row_group(0)
+    print(t.report())        # per-stage wall time + bytes
+
+    with jax_profile("/tmp/trace"):   # wraps jax.profiler.trace
+        reader.read_row_group(0)      # inspect with TensorBoard/XProf
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+__all__ = ["decode_trace", "stage", "add_bytes", "jax_profile", "DecodeTrace"]
+
+_active: "DecodeTrace | None" = None
+
+
+@dataclass
+class StageStats:
+    seconds: float = 0.0
+    bytes: int = 0
+    calls: int = 0
+
+
+@dataclass
+class DecodeTrace:
+    stages: dict = field(default_factory=dict)
+
+    def _stat(self, name: str) -> StageStats:
+        s = self.stages.get(name)
+        if s is None:
+            s = self.stages[name] = StageStats()
+        return s
+
+    def report(self) -> str:
+        lines = []
+        for name, s in sorted(self.stages.items()):
+            rate = f" ({s.bytes / s.seconds / 1e6:.0f} MB/s)" if s.seconds > 0 and s.bytes else ""
+            lines.append(
+                f"{name:12s} {s.seconds * 1000:8.1f} ms  {s.bytes:>12,} B  "
+                f"{s.calls:>6} calls{rate}"
+            )
+        return "\n".join(lines)
+
+
+@contextmanager
+def decode_trace():
+    """Activate stage collection for the enclosed reads."""
+    global _active
+    prev = _active
+    t = DecodeTrace()
+    _active = t
+    try:
+        yield t
+    finally:
+        _active = prev
+
+
+@contextmanager
+def stage(name: str, nbytes: int = 0):
+    """Time a pipeline stage (no-op when no trace is active)."""
+    t = _active  # capture: the trace may deactivate concurrently
+    if t is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        s = t._stat(name)
+        s.seconds += time.perf_counter() - t0
+        s.bytes += nbytes
+        s.calls += 1
+
+
+def add_bytes(name: str, nbytes: int) -> None:
+    if _active is not None:
+        _active._stat(name).bytes += nbytes
+
+
+@contextmanager
+def jax_profile(logdir: str):
+    """Capture a JAX/XLA device trace for the enclosed block."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
