@@ -258,6 +258,7 @@ ssize_t ptq_prescan_hybrid(const uint8_t* src, size_t src_len, int64_t num_value
     for (;;) {
       if (pos >= src_len || shift > 63) return -1;
       uint8_t b = src[pos++];
+      if (shift == 63 && (b & 0x7e)) return -1;  // overflows uint64
       header |= static_cast<uint64_t>(b & 0x7f) << shift;
       if (!(b & 0x80)) break;
       shift += 7;
@@ -294,6 +295,252 @@ ssize_t ptq_prescan_hybrid(const uint8_t* src, size_t src_len, int64_t num_value
   }
   *consumed = static_cast<int64_t>(pos);
   return static_cast<ssize_t>(runs);
+}
+
+// ---------------------------------------------------------------------------
+// bit-stream reader (LSB-first, parquet bit-packed order)
+// ---------------------------------------------------------------------------
+
+struct BitReader {
+  const uint8_t* src;
+  size_t len;
+  size_t pos;     // next byte
+  uint64_t buf;   // pending bits, LSB first
+  int bits;       // number of pending bits
+};
+
+static inline void br_init(BitReader* r, const uint8_t* src, size_t len) {
+  r->src = src; r->len = len; r->pos = 0; r->buf = 0; r->bits = 0;
+}
+
+// Reads `w` bits (0 <= w <= 64). Caller guarantees the underlying payload is
+// in bounds (all call sites bounds-check the whole run/miniblock first).
+static inline uint64_t br_read(BitReader* r, int w) {
+  uint64_t v = 0;
+  int got = 0;
+  while (got < w) {
+    if (r->bits == 0) {
+      r->buf = r->src[r->pos++];
+      r->bits = 8;
+    }
+    int take = w - got;
+    if (take > r->bits) take = r->bits;
+    v |= (r->buf & ((take == 64) ? ~0ull : ((1ull << take) - 1))) << got;
+    r->buf >>= take;
+    r->bits -= take;
+    got += take;
+  }
+  return v;
+}
+
+// ---------------------------------------------------------------------------
+// one-shot hybrid RLE/bit-pack decode (prescan + expand fused, host hot path)
+// ---------------------------------------------------------------------------
+
+// Decodes `num_values` into out32 or out64 (exactly one non-null). Returns
+// bytes consumed, or -1 on corrupt input. Semantics mirror prescan_hybrid +
+// expand_runs in ops/rle_hybrid.py (the NumPy reference implementation).
+ssize_t ptq_hybrid_decode(const uint8_t* src, size_t src_len, int64_t num_values,
+                          int width, uint32_t* out32, uint64_t* out64) {
+  if (width < 0 || width > 64) return -1;
+  if (width > 32 && out32) return -1;
+  const size_t vbytes = (width + 7) / 8;
+  size_t pos = 0;
+  int64_t produced = 0;
+  while (produced < num_values) {
+    uint64_t header = 0;
+    int shift = 0;
+    for (;;) {
+      if (pos >= src_len || shift > 63) return -1;
+      uint8_t b = src[pos++];
+      if (shift == 63 && (b & 0x7e)) return -1;  // overflows uint64
+      header |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    if (header & 1) {
+      uint64_t groups = header >> 1;
+      if (groups == 0 || groups > (1ull << 40)) return -1;
+      uint64_t count = groups * 8;
+      uint64_t nbytes = groups * static_cast<uint64_t>(width);
+      if (pos + nbytes > src_len) return -1;
+      int64_t take = num_values - produced;
+      if (static_cast<uint64_t>(take) > count) take = static_cast<int64_t>(count);
+      BitReader r;
+      br_init(&r, src + pos, nbytes);
+      if (out32) {
+        for (int64_t i = 0; i < take; i++) out32[produced + i] = static_cast<uint32_t>(br_read(&r, width));
+      } else {
+        for (int64_t i = 0; i < take; i++) out64[produced + i] = br_read(&r, width);
+      }
+      pos += nbytes;
+      produced += take;
+    } else {
+      uint64_t count = header >> 1;
+      if (count == 0 || count > (1ull << 40) || pos + vbytes > src_len) return -1;
+      uint64_t v = 0;
+      for (size_t i = 0; i < vbytes; i++) v |= static_cast<uint64_t>(src[pos + i]) << (8 * i);
+      if (width < 64 && v >= (1ull << width)) return -1;
+      pos += vbytes;
+      int64_t take = num_values - produced;
+      if (static_cast<uint64_t>(take) > count) take = static_cast<int64_t>(count);
+      if (out32) {
+        uint32_t v32 = static_cast<uint32_t>(v);
+        for (int64_t i = 0; i < take; i++) out32[produced + i] = v32;
+      } else {
+        for (int64_t i = 0; i < take; i++) out64[produced + i] = v;
+      }
+      produced += take;
+    }
+  }
+  return static_cast<ssize_t>(pos);
+}
+
+// ---------------------------------------------------------------------------
+// DELTA_BINARY_PACKED decode (header walk + miniblock unpack + wrapping cumsum)
+// ---------------------------------------------------------------------------
+
+static inline bool read_uvarint64(const uint8_t* src, size_t src_len, size_t* pos,
+                                  uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (;;) {
+    if (*pos >= src_len || shift > 63) return false;
+    uint8_t b = src[(*pos)++];
+    if (shift == 63 && (b & 0x7e)) return false;  // overflows uint64
+    v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  *out = v;
+  return true;
+}
+
+// Full decode of a DELTA_BINARY_PACKED stream into out (int32 when nbits==32,
+// int64 when nbits==64; the buffer must hold the header's value count, which
+// is bounded by max_total). Returns bytes consumed, -1 on corrupt input, -3
+// if the stream's count exceeds max_total (validation-before-allocation: the
+// caller probes the count first via ptq_delta_peek_total).
+// Semantics mirror ops/delta.py prescan_delta + decode_delta exactly,
+// including wrapping min-delta arithmetic (reference: deltabp_encoder.go:58-61)
+// and trailing-miniblock payload rules (reference: deltabp_decoder.go flush()).
+ssize_t ptq_delta_decode(const uint8_t* src, size_t src_len, int nbits,
+                         int64_t max_total, void* out_v, int64_t* total_out) {
+  if (nbits != 32 && nbits != 64) return -1;
+  size_t pos = 0;
+  uint64_t block_size, mini_count, total_u;
+  if (!read_uvarint64(src, src_len, &pos, &block_size)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &mini_count)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &total_u)) return -1;
+  uint64_t first_zz;
+  if (!read_uvarint64(src, src_len, &pos, &first_zz)) return -1;
+  uint64_t first = (first_zz >> 1) ^ (~(first_zz & 1) + 1);  // zigzag decode
+  if (block_size == 0 || block_size % 128 != 0 || block_size > (1ull << 20)) return -1;
+  if (mini_count == 0 || mini_count > 512 || block_size % mini_count != 0) return -1;
+  uint64_t mini_len = block_size / mini_count;
+  if (mini_len % 8 != 0) return -1;
+  int64_t total = static_cast<int64_t>(total_u);
+  if (total_u > (1ull << 62)) return -1;
+  if (max_total >= 0 && total > max_total) return -3;
+  // plausibility backstop (parity with prescan_delta)
+  uint64_t plausible = 1 + (src_len / (1 + mini_count) + 1) * block_size;
+  if (total_u > plausible) return -3;
+  *total_out = total;
+
+  const uint64_t mask = (nbits == 64) ? ~0ull : ((1ull << nbits) - 1);
+  int32_t* out32 = (nbits == 32) ? static_cast<int32_t*>(out_v) : nullptr;
+  int64_t* out64 = (nbits == 64) ? static_cast<int64_t*>(out_v) : nullptr;
+  uint64_t acc = first & mask;
+  if (total > 0) {
+    if (out32) out32[0] = static_cast<int32_t>(static_cast<uint32_t>(acc));
+    else out64[0] = static_cast<int64_t>(acc);
+  }
+  int64_t n_deltas = total > 1 ? total - 1 : 0;
+  int64_t produced = 0;
+  while (produced < n_deltas) {
+    uint64_t md_zz;
+    if (!read_uvarint64(src, src_len, &pos, &md_zz)) return -1;
+    uint64_t min_delta = (md_zz >> 1) ^ (~(md_zz & 1) + 1);
+    if (pos + mini_count > src_len) return -1;
+    const uint8_t* widths = src + pos;
+    pos += mini_count;
+    for (uint64_t m = 0; m < mini_count; m++) {
+      int64_t remaining = n_deltas - produced;
+      if (remaining <= 0) continue;  // unused trailing miniblock: no payload
+      int w = widths[m];
+      if (w > nbits) return -1;
+      uint64_t payload = (mini_len / 8) * static_cast<uint64_t>(w);
+      if (pos + payload > src_len) return -1;
+      int64_t take = remaining < static_cast<int64_t>(mini_len)
+                         ? remaining : static_cast<int64_t>(mini_len);
+      BitReader r;
+      br_init(&r, src + pos, payload);
+      if (out32) {
+        uint32_t a = static_cast<uint32_t>(acc);
+        uint32_t md32 = static_cast<uint32_t>(min_delta);
+        for (int64_t i = 0; i < take; i++) {
+          a += static_cast<uint32_t>(br_read(&r, w)) + md32;
+          out32[produced + 1 + i] = static_cast<int32_t>(a);
+        }
+        acc = a;
+      } else {
+        uint64_t a = acc;
+        for (int64_t i = 0; i < take; i++) {
+          a += br_read(&r, w) + min_delta;
+          out64[produced + 1 + i] = static_cast<int64_t>(a);
+        }
+        acc = a;
+      }
+      pos += payload;
+      produced += take;
+    }
+  }
+  return static_cast<ssize_t>(pos);
+}
+
+// Header probe for pre-allocation: validates the full header (same rules as
+// ptq_delta_decode, including the plausibility backstop that bounds the value
+// count by the stream length — validation-before-allocation) and returns the
+// value count. Returns 0 on success, -1 on corrupt/implausible header.
+ssize_t ptq_delta_peek_total(const uint8_t* src, size_t src_len, int64_t* total) {
+  size_t pos = 0;
+  uint64_t bs, mc, t, fz;
+  if (!read_uvarint64(src, src_len, &pos, &bs)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &mc)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &t)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &fz)) return -1;
+  if (bs == 0 || bs % 128 != 0 || bs > (1ull << 20)) return -1;
+  if (mc == 0 || mc > 512 || bs % mc != 0) return -1;
+  if ((bs / mc) % 8 != 0) return -1;
+  if (t > (1ull << 62)) return -1;
+  uint64_t plausible = 1 + (src_len / (1 + mc) + 1) * bs;
+  if (t > plausible) return -1;
+  *total = static_cast<int64_t>(t);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// byte-array dictionary gather (ByteArrayData.take hot path)
+// ---------------------------------------------------------------------------
+
+// out must hold sum of the gathered lengths (caller computes via new_offsets,
+// which it builds with a NumPy cumsum). Returns 0, or -1 on a bad index.
+ssize_t ptq_bytearray_take(const char* data, size_t data_len,
+                           const int64_t* offsets, int64_t n_src,
+                           const int64_t* indices, int64_t n_idx,
+                           const int64_t* new_offsets, char* out, size_t out_cap) {
+  for (int64_t k = 0; k < n_idx; k++) {
+    int64_t i = indices[k];
+    if (i < 0 || i >= n_src) return -1;
+    int64_t start = offsets[i];
+    int64_t len = offsets[i + 1] - start;
+    int64_t dst = new_offsets[k];
+    if (start < 0 || len < 0 || static_cast<size_t>(start + len) > data_len ||
+        static_cast<size_t>(dst + len) > out_cap)
+      return -1;
+    std::memcpy(out + dst, data + start, len);
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -52,6 +52,10 @@ class ByteArrayData:
         arange(total) - repeat(out_starts) + repeat(src_starts).
         """
         indices = np.asarray(indices, dtype=np.int64)
+        if len(indices) and (
+            int(indices.min()) < 0 or int(indices.max()) >= len(self)
+        ):
+            raise IndexError("byte-array take: index out of range")
         o = self.offsets
         lengths = (o[1:] - o[:-1])[indices]
         new_off = np.zeros(len(indices) + 1, dtype=np.int64)
@@ -59,6 +63,12 @@ class ByteArrayData:
         total = int(new_off[-1])
         if total == 0:
             return ByteArrayData(offsets=new_off, data=b"")
+        from ..utils.native import get_native
+
+        lib = get_native()
+        if lib is not None and lib.has_bytearray_take:
+            data = lib.bytearray_take(self.data, o, indices, new_off, total)
+            return ByteArrayData(offsets=new_off, data=data)
         src = np.frombuffer(self.data, dtype=np.uint8)
         starts = o[:-1][indices]
         gather = (

@@ -183,6 +183,11 @@ class FileReader:
         for i in range(self.num_row_groups):
             yield self.read_row_group(i, columns=columns)
 
+    def __iter__(self):
+        """Iterating the reader yields rows — the `for reader.NextRow()` loop
+        of the reference (file_reader.go:258) as a Python iterator."""
+        return self.iter_rows()
+
     # -- lifecycle -------------------------------------------------------------
 
     def close(self) -> None:

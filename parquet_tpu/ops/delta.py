@@ -157,6 +157,16 @@ def decode_delta(data, nbits: int, max_total: int | None = None) -> tuple[np.nda
     from the stream header; `max_total` (the page/chunk value count) bounds it
     before allocation.
     """
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is not None and lib.has_delta_decode and nbits in (32, 64):
+        try:
+            return lib.delta_decode(bytes(data), nbits, max_total)
+        except OverflowError as e:
+            raise DeltaError(f"delta: {e}") from e
+        except ValueError as e:
+            raise DeltaError(f"delta: {e}") from e
     t = prescan_delta(data, nbits, max_total)
     if nbits == 32:
         seq = np.empty(t.total, dtype=np.uint32)

@@ -191,9 +191,24 @@ def expand_runs(table: RunTable, num_values: int, width: int, dtype=np.uint32) -
 
 
 def decode_hybrid(data, num_values: int, width: int, dtype=np.uint32) -> np.ndarray:
-    """One-shot host decode: prescan + expand."""
+    """One-shot host decode: prescan + expand (C fast path when built)."""
     if num_values == 0:
         return np.empty(0, dtype=dtype)
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is not None and lib.has_hybrid_decode and 0 <= width <= 64:
+        nbits = 32 if width <= 32 else 64
+        try:
+            out, _ = lib.hybrid_decode(bytes(data), num_values, width, nbits)
+        except ValueError as e:
+            raise HybridError(f"hybrid: {e}") from e
+        want = np.dtype(dtype)
+        if want == out.dtype:
+            return out
+        if want.itemsize == out.dtype.itemsize:  # e.g. int32 view of uint32
+            return out.view(want)
+        return out.astype(want)
     table = prescan_hybrid(data, num_values, width)
     return expand_runs(table, num_values, width, dtype=dtype)
 

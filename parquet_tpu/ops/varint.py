@@ -15,6 +15,10 @@ def read_uvarint(buf, pos: int, end: int, err=ValueError) -> tuple[int, int]:
         pos += 1
         result |= (b & 0x7F) << shift
         if not (b & 0x80):
+            if result >= 1 << 64:
+                # overflow — same rejection as Go's binary.ReadUvarint (the
+                # native C path accumulates in uint64 and must agree)
+                raise err("varint overflows uint64")
             return result, pos
         shift += 7
         if shift > 63:

@@ -49,6 +49,48 @@ class NativeLib:
                 ctypes.c_char_p,
                 ctypes.c_size_t,
             ]
+        self.has_hybrid_decode = hasattr(lib, "ptq_hybrid_decode")
+        if self.has_hybrid_decode:
+            lib.ptq_hybrid_decode.restype = ctypes.c_ssize_t
+            lib.ptq_hybrid_decode.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+        self.has_delta_decode = hasattr(lib, "ptq_delta_decode")
+        if self.has_delta_decode:
+            lib.ptq_delta_decode.restype = ctypes.c_ssize_t
+            lib.ptq_delta_decode.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.ptq_delta_peek_total.restype = ctypes.c_ssize_t
+            lib.ptq_delta_peek_total.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+            ]
+        self.has_bytearray_take = hasattr(lib, "ptq_bytearray_take")
+        if self.has_bytearray_take:
+            lib.ptq_bytearray_take.restype = ctypes.c_ssize_t
+            lib.ptq_bytearray_take.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+            ]
         self.has_prescan_hybrid = hasattr(lib, "ptq_prescan_hybrid")
         if self.has_prescan_hybrid:
             lib.ptq_prescan_hybrid.restype = ctypes.c_ssize_t
@@ -99,6 +141,77 @@ class NativeLib:
         # single copy of exactly the payload (out.raw would copy the whole cap)
         flat = ctypes.string_at(out, int(offsets[-1]))
         return offsets, flat, int(consumed)
+
+    def hybrid_decode(self, data: bytes, num_values: int, width: int, nbits: int):
+        """One-shot hybrid RLE/bit-pack decode. Returns (values, consumed);
+        values is uint32 (nbits==32) or uint64 (nbits==64)."""
+        import numpy as np
+
+        out = np.empty(num_values, dtype=np.uint32 if nbits == 32 else np.uint64)
+        p = out.ctypes.data_as(ctypes.c_void_p)
+        consumed = self._lib.ptq_hybrid_decode(
+            data,
+            len(data),
+            num_values,
+            width,
+            p if nbits == 32 else None,
+            p if nbits == 64 else None,
+        )
+        if consumed < 0:
+            raise ValueError("native: corrupt hybrid stream")
+        return out, int(consumed)
+
+    def delta_decode(self, data: bytes, nbits: int, max_total: int | None):
+        """Full DELTA_BINARY_PACKED decode. Returns (int32/int64 values, consumed).
+        Raises OverflowError when the stream's count exceeds max_total so the
+        caller can report the same error as the NumPy path."""
+        import numpy as np
+
+        total = np.zeros(1, dtype=np.int64)
+        if self._lib.ptq_delta_peek_total(data, len(data), total.ctypes.data_as(ctypes.c_void_p)) < 0:
+            raise ValueError("native: corrupt delta header")
+        cap = int(total[0])
+        if max_total is not None and cap > max(max_total, 0):
+            raise OverflowError(
+                f"stream claims {cap} values, caller expects at most {max_total}"
+            )
+        out = np.empty(cap, dtype=np.int32 if nbits == 32 else np.int64)
+        # max_total already enforced above on the peeked count; the C-side
+        # bound (-3) is unreachable from here, so pass "no bound".
+        consumed = self._lib.ptq_delta_decode(
+            data,
+            len(data),
+            nbits,
+            -1,
+            out.ctypes.data_as(ctypes.c_void_p),
+            total.ctypes.data_as(ctypes.c_void_p),
+        )
+        if consumed < 0:
+            raise ValueError("native: corrupt delta stream")
+        return out, int(consumed)
+
+    def bytearray_take(self, data: bytes, offsets, indices, new_offsets, total: int) -> bytes:
+        """Gather rows of an (offsets, data) byte-array column by index."""
+        import numpy as np
+
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        indices = np.ascontiguousarray(indices, dtype=np.int64)
+        new_offsets = np.ascontiguousarray(new_offsets, dtype=np.int64)
+        out = ctypes.create_string_buffer(max(total, 1))
+        rc = self._lib.ptq_bytearray_take(
+            data,
+            len(data),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            len(offsets) - 1,
+            indices.ctypes.data_as(ctypes.c_void_p),
+            len(indices),
+            new_offsets.ctypes.data_as(ctypes.c_void_p),
+            out,
+            total,
+        )
+        if rc < 0:
+            raise ValueError("native: byte-array take index out of range")
+        return ctypes.string_at(out, total)
 
     def prescan_hybrid(self, data: bytes, num_values: int, width: int):
         """Run-header prescan: returns (is_rle, counts, values, bp_offsets, consumed)

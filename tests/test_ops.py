@@ -413,3 +413,101 @@ class TestCompress:
     def test_unregistered_codec_rejected(self):
         with pytest.raises(compress.CompressionError):
             compress.compress_block(b"x", CompressionCodec.LZO)
+
+
+class TestNativeParity:
+    """The C fast paths (native/parquet_tpu_native.cc) must agree bit-for-bit
+    with the NumPy reference implementations on values, consumed counts, and
+    error behavior. Skipped when the library isn't built."""
+
+    @pytest.fixture()
+    def native(self):
+        from parquet_tpu.utils.native import get_native
+
+        lib = get_native()
+        if lib is None:
+            pytest.skip("native library not built")
+        return lib
+
+    @pytest.fixture()
+    def numpy_only(self):
+        """Force the pure-NumPy implementations for the duration of a test."""
+        from parquet_tpu.utils import native as native_mod
+
+        old = native_mod._cached, native_mod._probed
+        native_mod._cached, native_mod._probed = None, True
+        yield
+        native_mod._cached, native_mod._probed = old
+
+    def test_delta_decode_matches_numpy(self, native):
+        from parquet_tpu.ops.delta import prescan_delta
+
+        r = np.random.default_rng(7)
+        for nbits, dt in ((32, np.int32), (64, np.int64)):
+            for n in (0, 1, 2, 127, 128, 129, 4096):
+                v = r.integers(-(10**6), 10**6, n).astype(dt)
+                enc = encode_delta(v, nbits)
+                got, consumed = native.delta_decode(enc, nbits, n)
+                assert np.array_equal(got.view(dt), v)
+                if n:
+                    assert consumed == prescan_delta(enc, nbits, n).consumed
+
+    def test_delta_decode_wrapping(self, native):
+        v = np.array([2**62, -(2**62), 5, 2**62 - 1, -1], dtype=np.int64)
+        enc = encode_delta(v, 64)
+        got, _ = native.delta_decode(enc, 64, len(v))
+        assert np.array_equal(got, v)
+
+    def test_delta_rejects_oversized_claim(self, native):
+        v = np.arange(100, dtype=np.int32)
+        enc = encode_delta(v, 32)
+        with pytest.raises(OverflowError):
+            native.delta_decode(enc, 32, 50)
+
+    def test_delta_rejects_implausible_header_before_alloc(self, native):
+        out = bytearray()
+        from parquet_tpu.ops.varint import emit_uvarint, emit_zigzag
+
+        emit_uvarint(out, 128)  # block size
+        emit_uvarint(out, 4)  # miniblocks
+        emit_uvarint(out, 1 << 40)  # absurd value count for a tiny stream
+        emit_zigzag(out, 0)
+        with pytest.raises(ValueError):
+            native.delta_decode(bytes(out), 32, None)
+
+    def test_hybrid_decode_matches_numpy(self, native):
+        r = np.random.default_rng(8)
+        for width in (0, 1, 3, 8, 13, 24, 32, 47, 64):
+            n = 1000
+            hi = 1 << min(width, 48) if width else 1
+            vals = r.integers(0, hi, n, dtype=np.uint64)
+            enc = encode_hybrid(vals, width)
+            nbits = 32 if width <= 32 else 64
+            got, _ = native.hybrid_decode(enc, n, width, nbits)
+            assert np.array_equal(got.astype(np.uint64), vals), width
+
+    def test_hybrid_rejects_rle_value_over_width(self, native):
+        # RLE run header (count 8, low bit 0) with a 1-byte value of 7 at width 2
+        bad = bytes([8 << 1, 7])
+        with pytest.raises(ValueError):
+            native.hybrid_decode(bad, 8, 2, 32)
+
+    def test_bytearray_take_matches_numpy(self, native, numpy_only):
+        r = np.random.default_rng(9)
+        items = [bytes([65 + i % 26]) * (i % 17) for i in range(300)]
+        ba = ByteArrayData.from_list(items)
+        idx = r.integers(0, 300, 5000)
+        want = ba.take(idx)  # numpy path (fixture forces it)
+        from parquet_tpu.utils import native as native_mod
+
+        native_mod._cached, native_mod._probed = native, True
+        got = ba.take(idx)
+        assert got == want
+
+    def test_varint_overflow_rejected_both_paths(self, native, numpy_only):
+        from parquet_tpu.ops.varint import read_uvarint
+
+        # 10-byte varint encoding a value >= 2**64
+        bad = bytes([0xFF] * 9 + [0x7F])
+        with pytest.raises(ValueError):
+            read_uvarint(bad, 0, len(bad))
