@@ -132,12 +132,48 @@ class FileReader:
     # -- columnar reads --------------------------------------------------------
 
     def read_row_group(self, i: int, columns=None) -> dict[tuple, ChunkData]:
-        """Decode one row group into {leaf path: ChunkData}."""
+        """Decode one row group into {leaf path: ChunkData}.
+
+        On the TPU backend all selected chunks are *planned* first (host
+        prescan + async device dispatch), then finalized — every chunk's
+        device work is in flight before the first fetch blocks (JAX async
+        dispatch over the host<->device link)."""
+        if self.backend == "tpu":
+            plans = self._plan_row_group(i, columns)
+            return {path: plan.finalize() for path, plan in plans.items()}
+        out: dict[tuple, ChunkData] = {}
+        for path, cc, column in self._selected_chunks(i, columns):
+            out[path] = read_chunk(
+                self._f, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
+            )
+        return out
+
+    def read_row_group_device(self, i: int, columns=None):
+        """Decode one row group straight into device memory (HBM).
+
+        The TPU-native delivery point: returns {leaf path: DeviceColumn} whose
+        value arrays are jax arrays resident on the accelerator — encoded
+        bytes go up, decoded columns never come back down. Works regardless
+        of the reader's configured backend."""
+        plans = self._plan_row_group(i, columns)
+        return {path: plan.device_column() for path, plan in plans.items()}
+
+    def _plan_row_group(self, i: int, columns=None):
+        from ..kernels.pipeline import plan_chunk_tpu
+
+        plans = {}
+        for path, cc, column in self._selected_chunks(i, columns):
+            plans[path] = plan_chunk_tpu(
+                self._f, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
+            )
+        return plans
+
+    def _selected_chunks(self, i: int, columns=None):
+        """Yield (path, ColumnChunk, Column) for the selected leaves of group i."""
         rg = self.row_group(i)
         selected = self._resolve_columns(columns) if columns else self._selected
         if self.alloc is not None:
             self.alloc.release()
-        out: dict[tuple, ChunkData] = {}
         for cc in rg.columns or []:
             md = cc.meta_data
             if md is None:
@@ -145,22 +181,7 @@ class FileReader:
             path = tuple(md.path_in_schema or [])
             if selected is not None and path not in selected:
                 continue  # skipChunk (reference: chunk_reader.go:271)
-            column = self.schema.column(path)
-            out[path] = self._read_chunk_fn()(
-                self._f,
-                cc,
-                column,
-                validate_crc=self.validate_crc,
-                alloc=self.alloc,
-            )
-        return out
-
-    def _read_chunk_fn(self):
-        if self.backend == "tpu":
-            from ..kernels.pipeline import read_chunk_tpu
-
-            return read_chunk_tpu
-        return read_chunk
+            yield path, cc, self.schema.column(path)
 
     # -- record iteration ------------------------------------------------------
 

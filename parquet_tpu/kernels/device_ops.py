@@ -13,8 +13,15 @@ then val = (words[b>>5] >> (b&31)) | (words[b>>5+1] << (32-(b&31))), masked to
 W bits: two gathers + two shifts per value, fully vectorized. 64-bit widths use
 the same two-gather trick on uint64 words.
 
-int64 support requires jax_enable_x64; enabled at import (documented in the
-package README).
+All index arithmetic is int32: TPU v5e has no native 64-bit integer ALU path
+(XLA emulates i64 as i32 pairs, ~10-100x slower for gather/scan-heavy code),
+and every batch this framework builds is < 2^31 bits (buckets are capped by
+MAX_DEVICE_BATCH_BITS; the host drivers in pipeline.py split larger chunks).
+64-bit *values* (delta int64 payloads) still use uint64 lanes — only the
+positions/indices stay 32-bit.
+
+int64 value support requires jax_enable_x64; enabled at import (documented in
+the package README).
 """
 
 from __future__ import annotations
@@ -28,13 +35,17 @@ import numpy as np
 from functools import partial
 
 __all__ = [
+    "MAX_DEVICE_BATCH_BITS",
     "bytes_to_words32",
     "bytes_to_words64",
-    "unpack_bits_device",
     "expand_hybrid_device",
-    "delta_decode_device",
+    "delta_packed_decode_device",
     "dict_gather_device",
 ]
+
+# Largest bit offset representable in the int32 position math (host drivers
+# assert batches stay under this; 2^31 bits = 256 MiB of packed payload).
+MAX_DEVICE_BATCH_BITS = 1 << 31
 
 
 def bytes_to_words32(data: bytes) -> np.ndarray:
@@ -51,48 +62,12 @@ def bytes_to_words64(data: bytes) -> np.ndarray:
 
 
 @partial(jax.jit, static_argnames=("width", "num_values"))
-def unpack_bits_device(words: jnp.ndarray, width: int, num_values: int) -> jnp.ndarray:
-    """Unpack `num_values` LSB-first `width`-bit values from uint32 words.
-
-    Returns uint32 (width <= 32). The two-word gather handles values straddling
-    word boundaries; shift-by-32 is avoided with a where on s == 0.
-    """
-    assert 0 < width <= 32
-    i = jnp.arange(num_values, dtype=jnp.int64)
-    bitpos = i * width
-    w0 = (bitpos >> 5).astype(jnp.int32)
-    s = (bitpos & 31).astype(jnp.uint32)
-    lo = words[w0] >> s
-    hi = jnp.where(s == 0, jnp.uint32(0), words[w0 + 1] << ((32 - s) & 31))
-    mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
-    return (lo | hi) & mask
-
-
-@partial(jax.jit, static_argnames=("width", "num_values"))
-def unpack_bits_device64(words: jnp.ndarray, width: int, num_values: int) -> jnp.ndarray:
-    """64-bit variant: unpack from uint64 words, return uint64 (width <= 64)."""
-    assert 0 < width <= 64
-    i = jnp.arange(num_values, dtype=jnp.int64)
-    bitpos = i * width
-    w0 = (bitpos >> 6).astype(jnp.int32)
-    s = (bitpos & 63).astype(jnp.uint64)
-    lo = words[w0] >> s
-    hi = jnp.where(s == 0, jnp.uint64(0), words[w0 + 1] << ((64 - s) & 63))
-    mask = (
-        jnp.uint64((1 << width) - 1)
-        if width < 64
-        else jnp.uint64(0xFFFFFFFFFFFFFFFF)
-    )
-    return (lo | hi) & mask
-
-
-@partial(jax.jit, static_argnames=("width", "num_values"))
 def expand_hybrid_device(
     packed_words: jnp.ndarray,
     run_is_rle: jnp.ndarray,  # (R,) bool
-    run_out_start: jnp.ndarray,  # (R,) int64 exclusive cumsum of counts
+    run_out_start: jnp.ndarray,  # (R,) int32 exclusive cumsum of counts
     run_rle_value: jnp.ndarray,  # (R,) uint32
-    run_bp_bit_start: jnp.ndarray,  # (R,) int64 bit offset of run payload
+    run_bp_bit_start: jnp.ndarray,  # (R,) int32 bit offset of run payload
     width: int,
     num_values: int,
 ) -> jnp.ndarray:
@@ -102,13 +77,13 @@ def expand_hybrid_device(
     RLE runs broadcast their value; bit-packed runs extract bits at
     run_bp_bit_start[r] + (i - run_out_start[r]) * width.
     """
-    i = jnp.arange(num_values, dtype=jnp.int64)
-    r = jnp.searchsorted(run_out_start, i, side="right") - 1
+    i = jnp.arange(num_values, dtype=jnp.int32)
+    r = jnp.searchsorted(run_out_start, i, side="right").astype(jnp.int32) - 1
     within = i - run_out_start[r]
     if width == 0:
         return jnp.zeros(num_values, dtype=jnp.uint32)
     bitpos = run_bp_bit_start[r] + within * width
-    w0 = (bitpos >> 5).astype(jnp.int32)
+    w0 = bitpos >> 5
     s = (bitpos & 31).astype(jnp.uint32)
     lo = packed_words[w0] >> s
     hi = jnp.where(s == 0, jnp.uint32(0), packed_words[w0 + 1] << ((32 - s) & 31))
@@ -117,51 +92,70 @@ def expand_hybrid_device(
     return jnp.where(run_is_rle[r], run_rle_value[r], bp_vals)
 
 
-@partial(jax.jit, static_argnames=("nbits", "num_values", "width"))
-def _unpack_miniblocks(words, mb_bit_start, mb_out_start, width, nbits, num_values):
-    """Unpack all miniblocks of one distinct width into their delta positions."""
-    # Done per distinct width by the host driver; indexes like expand_hybrid.
-    i = jnp.arange(num_values, dtype=jnp.int64)
-    m = jnp.searchsorted(mb_out_start, i, side="right") - 1
-    within = i - mb_out_start[m]
-    if nbits == 32:
-        bitpos = mb_bit_start[m] + within * width
-        w0 = (bitpos >> 5).astype(jnp.int32)
-        s = (bitpos & 31).astype(jnp.uint32)
-        lo = words[w0] >> s
-        hi = jnp.where(s == 0, jnp.uint32(0), words[w0 + 1] << ((32 - s) & 31))
-        mask = jnp.uint32((1 << width) - 1) if width < 32 else jnp.uint32(0xFFFFFFFF)
-        return (lo | hi) & mask
-    bitpos = mb_bit_start[m] + within * width
-    w0 = (bitpos >> 6).astype(jnp.int32)
-    s = (bitpos & 63).astype(jnp.uint64)
-    lo = words[w0] >> s
-    hi = jnp.where(s == 0, jnp.uint64(0), words[w0 + 1] << ((64 - s) & 63))
-    mask = (
-        jnp.uint64((1 << width) - 1) if width < 64 else jnp.uint64(0xFFFFFFFFFFFFFFFF)
-    )
-    return (lo | hi) & mask
-
-
 @partial(jax.jit, static_argnames=("nbits", "num_values"))
-def delta_decode_device(
-    deltas_plus_min: jnp.ndarray,  # (num_values-1,) unsigned, already + min_delta
-    first_value,  # scalar unsigned
+def delta_packed_decode_device(
+    words: jnp.ndarray,  # packed wire bytes as uint32/uint64 words (+guard)
+    mb_width: jnp.ndarray,  # (M,) uint32 miniblock bit widths
+    mb_bit_start: jnp.ndarray,  # (M,) int32 bit offset of miniblock payload
+    mb_out_start: jnp.ndarray,  # (M,) int32 global delta position of miniblock
+    mb_min: jnp.ndarray,  # (M,) uint32/uint64 block min_delta (mod 2**nbits)
+    page_start: jnp.ndarray,  # (P,) int32 global position of each page's first value
+    page_first: jnp.ndarray,  # (P,) uint32/uint64 first value of each page
     nbits: int,
     num_values: int,
 ) -> jnp.ndarray:
-    """Wrapping prefix-sum: values[k] = first + sum(deltas[:k]) mod 2**nbits.
+    """Fused DELTA_BINARY_PACKED decode of a whole chunk from *wire* bytes.
 
-    The cumulative sum is an associative scan — XLA lowers it to a logarithmic
-    tree, the TPU-friendly inversion of the reference's one-value-at-a-time
-    loop (deltabp_decoder.go:113-174, SURVEY §7.2 M3c).
+    The host ships the encoded stream (plus tiny per-miniblock/per-page
+    tables); the device does everything: dynamic-width bit-unpack of every
+    miniblock (two-word gather; the width is data, not a static — TPU vector
+    shifts take vector amounts), + block min_delta, then one wrapping
+    prefix-sum segmented per page:
+
+        value[i] = first[p(i)] + C[i] - C[page_start[p(i)]]
+
+    with C = cumsum of the per-position deltas (positions at page starts
+    contribute 0). This is the SURVEY §7.2 M3c shape — headers prescanned,
+    payload never expanded host-side — and the upload is the wire size, ~5-10x
+    smaller than the decoded column (the reason device decode beats
+    host-decode-plus-upload on the host<->device link).
     """
-    ud = jnp.uint32 if nbits == 32 else jnp.uint64
-    sd = jnp.int32 if nbits == 32 else jnp.int64
-    first = jnp.asarray(first_value, dtype=ud)
-    body = jnp.cumsum(deltas_plus_min.astype(ud), dtype=ud) + first
-    out = jnp.concatenate([first[None], body])
-    return jax.lax.bitcast_convert_type(out, sd)
+    i = jnp.arange(num_values, dtype=jnp.int32)
+    m = jnp.searchsorted(mb_out_start, i, side="right").astype(jnp.int32) - 1
+    w = mb_width[m]
+    within = i - mb_out_start[m]
+    p = jnp.searchsorted(page_start, i, side="right").astype(jnp.int32) - 1
+    is_start = i == page_start[p]
+    if nbits == 32:
+        bitpos = mb_bit_start[m] + within * w.astype(jnp.int32)
+        w0 = bitpos >> 5
+        s = (bitpos & 31).astype(jnp.uint32)
+        lo = words[w0] >> s
+        hi = jnp.where(s == 0, jnp.uint32(0), words[w0 + 1] << ((32 - s) & 31))
+        mask = jnp.where(
+            w >= 32, jnp.uint32(0xFFFFFFFF), (jnp.uint32(1) << (w & 31)) - 1
+        )
+        d = ((lo | hi) & mask) + mb_min[m]
+        d = jnp.where(is_start, jnp.uint32(0), d)
+        c = jnp.cumsum(d, dtype=jnp.uint32)
+        vals = page_first[p] + c - c[page_start[p]]
+        return jax.lax.bitcast_convert_type(vals, jnp.int32)
+    bitpos = mb_bit_start[m] + within * w.astype(jnp.int32)
+    w0 = bitpos >> 6
+    s = (bitpos & 63).astype(jnp.uint64)
+    lo = words[w0] >> s
+    hi = jnp.where(s == 0, jnp.uint64(0), words[w0 + 1] << ((64 - s) & 63))
+    wmask = w.astype(jnp.uint64)
+    mask = jnp.where(
+        w >= 64,
+        jnp.uint64(0xFFFFFFFFFFFFFFFF),
+        (jnp.uint64(1) << (wmask & 63)) - 1,
+    )
+    d = ((lo | hi) & mask) + mb_min[m]
+    d = jnp.where(is_start, jnp.uint64(0), d)
+    c = jnp.cumsum(d, dtype=jnp.uint64)
+    vals = page_first[p] + c - c[page_start[p]]
+    return jax.lax.bitcast_convert_type(vals, jnp.int64)
 
 
 @jax.jit

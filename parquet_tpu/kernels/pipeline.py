@@ -9,16 +9,30 @@ FileReader(..., backend="tpu") — the WithDecoderBackend(TPU) analogue.
 Batching model per chunk:
   RLE_DICTIONARY  all pages' run tables concatenate into one table (bit
                   offsets rebased into one packed buffer, output starts into
-                  one output index space) -> ONE device expansion for the whole
-                  chunk, then one device gather against the dictionary.
+                  one output index space, run counts clamped to each page's
+                  real value count so no padding enters the output) -> ONE
+                  device expansion for the whole chunk, then one device gather
+                  against the dictionary.
   DELTA_BP        all pages' delta vectors concatenate; a single wrapping
                   cumsum decodes every page at once — per-page starts are
-                  restored by subtracting the running sum at each page start
+                  restored by injecting a correction delta at each page start
                   (valid in modular arithmetic).
   PLAIN           raw little-endian bytes upload + device bitcast.
 
+The decode of one chunk is split into two phases so a whole row group's worth
+of device work can be in flight before anything synchronizes (JAX async
+dispatch; the host<->device link is the scarce resource, SURVEY §7.3.4):
+
+  plan_chunk_tpu()   host prescan + device dispatch; returns a _ChunkPlan
+                     holding un-synchronized device arrays.
+  plan.finalize()    fetches results and reassembles a ChunkData, byte-
+                     identical to the host path.
+  plan.device_column()  keeps the decoded values in HBM instead: the
+                     decode-to-device delivery point (DeviceColumn).
+
 All shapes are padded to power-of-two buckets so XLA compiles each kernel a
-bounded number of times (static shapes, SURVEY §7.1).
+bounded number of times (static shapes, SURVEY §7.1). All device index math is
+int32 (device_ops.py); batches are split at MAX_DEVICE_BATCH_BITS.
 """
 
 from __future__ import annotations
@@ -36,18 +50,26 @@ from ..core.chunk import ChunkData, ChunkError, iter_chunk_pages, _check_crc
 from ..core.compress import decompress_block
 from ..core.page import PageError, decode_dict_page
 from ..core.schema import Column
-from ..ops.bitpack import bit_width
-from ..ops.levels import decode_levels_v1, decode_levels_v2
 from ..ops.rle_hybrid import prescan_hybrid
-from ..ops.delta import prescan_delta
+from ..ops.delta import prescan_delta_packed
 from .device_ops import (
+    MAX_DEVICE_BATCH_BITS,
     bytes_to_words32,
-    delta_decode_device,
+    bytes_to_words64,
+    delta_packed_decode_device,
     dict_gather_device,
     expand_hybrid_device,
 )
 
-__all__ = ["read_chunk_tpu", "TpuDecodeStats"]
+__all__ = [
+    "read_chunk_tpu",
+    "plan_chunk_tpu",
+    "DeviceColumn",
+    "TpuDecodeStats",
+]
+
+# Patchable in tests to force multi-batch splitting on small inputs.
+_BATCH_BITS_CAP = MAX_DEVICE_BATCH_BITS
 
 
 def _bucket(n: int, floor: int = 1024) -> int:
@@ -63,15 +85,32 @@ class TpuDecodeStats:
     pages: int = 0
     device_values: int = 0
     host_fallback_pages: int = 0
+    device_batches: int = 0
+
+
+_NUMERIC_DTYPE = {
+    Type.INT32: np.int32,
+    Type.INT64: np.int64,
+    Type.FLOAT: np.float32,
+    Type.DOUBLE: np.float64,
+}
 
 
 # -- per-chunk batch assembly --------------------------------------------------
 
 
 class _HybridBatch:
-    """Concatenated run tables of all dict-encoded pages of a chunk."""
+    """Concatenated, clamped run tables of dict-encoded pages of a chunk.
 
-    def __init__(self):
+    Run counts are clamped so each page contributes exactly its real value
+    count to the output index space (the final bit-packed group of a page may
+    encode up to 7 padding values; clamping the last run's count drops them
+    without touching bit offsets). The device expansion therefore yields the
+    concatenation of all pages' values directly.
+    """
+
+    def __init__(self, width: int):
+        self.width = width
         self.is_rle: list[np.ndarray] = []
         self.counts: list[np.ndarray] = []
         self.values: list[np.ndarray] = []
@@ -79,123 +118,490 @@ class _HybridBatch:
         self.packed: list[bytes] = []
         self.packed_bits = 0
         self.out_count = 0
-        self.width: int | None = None
 
-    def add_page(self, table, take: int, width: int):
-        if self.width is None:
-            self.width = width
-        elif self.width != width:
-            return False  # width changed mid-chunk: caller falls back per-page
-        self.is_rle.append(table.is_rle)
-        self.counts.append(table.counts)
-        self.values.append(table.rle_values)
-        self.bit_starts.append(table.bp_offsets * 8 + self.packed_bits)
+    def fits(self, table, width: int) -> bool:
+        return (
+            width == self.width
+            and self.packed_bits + len(table.packed) * 8 <= _BATCH_BITS_CAP
+        )
+
+    def add_page(self, table, take: int) -> None:
+        counts = table.counts.astype(np.int64)
+        cum = np.cumsum(counts)
+        if take > (int(cum[-1]) if len(cum) else 0):
+            raise PageError("page: hybrid run table shorter than value count")
+        k = int(np.searchsorted(cum, take, side="left"))
+        counts = counts[: k + 1].copy()
+        counts[k] = take - (int(cum[k - 1]) if k else 0)
+        self.is_rle.append(table.is_rle[: k + 1])
+        self.counts.append(counts)
+        self.values.append(table.rle_values[: k + 1])
+        self.bit_starts.append(table.bp_offsets[: k + 1] * 8 + self.packed_bits)
         self.packed.append(table.packed)
         self.packed_bits += len(table.packed) * 8
         self.out_count += take
-        return True
 
-
-def _expand_hybrid_batch(batch: _HybridBatch, per_page_take: list[int]) -> np.ndarray:
-    """One device expansion for a whole chunk's worth of runs.
-
-    Pages may carry padding values in their final bit-packed group; output
-    index space is built per page with that padding included, then the real
-    values are sliced out per page.
-    """
-    width = batch.width or 0
-    counts = np.concatenate(batch.counts) if batch.counts else np.zeros(0, np.int64)
-    # output start of each run, with page boundaries padded to full run counts
-    out_start = np.zeros(len(counts), dtype=np.int64)
-    np.cumsum(counts[:-1], out=out_start[1:])
-    total = int(counts.sum())
-    n_pad = _bucket(max(total, 1))
-    run_pad = _bucket(len(counts), 64)
-    is_rle = np.zeros(run_pad, dtype=bool)
-    values = np.zeros(run_pad, dtype=np.uint32)
-    bit_starts = np.zeros(run_pad, dtype=np.int64)
-    starts = np.full(run_pad, n_pad + 1, dtype=np.int64)
-    if len(counts):
-        is_rle[: len(counts)] = np.concatenate(batch.is_rle)
-        values[: len(counts)] = np.concatenate(batch.values).astype(np.uint32)
-        bit_starts[: len(counts)] = np.concatenate(batch.bit_starts)
+    def dispatch(self) -> jnp.ndarray:
+        """One device expansion covering every page in this batch."""
+        width = self.width
+        counts = np.concatenate(self.counts)
+        out_start = np.zeros(len(counts), dtype=np.int64)
+        np.cumsum(counts[:-1], out=out_start[1:])
+        total = int(counts.sum())
+        assert total == self.out_count
+        n_pad = _bucket(max(total, 1))
+        run_pad = _bucket(len(counts), 64)
+        is_rle = np.zeros(run_pad, dtype=bool)
+        values = np.zeros(run_pad, dtype=np.uint32)
+        bit_starts = np.zeros(run_pad, dtype=np.int32)
+        starts = np.full(run_pad, n_pad + 1, dtype=np.int32)
+        is_rle[: len(counts)] = np.concatenate(self.is_rle)
+        values[: len(counts)] = np.concatenate(self.values).astype(np.uint32)
+        bit_starts[: len(counts)] = np.concatenate(self.bit_starts)
         starts[: len(counts)] = out_start
-    # RLE-pad the tail so padded output indices hit a dummy run
-    packed = b"".join(batch.packed)
-    words = bytes_to_words32(packed)
-    w_pad = _bucket(len(words), 1024)
-    words_p = np.zeros(w_pad, dtype=np.uint32)
-    words_p[: len(words)] = words
-    dev = expand_hybrid_device(
-        jnp.asarray(words_p),
-        jnp.asarray(is_rle),
-        jnp.asarray(starts),
-        jnp.asarray(values),
-        jnp.asarray(bit_starts),
-        width,
-        n_pad,
-    )
-    flat = np.asarray(dev[:total])
-    # slice out real values per page (drop per-page bit-pack padding)
-    out = np.empty(sum(per_page_take), dtype=np.uint32)
-    pos_in = 0
-    pos_out = 0
-    for page_counts, take in zip(batch.counts, per_page_take):
-        page_total = int(page_counts.sum())
-        out[pos_out : pos_out + take] = flat[pos_in : pos_in + take]
-        pos_in += page_total
-        pos_out += take
-    return out
+        packed = b"".join(self.packed)
+        words = bytes_to_words32(packed)
+        w_pad = _bucket(len(words), 1024)
+        words_p = np.zeros(w_pad, dtype=np.uint32)
+        words_p[: len(words)] = words
+        dev = expand_hybrid_device(
+            jnp.asarray(words_p),
+            jnp.asarray(is_rle),
+            jnp.asarray(starts),
+            jnp.asarray(values),
+            jnp.asarray(bit_starts),
+            width,
+            n_pad,
+        )
+        return dev[:total]
 
 
 class _DeltaBatch:
+    """Concatenated *packed* delta streams of a chunk's pages.
+
+    Only wire bytes + tiny per-miniblock/per-page tables go to the device;
+    kernels/device_ops.py delta_packed_decode_device unpacks + prefix-sums
+    everything in one program, segmented per page."""
+
     def __init__(self, nbits: int):
         self.nbits = nbits
-        self.deltas: list[np.ndarray] = []
-        self.firsts: list[int] = []
-        self.totals: list[int] = []
+        self.streams: list[bytes] = []
+        self.stream_bytes = 0
+        self.widths: list[np.ndarray] = []
+        self.byte_starts: list[np.ndarray] = []
+        self.out_starts: list[np.ndarray] = []
+        self.mins: list[np.ndarray] = []
+        self.page_starts: list[int] = []
+        self.page_firsts: list[int] = []
+        self.out_count = 0
 
-    def add_page(self, table):
+    def fits(self, table) -> bool:
+        return (self.stream_bytes + table.consumed) * 8 <= _BATCH_BITS_CAP
+
+    def add_page(self, table, stream: bytes) -> None:
         if table.total == 0:
-            return  # no values: nothing to contribute to the stream
-        self.deltas.append(table.deltas_plus_min)
-        self.firsts.append(table.first_value)
-        self.totals.append(table.total)
+            return  # no values: nothing to contribute
+        b = self.out_count
+        self.widths.append(table.widths)
+        self.byte_starts.append(table.byte_starts + self.stream_bytes)
+        self.out_starts.append(table.out_starts + (b + 1))
+        self.mins.append(table.mins)
+        self.page_starts.append(b)
+        self.page_firsts.append(table.first_value)
+        self.streams.append(stream[: table.consumed])
+        self.stream_bytes += table.consumed
+        self.out_count += table.total
+
+    def dispatch(self) -> jnp.ndarray | None:
+        if not self.page_starts:
+            return None
+        nbits = self.nbits
+        ud = np.uint32 if nbits == 32 else np.uint64
+        total = self.out_count
+        n_pad = _bucket(total)
+        m = sum(len(w) for w in self.widths)
+        m_pad = _bucket(max(m, 1), 64)
+        widths = np.zeros(m_pad, dtype=np.uint32)
+        bit_starts = np.zeros(m_pad, dtype=np.int32)
+        out_starts = np.full(m_pad, n_pad + 1, dtype=np.int32)
+        mins = np.zeros(m_pad, dtype=ud)
+        if m:
+            widths[:m] = np.concatenate(self.widths)
+            bit_starts[:m] = np.concatenate(self.byte_starts) * 8
+            out_starts[:m] = np.concatenate(self.out_starts)
+            mins[:m] = np.concatenate(self.mins).astype(ud)
+        p = len(self.page_starts)
+        p_pad = _bucket(p, 64)
+        page_start = np.full(p_pad, n_pad + 1, dtype=np.int32)
+        page_first = np.zeros(p_pad, dtype=ud)
+        page_start[:p] = self.page_starts
+        page_first[:p] = np.array(self.page_firsts, dtype=ud)
+        stream = b"".join(self.streams)
+        words = bytes_to_words32(stream) if nbits == 32 else bytes_to_words64(stream)
+        w_pad = _bucket(len(words), 1024)
+        words_p = np.zeros(w_pad, dtype=words.dtype)
+        words_p[: len(words)] = words
+        dev = delta_packed_decode_device(
+            jnp.asarray(words_p),
+            jnp.asarray(widths),
+            jnp.asarray(bit_starts),
+            jnp.asarray(out_starts),
+            jnp.asarray(mins),
+            jnp.asarray(page_start),
+            jnp.asarray(page_first),
+            nbits,
+            n_pad,
+        )
+        return dev[:total]
 
 
-def _expand_delta_batch(batch: _DeltaBatch) -> np.ndarray:
-    """Decode all pages with one device cumsum.
+# -- the chunk plan ------------------------------------------------------------
 
-    Concatenate deltas of all pages; the global wrapping cumsum S satisfies,
-    for value k of page p with delta-range [a_p, b_p):
-        value = first_p + (S[k] - S[a_p - 1])  (mod 2**nbits)
-    which we realize by injecting a correction delta at each page boundary.
-    """
-    nbits = batch.nbits
-    ud = np.uint32 if nbits == 32 else np.uint64
-    mask = (1 << nbits) - 1
-    parts = []
-    prev_end_value = 0  # running value of the previous page's end (mod)
-    # Build one delta stream where each page's first value appears as a delta
-    # from the previous page's last value: cumsum then yields every value.
-    for deltas, first in zip(batch.deltas, batch.firsts):
-        start_delta = (first - prev_end_value) & mask
-        parts.append(np.array([start_delta], dtype=ud))
-        parts.append(deltas.astype(ud))
-        prev_end_value = (first + int(deltas.astype(ud).sum(dtype=ud))) & mask
-    if not parts:
-        sd = np.int32 if nbits == 32 else np.int64
-        return np.zeros(0, dtype=sd)
-    stream = np.concatenate(parts)
-    n = len(stream)
-    n_pad = _bucket(n)
-    stream_p = np.zeros(n_pad, dtype=ud)
-    stream_p[:n] = stream
-    dev = delta_decode_device(jnp.asarray(stream_p[1:]), int(stream_p[0]), nbits, n_pad)
-    return np.asarray(dev[:n])
+
+@dataclass
+class DeviceColumn:
+    """Decoded column delivered in device memory (HBM) — the TPU-native
+    output of the decode pipeline. Numeric columns carry `values` (real
+    dtype; floats bitcast on device from their wire bit patterns). Byte-array
+    columns carry Arrow-style `data` + `offsets`, or — for dictionary-encoded
+    chunks — device `indices` plus the (small) dictionary both host-side and
+    as device `dict_data`/`dict_offsets`.
+
+    def/rep levels stay host-side (record assembly is a host concern,
+    SURVEY §7.1)."""
+
+    num_values: int
+    values: jnp.ndarray | None = None
+    indices: jnp.ndarray | None = None
+    dictionary: object | None = None  # host ByteArrayData | np.ndarray
+    data: jnp.ndarray | None = None  # uint8 payload (byte arrays)
+    offsets: jnp.ndarray | None = None  # int64 offsets, len = n + 1
+    dict_data: jnp.ndarray | None = None  # uint8 dictionary payload
+    dict_offsets: jnp.ndarray | None = None
+    def_levels: np.ndarray | None = None
+    rep_levels: np.ndarray | None = None
+
+
+class _ChunkPlan:
+    """Host-side record of one chunk's in-flight device decode."""
+
+    def __init__(self, column: Column, expected: int):
+        self.column = column
+        self.expected = expected
+        self.page_infos: list[tuple] = []  # (n, def, rep, kind, payload)
+        self.dictionary = None
+        self.dict_dev = None
+        self.dev_hybrid: list[jnp.ndarray] = []  # per batch, page order
+        self.dev_delta: list[jnp.ndarray] = []  # per batch, page order
+        self.stats: TpuDecodeStats | None = None
+
+    # -- fetch + host reassembly (byte-identical to core.chunk.read_chunk) ----
+
+    def finalize(self) -> ChunkData:
+        column = self.column
+        hybrid_flat = None
+        if self.dev_hybrid:
+            fetched = [np.asarray(d) for d in self.dev_hybrid]
+            hybrid_flat = fetched[0] if len(fetched) == 1 else np.concatenate(fetched)
+        delta_flat = None
+        if self.dev_delta:
+            fetched = [np.asarray(d) for d in self.dev_delta]
+            delta_flat = fetched[0] if len(fetched) == 1 else np.concatenate(fetched)
+        pages_values = []
+        all_def: list[np.ndarray] = []
+        all_rep: list[np.ndarray] = []
+        hpos = 0
+        dpos = 0
+        num_values_total = 0
+        for n, dfl, rep, kind, payload in self.page_infos:
+            num_values_total += n
+            if dfl is not None:
+                all_def.append(dfl)
+            if rep is not None:
+                all_rep.append(rep)
+            if kind == "dict":
+                take = payload
+                idx = hybrid_flat[hpos : hpos + take]
+                hpos += take
+                pages_values.append(_materialize(self.dictionary, self.dict_dev, idx))
+            elif kind == "indices":
+                pages_values.append(
+                    _materialize(self.dictionary, self.dict_dev, payload)
+                )
+            elif kind == "delta":
+                if payload:
+                    vals = delta_flat[dpos : dpos + payload]
+                    dpos += payload
+                    pages_values.append(vals)
+            elif kind == "values":
+                pages_values.append(payload)
+            elif kind == "empty":
+                pass
+        if num_values_total != self.expected:
+            raise ChunkError(
+                f"chunk: pages hold {num_values_total} values, "
+                f"metadata says {self.expected}"
+            )
+        values = _concat_values(pages_values, column)
+        def_levels = np.concatenate(all_def) if all_def else None
+        rep_levels = np.concatenate(all_rep) if all_rep else None
+        return ChunkData(
+            column=column,
+            num_values=num_values_total,
+            values=values,
+            def_levels=def_levels,
+            rep_levels=rep_levels,
+            dictionary=self.dictionary,
+        )
+
+    # -- decode-to-device ------------------------------------------------------
+
+    def device_column(self) -> DeviceColumn:
+        """Deliver the chunk's decoded values in HBM (no device->host fetch of
+        the value data). Falls back to host decode + upload for shapes the
+        device path doesn't cover (byte-array delta pages, booleans, ...)."""
+        column = self.column
+        kinds = {k for _, _, _, k, _ in self.page_infos if k != "empty"}
+        all_def = [d for _, d, _, _, _ in self.page_infos if d is not None]
+        all_rep = [r for _, _, r, _, _ in self.page_infos if r is not None]
+        def_levels = np.concatenate(all_def) if all_def else None
+        rep_levels = np.concatenate(all_rep) if all_rep else None
+        n_total = sum(n for n, *_ in self.page_infos)
+        out = DeviceColumn(
+            num_values=n_total, def_levels=def_levels, rep_levels=rep_levels
+        )
+
+        if kinds <= {"dict", "empty"} and self.dev_hybrid and self.dictionary is not None:
+            idx = (
+                self.dev_hybrid[0]
+                if len(self.dev_hybrid) == 1
+                else jnp.concatenate(self.dev_hybrid)
+            )
+            idx = idx.astype(jnp.int32)
+            if isinstance(self.dictionary, ByteArrayData):
+                out.indices = idx
+                out.dictionary = self.dictionary
+                out.dict_data = jnp.asarray(
+                    np.frombuffer(self.dictionary.data, dtype=np.uint8)
+                )
+                out.dict_offsets = jnp.asarray(self.dictionary.offsets)
+            else:
+                vals = dict_gather_device(self.dict_dev, idx)
+                out.values = _device_bitcast(vals, column)
+            return out
+
+        if kinds <= {"delta", "empty"} and self.dev_delta:
+            out.values = (
+                self.dev_delta[0]
+                if len(self.dev_delta) == 1
+                else jnp.concatenate(self.dev_delta)
+            )
+            return out
+
+        if "values" in kinds and kinds <= {"values", "empty"} and column.type in _NUMERIC_DTYPE:
+            parts = [p for _, _, _, k, p in self.page_infos if k == "values"]
+            host = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            out.values = _upload_typed(host)
+            return out
+
+        # Mixed, unsupported, or fully empty shapes: host decode, then upload.
+        data = self.finalize()
+        if isinstance(data.values, ByteArrayData):
+            out.data = jnp.asarray(np.frombuffer(data.values.data, dtype=np.uint8))
+            out.offsets = jnp.asarray(data.values.offsets)
+        else:
+            out.values = _upload_typed(np.asarray(data.values))
+        return out
 
 
 # -- the chunk decoder ---------------------------------------------------------
+
+
+def plan_chunk_tpu(
+    f,
+    chunk,
+    column: Column,
+    validate_crc: bool = False,
+    alloc=None,
+    stats: TpuDecodeStats | None = None,
+) -> _ChunkPlan:
+    """Phase 1: host prescan + async device dispatch for one chunk.
+
+    Returns a _ChunkPlan whose device arrays are in flight; call .finalize()
+    for a host ChunkData (byte-identical to core.chunk.read_chunk) or
+    .device_column() to keep the decoded values in HBM.
+    """
+    md = chunk.meta_data
+    codec = md.codec or 0
+    expected = md.num_values or 0
+    plan = _ChunkPlan(column, expected)
+    plan.stats = stats
+    ptype = column.type
+
+    hybrid_batches: list[_HybridBatch] = []
+    delta_batches: list[_DeltaBatch] = []
+
+    for raw in iter_chunk_pages(f, chunk):
+        header = raw.header
+        if alloc is not None:
+            alloc.check(header.uncompressed_page_size or 0)
+        pt = header.type
+        if pt == int(PageType.DICTIONARY_PAGE):
+            if plan.dictionary is not None:
+                raise ChunkError("chunk: more than one dictionary page")
+            if validate_crc:
+                _check_crc(header, raw.payload)
+            block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
+            plan.dictionary = decode_dict_page(header, block, column)
+            d = plan.dictionary
+            if isinstance(d, np.ndarray) and d.ndim == 1:
+                # Floats travel as bit patterns: TPU f64 transfer is not
+                # bit-exact (observed 1-ulp corruption through the axon
+                # runtime), and a gather is dtype-agnostic anyway.
+                if d.dtype.kind == "f":
+                    u = np.uint32 if d.dtype.itemsize == 4 else np.uint64
+                    plan.dict_dev = jnp.asarray(d.view(u))
+                else:
+                    plan.dict_dev = jnp.asarray(d)
+            continue
+        if pt == int(PageType.INDEX_PAGE):
+            continue
+        if pt not in (int(PageType.DATA_PAGE), int(PageType.DATA_PAGE_V2)):
+            raise ChunkError(f"chunk: unknown page type {pt}")
+        if validate_crc:
+            _check_crc(header, raw.payload)
+
+        n, dfl, rep, non_null, enc, values_buf = _split_page(
+            raw, header, pt, codec, column
+        )
+        if stats is not None:
+            stats.pages += 1
+
+        # -- route the value stream --------------------------------------------
+        if enc in (int(Encoding.RLE_DICTIONARY), int(Encoding.PLAIN_DICTIONARY)):
+            if plan.dictionary is None:
+                raise PageError("page: dictionary encoding without dictionary")
+            if non_null == 0:
+                plan.page_infos.append((n, dfl, rep, "empty", None))
+                continue
+            width = values_buf[0] if values_buf else 0
+            if width > 32:
+                raise PageError(f"page: invalid dict index width {width}")
+            table = prescan_hybrid(values_buf[1:], non_null, width)
+            if len(table.packed) * 8 > _BATCH_BITS_CAP:
+                # One page alone exceeds the int32 bit-offset range of the
+                # device kernel: decode it on host (adversarially large pages;
+                # real writers page at ~1 MiB, data_store.go:149-154).
+                from ..ops.rle_hybrid import expand_runs
+
+                idx = expand_runs(table, non_null, width, np.uint32)
+                plan.page_infos.append((n, dfl, rep, "indices", idx))
+                if stats is not None:
+                    stats.host_fallback_pages += 1
+                continue
+            if not hybrid_batches or not hybrid_batches[-1].fits(table, width):
+                hybrid_batches.append(_HybridBatch(width))
+            hybrid_batches[-1].add_page(table, non_null)
+            plan.page_infos.append((n, dfl, rep, "dict", non_null))
+        elif enc == int(Encoding.DELTA_BINARY_PACKED) and ptype in (
+            Type.INT32,
+            Type.INT64,
+        ):
+            nbits = 32 if ptype == Type.INT32 else 64
+            table = prescan_delta_packed(values_buf, nbits, max_total=non_null)
+            if table.consumed * 8 > _BATCH_BITS_CAP:
+                # Same int32-range guard as the hybrid path: host decode.
+                from ..ops.delta import decode_delta
+
+                vals, _ = decode_delta(values_buf, nbits, max_total=non_null)
+                plan.page_infos.append((n, dfl, rep, "values", vals[:non_null]))
+                if stats is not None:
+                    stats.host_fallback_pages += 1
+                continue
+            if not delta_batches or not delta_batches[-1].fits(table):
+                delta_batches.append(_DeltaBatch(nbits))
+            delta_batches[-1].add_page(table, values_buf)
+            plan.page_infos.append((n, dfl, rep, "delta", table.total))
+        elif enc == int(Encoding.PLAIN) and ptype in _NUMERIC_DTYPE:
+            dt = _NUMERIC_DTYPE[ptype]
+            need = non_null * np.dtype(dt).itemsize
+            if len(values_buf) < need:
+                raise PageError("page: plain payload too short")
+            vals = np.frombuffer(values_buf, dtype=dt, count=non_null)
+            plan.page_infos.append((n, dfl, rep, "values", vals))
+        else:
+            # Anything else (byte arrays, boolean, deltas on other types):
+            # host decode for this page.
+            from ..core.page import _decode_values
+
+            dict_size = len(plan.dictionary) if plan.dictionary is not None else None
+            values, indices = _decode_values(
+                values_buf, non_null, enc, column, dict_size
+            )
+            if indices is not None:
+                plan.page_infos.append((n, dfl, rep, "indices", indices))
+            else:
+                plan.page_infos.append((n, dfl, rep, "values", values))
+            if stats is not None:
+                stats.host_fallback_pages += 1
+
+    # -- device dispatch (async; nothing synchronizes here) --------------------
+    for batch in hybrid_batches:
+        dev = batch.dispatch()
+        plan.dev_hybrid.append(dev)
+        if stats is not None:
+            stats.device_values += batch.out_count
+            stats.device_batches += 1
+    for batch in delta_batches:
+        dev = batch.dispatch()
+        if dev is not None:
+            plan.dev_delta.append(dev)
+            if stats is not None:
+                stats.device_values += batch.out_count
+                stats.device_batches += 1
+    return plan
+
+
+def _split_page(raw, header, pt, codec, column: Column):
+    """Split a data page into levels (host-decoded) and the value stream."""
+    from ..ops.levels import decode_levels_v1, decode_levels_v2
+
+    if pt == int(PageType.DATA_PAGE):
+        h = header.data_page_header
+        n = h.num_values or 0
+        block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
+        buf = memoryview(block)
+        pos = 0
+        rep = None
+        if column.max_rep > 0:
+            rep, used = decode_levels_v1(buf, n, column.max_rep)
+            pos += used
+        dfl = None
+        non_null = n
+        if column.max_def > 0:
+            dfl, used = decode_levels_v1(buf[pos:], n, column.max_def)
+            pos += used
+            non_null = int((dfl == column.max_def).sum())
+        return n, dfl, rep, non_null, h.encoding, bytes(buf[pos:])
+
+    h = header.data_page_header_v2
+    n = h.num_values or 0
+    rep_len = h.repetition_levels_byte_length or 0
+    def_len = h.definition_levels_byte_length or 0
+    buf = memoryview(raw.payload)
+    if rep_len + def_len > len(buf):
+        raise ChunkError("chunk: v2 level sizes exceed page")
+    rep = decode_levels_v2(buf[:rep_len], n, column.max_rep) if column.max_rep > 0 else None
+    dfl = None
+    non_null = n
+    if column.max_def > 0:
+        dfl = decode_levels_v2(buf[rep_len : rep_len + def_len], n, column.max_def)
+        non_null = int((dfl == column.max_def).sum())
+    values_buf = bytes(buf[rep_len + def_len :])
+    if h.is_compressed is None or h.is_compressed:
+        un = (header.uncompressed_page_size or 0) - rep_len - def_len
+        values_buf = decompress_block(values_buf, codec, max(un, 0))
+    return n, dfl, rep, non_null, h.encoding, values_buf
 
 
 def read_chunk_tpu(
@@ -211,211 +617,30 @@ def read_chunk_tpu(
     Byte-identical to core.chunk.read_chunk (the M1 oracle) — enforced by
     tests/test_tpu_backend.py on every supported shape.
     """
-    md = chunk.meta_data
-    codec = md.codec or 0
-    dictionary = None
-    dict_dev = None
-    expected = md.num_values or 0
+    return plan_chunk_tpu(
+        f, chunk, column, validate_crc=validate_crc, alloc=alloc, stats=stats
+    ).finalize()
 
-    page_infos = []  # (num_values, def, rep, kind, payload-specific)
-    hybrid_batch = _HybridBatch()
-    hybrid_takes: list[int] = []
-    delta_batch: _DeltaBatch | None = None
-    ptype = column.type
 
-    for raw in iter_chunk_pages(f, chunk):
-        header = raw.header
-        if alloc is not None:
-            alloc.check(header.uncompressed_page_size or 0)
-        pt = header.type
-        if pt == int(PageType.DICTIONARY_PAGE):
-            if dictionary is not None:
-                raise ChunkError("chunk: more than one dictionary page")
-            if validate_crc:
-                _check_crc(header, raw.payload)
-            block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
-            dictionary = decode_dict_page(header, block, column)
-            if isinstance(dictionary, np.ndarray) and dictionary.ndim == 1:
-                # Floats travel as bit patterns: TPU f64 transfer is not
-                # bit-exact (observed 1-ulp corruption through the axon
-                # runtime), and a gather is dtype-agnostic anyway.
-                if dictionary.dtype.kind == "f":
-                    u = np.uint32 if dictionary.dtype.itemsize == 4 else np.uint64
-                    dict_dev = jnp.asarray(dictionary.view(u))
-                else:
-                    dict_dev = jnp.asarray(dictionary)
-            continue
-        if pt == int(PageType.INDEX_PAGE):
-            continue
-        if pt not in (int(PageType.DATA_PAGE), int(PageType.DATA_PAGE_V2)):
-            raise ChunkError(f"chunk: unknown page type {pt}")
-        if validate_crc:
-            _check_crc(header, raw.payload)
+def _device_bitcast(vals: jnp.ndarray, column: Column) -> jnp.ndarray:
+    """Bitcast gathered uint patterns back to the column's real dtype."""
+    if column.type == Type.FLOAT:
+        return jax.lax.bitcast_convert_type(vals, jnp.float32)
+    if column.type == Type.DOUBLE:
+        return jax.lax.bitcast_convert_type(vals, jnp.float64)
+    return vals
 
-        # -- split levels (host) from values (device) --------------------------
-        if pt == int(PageType.DATA_PAGE):
-            h = header.data_page_header
-            n = h.num_values or 0
-            block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
-            buf = memoryview(block)
-            pos = 0
-            rep = None
-            if column.max_rep > 0:
-                rep, used = decode_levels_v1(buf, n, column.max_rep)
-                pos += used
-            dfl = None
-            non_null = n
-            if column.max_def > 0:
-                dfl, used = decode_levels_v1(buf[pos:], n, column.max_def)
-                pos += used
-                non_null = int((dfl == column.max_def).sum())
-            enc = h.encoding
-            values_buf = bytes(buf[pos:])
-        else:
-            h = header.data_page_header_v2
-            n = h.num_values or 0
-            rep_len = h.repetition_levels_byte_length or 0
-            def_len = h.definition_levels_byte_length or 0
-            buf = memoryview(raw.payload)
-            if rep_len + def_len > len(buf):
-                raise ChunkError("chunk: v2 level sizes exceed page")
-            rep = (
-                decode_levels_v2(buf[:rep_len], n, column.max_rep)
-                if column.max_rep > 0
-                else None
-            )
-            dfl = None
-            non_null = n
-            if column.max_def > 0:
-                dfl = decode_levels_v2(buf[rep_len : rep_len + def_len], n, column.max_def)
-                non_null = int((dfl == column.max_def).sum())
-            values_buf = bytes(buf[rep_len + def_len :])
-            if h.is_compressed is None or h.is_compressed:
-                un = (header.uncompressed_page_size or 0) - rep_len - def_len
-                values_buf = decompress_block(values_buf, codec, max(un, 0))
-            enc = h.encoding
 
-        if stats is not None:
-            stats.pages += 1
-
-        # -- route the value stream --------------------------------------------
-        if enc in (int(Encoding.RLE_DICTIONARY), int(Encoding.PLAIN_DICTIONARY)):
-            if dictionary is None:
-                raise PageError("page: dictionary encoding without dictionary")
-            if non_null == 0:
-                page_infos.append((n, dfl, rep, "empty", None))
-                continue
-            width = values_buf[0] if values_buf else 0
-            if width > 32:
-                raise PageError(f"page: invalid dict index width {width}")
-            table = prescan_hybrid(values_buf[1:], non_null, width)
-            if hybrid_batch.add_page(table, non_null, width):
-                hybrid_takes.append(non_null)
-                page_infos.append((n, dfl, rep, "dict", None))
-            else:  # width changed mid-chunk — rare; decode alone
-                from ..ops.rle_hybrid import expand_runs
-
-                idx = expand_runs(table, non_null, width, np.uint32)
-                page_infos.append((n, dfl, rep, "indices", idx))
-                if stats is not None:
-                    stats.host_fallback_pages += 1
-        elif enc == int(Encoding.DELTA_BINARY_PACKED) and ptype in (Type.INT32, Type.INT64):
-            nbits = 32 if ptype == Type.INT32 else 64
-            if delta_batch is None:
-                delta_batch = _DeltaBatch(nbits)
-            table = prescan_delta(values_buf, nbits, max_total=non_null)
-            delta_batch.add_page(table)
-            page_infos.append((n, dfl, rep, "delta", table.total))
-        elif enc == int(Encoding.PLAIN) and ptype in (
-            Type.INT32,
-            Type.INT64,
-            Type.FLOAT,
-            Type.DOUBLE,
-        ):
-            dt = {
-                Type.INT32: np.int32,
-                Type.INT64: np.int64,
-                Type.FLOAT: np.float32,
-                Type.DOUBLE: np.float64,
-            }[ptype]
-            need = non_null * np.dtype(dt).itemsize
-            if len(values_buf) < need:
-                raise PageError("page: plain payload too short")
-            vals = np.frombuffer(values_buf, dtype=dt, count=non_null)
-            page_infos.append((n, dfl, rep, "values", vals))
-        else:
-            # Anything else (byte arrays, boolean, deltas on other types):
-            # host decode for this page.
-            from ..core.page import _decode_values
-
-            dict_size = len(dictionary) if dictionary is not None else None
-            values, indices = _decode_values(values_buf, non_null, enc, column, dict_size)
-            if indices is not None:
-                page_infos.append((n, dfl, rep, "indices", indices))
-            else:
-                page_infos.append((n, dfl, rep, "values", values))
-            if stats is not None:
-                stats.host_fallback_pages += 1
-
-    # -- device execution ------------------------------------------------------
-    dict_indices_flat = None
-    if hybrid_takes:
-        dict_indices_flat = _expand_hybrid_batch(hybrid_batch, hybrid_takes)
-        if stats is not None:
-            stats.device_values += len(dict_indices_flat)
-    delta_flat = None
-    if delta_batch is not None:
-        delta_flat = _expand_delta_batch(delta_batch)
-        if stats is not None:
-            stats.device_values += len(delta_flat)
-
-    # -- reassemble per-page values in order -----------------------------------
-    pages_values = []
-    all_def: list[np.ndarray] = []
-    all_rep: list[np.ndarray] = []
-    take_iter = iter(hybrid_takes)
-    hpos = 0
-    dpos = 0
-    num_values_total = 0
-    for n, dfl, rep, kind, payload in page_infos:
-        num_values_total += n
-        if dfl is not None:
-            all_def.append(dfl)
-        if rep is not None:
-            all_rep.append(rep)
-        if kind == "dict":
-            take = next(take_iter)
-            idx = dict_indices_flat[hpos : hpos + take]
-            hpos += take
-            pages_values.append(_materialize(dictionary, dict_dev, idx))
-        elif kind == "indices":
-            pages_values.append(_materialize(dictionary, dict_dev, payload))
-        elif kind == "delta":
-            total = payload
-            vals = delta_flat[dpos : dpos + total]
-            dpos += total
-            pages_values.append(vals)
-        elif kind == "values":
-            pages_values.append(payload)
-        elif kind == "empty":
-            pass
-
-    if num_values_total != expected:
-        raise ChunkError(
-            f"chunk: pages hold {num_values_total} values, metadata says {expected}"
+def _upload_typed(host: np.ndarray) -> jnp.ndarray:
+    """Upload a host array; floats travel as bit patterns (the axon f64
+    transfer is not bit-exact) and are bitcast back on device."""
+    if host.dtype.kind == "f":
+        u = np.uint32 if host.dtype.itemsize == 4 else np.uint64
+        return jax.lax.bitcast_convert_type(
+            jnp.asarray(host.view(u)),
+            jnp.float32 if host.dtype.itemsize == 4 else jnp.float64,
         )
-
-    values = _concat_values(pages_values, column)
-    def_levels = np.concatenate(all_def) if all_def else None
-    rep_levels = np.concatenate(all_rep) if all_rep else None
-    return ChunkData(
-        column=column,
-        num_values=num_values_total,
-        values=values,
-        def_levels=def_levels,
-        rep_levels=rep_levels,
-        dictionary=dictionary,
-    )
+    return jnp.asarray(host)
 
 
 def _materialize(dictionary, dict_dev, indices: np.ndarray):

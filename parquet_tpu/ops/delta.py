@@ -37,7 +37,9 @@ __all__ = [
     "decode_delta",
     "encode_delta",
     "prescan_delta",
+    "prescan_delta_packed",
     "DeltaTable",
+    "DeltaPackedTable",
 ]
 
 # Defaults carried over from the reference (chunk_writer.go:53-57,69-73).
@@ -144,6 +146,99 @@ def prescan_delta(data, nbits: int, max_total: int | None = None) -> DeltaTable:
     )
     return DeltaTable(
         deltas_plus_min=deltas,
+        first_value=first & mask,
+        total=total,
+        consumed=pos,
+    )
+
+
+@dataclass
+class DeltaPackedTable:
+    """Header-only prescan of a delta stream: payload bytes stay *packed*.
+
+    The TPU path uploads the wire bytes plus these tiny tables and unpacks on
+    device (kernels/device_ops.py delta_packed_decode_device) — the upload is
+    the encoded size, not 8 bytes/value. One entry per miniblock that covers
+    >=1 real delta (zero-width miniblocks included: they still carry the
+    block's min_delta).
+    """
+
+    widths: np.ndarray  # uint32[M]
+    byte_starts: np.ndarray  # int64[M], payload offset in the stream
+    out_starts: np.ndarray  # int32[M], delta index (0-based) at miniblock start
+    mins: np.ndarray  # uint64[M], block min_delta mod 2**nbits
+    first_value: int  # unsigned first value (mod 2**nbits)
+    total: int  # value count from the header
+    consumed: int  # bytes consumed from the input
+
+
+def prescan_delta_packed(data, nbits: int, max_total: int | None = None) -> DeltaPackedTable:
+    """Walk delta block/miniblock *headers* only; never unpack payloads.
+
+    Same validation discipline as prescan_delta (reference:
+    deltabp_decoder.go:51-111 header sanity); the payload bytes are left in
+    place for the device kernel.
+    """
+    if nbits not in (32, 64):
+        raise DeltaError(f"delta: unsupported type width {nbits}")
+    mask = (1 << nbits) - 1
+    buf = memoryview(data) if not isinstance(data, memoryview) else data
+    end = len(buf)
+    pos = 0
+    block_size, pos = read_uvarint(buf, pos, end, DeltaError)
+    mini_count, pos = read_uvarint(buf, pos, end, DeltaError)
+    total, pos = read_uvarint(buf, pos, end, DeltaError)
+    first, pos = read_zigzag(buf, pos, end, DeltaError)
+    if block_size <= 0 or block_size % 128 != 0 or block_size > (1 << 20):
+        raise DeltaError(f"delta: invalid block size {block_size}")
+    if mini_count <= 0 or mini_count > 512 or block_size % mini_count != 0:
+        raise DeltaError(f"delta: invalid miniblock count {mini_count}")
+    mini_len = block_size // mini_count
+    if mini_len % 8 != 0:
+        raise DeltaError(f"delta: miniblock length {mini_len} not a multiple of 8")
+    if max_total is not None and total > max(max_total, 0):
+        raise DeltaError(
+            f"delta: stream claims {total} values, caller expects at most {max_total}"
+        )
+    plausible = 1 + (end // (1 + mini_count) + 1) * block_size
+    if total > plausible:
+        raise DeltaError(
+            f"delta: implausible value count {total} for {end}-byte stream"
+        )
+
+    n_deltas = max(total - 1, 0)
+    widths: list[int] = []
+    byte_starts: list[int] = []
+    out_starts: list[int] = []
+    mins: list[int] = []
+    produced = 0
+    while produced < n_deltas:
+        min_delta, pos = read_zigzag(buf, pos, end, DeltaError)
+        if pos + mini_count > end:
+            raise DeltaError("delta: truncated miniblock widths")
+        wbytes = bytes(buf[pos : pos + mini_count])
+        pos += mini_count
+        md = min_delta & mask
+        for w in wbytes:
+            remaining = n_deltas - produced
+            if remaining <= 0:
+                continue  # unused trailing miniblock: width byte, no payload
+            if w > nbits:
+                raise DeltaError(f"delta: miniblock width {w} exceeds type width")
+            payload = (mini_len // 8) * w
+            if pos + payload > end:
+                raise DeltaError("delta: miniblock payload exceeds buffer")
+            widths.append(w)
+            byte_starts.append(pos)
+            out_starts.append(produced)
+            mins.append(md)
+            pos += payload
+            produced += min(mini_len, remaining)
+    return DeltaPackedTable(
+        widths=np.array(widths, dtype=np.uint32),
+        byte_starts=np.array(byte_starts, dtype=np.int64),
+        out_starts=np.array(out_starts, dtype=np.int32),
+        mins=np.array(mins, dtype=np.uint64),
         first_value=first & mask,
         total=total,
         consumed=pos,

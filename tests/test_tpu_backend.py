@@ -160,3 +160,203 @@ class TestDeviceOpBuckets:
         assert _bucket(1024) == 1024
         assert _bucket(1025) == 2048
         assert _bucket(3) == 1024
+
+    def test_delta_multi_page_segmented_cumsum(self, tmp_path):
+        # many small pages force per-page segmentation inside one device batch
+        v = rng.integers(-(2**40), 2**40, 50_000).astype(np.int64)
+        t = pa.table({"x": pa.array(v)})
+        path = str(tmp_path / "dseg.parquet")
+        pq.write_table(
+            t, path, use_dictionary=False, data_page_size=2048,
+            column_encoding={"x": "DELTA_BINARY_PACKED"},
+        )
+        both_backends(path)
+
+    def test_delta_int32_negatives(self, tmp_path):
+        v = rng.integers(-(2**30), 2**30, 20_000).astype(np.int32)
+        t = pa.table({"x": pa.array(v)})
+        path = str(tmp_path / "d32.parquet")
+        pq.write_table(
+            t, path, use_dictionary=False,
+            column_encoding={"x": "DELTA_BINARY_PACKED"},
+        )
+        both_backends(path)
+
+    def test_delta_batch_split_at_bits_cap(self, tmp_path, monkeypatch):
+        from parquet_tpu.kernels import pipeline
+        from parquet_tpu.kernels.pipeline import TpuDecodeStats, plan_chunk_tpu
+
+        v = np.cumsum(rng.integers(-500, 500, 30_000)).astype(np.int64)
+        t = pa.table({"x": pa.array(v)})
+        path = str(tmp_path / "dsplit.parquet")
+        pq.write_table(
+            t, path, use_dictionary=False, data_page_size=2048,
+            column_encoding={"x": "DELTA_BINARY_PACKED"},
+        )
+        monkeypatch.setattr(pipeline, "_BATCH_BITS_CAP", 4096 * 8)
+        stats = TpuDecodeStats()
+        with FileReader(path) as r:
+            cc = r.row_group(0).columns[0]
+            col = r.schema.column(("x",))
+            tpu_chunk = plan_chunk_tpu(r._f, cc, col, stats=stats).finalize()
+        assert stats.device_batches > 1
+        with FileReader(path, backend="host") as r:
+            host_chunk = r.read_row_group(0)[("x",)]
+        assert_chunks_identical(host_chunk, tpu_chunk)
+
+    def test_hybrid_batch_split_at_bits_cap(self, tmp_path, monkeypatch):
+        # Force the int32-safety batch cap down so one chunk needs several
+        # device batches; output must stay byte-identical.
+        from parquet_tpu.kernels import pipeline
+        from parquet_tpu.kernels.pipeline import TpuDecodeStats, plan_chunk_tpu
+
+        t = pa.table({"x": pa.array(rng.integers(0, 100, 40_000).astype(np.int64))})
+        path = str(tmp_path / "split.parquet")
+        pq.write_table(t, path, data_page_size=2048)
+        monkeypatch.setattr(pipeline, "_BATCH_BITS_CAP", 4096 * 8)
+        stats = TpuDecodeStats()
+        with FileReader(path) as r:
+            cc = r.row_group(0).columns[0]
+            col = r.schema.column(("x",))
+            plan = plan_chunk_tpu(r._f, cc, col, stats=stats)
+            tpu_chunk = plan.finalize()
+        assert stats.device_batches > 1
+        with FileReader(path, backend="host") as r:
+            host_chunk = r.read_row_group(0)[("x",)]
+        assert_chunks_identical(host_chunk, tpu_chunk)
+
+
+def device_vs_host(path):
+    """Check read_row_group_device delivers the same values as the host path."""
+    with FileReader(path, backend="host") as r:
+        host = {i: r.read_row_group(i) for i in range(r.num_row_groups)}
+    with FileReader(path) as r:
+        dev = {i: r.read_row_group_device(i) for i in range(r.num_row_groups)}
+    for i in host:
+        assert host[i].keys() == dev[i].keys()
+        for p in host[i]:
+            h, d = host[i][p], dev[i][p]
+            assert d.num_values == h.num_values
+            if d.indices is not None:  # dictionary-encoded byte arrays
+                idx = np.asarray(d.indices)
+                got = d.dictionary.take(idx.astype(np.int64))
+                assert isinstance(h.values, ByteArrayData)
+                np.testing.assert_array_equal(got.offsets, h.values.offsets)
+                assert got.data == h.values.data
+                # device-side dictionary copy matches too
+                np.testing.assert_array_equal(
+                    np.asarray(d.dict_offsets), d.dictionary.offsets
+                )
+                assert bytes(np.asarray(d.dict_data)) == d.dictionary.data
+            elif d.offsets is not None:  # byte arrays uploaded flat
+                assert isinstance(h.values, ByteArrayData)
+                np.testing.assert_array_equal(np.asarray(d.offsets), h.values.offsets)
+                assert bytes(np.asarray(d.data)) == h.values.data
+            else:
+                got = np.asarray(d.values)
+                want = np.asarray(h.values)
+                assert got.dtype == want.dtype
+                if got.dtype.kind == "f":
+                    u = np.uint32 if got.itemsize == 4 else np.uint64
+                    np.testing.assert_array_equal(got.view(u), want.view(u))
+                else:
+                    np.testing.assert_array_equal(got, want)
+            for lv in ("def_levels", "rep_levels"):
+                la, lb = getattr(h, lv), getattr(d, lv)
+                assert (la is None) == (lb is None)
+                if la is not None:
+                    np.testing.assert_array_equal(la, lb)
+
+
+class TestDecodeToDevice:
+    def test_numeric_dict_column(self, tmp_path):
+        t = pa.table({"x": pa.array(rng.integers(0, 500, 30_000).astype(np.int64))})
+        path = str(tmp_path / "dd.parquet")
+        pq.write_table(t, path, compression="snappy")
+        device_vs_host(path)
+
+    def test_string_dict_column_stays_encoded(self, tmp_path):
+        vals = [f"cat_{i % 40}" for i in range(20_000)]
+        t = pa.table({"s": pa.array(vals)})
+        path = str(tmp_path / "ds.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            dc = r.read_row_group_device(0)[("s",)]
+        assert dc.indices is not None  # delivered Arrow-dictionary style
+        device_vs_host(path)
+
+    def test_delta_and_plain_numeric(self, tmp_path):
+        ts = (10**15 + np.cumsum(rng.integers(0, 900, 25_000))).astype(np.int64)
+        t = pa.table({
+            "ts": pa.array(ts),
+            "v": pa.array(rng.standard_normal(25_000)),
+            "f": pa.array(rng.standard_normal(25_000).astype(np.float32)),
+        })
+        path = str(tmp_path / "dp.parquet")
+        pq.write_table(
+            t, path, use_dictionary=False,
+            column_encoding={"ts": "DELTA_BINARY_PACKED", "v": "PLAIN", "f": "PLAIN"},
+        )
+        device_vs_host(path)
+
+    def test_plain_strings_upload_path(self, tmp_path):
+        t = pa.table({"s": pa.array([f"unique_{i}" for i in range(15_000)])})
+        path = str(tmp_path / "du.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        device_vs_host(path)
+
+    def test_optional_and_nested(self, tmp_path):
+        data = [list(range(i % 5)) if i % 6 else None for i in range(4000)]
+        t = pa.table({
+            "l": pa.array(data, pa.list_(pa.int64())),
+            "o": pa.array([i if i % 4 else None for i in range(4000)], pa.int64()),
+        })
+        path = str(tmp_path / "don.parquet")
+        pq.write_table(t, path, compression="snappy")
+        device_vs_host(path)
+
+    def test_all_null_dict_column(self, tmp_path):
+        # regression: every page is kind 'empty' — must not crash on concat
+        t = pa.table({"s": pa.array([None] * 5000, pa.string())})
+        path = str(tmp_path / "allnull.parquet")
+        pq.write_table(t, path)
+        device_vs_host(path)
+
+    def test_oversized_page_falls_back_to_host(self, tmp_path, monkeypatch):
+        # regression: a single page above the int32 bit-offset cap must be
+        # host-decoded, not silently wrapped into negative offsets
+        from parquet_tpu.kernels import pipeline
+        from parquet_tpu.kernels.pipeline import TpuDecodeStats, plan_chunk_tpu
+
+        t = pa.table({
+            "x": pa.array(rng.integers(0, 64, 20_000).astype(np.int64)),
+            "ts": pa.array(np.cumsum(rng.integers(0, 9, 20_000)).astype(np.int64)),
+        })
+        path = str(tmp_path / "big.parquet")
+        pq.write_table(
+            t, path, data_page_size=1 << 30,
+            use_dictionary=["x"], column_encoding={"ts": "DELTA_BINARY_PACKED"},
+        )
+        monkeypatch.setattr(pipeline, "_BATCH_BITS_CAP", 128)  # absurdly small
+        with FileReader(path, backend="host") as r:
+            host = r.read_row_group(0)
+        with FileReader(path) as r:
+            for j, cc in enumerate(r.row_group(0).columns):
+                p = tuple(cc.meta_data.path_in_schema)
+                stats = TpuDecodeStats()
+                plan = plan_chunk_tpu(r._f, cc, r.schema.column(p), stats=stats)
+                assert stats.host_fallback_pages > 0, p
+                assert_chunks_identical(host[p], plan.finalize())
+
+    def test_values_live_on_device(self, tmp_path):
+        import jax
+
+        t = pa.table({"x": pa.array(np.arange(1000, dtype=np.int64))})
+        path = str(tmp_path / "dev.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        with FileReader(path) as r:
+            dc = r.read_row_group_device(0)[("x",)]
+        assert isinstance(dc.values, jax.Array)
+        # usable directly by jitted compute without a host trip
+        total = jax.jit(lambda a: a.sum())(dc.values)
+        assert int(total) == int(np.arange(1000).sum())
