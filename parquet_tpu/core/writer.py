@@ -52,6 +52,25 @@ __all__ = ["FileWriter", "WriterError"]
 
 ROW_GROUP_SIZE_DEFAULT = 128 << 20  # bytes, reference file_writer.go default
 
+# Allowed fallback (non-dictionary) encodings per physical type — the write
+# side of the reference's encoder selection matrix (chunk_writer.go:13-128;
+# per-column encoding choice mirrors New*Store(enc, useDict, params),
+# data_store.go:364-461).
+_ALLOWED_ENCODINGS = {
+    Type.BOOLEAN: {Encoding.PLAIN, Encoding.RLE},
+    Type.INT32: {Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED},
+    Type.INT64: {Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED},
+    Type.INT96: {Encoding.PLAIN},
+    Type.FLOAT: {Encoding.PLAIN},
+    Type.DOUBLE: {Encoding.PLAIN},
+    Type.BYTE_ARRAY: {
+        Encoding.PLAIN,
+        Encoding.DELTA_LENGTH_BYTE_ARRAY,
+        Encoding.DELTA_BYTE_ARRAY,
+    },
+    Type.FIXED_LEN_BYTE_ARRAY: {Encoding.PLAIN},
+}
+
 
 class WriterError(ValueError):
     pass
@@ -79,9 +98,18 @@ class FileWriter:
         max_page_size: int = MAX_PAGE_SIZE_DEFAULT,
         row_group_size: int = ROW_GROUP_SIZE_DEFAULT,
         enable_dictionary: bool = True,
+        column_encodings: dict | None = None,
+        use_dictionary=None,
         with_crc: bool = False,
         key_value_metadata: dict | None = None,
     ):
+        """`column_encodings` maps a leaf ("a.b" or tuple) to the fallback
+        value encoding used when the column is not dictionary-encoded:
+        PLAIN (default), DELTA_BINARY_PACKED (int32/int64), RLE (boolean),
+        DELTA_LENGTH_BYTE_ARRAY / DELTA_BYTE_ARRAY (byte arrays).
+        `use_dictionary` is True/False for all columns or a list of leaves
+        to dictionary-encode (overrides `enable_dictionary` when given) —
+        the per-column useDict of the reference (data_store.go:364-461)."""
         if isinstance(sink, (str, Path)):
             self._f = open(sink, "wb")
             self._owns_file = True
@@ -104,6 +132,10 @@ class FileWriter:
         self.max_page_size = max_page_size
         self.row_group_size = row_group_size
         self.enable_dictionary = enable_dictionary
+        self._column_encodings = self._resolve_encodings(schema, column_encodings)
+        self._dict_columns = self._resolve_use_dictionary(
+            schema, use_dictionary, enable_dictionary
+        )
         self.with_crc = with_crc
         self.created_by = created_by
         self.key_value_metadata = dict(key_value_metadata or {})
@@ -116,9 +148,52 @@ class FileWriter:
         self._reset_builders()
         self._write(MAGIC)  # leading magic (reference: file_writer.go:240-244)
 
+    @staticmethod
+    def _leaf(schema: Schema, key) -> Column:
+        try:
+            leaf = schema.column(key)
+        except Exception:
+            raise WriterError(
+                f"writer: {key!r} is not a leaf column of the schema"
+            ) from None
+        if not leaf.is_leaf:
+            raise WriterError(f"writer: {key!r} is not a leaf column of the schema")
+        return leaf
+
+    def _resolve_encodings(self, schema: Schema, column_encodings) -> dict:
+        out: dict[tuple, Encoding] = {}
+        for key, enc in (column_encodings or {}).items():
+            leaf = self._leaf(schema, key)
+            if isinstance(enc, str):
+                try:
+                    enc = Encoding[enc.upper()]
+                except KeyError:
+                    raise WriterError(f"writer: unknown encoding {enc!r}") from None
+            enc = Encoding(enc)
+            allowed = _ALLOWED_ENCODINGS.get(leaf.type, {Encoding.PLAIN})
+            if enc not in allowed:
+                names = ", ".join(sorted(e.name for e in allowed))
+                raise WriterError(
+                    f"writer: encoding {enc.name} not supported for "
+                    f"{leaf.type.name} column {key!r} (allowed: {names})"
+                )
+            out[leaf.path] = enc
+        return out
+
+    def _resolve_use_dictionary(self, schema: Schema, use_dictionary, default) -> set:
+        if use_dictionary is None:
+            use_dictionary = default
+        if use_dictionary is True:
+            return {leaf.path for leaf in schema.leaves}
+        if use_dictionary is False:
+            return set()
+        if isinstance(use_dictionary, (str, bytes)):
+            use_dictionary = [use_dictionary]  # one column, not its characters
+        return {self._leaf(schema, k).path for k in use_dictionary}
+
     def _reset_builders(self) -> None:
         self._builders = {
-            leaf.path: ColumnChunkBuilder(leaf, self.enable_dictionary)
+            leaf.path: ColumnChunkBuilder(leaf, leaf.path in self._dict_columns)
             for leaf in self.schema.leaves
         }
         self._columnar_rows = None
@@ -281,7 +356,7 @@ class FileWriter:
             page_values = indices
             dict_size = len(dict_values)
         else:
-            value_encoding = Encoding.PLAIN
+            value_encoding = self._column_encodings.get(column.path, Encoding.PLAIN)
             page_values = typed
             dict_size = None
 
