@@ -187,7 +187,7 @@ class FileWriter:
             return {leaf.path for leaf in schema.leaves}
         if use_dictionary is False:
             return set()
-        if isinstance(use_dictionary, (str, bytes)):
+        if isinstance(use_dictionary, str):
             use_dictionary = [use_dictionary]  # one column, not its characters
         return {self._leaf(schema, k).path for k in use_dictionary}
 
@@ -254,8 +254,27 @@ class FileWriter:
 
     # -- row group flush -------------------------------------------------------
 
-    def flush_row_group(self) -> None:
+    def flush_row_group(self, metadata=None, column_metadata=None) -> None:
+        """Flush buffered rows/columns as one row group.
+
+        `metadata` ({k: v}) attaches key-value metadata to every column chunk
+        of this row group; `column_metadata` ({leaf: {k: v}}) targets single
+        columns — the reference's per-flush FlushRowGroupOption KV metadata
+        (file_writer.go:156-226, WithRowGroupMetaData[ForColumn])."""
         self._check_open()
+        per_col: dict[tuple, dict] = {}
+        if metadata or column_metadata:
+            if not self._shredder.num_rows and self._columnar_rows is None:
+                raise WriterError(
+                    "writer: flush_row_group with metadata but nothing buffered "
+                    "(an auto-flush may have emptied the buffer)"
+                )
+            for leaf in self.schema.leaves:
+                kv = dict(metadata or {})
+                per_col[leaf.path] = kv
+            for key, kv in (column_metadata or {}).items():
+                per_col.setdefault(self._leaf(self.schema, key).path, {}).update(kv)
+        self._flush_kv = per_col
         if self._shredder.num_rows:
             shredded, n_rows = self._shredder.drain()
             for path, (vals, dls, rls) in shredded.items():
@@ -279,6 +298,7 @@ class FileWriter:
             chunks.append(cc)
             total_bytes += cc.meta_data.total_uncompressed_size
             total_compressed += cc.meta_data.total_compressed_size
+        self._flush_kv = {}
         first_md = chunks[0].meta_data if chunks else None
         first_page_offset = None
         if first_md is not None:
@@ -388,6 +408,7 @@ class FileWriter:
         )
         total_compressed = self._pos - first_offset
         stats = compute_statistics(column.type, typed, null_count)
+        kv = getattr(self, "_flush_kv", {}).get(column.path)
         md = ColumnMetaData(
             type=int(column.type),
             encodings=sorted(encodings),
@@ -400,6 +421,9 @@ class FileWriter:
             dictionary_page_offset=dict_offset,
             statistics=stats,
             encoding_stats=enc_stats,
+            key_value_metadata=(
+                [KeyValue(key=k, value=v) for k, v in kv.items()] if kv else None
+            ),
         )
         return ColumnChunk(file_offset=0, meta_data=md)
 
@@ -462,6 +486,7 @@ class FileWriter:
     # -- lifecycle -------------------------------------------------------------
 
     _uncompressed_total = 0
+    _flush_kv: dict = {}
 
     def close(self) -> FileMetaData:
         self._check_open()

@@ -1,0 +1,218 @@
+"""Cross-implementation conformance matrix — the compatibility/ harness analogue.
+
+The reference validates its written files with Java parquet-mr across
+{none,gzip,snappy} x {v1,v2} (reference: compatibility/run_tests.bash:3-19,
+Dockerfile:13-37) and reads the apache/parquet-testing corpus (SURVEY §4.5-4.6).
+No JVM or network here, so pyarrow (Arrow C++, the most widely deployed
+implementation) is the oracle, both directions:
+
+  write-with-ours  -> read-with-pyarrow   (the parquet-mr direction)
+  write-with-pyarrow -> read-with-ours    (the golden-corpus direction),
+                                          host AND tpu decode backends
+
+parameterized over page version x codec x dictionary x CRC, on a table that
+exercises every physical type plus optional and LIST columns.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.arrays import ByteArrayData
+from parquet_tpu.core.reader import FileReader
+from parquet_tpu.core.writer import FileWriter
+from parquet_tpu.meta.parquet_types import Type
+from parquet_tpu.schema.builder import (
+    list_of,
+    message,
+    optional,
+    required,
+    string,
+)
+
+N = 3000
+rng = np.random.default_rng(99)
+
+CODECS = ["uncompressed", "snappy", "gzip", "zstd"]
+VERSIONS = [1, 2]
+
+
+def _sample_columns():
+    return {
+        "i32": rng.integers(-(2**31), 2**31, N).astype(np.int32),
+        "i64": rng.integers(-(2**62), 2**62, N).astype(np.int64),
+        "f32": rng.standard_normal(N).astype(np.float32),
+        "f64": rng.standard_normal(N),
+        "flag": rng.random(N) < 0.5,
+        "name": [f"name_{i % 101}" for i in range(N)],
+    }
+
+
+def _our_schema():
+    return message(
+        required("i32", Type.INT32),
+        required("i64", Type.INT64),
+        required("f32", Type.FLOAT),
+        required("f64", Type.DOUBLE),
+        required("flag", Type.BOOLEAN),
+        required("name", string()),
+    )
+
+
+class TestOursToPyarrow:
+    """Files we write must be readable by Arrow C++ — byte-exact values."""
+
+    @pytest.mark.parametrize("codec", CODECS)
+    @pytest.mark.parametrize("version", VERSIONS)
+    def test_matrix(self, tmp_path, codec, version):
+        cols = _sample_columns()
+        path = str(tmp_path / f"ours_{codec}_{version}.parquet")
+        with FileWriter(
+            path, _our_schema(), codec=codec, data_page_version=version
+        ) as w:
+            for k, v in cols.items():
+                if k == "name":
+                    w.write_column(k, ByteArrayData.from_list([s.encode() for s in v]))
+                else:
+                    w.write_column(k, v)
+            w.flush_row_group()
+        t = pq.read_table(path)
+        for k, v in cols.items():
+            got = t.column(k).to_pylist()
+            if k == "name":
+                assert got == list(v)
+            elif k == "flag":
+                assert got == v.tolist()
+            elif np.asarray(v).dtype.kind == "f":
+                np.testing.assert_array_equal(
+                    np.asarray(got, dtype=np.asarray(v).dtype), v
+                )
+            else:
+                assert got == v.tolist()
+
+    @pytest.mark.parametrize("with_crc", [False, True])
+    def test_crc_variants(self, tmp_path, with_crc):
+        cols = _sample_columns()
+        path = str(tmp_path / f"crc_{with_crc}.parquet")
+        with FileWriter(path, _our_schema(), codec="snappy", with_crc=with_crc) as w:
+            for k, v in cols.items():
+                if k == "name":
+                    w.write_column(k, ByteArrayData.from_list([s.encode() for s in v]))
+                else:
+                    w.write_column(k, v)
+            w.flush_row_group()
+        assert pq.read_table(path).column("i64").to_pylist() == cols["i64"].tolist()
+        # and our own reader validates the CRCs we wrote
+        with FileReader(path, validate_crc=True) as r:
+            np.testing.assert_array_equal(
+                r.read_row_group(0)[("i64",)].values, cols["i64"]
+            )
+
+    def test_nested_list_to_pyarrow(self, tmp_path):
+        schema = message(list_of("vals", required("element", Type.INT64)))
+        rows = [{"vals": list(range(i % 5))} for i in range(500)]
+        path = str(tmp_path / "list.parquet")
+        with FileWriter(path, schema) as w:
+            w.write_rows(rows)
+        assert pq.read_table(path).column("vals").to_pylist() == [
+            r["vals"] for r in rows
+        ]
+
+
+class TestPyarrowToOurs:
+    """Files pyarrow writes must decode identically on both our backends."""
+
+    @pytest.mark.parametrize("codec", CODECS)
+    @pytest.mark.parametrize("version", ["1.0", "2.6"])
+    def test_matrix(self, tmp_path, codec, version):
+        cols = _sample_columns()
+        t = pa.table(cols)
+        path = str(tmp_path / f"pa_{codec}_{version}.parquet")
+        pq.write_table(
+            t,
+            path,
+            compression="none" if codec == "uncompressed" else codec,
+            version=version,
+            data_page_version="2.0" if version == "2.6" else "1.0",
+        )
+        for backend in ("host", "tpu"):
+            with FileReader(path, backend=backend) as r:
+                out = {}
+                for i in range(r.num_row_groups):
+                    for p, cd in r.read_row_group(i).items():
+                        out.setdefault(p, []).append(cd)
+            for k, v in cols.items():
+                chunks = out[(k,)]
+                if k == "name":
+                    got = []
+                    for c in chunks:
+                        got.extend(
+                            s.decode() for s in c.values.to_list()
+                        )
+                    assert got == list(v), (backend, k)
+                else:
+                    arr = np.concatenate([np.asarray(c.values) for c in chunks])
+                    want = np.asarray(v)
+                    if want.dtype.kind == "f":
+                        u = np.uint32 if want.itemsize == 4 else np.uint64
+                        np.testing.assert_array_equal(
+                            arr.view(u), want.view(u), err_msg=f"{backend}:{k}"
+                        )
+                    else:
+                        np.testing.assert_array_equal(
+                            arr, want, err_msg=f"{backend}:{k}"
+                        )
+
+    def test_rows_roundtrip_through_assembly(self, tmp_path):
+        cols = _sample_columns()
+        t = pa.table(cols)
+        path = str(tmp_path / "rows.parquet")
+        pq.write_table(t, path, compression="snappy")
+        with FileReader(path) as r:
+            rows = list(r.iter_rows())
+        assert rows == t.to_pylist()
+
+    def test_optional_and_nested_from_pyarrow(self, tmp_path):
+        t = pa.table(
+            {
+                "o": pa.array(
+                    [i if i % 3 else None for i in range(1000)], pa.int64()
+                ),
+                "l": pa.array(
+                    [list(range(i % 4)) if i % 5 else None for i in range(1000)],
+                    pa.list_(pa.int32()),
+                ),
+            }
+        )
+        path = str(tmp_path / "on.parquet")
+        pq.write_table(t, path, compression="zstd")
+        with FileReader(path) as r:
+            rows = list(r.iter_rows())
+        assert rows == t.to_pylist()
+
+
+class TestFullCircle:
+    """ours -> pyarrow -> ours: values survive a round trip through Arrow."""
+
+    def test_full_circle(self, tmp_path):
+        cols = _sample_columns()
+        p1 = str(tmp_path / "ours.parquet")
+        with FileWriter(p1, _our_schema(), codec="snappy") as w:
+            for k, v in cols.items():
+                if k == "name":
+                    w.write_column(k, ByteArrayData.from_list([s.encode() for s in v]))
+                else:
+                    w.write_column(k, v)
+            w.flush_row_group()
+        t = pq.read_table(p1)
+        p2 = str(tmp_path / "back.parquet")
+        pq.write_table(t, p2, compression="gzip")
+        with FileReader(p2) as r:
+            got = {p: cd for i in range(r.num_row_groups) for p, cd in r.read_row_group(i).items()}
+        np.testing.assert_array_equal(got[("i64",)].values, cols["i64"])
+        np.testing.assert_array_equal(
+            np.asarray(got[("f64",)].values).view(np.uint64),
+            cols["f64"].view(np.uint64),
+        )
+        assert [s.decode() for s in got[("name",)].values.to_list()] == list(cols["name"])

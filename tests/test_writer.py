@@ -491,3 +491,72 @@ class TestColumnEncodings:
         with pytest.raises(WriterError, match="not supported for FIXED_LEN"):
             FileWriter(str(tmp_path / "x.parquet"), schema,
                        column_encodings={"f": "DELTA_BYTE_ARRAY"})
+
+
+class TestFlushMetadata:
+    def test_per_flush_column_kv(self, tmp_path):
+        # per-row-group KV metadata on column chunks
+        # (reference: file_writer.go:156-226 FlushRowGroupOption)
+        schema = message(required("a", Type.INT64), required("b", Type.INT64))
+        path = str(tmp_path / "kv.parquet")
+        v = np.arange(100, dtype=np.int64)
+        with FileWriter(path, schema) as w:
+            w.write_column("a", v)
+            w.write_column("b", v)
+            w.flush_row_group(metadata={"batch": "1"}, column_metadata={"a": {"x": "y"}})
+            w.write_column("a", v)
+            w.write_column("b", v)
+            w.flush_row_group()  # no metadata on the second group
+        with FileReader(path) as r:
+            rg0 = {tuple(c.meta_data.path_in_schema):
+                   {kv.key: kv.value for kv in (c.meta_data.key_value_metadata or [])}
+                   for c in r.row_group(0).columns}
+            rg1_kv = [c.meta_data.key_value_metadata for c in r.row_group(1).columns]
+            rows = list(r.iter_rows())
+        assert rg0[("a",)] == {"batch": "1", "x": "y"}
+        assert rg0[("b",)] == {"batch": "1"}
+        assert rg1_kv == [None, None]
+        assert len(rows) == 200
+        assert pq.read_table(path).num_rows == 200
+
+
+class TestSchemaNavigation:
+    def test_sub_schema_and_clone(self, tmp_path):
+        from parquet_tpu.schema.dsl import parse_schema, schema_to_string
+
+        schema = parse_schema("""
+            message doc {
+              required int64 id;
+              optional group meta {
+                required binary name (STRING);
+                optional int32 rank;
+              }
+            }
+        """)
+        sub = schema.sub_schema("meta")
+        assert [l.path_str for l in sub.leaves] == ["name", "rank"]
+        clone = schema.clone()
+        assert schema_to_string(clone) == schema_to_string(schema)
+        # mutating the clone must not touch the original
+        clone.column("id").element.name = "renamed"
+        assert schema.column("id").name == "id"
+
+    def test_flush_metadata_with_empty_buffer_rejected(self, tmp_path):
+        schema = message(required("a", Type.INT64))
+        with FileWriter(str(tmp_path / "e.parquet"), schema) as w:
+            w.write_column("a", np.arange(5, dtype=np.int64))
+            w.flush_row_group()
+            with pytest.raises(WriterError, match="nothing buffered"):
+                w.flush_row_group(metadata={"k": "v"})
+            w.write_column("a", np.arange(5, dtype=np.int64))
+
+
+class TestSchemaClone:
+    def test_clone_deep_copies_logical_type(self):
+        from parquet_tpu.schema.builder import message as msg, required as req, string
+        from parquet_tpu.core.schema import SchemaError
+        s = msg(req("name", string()), req("id", Type.INT64))
+        c = s.clone()
+        assert c.column("name").element.logicalType is not s.column("name").element.logicalType
+        with pytest.raises(SchemaError, match="is a leaf"):
+            s.sub_schema("id")
