@@ -65,3 +65,16 @@ from .schema.dsl import (  # noqa: F401
 )
 from .schema import builder  # noqa: F401
 from . import floor  # noqa: F401
+
+
+def __getattr__(name):
+    # `parallel` imports jax (and flips jax_enable_x64) at module load; keep
+    # that out of the base import path — pure host read/write must work
+    # without jax, and backend init can be slow on experimental platforms.
+    if name == "parallel":
+        import importlib
+
+        module = importlib.import_module(".parallel", __name__)
+        globals()["parallel"] = module
+        return module
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

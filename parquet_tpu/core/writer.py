@@ -143,6 +143,7 @@ class FileWriter:
         self._builders: dict[tuple, ColumnChunkBuilder] = {}
         self._columnar_rows: int | None = None
         self._row_groups: list[RowGroup] = []
+        self._flush_kv: dict[tuple, dict] = {}
         self._pos = 0
         self._closed = False
         self._reset_builders()
@@ -408,7 +409,7 @@ class FileWriter:
         )
         total_compressed = self._pos - first_offset
         stats = compute_statistics(column.type, typed, null_count)
-        kv = getattr(self, "_flush_kv", {}).get(column.path)
+        kv = self._flush_kv.get(column.path)
         md = ColumnMetaData(
             type=int(column.type),
             encodings=sorted(encodings),
@@ -486,7 +487,6 @@ class FileWriter:
     # -- lifecycle -------------------------------------------------------------
 
     _uncompressed_total = 0
-    _flush_kv: dict = {}
 
     def close(self) -> FileMetaData:
         self._check_open()

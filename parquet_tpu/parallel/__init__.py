@@ -1,0 +1,9 @@
+"""Multi-chip scale-out: sharded decode and row-group scans over device meshes."""
+
+from .mesh import (  # noqa: F401
+    PageGrid,
+    build_page_grid,
+    make_decode_mesh,
+    sharded_decode_step,
+)
+from .scan import column_stats, scan_row_groups  # noqa: F401

@@ -519,6 +519,15 @@ class TestFlushMetadata:
         assert len(rows) == 200
         assert pq.read_table(path).num_rows == 200
 
+    def test_flush_metadata_with_empty_buffer_rejected(self, tmp_path):
+        schema = message(required("a", Type.INT64))
+        with FileWriter(str(tmp_path / "e.parquet"), schema) as w:
+            w.write_column("a", np.arange(5, dtype=np.int64))
+            w.flush_row_group()
+            with pytest.raises(WriterError, match="nothing buffered"):
+                w.flush_row_group(metadata={"k": "v"})
+            w.write_column("a", np.arange(5, dtype=np.int64))
+
 
 class TestSchemaNavigation:
     def test_sub_schema_and_clone(self, tmp_path):
@@ -540,15 +549,6 @@ class TestSchemaNavigation:
         # mutating the clone must not touch the original
         clone.column("id").element.name = "renamed"
         assert schema.column("id").name == "id"
-
-    def test_flush_metadata_with_empty_buffer_rejected(self, tmp_path):
-        schema = message(required("a", Type.INT64))
-        with FileWriter(str(tmp_path / "e.parquet"), schema) as w:
-            w.write_column("a", np.arange(5, dtype=np.int64))
-            w.flush_row_group()
-            with pytest.raises(WriterError, match="nothing buffered"):
-                w.flush_row_group(metadata={"k": "v"})
-            w.write_column("a", np.arange(5, dtype=np.int64))
 
 
 class TestSchemaClone:
