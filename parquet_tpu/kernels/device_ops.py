@@ -64,19 +64,27 @@ def bytes_to_words64(data: bytes) -> np.ndarray:
 @partial(jax.jit, static_argnames=("width", "num_values"))
 def expand_hybrid_device(
     packed_words: jnp.ndarray,
-    run_is_rle: jnp.ndarray,  # (R,) bool
-    run_out_start: jnp.ndarray,  # (R,) int32 exclusive cumsum of counts
-    run_rle_value: jnp.ndarray,  # (R,) uint32
-    run_bp_bit_start: jnp.ndarray,  # (R,) int32 bit offset of run payload
+    run_meta: jnp.ndarray,  # (4, R) uint32 — see row layout below
     width: int,
     num_values: int,
 ) -> jnp.ndarray:
     """Expand a prescanned hybrid RLE/bit-packed stream on device.
 
-    For output index i: its run r = searchsorted(run_out_start, i, 'right')-1.
+    run_meta packs the four per-run vectors into ONE upload (the host<->device
+    link pays a fixed per-transfer latency that dwarfs these tiny tables):
+      row 0  is_rle      0/1
+      row 1  out_start   exclusive cumsum of counts (int32 bit pattern)
+      row 2  rle_value   broadcast value of RLE runs
+      row 3  bit_start   bit offset of bit-packed payload (int32 bit pattern)
+
+    For output index i: its run r = searchsorted(out_start, i, 'right')-1.
     RLE runs broadcast their value; bit-packed runs extract bits at
-    run_bp_bit_start[r] + (i - run_out_start[r]) * width.
+    bit_start[r] + (i - out_start[r]) * width.
     """
+    run_is_rle = run_meta[0] != 0
+    run_out_start = jax.lax.bitcast_convert_type(run_meta[1], jnp.int32)
+    run_rle_value = run_meta[2]
+    run_bp_bit_start = jax.lax.bitcast_convert_type(run_meta[3], jnp.int32)
     i = jnp.arange(num_values, dtype=jnp.int32)
     r = jnp.searchsorted(run_out_start, i, side="right").astype(jnp.int32) - 1
     within = i - run_out_start[r]
@@ -92,17 +100,15 @@ def expand_hybrid_device(
     return jnp.where(run_is_rle[r], run_rle_value[r], bp_vals)
 
 
-@partial(jax.jit, static_argnames=("nbits", "num_values"))
+@partial(jax.jit, static_argnames=("nbits", "num_values", "m_pad", "p_pad"))
 def delta_packed_decode_device(
     words: jnp.ndarray,  # packed wire bytes as uint32/uint64 words (+guard)
-    mb_width: jnp.ndarray,  # (M,) uint32 miniblock bit widths
-    mb_bit_start: jnp.ndarray,  # (M,) int32 bit offset of miniblock payload
-    mb_out_start: jnp.ndarray,  # (M,) int32 global delta position of miniblock
-    mb_min: jnp.ndarray,  # (M,) uint32/uint64 block min_delta (mod 2**nbits)
-    page_start: jnp.ndarray,  # (P,) int32 global position of each page's first value
-    page_first: jnp.ndarray,  # (P,) uint32/uint64 first value of each page
+    meta32: jnp.ndarray,  # (3*m_pad + p_pad,) uint32 — packed 32-bit tables
+    meta_wide: jnp.ndarray,  # (m_pad + p_pad,) uint32/uint64 — packed wide tables
     nbits: int,
     num_values: int,
+    m_pad: int,
+    p_pad: int,
 ) -> jnp.ndarray:
     """Fused DELTA_BINARY_PACKED decode of a whole chunk from *wire* bytes.
 
@@ -119,7 +125,21 @@ def delta_packed_decode_device(
     payload never expanded host-side — and the upload is the wire size, ~5-10x
     smaller than the decoded column (the reason device decode beats
     host-decode-plus-upload on the host<->device link).
+
+    The per-miniblock and per-page tables travel as TWO packed uploads
+    (per-transfer latency on the link dwarfs their size):
+      meta32    [widths(m) | bit_starts(m) | out_starts(m) | page_start(p)]
+                (int32 fields as bit patterns)
+      meta_wide [mins(m) | page_first(p)]  in the value dtype's width
     """
+    mb_width = meta32[:m_pad]
+    mb_bit_start = jax.lax.bitcast_convert_type(meta32[m_pad : 2 * m_pad], jnp.int32)
+    mb_out_start = jax.lax.bitcast_convert_type(
+        meta32[2 * m_pad : 3 * m_pad], jnp.int32
+    )
+    page_start = jax.lax.bitcast_convert_type(meta32[3 * m_pad :], jnp.int32)
+    mb_min = meta_wide[:m_pad]
+    page_first = meta_wide[m_pad:]
     i = jnp.arange(num_values, dtype=jnp.int32)
     m = jnp.searchsorted(mb_out_start, i, side="right").astype(jnp.int32) - 1
     w = mb_width[m]

@@ -151,14 +151,14 @@ class _HybridBatch:
         assert total == self.out_count
         n_pad = _bucket(max(total, 1))
         run_pad = _bucket(len(counts), 64)
-        is_rle = np.zeros(run_pad, dtype=bool)
-        values = np.zeros(run_pad, dtype=np.uint32)
-        bit_starts = np.zeros(run_pad, dtype=np.int32)
-        starts = np.full(run_pad, n_pad + 1, dtype=np.int32)
-        is_rle[: len(counts)] = np.concatenate(self.is_rle)
-        values[: len(counts)] = np.concatenate(self.values).astype(np.uint32)
-        bit_starts[: len(counts)] = np.concatenate(self.bit_starts)
-        starts[: len(counts)] = out_start
+        # One packed (4, run_pad) upload — see expand_hybrid_device row layout.
+        meta = np.zeros((4, run_pad), dtype=np.uint32)
+        meta[1] = np.int32(n_pad + 1).view(np.uint32)  # padding sentinel starts
+        k = len(counts)
+        meta[0, :k] = np.concatenate(self.is_rle)
+        meta[1, :k] = out_start.astype(np.int32).view(np.uint32)
+        meta[2, :k] = np.concatenate(self.values).astype(np.uint32)
+        meta[3, :k] = np.concatenate(self.bit_starts).astype(np.int32).view(np.uint32)
         packed = b"".join(self.packed)
         words = bytes_to_words32(packed)
         w_pad = _bucket(len(words), 1024)
@@ -166,10 +166,7 @@ class _HybridBatch:
         words_p[: len(words)] = words
         dev = expand_hybrid_device(
             jnp.asarray(words_p),
-            jnp.asarray(is_rle),
-            jnp.asarray(starts),
-            jnp.asarray(values),
-            jnp.asarray(bit_starts),
+            jnp.asarray(meta),
             width,
             n_pad,
         )
@@ -221,21 +218,28 @@ class _DeltaBatch:
         n_pad = _bucket(total)
         m = sum(len(w) for w in self.widths)
         m_pad = _bucket(max(m, 1), 64)
-        widths = np.zeros(m_pad, dtype=np.uint32)
-        bit_starts = np.zeros(m_pad, dtype=np.int32)
-        out_starts = np.full(m_pad, n_pad + 1, dtype=np.int32)
-        mins = np.zeros(m_pad, dtype=ud)
-        if m:
-            widths[:m] = np.concatenate(self.widths)
-            bit_starts[:m] = np.concatenate(self.byte_starts) * 8
-            out_starts[:m] = np.concatenate(self.out_starts)
-            mins[:m] = np.concatenate(self.mins).astype(ud)
         p = len(self.page_starts)
         p_pad = _bucket(p, 64)
-        page_start = np.full(p_pad, n_pad + 1, dtype=np.int32)
-        page_first = np.zeros(p_pad, dtype=ud)
-        page_start[:p] = self.page_starts
-        page_first[:p] = np.array(self.page_firsts, dtype=ud)
+        sentinel = np.int32(n_pad + 1).view(np.uint32)
+        # Packed uploads — see delta_packed_decode_device field layout.
+        meta32 = np.zeros(3 * m_pad + p_pad, dtype=np.uint32)
+        meta32[2 * m_pad : 3 * m_pad] = sentinel  # out_starts padding
+        meta32[3 * m_pad :] = sentinel  # page_start padding
+        if m:
+            meta32[:m] = np.concatenate(self.widths)
+            meta32[m_pad : m_pad + m] = (
+                (np.concatenate(self.byte_starts) * 8).astype(np.int32).view(np.uint32)
+            )
+            meta32[2 * m_pad : 2 * m_pad + m] = (
+                np.concatenate(self.out_starts).astype(np.int32).view(np.uint32)
+            )
+        meta32[3 * m_pad : 3 * m_pad + p] = (
+            np.asarray(self.page_starts, dtype=np.int32).view(np.uint32)
+        )
+        meta_wide = np.zeros(m_pad + p_pad, dtype=ud)
+        if m:
+            meta_wide[:m] = np.concatenate(self.mins).astype(ud)
+        meta_wide[m_pad : m_pad + p] = np.array(self.page_firsts, dtype=ud)
         stream = b"".join(self.streams)
         words = bytes_to_words32(stream) if nbits == 32 else bytes_to_words64(stream)
         w_pad = _bucket(len(words), 1024)
@@ -243,14 +247,12 @@ class _DeltaBatch:
         words_p[: len(words)] = words
         dev = delta_packed_decode_device(
             jnp.asarray(words_p),
-            jnp.asarray(widths),
-            jnp.asarray(bit_starts),
-            jnp.asarray(out_starts),
-            jnp.asarray(mins),
-            jnp.asarray(page_start),
-            jnp.asarray(page_first),
+            jnp.asarray(meta32),
+            jnp.asarray(meta_wide),
             nbits,
             n_pad,
+            m_pad,
+            p_pad,
         )
         return dev[:total]
 

@@ -113,10 +113,12 @@ def column_stats(reader, devices, columns=None):
     folded = scan_row_groups(reader, devices, map_fn, reduce_fn, columns=columns)
     if folded is None:
         return {}
+    # count == 0: every shard contributed only the fold identity (inverted
+    # dtype extremes) — there are no values, so there are no bounds.
     return {
         p: {
-            "min": np.asarray(s["min"])[()],
-            "max": np.asarray(s["max"])[()],
+            "min": np.asarray(s["min"])[()] if int(s["count"]) else None,
+            "max": np.asarray(s["max"])[()] if int(s["count"]) else None,
             "count": int(s["count"]),
         }
         for p, s in folded.items()

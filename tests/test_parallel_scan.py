@@ -83,6 +83,16 @@ class TestShardedScan:
             with pytest.raises(ValueError, match="no devices"):
                 scan_row_groups(r, [], lambda c: 0, lambda a, b: a)
 
+    def test_all_null_column_has_no_bounds(self, tmp_path):
+        # a column with zero values in every row group must not surface the
+        # fold identity (inverted dtype extremes) as real min/max
+        t = pa.table({"x": pa.array([None] * 2000, pa.int64())})
+        path = str(tmp_path / "allnull.parquet")
+        pq.write_table(t, path, row_group_size=500)
+        with FileReader(path) as r:
+            stats = column_stats(r, jax.devices(), columns=["x"])
+        assert stats[("x",)] == {"min": None, "max": None, "count": 0}
+
     def test_all_null_boolean_shard(self, tmp_path):
         # regression: empty bool values array must yield identity stats,
         # not a jnp.iinfo(bool) crash
