@@ -225,6 +225,63 @@ def _device_ready(timeout_s: float = 240.0) -> bool:
     return False
 
 
+# -- phase isolation -----------------------------------------------------------
+#
+# Every measurement phase runs in its OWN subprocess. Reason (measured, not
+# theoretical): on the axon TPU backend, the first device->host DMA of a
+# process permanently switches that process's transfer path into a slow
+# synchronous mode (~30x slower uploads, async dispatch gone). Any phase that
+# fetches results (verification, decode-to-host) would poison the timing of
+# every phase after it. Process isolation gives each phase a fresh, fast
+# connection; the persistent XLA compile cache (kernels/device_ops.py) makes
+# the per-process compile cost a few seconds after the first-ever run.
+
+
+def _phase_verify(path) -> None:
+    verify_deliveries(path)
+    host = decode_all_host(path)
+    tpu = decode_all_tpu_to_host(path)
+    _verify_host_paths(host, tpu)
+    print(json.dumps({"ok": True}))
+
+
+_PHASE_FNS = {
+    "host": decode_all_host,
+    "tpu_host": decode_all_tpu_to_host,
+    "baseline": deliver_baseline,
+    "device": deliver_device,
+}
+
+
+def _phase_timed(name: str, path) -> None:
+    fn = _PHASE_FNS[name]
+    fn(path)  # warmup: compile (disk-cached) + connection establishment
+    t = timed(lambda: fn(path), REPEATS, name)
+    print(json.dumps({"t": t}))
+
+
+def _run_phase(name: str, timeout_s: float = 1800.0) -> dict | None:
+    import subprocess
+
+    cmd = [sys.executable, os.path.abspath(__file__), "--phase", name]
+    try:
+        proc = subprocess.run(
+            cmd, stdout=subprocess.PIPE, timeout=timeout_s, cwd=str(Path(__file__).parent)
+        )
+    except subprocess.TimeoutExpired:
+        log(f"bench: phase {name} timed out after {timeout_s:.0f}s")
+        return None
+    if proc.returncode != 0:
+        log(f"bench: phase {name} exited {proc.returncode}")
+        return None
+    for line in reversed(proc.stdout.decode().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    log(f"bench: phase {name} produced no result line")
+    return None
+
+
 def main() -> None:
     path = build_file()
     if not _device_ready():
@@ -245,27 +302,25 @@ def main() -> None:
         )
         return
 
-    # warmup (compile) + verification
-    log("bench: warmup + parity checks")
-    verify_deliveries(path)
-    host = decode_all_host(path)
-    tpu = decode_all_tpu_to_host(path)
-    _verify_host_paths(host, tpu)
-    del host, tpu
+    log("bench: parity checks (isolated process; also warms the compile cache)")
+    if _run_phase("verify") is None:
+        raise SystemExit("bench: verification phase failed")
 
     # secondary metric (stderr): classic decode-to-host rows/s
-    t_h = timed(lambda: decode_all_host(path), REPEATS, "to-host/host")
-    t_t = timed(lambda: decode_all_tpu_to_host(path), REPEATS, "to-host/tpu")
-    log(
-        f"bench: decode-to-host: host {ROWS / t_h / 1e6:.2f} M rows/s | "
-        f"tpu {ROWS / t_t / 1e6:.2f} M rows/s | ratio {t_h / t_t:.2f}x"
-    )
+    r_h = _run_phase("host")
+    r_t = _run_phase("tpu_host")
+    if r_h and r_t:
+        log(
+            f"bench: decode-to-host: host {ROWS / r_h['t'] / 1e6:.2f} M rows/s | "
+            f"tpu {ROWS / r_t['t'] / 1e6:.2f} M rows/s | ratio {r_h['t'] / r_t['t']:.2f}x"
+        )
 
-    # headline: columns delivered into HBM
-    log("bench: timing delivery-to-HBM (baseline: host decode + upload)")
-    t_base = timed(lambda: deliver_baseline(path), REPEATS, "to-HBM/baseline")
-    log("bench: timing delivery-to-HBM (device decode)")
-    t_dev = timed(lambda: deliver_device(path), REPEATS, "to-HBM/device")
+    # headline: columns delivered into HBM, each path in a clean process
+    r_base = _run_phase("baseline")
+    r_dev = _run_phase("device")
+    if not (r_base and r_dev):
+        raise SystemExit("bench: to-HBM phases failed")
+    t_base, t_dev = r_base["t"], r_dev["t"]
 
     rate = ROWS / t_dev
     vs = t_base / t_dev
@@ -310,4 +365,12 @@ def _verify_host_paths(host, tpu) -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
+        name = sys.argv[2]
+        p = build_file()
+        if name == "verify":
+            _phase_verify(p)
+        else:
+            _phase_timed(name, p)
+    else:
+        main()

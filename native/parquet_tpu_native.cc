@@ -543,4 +543,255 @@ ssize_t ptq_bytearray_take(const char* data, size_t data_len,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// DELTA_BINARY_PACKED header-only prescan (device-decode planning hot path)
+// ---------------------------------------------------------------------------
+
+// Walks block/miniblock headers only (payload bytes stay packed for the
+// device kernel). One table entry per miniblock covering >=1 real delta.
+// Semantics mirror ops/delta.py prescan_delta_packed exactly. Returns the
+// number of entries M, or -1 corrupt, -2 table overflow, -3 count exceeds
+// max_total / implausible.
+ssize_t ptq_prescan_delta_packed(const uint8_t* src, size_t src_len, int nbits,
+                                 int64_t max_total, uint32_t* widths,
+                                 int64_t* byte_starts, int32_t* out_starts,
+                                 uint64_t* mins, size_t max_entries,
+                                 uint64_t* first_value, int64_t* total_out,
+                                 int64_t* consumed) {
+  if (nbits != 32 && nbits != 64) return -1;
+  size_t pos = 0;
+  uint64_t block_size, mini_count, total_u, first_zz;
+  if (!read_uvarint64(src, src_len, &pos, &block_size)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &mini_count)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &total_u)) return -1;
+  if (!read_uvarint64(src, src_len, &pos, &first_zz)) return -1;
+  if (block_size == 0 || block_size % 128 != 0 || block_size > (1ull << 20)) return -1;
+  if (mini_count == 0 || mini_count > 512 || block_size % mini_count != 0) return -1;
+  uint64_t mini_len = block_size / mini_count;
+  if (mini_len % 8 != 0) return -1;
+  if (total_u > (1ull << 62)) return -1;
+  int64_t total = static_cast<int64_t>(total_u);
+  if (max_total >= 0 && total > max_total) return -3;
+  uint64_t plausible = 1 + (src_len / (1 + mini_count) + 1) * block_size;
+  if (total_u > plausible) return -3;
+  const uint64_t mask = (nbits == 64) ? ~0ull : ((1ull << nbits) - 1);
+  *first_value = ((first_zz >> 1) ^ (~(first_zz & 1) + 1)) & mask;
+  *total_out = total;
+
+  int64_t n_deltas = total > 1 ? total - 1 : 0;
+  int64_t produced = 0;
+  size_t m = 0;
+  while (produced < n_deltas) {
+    uint64_t md_zz;
+    if (!read_uvarint64(src, src_len, &pos, &md_zz)) return -1;
+    uint64_t min_delta = ((md_zz >> 1) ^ (~(md_zz & 1) + 1)) & mask;
+    if (pos + mini_count > src_len) return -1;
+    const uint8_t* wb = src + pos;
+    pos += mini_count;
+    for (uint64_t i = 0; i < mini_count; i++) {
+      int64_t remaining = n_deltas - produced;
+      if (remaining <= 0) continue;  // unused trailing miniblock: no payload
+      int w = wb[i];
+      if (w > nbits) return -1;
+      uint64_t payload = (mini_len / 8) * static_cast<uint64_t>(w);
+      if (pos + payload > src_len) return -1;
+      if (m >= max_entries) return -2;
+      widths[m] = static_cast<uint32_t>(w);
+      byte_starts[m] = static_cast<int64_t>(pos);
+      out_starts[m] = static_cast<int32_t>(produced);
+      mins[m] = min_delta;
+      m++;
+      pos += payload;
+      produced += remaining < static_cast<int64_t>(mini_len)
+                      ? remaining : static_cast<int64_t>(mini_len);
+    }
+  }
+  *consumed = static_cast<int64_t>(pos);
+  return static_cast<ssize_t>(m);
+}
+
+// ---------------------------------------------------------------------------
+// Thrift compact-protocol PageHeader parser (one header per page — the hot
+// metadata path, SURVEY §7.3.6). Unknown/unneeded fields (statistics) are
+// skipped by wire type exactly like generated Thrift readers.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct CpReader {
+  const uint8_t* src;
+  size_t len;
+  size_t pos;
+  bool truncated;  // ran off the window (retry with a larger peek)
+};
+
+inline bool cp_byte(CpReader* r, uint8_t* out) {
+  if (r->pos >= r->len) { r->truncated = true; return false; }
+  *out = r->src[r->pos++];
+  return true;
+}
+
+inline bool cp_uvarint(CpReader* r, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (;;) {
+    uint8_t b;
+    if (!cp_byte(r, &b)) return false;
+    if (shift > 63) return false;
+    v |= static_cast<uint64_t>(b & 0x7f) << shift;
+    if (!(b & 0x80)) break;
+    shift += 7;
+  }
+  *out = v;
+  return true;
+}
+
+inline bool cp_zigzag(CpReader* r, int64_t* out) {
+  uint64_t u;
+  if (!cp_uvarint(r, &u)) return false;
+  *out = static_cast<int64_t>((u >> 1) ^ (~(u & 1) + 1));
+  return true;
+}
+
+bool cp_skip(CpReader* r, int wire, int depth);
+
+// Skip the fields of a struct up to and including STOP.
+bool cp_skip_struct(CpReader* r, int depth) {
+  if (depth > 16) return false;
+  for (;;) {
+    uint8_t fh;
+    if (!cp_byte(r, &fh)) return false;
+    if (fh == 0) return true;  // STOP
+    if (!(fh >> 4)) {          // long form: explicit zigzag field id
+      int64_t fid;
+      if (!cp_zigzag(r, &fid)) return false;
+    }
+    if (!cp_skip(r, fh & 0x0F, depth)) return false;
+  }
+}
+
+bool cp_skip(CpReader* r, int wire, int depth) {
+  if (depth > 16) return false;
+  uint64_t u;
+  int64_t s;
+  uint8_t b;
+  switch (wire) {
+    case 1: case 2: return true;        // bool true/false: value in type nibble
+    case 3: return cp_byte(r, &b);      // byte
+    case 4: case 5: case 6:             // i16/i32/i64: zigzag varint
+      return cp_zigzag(r, &s);
+    case 7:                             // double: 8 bytes
+      if (r->pos + 8 > r->len) { r->truncated = true; return false; }
+      r->pos += 8;
+      return true;
+    case 8:                             // binary: len + bytes
+      if (!cp_uvarint(r, &u)) return false;
+      if (r->pos + u > r->len) { r->truncated = true; return false; }
+      r->pos += u;
+      return true;
+    case 9: case 10: {                  // list/set: (size<<4)|etype
+      if (!cp_byte(r, &b)) return false;
+      uint64_t n = b >> 4;
+      int etype = b & 0x0F;
+      if (n == 15 && !cp_uvarint(r, &n)) return false;
+      for (uint64_t i = 0; i < n; i++)
+        if (!cp_skip(r, etype, depth + 1)) return false;
+      return true;
+    }
+    case 11: {                          // map: size==0 -> empty, else kv types
+      if (!cp_uvarint(r, &u)) return false;
+      if (u == 0) return true;
+      if (!cp_byte(r, &b)) return false;
+      for (uint64_t i = 0; i < u; i++) {
+        if (!cp_skip(r, b >> 4, depth + 1)) return false;
+        if (!cp_skip(r, b & 0x0F, depth + 1)) return false;
+      }
+      return true;
+    }
+    case 12: return cp_skip_struct(r, depth + 1);
+    default: return false;              // unknown wire type: corrupt
+  }
+}
+
+// Parse one nested header struct, keeping declared fields into keep[fid-1].
+// kinds[fid-1] gives the declared type: 'i' int (i16/i32/i64), 'b' bool.
+// A field whose wire type mismatches its declaration is skipped by wire type
+// (left absent), matching the Python reader's _wire_matches discipline.
+bool cp_parse_flat_struct(CpReader* r, int64_t* keep, const char* kinds,
+                          int n_keep) {
+  int64_t fid = 0;
+  for (;;) {
+    uint8_t fh;
+    if (!cp_byte(r, &fh)) return false;
+    if (fh == 0) return true;
+    int delta = fh >> 4;
+    int wire = fh & 0x0F;
+    if (delta) fid += delta;
+    else if (!cp_zigzag(r, &fid)) return false;
+    char kind = (fid >= 1 && fid <= n_keep) ? kinds[fid - 1] : 0;
+    if (kind == 'b' && (wire == 1 || wire == 2)) {
+      keep[fid - 1] = (wire == 1) ? 1 : 0;
+    } else if (kind == 'i' && wire >= 4 && wire <= 6) {
+      int64_t v;
+      if (!cp_zigzag(r, &v)) return false;
+      keep[fid - 1] = v;
+    } else {
+      if (!cp_skip(r, wire, 0)) return false;
+    }
+  }
+}
+
+}  // namespace
+
+// Slot layout of out[28] (absent = INT64_MIN):
+//   0 consumed bytes         1 type    2 uncompressed_size  3 compressed_size
+//   4 crc
+//   5 v1 present   6..9   v1 {num_values, encoding, def_enc, rep_enc}
+//  10 dict present 11..13 dict {num_values, encoding, is_sorted}
+//  14 v2 present   15..21 v2 {num_values, num_nulls, num_rows, encoding,
+//                             def_len, rep_len, is_compressed}
+//  22 index present
+// Returns 0 on success, -1 corrupt, -2 window truncated (retry larger).
+ssize_t ptq_parse_page_header(const uint8_t* src, size_t src_len, int64_t* out) {
+  const int64_t ABSENT = INT64_MIN;
+  for (int i = 0; i < 23; i++) out[i] = ABSENT;
+  CpReader r{src, src_len, 0, false};
+  int64_t fid = 0;
+  for (;;) {
+    uint8_t fh;
+    if (!cp_byte(&r, &fh)) return r.truncated ? -2 : -1;
+    if (fh == 0) break;  // STOP
+    int delta = fh >> 4;
+    int wire = fh & 0x0F;
+    if (delta) fid += delta;
+    else if (!cp_zigzag(&r, &fid)) return r.truncated ? -2 : -1;
+    bool ok = true;
+    if (fid >= 1 && fid <= 4 && wire >= 4 && wire <= 6) {
+      int64_t v;
+      ok = cp_zigzag(&r, &v);
+      if (ok) out[fid] = v;
+    } else if (fid == 5 && wire == 12) {
+      int64_t keep[4] = {ABSENT, ABSENT, ABSENT, ABSENT};
+      ok = cp_parse_flat_struct(&r, keep, "iiii", 4);
+      if (ok) { out[5] = 1; for (int i = 0; i < 4; i++) out[6 + i] = keep[i]; }
+    } else if (fid == 6 && wire == 12) {
+      ok = cp_skip_struct(&r, 1);
+      if (ok) out[22] = 1;
+    } else if (fid == 7 && wire == 12) {
+      int64_t keep[3] = {ABSENT, ABSENT, ABSENT};
+      ok = cp_parse_flat_struct(&r, keep, "iib", 3);
+      if (ok) { out[10] = 1; for (int i = 0; i < 3; i++) out[11 + i] = keep[i]; }
+    } else if (fid == 8 && wire == 12) {
+      int64_t keep[7] = {ABSENT, ABSENT, ABSENT, ABSENT, ABSENT, ABSENT, ABSENT};
+      ok = cp_parse_flat_struct(&r, keep, "iiiiiib", 7);
+      if (ok) { out[14] = 1; for (int i = 0; i < 7; i++) out[15 + i] = keep[i]; }
+    } else {
+      ok = cp_skip(&r, wire, 0);
+    }
+    if (!ok) return r.truncated ? -2 : -1;
+  }
+  out[0] = static_cast<int64_t>(r.pos);
+  return 0;
+}
+
 }  // extern "C"

@@ -74,20 +74,97 @@ class RawPage:
     offset: int  # absolute file offset of the page header
 
 
+_ABSENT = -(1 << 63)  # ptq_parse_page_header's "field absent" sentinel
+
+
+def _header_from_slots(s) -> PageHeader:
+    """Build a PageHeader from the native parser's slot array (layout in
+    native/parquet_tpu_native.cc ptq_parse_page_header). Page-header
+    statistics are not materialized — they are not consumed on read, matching
+    the reference ("not used by parquet-go", README.md:47)."""
+    from ..meta.parquet_types import (
+        DataPageHeader,
+        DataPageHeaderV2,
+        DictionaryPageHeader,
+        IndexPageHeader,
+    )
+
+    def g(i):
+        v = int(s[i])
+        return None if v == _ABSENT else v
+
+    h = PageHeader(
+        type=g(1),
+        uncompressed_page_size=g(2),
+        compressed_page_size=g(3),
+        crc=g(4),
+    )
+    if int(s[5]) == 1:
+        h.data_page_header = DataPageHeader(
+            num_values=g(6),
+            encoding=g(7),
+            definition_level_encoding=g(8),
+            repetition_level_encoding=g(9),
+        )
+    if int(s[10]) == 1:
+        sorted_ = g(13)
+        h.dictionary_page_header = DictionaryPageHeader(
+            num_values=g(11),
+            encoding=g(12),
+            is_sorted=None if sorted_ is None else bool(sorted_),
+        )
+    if int(s[14]) == 1:
+        comp = g(21)
+        h.data_page_header_v2 = DataPageHeaderV2(
+            num_values=g(15),
+            num_nulls=g(16),
+            num_rows=g(17),
+            encoding=g(18),
+            definition_levels_byte_length=g(19),
+            repetition_levels_byte_length=g(20),
+            is_compressed=None if comp is None else bool(comp),
+        )
+    if int(s[22]) == 1:
+        h.index_page_header = IndexPageHeader()
+    return h
+
+
 def _read_page_header(f) -> PageHeader:
     """Decode one page header from the stream, consuming exactly its bytes.
 
     Thrift needs lookahead but over-reading would swallow page data (the
     reference solves this with an unbuffered reader, helpers.go:104-106); here
     we peek a bounded window, decode, and seek back to the consumed position.
+    One header per page makes this the hot metadata path (SURVEY §7.3.6): the
+    native compact-protocol parser handles it when built, falling back to the
+    declarative Python reader for corrupt input (exact error messages) or
+    when the library is absent.
     """
+    from ..utils.native import get_native
+
     start = f.tell()
     peek = _HEADER_PEEK
+    lib = get_native()
+    use_native = lib is not None and lib.has_parse_page_header
     while True:
         f.seek(start)
         window = f.read(peek)
         if not window:
             raise ChunkError("chunk: eof reading page header")
+        if use_native:
+            try:
+                slots = lib.parse_page_header(window)
+            except ValueError:
+                use_native = False  # corrupt: Python reader for its exact error
+                continue
+            if slots is not None:
+                f.seek(start + int(slots[0]))
+                return _header_from_slots(slots)
+            if len(window) == peek and peek < _HEADER_PEEK_MAX:
+                peek *= 8  # truncated window: re-peek larger
+                continue
+            use_native = False  # truncated file: Python reader for the error
+            continue
         r = CompactReader(window)
         try:
             header = PageHeader.read(r)

@@ -26,9 +26,31 @@ the package README).
 
 from __future__ import annotations
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the decode programs compile in O(100s) on
+# a real TPU backend (one-time per shape bucket); caching them on disk makes
+# every process after the first start in seconds. Opt out with
+# PQT_JAX_COMPILE_CACHE=0; the location is PQT_JAX_COMPILE_CACHE_DIR
+# (default ~/.cache/parquet_tpu/jax). A user-set jax_compilation_cache_dir
+# always wins.
+if (
+    os.environ.get("PQT_JAX_COMPILE_CACHE", "1") != "0"
+    and getattr(jax.config, "jax_compilation_cache_dir", None) is None
+):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "PQT_JAX_COMPILE_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "parquet_tpu", "jax"),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import jax.numpy as jnp
 import numpy as np

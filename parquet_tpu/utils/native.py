@@ -91,6 +91,31 @@ class NativeLib:
                 ctypes.c_char_p,
                 ctypes.c_size_t,
             ]
+        self.has_prescan_delta = hasattr(lib, "ptq_prescan_delta_packed")
+        if self.has_prescan_delta:
+            lib.ptq_prescan_delta_packed.restype = ctypes.c_ssize_t
+            lib.ptq_prescan_delta_packed.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_int,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+        self.has_parse_page_header = hasattr(lib, "ptq_parse_page_header")
+        if self.has_parse_page_header:
+            lib.ptq_parse_page_header.restype = ctypes.c_ssize_t
+            lib.ptq_parse_page_header.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+            ]
         self.has_prescan_hybrid = hasattr(lib, "ptq_prescan_hybrid")
         if self.has_prescan_hybrid:
             lib.ptq_prescan_hybrid.restype = ctypes.c_ssize_t
@@ -250,6 +275,73 @@ class NativeLib:
                 offsets[:n],
                 int(consumed[0]),
             )
+
+
+    def prescan_delta_packed(self, data: bytes, nbits: int, max_total: int):
+        """Header-only delta prescan. Returns (widths, byte_starts, out_starts,
+        mins, first_value, total, consumed). Raises OverflowError when the
+        stream's count exceeds max_total (parity with the Python path)."""
+        import numpy as np
+
+        # One table entry per miniblock with >=1 real delta; mini_len >= 8, so
+        # M <= ceil((total-1)/8) and total <= max_total.
+        max_entries = max(max_total, 8) // 8 + 2
+        widths = np.empty(max_entries, dtype=np.uint32)
+        byte_starts = np.empty(max_entries, dtype=np.int64)
+        out_starts = np.empty(max_entries, dtype=np.int32)
+        mins = np.empty(max_entries, dtype=np.uint64)
+        first = np.zeros(1, dtype=np.uint64)
+        total = np.zeros(1, dtype=np.int64)
+        consumed = np.zeros(1, dtype=np.int64)
+        m = self._lib.ptq_prescan_delta_packed(
+            data,
+            len(data),
+            nbits,
+            max_total,
+            widths.ctypes.data_as(ctypes.c_void_p),
+            byte_starts.ctypes.data_as(ctypes.c_void_p),
+            out_starts.ctypes.data_as(ctypes.c_void_p),
+            mins.ctypes.data_as(ctypes.c_void_p),
+            max_entries,
+            first.ctypes.data_as(ctypes.c_void_p),
+            total.ctypes.data_as(ctypes.c_void_p),
+            consumed.ctypes.data_as(ctypes.c_void_p),
+        )
+        if m == -3:
+            raise OverflowError(
+                f"stream claims more than the caller's bound of {max_total} values"
+            )
+        if m < 0:
+            raise ValueError("native: corrupt delta stream")
+        m = int(m)
+        return (
+            widths[:m],
+            byte_starts[:m],
+            out_starts[:m],
+            mins[:m],
+            int(first[0]),
+            int(total[0]),
+            int(consumed[0]),
+        )
+
+    def parse_page_header(self, window: bytes):
+        """Parse one Thrift compact PageHeader from a peeked window.
+
+        Returns the 23-slot int64 array (see ptq_parse_page_header layout),
+        None when the window was too small (caller re-peeks larger), or
+        raises ValueError on structurally corrupt bytes (caller falls back
+        to the Python reader for its exact error)."""
+        import numpy as np
+
+        out = np.empty(23, dtype=np.int64)
+        rc = self._lib.ptq_parse_page_header(
+            window, len(window), out.ctypes.data_as(ctypes.c_void_p)
+        )
+        if rc == -2:
+            return None
+        if rc < 0:
+            raise ValueError("native: corrupt page header")
+        return out
 
 
 def get_native() -> NativeLib | None:
