@@ -571,7 +571,8 @@ ssize_t ptq_prescan_delta_packed(const uint8_t* src, size_t src_len, int nbits,
   if (mini_len % 8 != 0) return -1;
   if (total_u > (1ull << 62)) return -1;
   int64_t total = static_cast<int64_t>(total_u);
-  if (max_total >= 0 && total > max_total) return -3;
+  if (max_total < 0) max_total = 0;  // match Python's max(max_total, 0) clamp
+  if (total > max_total) return -3;
   uint64_t plausible = 1 + (src_len / (1 + mini_count) + 1) * block_size;
   if (total_u > plausible) return -3;
   const uint64_t mask = (nbits == 64) ? ~0ull : ((1ull << nbits) - 1);
@@ -686,7 +687,9 @@ bool cp_skip(CpReader* r, int wire, int depth) {
       return true;
     case 8:                             // binary: len + bytes
       if (!cp_uvarint(r, &u)) return false;
-      if (r->pos + u > r->len) { r->truncated = true; return false; }
+      // Subtraction form: pos <= len is invariant, so len-pos cannot
+      // underflow, and a near-2^64 u cannot wrap the addition-form check.
+      if (u > r->len - r->pos) { r->truncated = true; return false; }
       r->pos += u;
       return true;
     case 9: case 10: {                  // list/set: (size<<4)|etype
@@ -731,7 +734,7 @@ bool cp_parse_flat_struct(CpReader* r, int64_t* keep, const char* kinds,
     char kind = (fid >= 1 && fid <= n_keep) ? kinds[fid - 1] : 0;
     if (kind == 'b' && (wire == 1 || wire == 2)) {
       keep[fid - 1] = (wire == 1) ? 1 : 0;
-    } else if (kind == 'i' && wire >= 4 && wire <= 6) {
+    } else if (kind == 'i' && wire == 5) {  // exact CT_I32, like _wire_matches
       int64_t v;
       if (!cp_zigzag(r, &v)) return false;
       keep[fid - 1] = v;
@@ -766,7 +769,7 @@ ssize_t ptq_parse_page_header(const uint8_t* src, size_t src_len, int64_t* out) 
     if (delta) fid += delta;
     else if (!cp_zigzag(&r, &fid)) return r.truncated ? -2 : -1;
     bool ok = true;
-    if (fid >= 1 && fid <= 4 && wire >= 4 && wire <= 6) {
+    if (fid >= 1 && fid <= 4 && wire == 5) {  // all i32 fields: exact CT_I32
       int64_t v;
       ok = cp_zigzag(&r, &v);
       if (ok) out[fid] = v;

@@ -181,6 +181,25 @@ def prescan_delta_packed(data, nbits: int, max_total: int | None = None) -> Delt
     """
     if nbits not in (32, 64):
         raise DeltaError(f"delta: unsupported type width {nbits}")
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is not None and lib.has_prescan_delta and max_total is not None:
+        try:
+            widths, byte_starts, out_starts, mins, first, total, consumed = (
+                lib.prescan_delta_packed(bytes(data), nbits, max_total)
+            )
+        except (OverflowError, ValueError) as e:
+            raise DeltaError(f"delta: {e}") from e
+        return DeltaPackedTable(
+            widths=widths,
+            byte_starts=byte_starts,
+            out_starts=out_starts,
+            mins=mins,
+            first_value=int(first),
+            total=int(total),
+            consumed=int(consumed),
+        )
     mask = (1 << nbits) - 1
     buf = memoryview(data) if not isinstance(data, memoryview) else data
     end = len(buf)

@@ -283,9 +283,16 @@ class NativeLib:
         stream's count exceeds max_total (parity with the Python path)."""
         import numpy as np
 
+        # Negative bounds clamp to 0, matching the Python path's
+        # max(max_total, 0); the C side applies the same clamp, and the table
+        # is sized from the bound actually enforced.
+        max_total = max(max_total, 0)
         # One table entry per miniblock with >=1 real delta; mini_len >= 8, so
-        # M <= ceil((total-1)/8) and total <= max_total.
-        max_entries = max(max_total, 8) // 8 + 2
+        # M <= ceil((total-1)/8) and total <= max_total. Each entry also
+        # consumes at least its one width byte from the stream, so M <= len:
+        # a lying header with a huge count must not drive the allocation
+        # (validation-before-allocation discipline).
+        max_entries = min(max(max_total, 8) // 8 + 2, len(data) + 2)
         widths = np.empty(max_entries, dtype=np.uint32)
         byte_starts = np.empty(max_entries, dtype=np.int64)
         out_starts = np.empty(max_entries, dtype=np.int32)

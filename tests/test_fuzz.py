@@ -150,6 +150,56 @@ class TestFrozenCrashers:
         with pytest.raises(CompressionError):
             decompress_block(b"\xff\xff\xff\xff\xff", CompressionCodec.SNAPPY, 10)
 
+    def test_native_page_header_varint_near_2e64_no_hang(self):
+        """A binary field whose varint length is near 2^64 must not wrap the
+        native parser's bound check into an infinite loop (cp_skip used an
+        addition-form check; now subtraction-form)."""
+        from parquet_tpu.ops.varint import emit_uvarint
+        from parquet_tpu.utils.native import get_native
+
+        lib = get_native()
+        if lib is None or not lib.has_parse_page_header:
+            pytest.skip("native library not built")
+        crafted = bytearray([0xF8])  # unknown field, delta 15, wire 8 (binary)
+        emit_uvarint(crafted, 2**64 - 11)
+        crafted += bytes(64)
+        # must terminate promptly: either "window truncated" (None) or raise
+        result = lib.parse_page_header(bytes(crafted))
+        assert result is None
+
+    def test_native_delta_prescan_parity_and_negative_bound(self):
+        from parquet_tpu.ops.delta import DeltaError, encode_delta, prescan_delta_packed
+        from parquet_tpu.utils.native import get_native
+
+        vals = np.arange(5000, dtype=np.int64) * 7 - 123456
+        enc = encode_delta(vals, 64)
+        t_bound = prescan_delta_packed(enc, 64, max_total=5000)  # native if built
+        t_py = prescan_delta_packed(enc, 64, max_total=None)  # always Python
+        assert np.array_equal(t_bound.widths, t_py.widths)
+        assert np.array_equal(t_bound.byte_starts, t_py.byte_starts)
+        assert np.array_equal(t_bound.out_starts, t_py.out_starts)
+        assert np.array_equal(t_bound.mins, t_py.mins)
+        assert (t_bound.first_value, t_bound.total, t_bound.consumed) == (
+            t_py.first_value,
+            t_py.total,
+            t_py.consumed,
+        )
+        if get_native() is not None:
+            # a negative bound clamps to 0 on both paths -> rejects any values
+            with pytest.raises(DeltaError):
+                prescan_delta_packed(enc, 64, max_total=-5)
+        with pytest.raises(DeltaError):
+            prescan_delta_packed(enc, 64, max_total=4999)
+
+    def test_native_delta_prescan_huge_bound_tiny_stream(self):
+        """A lying page header (huge num_values) must not drive table
+        allocation: entries are bounded by the stream length too."""
+        from parquet_tpu.ops.delta import encode_delta, prescan_delta_packed
+
+        enc = encode_delta(np.arange(100, dtype=np.int64), 64)
+        t = prescan_delta_packed(enc, 64, max_total=2**40)
+        assert t.total == 100
+
 
 class TestInt96:
     def test_roundtrip(self):
