@@ -127,8 +127,8 @@ def deliver_device(path):
     out = []
     arrays = []
     with FileReader(path) as r:
-        for i in range(r.num_row_groups):
-            for p, dc in r.read_row_group_device(i).items():
+        for rg in r.read_row_groups_device():
+            for p, dc in rg.items():
                 out.append((p, dc))
                 for a in (dc.values, dc.indices, dc.data, dc.offsets, dc.dict_data, dc.dict_offsets):
                     if a is not None:
@@ -178,9 +178,12 @@ def decode_all_host(path):
 
 
 def decode_all_tpu_to_host(path):
+    """Explicit device decode + fetch-back (backend="tpu" itself auto-routes
+    host-bound reads to the host path; the roundtrip backend is the parity
+    oracle and the honest measure of fetch-back cost)."""
     from parquet_tpu.core.reader import FileReader
 
-    with FileReader(path, backend="tpu") as r:
+    with FileReader(path, backend="tpu_roundtrip") as r:
         return [r.read_row_group(i) for i in range(r.num_row_groups)]
 
 

@@ -1,5 +1,10 @@
 """High-level dataclass mapping (the analogue of the reference's floor examples)."""
 
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
 import datetime as dt
 from dataclasses import dataclass
 from typing import Optional

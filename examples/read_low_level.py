@@ -1,12 +1,17 @@
 """Low-level columnar read (the analogue of the reference's
 examples/read-low-level): open a file, walk row groups, get typed arrays."""
 
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
 import sys
 
 import parquet_tpu as pq
 
 path = sys.argv[1] if len(sys.argv) > 1 else "example.parquet"
-with pq.FileReader(path) as r:  # backend="tpu" for device decode
+with pq.FileReader(path) as r:  # read_row_group_device() for device decode
     print(f"{r.num_rows} rows, {r.num_row_groups} row groups")
     for i in range(r.num_row_groups):
         chunks = r.read_row_group(i)

@@ -5,12 +5,17 @@ The decoded arrays come back byte-identical to the host path; the decode
 programs on the accelerator.
 """
 
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
 import sys
 
 import parquet_tpu as pq
 
 path = sys.argv[1] if len(sys.argv) > 1 else "example.parquet"
-with pq.FileReader(path, backend="tpu") as r:
+with pq.FileReader(path) as r:
     for i in range(r.num_row_groups):
         for col_path, chunk in r.read_row_group(i).items():
             name = ".".join(col_path)

@@ -1,6 +1,11 @@
 """Low-level write with the schema DSL (the analogue of the reference's
 examples/write-low-level)."""
 
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
 import parquet_tpu as pq
 
 schema = pq.parse_schema("""

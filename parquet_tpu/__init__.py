@@ -5,14 +5,14 @@ A brand-new implementation of the capability set of fraugster/parquet-go
 decompression, and record assembly run on the host; the column-decode hot path
 (RLE/bit-packing hybrid, dictionary lookup, delta-binary-packed) runs as batched
 JAX/Pallas kernels behind a pluggable decoder backend
-(FileReader(..., backend="tpu")).
+(FileReader.read_row_group_device(); host-bound reads always decode on host).
 
 Quick start:
 
     import parquet_tpu as pq
 
     # read
-    with pq.FileReader("f.parquet") as r:           # or backend="tpu"
+    with pq.FileReader("f.parquet") as r:
         cols = r.read_row_group(0)                  # columnar arrays
         rows = list(r.iter_rows())                  # assembled records
 

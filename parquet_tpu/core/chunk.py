@@ -81,7 +81,12 @@ def _header_from_slots(s) -> PageHeader:
     """Build a PageHeader from the native parser's slot array (layout in
     native/parquet_tpu_native.cc ptq_parse_page_header). Page-header
     statistics are not materialized — they are not consumed on read, matching
-    the reference ("not used by parquet-go", README.md:47)."""
+    the reference ("not used by parquet-go", README.md:47).
+
+    Construction writes instance __dict__ directly: this runs once per page
+    (the hot metadata path, SURVEY §7.3.6) and the generic TStruct kwargs
+    __init__ was measurable there.
+    """
     from ..meta.parquet_types import (
         DataPageHeader,
         DataPageHeaderV2,
@@ -89,33 +94,45 @@ def _header_from_slots(s) -> PageHeader:
         IndexPageHeader,
     )
 
-    def g(i):
-        v = int(s[i])
-        return None if v == _ABSENT else v
+    v = s.tolist()  # one C call instead of 23 np scalar boxings
 
-    h = PageHeader(
+    def g(i):
+        return None if v[i] == _ABSENT else v[i]
+
+    h = PageHeader.__new__(PageHeader)
+    h.__dict__.update(
         type=g(1),
         uncompressed_page_size=g(2),
         compressed_page_size=g(3),
         crc=g(4),
+        data_page_header=None,
+        index_page_header=None,
+        dictionary_page_header=None,
+        data_page_header_v2=None,
     )
-    if int(s[5]) == 1:
-        h.data_page_header = DataPageHeader(
+    if v[5] == 1:
+        dp = DataPageHeader.__new__(DataPageHeader)
+        dp.__dict__.update(
             num_values=g(6),
             encoding=g(7),
             definition_level_encoding=g(8),
             repetition_level_encoding=g(9),
+            statistics=None,
         )
-    if int(s[10]) == 1:
+        h.data_page_header = dp
+    if v[10] == 1:
         sorted_ = g(13)
-        h.dictionary_page_header = DictionaryPageHeader(
+        dh = DictionaryPageHeader.__new__(DictionaryPageHeader)
+        dh.__dict__.update(
             num_values=g(11),
             encoding=g(12),
             is_sorted=None if sorted_ is None else bool(sorted_),
         )
-    if int(s[14]) == 1:
+        h.dictionary_page_header = dh
+    if v[14] == 1:
         comp = g(21)
-        h.data_page_header_v2 = DataPageHeaderV2(
+        d2 = DataPageHeaderV2.__new__(DataPageHeaderV2)
+        d2.__dict__.update(
             num_values=g(15),
             num_nulls=g(16),
             num_rows=g(17),
@@ -123,8 +140,10 @@ def _header_from_slots(s) -> PageHeader:
             definition_levels_byte_length=g(19),
             repetition_levels_byte_length=g(20),
             is_compressed=None if comp is None else bool(comp),
+            statistics=None,
         )
-    if int(s[22]) == 1:
+        h.data_page_header_v2 = d2
+    if v[22] == 1:
         h.index_page_header = IndexPageHeader()
     return h
 
@@ -179,8 +198,8 @@ def _read_page_header(f) -> PageHeader:
         return header
 
 
-def iter_chunk_pages(f, chunk: ColumnChunk):
-    """Yield RawPage for every page of a chunk (dictionary page first if any)."""
+def chunk_byte_range(chunk: ColumnChunk) -> tuple[int, int]:
+    """Absolute (offset, size) of a chunk's page bytes in the file."""
     md: ColumnMetaData = chunk.meta_data
     if md is None:
         raise ChunkError("chunk: missing metadata")
@@ -198,6 +217,52 @@ def iter_chunk_pages(f, chunk: ColumnChunk):
             offset = md.dictionary_page_offset
     if offset is None or offset <= 0:
         raise ChunkError(f"chunk: invalid page offset {offset}")
+    return offset, total
+
+
+class ChunkWindow:
+    """File-like view over one chunk's preloaded bytes, at absolute offsets.
+
+    Lets the page walk (iter_chunk_pages/_read_page_header, which seek/tell
+    in file coordinates) run against a buffer fetched with a single pread —
+    one I/O per chunk instead of one per page, and no shared file-position
+    state, so chunk preparation can run on worker threads.
+    """
+
+    __slots__ = ("_mv", "_base", "_pos")
+
+    def __init__(self, buf, base: int):
+        self._mv = memoryview(buf)
+        self._base = base
+        self._pos = 0
+
+    def seek(self, offset: int, whence: int = 0):
+        if whence == 0:
+            self._pos = offset - self._base
+        elif whence == 1:
+            self._pos += offset
+        else:
+            self._pos = len(self._mv) + offset
+        return self._base + self._pos
+
+    def tell(self) -> int:
+        return self._base + self._pos
+
+    def read(self, n: int = -1):
+        """Returns a zero-copy memoryview slice (payloads are ~1 MiB; all
+        downstream consumers — thrift reader, codecs, np.frombuffer, crc —
+        accept any buffer)."""
+        if self._pos < 0 or self._pos > len(self._mv):
+            return b""
+        end = len(self._mv) if n is None or n < 0 else min(self._pos + n, len(self._mv))
+        out = self._mv[self._pos : end]
+        self._pos = end
+        return out
+
+
+def iter_chunk_pages(f, chunk: ColumnChunk):
+    """Yield RawPage for every page of a chunk (dictionary page first if any)."""
+    offset, total = chunk_byte_range(chunk)
     f.seek(offset)
     consumed = 0
     while consumed < total:

@@ -75,9 +75,10 @@ class _PyArrowSnappy(_Codec):
         return self._codec.compress(bytes(data)).to_pybytes()
 
     def decompress(self, data, uncompressed_size):
-        return self._codec.decompress(
-            bytes(data), decompressed_size=uncompressed_size
-        ).to_pybytes()
+        # memoryview over the pa.Buffer: zero-copy, buffer kept alive by the view
+        return memoryview(
+            self._codec.decompress(bytes(data), decompressed_size=uncompressed_size)
+        )
 
 
 class _NativeSnappy(_Codec):
@@ -94,7 +95,7 @@ class _NativeSnappy(_Codec):
         return self._lib.snappy_compress(bytes(data))
 
     def decompress(self, data, uncompressed_size):
-        return self._lib.snappy_decompress(bytes(data), uncompressed_size)
+        return self._lib.snappy_decompress(data, uncompressed_size)
 
 
 class _Zstd(_Codec):

@@ -15,6 +15,9 @@ WithDecoderBackend(TPU) of the north star.
 from __future__ import annotations
 
 import io
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
 from ..meta.file_meta import ParquetFileError, read_file_metadata
@@ -26,6 +29,45 @@ from .schema import Schema
 from ..utils.trace import stage
 
 __all__ = ["FileReader"]
+
+_pool: ThreadPoolExecutor | None = None
+_pool_lock = threading.Lock()
+
+
+def _host_pool() -> ThreadPoolExecutor | None:
+    """Shared worker pool for the host-side chunk prepare phase.
+
+    Sized by PQT_HOST_THREADS (default: cpu count, capped at 8). Returns None
+    when threading cannot help (single worker): single-core hosts, or the
+    knob set to 0/1.
+    """
+    global _pool
+    env = os.environ.get("PQT_HOST_THREADS")
+    workers = int(env) if env else min(os.cpu_count() or 1, 8)
+    if workers <= 1:
+        return None
+    with _pool_lock:
+        if _pool is None:
+            _pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="pqt-host"
+            )
+        return _pool
+
+
+_dispatcher: ThreadPoolExecutor | None = None
+
+
+def _dispatch_pool() -> ThreadPoolExecutor:
+    """Single-thread executor that owns device dispatch (uploads + kernel
+    launches): keeps jax calls serialized in deterministic order while
+    overlapping their RPC latency with host-side chunk preparation."""
+    global _dispatcher
+    with _pool_lock:
+        if _dispatcher is None:
+            _dispatcher = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pqt-dispatch"
+            )
+        return _dispatcher
 
 
 def _timed_rows(assembler):
@@ -67,6 +109,7 @@ class FileReader:
         else:
             self._f = source
             self._owns_file = False
+        self._f_lock = threading.Lock()
         try:
             self.metadata = (
                 metadata if metadata is not None else read_file_metadata(self._f)
@@ -74,6 +117,11 @@ class FileReader:
             self.schema = Schema.from_thrift(self.metadata.schema)
             self.validate_crc = validate_crc
             self.alloc = AllocTracker(max_memory) if max_memory else None
+            if backend not in ("host", "tpu", "tpu_roundtrip"):
+                raise ValueError(
+                    f"unknown backend {backend!r}: expected 'host', 'tpu', "
+                    "or 'tpu_roundtrip'"
+                )
             self.backend = backend
             self._selected = self._resolve_columns(columns)
         except BaseException:
@@ -134,11 +182,19 @@ class FileReader:
     def read_row_group(self, i: int, columns=None) -> dict[tuple, ChunkData]:
         """Decode one row group into {leaf path: ChunkData}.
 
-        On the TPU backend all selected chunks are *planned* first (host
-        prescan + async device dispatch), then finalized — every chunk's
-        device work is in flight before the first fetch blocks (JAX async
-        dispatch over the host<->device link)."""
-        if self.backend == "tpu":
+        Host-bound delivery always decodes on the host, even on the TPU
+        backend: round-tripping every value through the device for a host
+        destination is a measured net loss (fetching decoded columns back
+        over the transfer link costs more than decoding them locally). The
+        device path pays off when values *stay* in HBM — that's
+        read_row_group_device. backend="tpu_roundtrip" forces the device
+        decode + fetch anyway: it is the byte-identical parity oracle used
+        by tests/test_tpu_backend.py.
+
+        On the roundtrip backend all selected chunks are *planned* first
+        (host prescan + async device dispatch), then finalized — every
+        chunk's device work is in flight before the first fetch blocks."""
+        if self.backend == "tpu_roundtrip":
             plans = self._plan_row_group(i, columns)
             return {path: plan.finalize() for path, plan in plans.items()}
         out: dict[tuple, ChunkData] = {}
@@ -158,15 +214,110 @@ class FileReader:
         plans = self._plan_row_group(i, columns)
         return {path: plan.device_column() for path, plan in plans.items()}
 
-    def _plan_row_group(self, i: int, columns=None):
-        from ..kernels.pipeline import plan_chunk_tpu
+    def read_row_groups_device(self, row_groups=None, columns=None):
+        """Decode row groups into device memory with full pipelining.
 
-        plans = {}
-        for path, cc, column in self._selected_chunks(i, columns):
-            plans[path] = plan_chunk_tpu(
-                self._f, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
+        Unlike per-group read_row_group_device calls — which resolve each
+        group's dispatch futures before the next group's host prepare starts
+        — this plans EVERY chunk of every requested group first (prepare on
+        worker threads / dispatch on the dispatch thread, all overlapped) and
+        only then materializes results. Returns [{leaf path: DeviceColumn}]
+        in row-group order."""
+        indices = list(
+            range(self.num_row_groups) if row_groups is None else row_groups
+        )
+        staged = self._plan_row_groups_async(indices, columns)
+        return [
+            {path: fut.result().device_column() for path, fut in group}
+            for group in staged
+        ]
+
+    def _plan_row_group_async(self, i: int, columns=None):
+        """Stage one row group: prepare (pool or inline) + enqueue dispatch.
+        Returns [(path, future-of-dispatched-plan)] without resolving."""
+        return self._plan_row_groups_async([i], columns)[0]
+
+    def _plan_row_groups_async(self, indices, columns=None):
+        """Stage chunks of several row groups at once.
+
+        Every chunk's prepare is submitted to the worker pool up front (no
+        per-group barrier — the pool never drains between groups); device
+        dispatch is enqueued per chunk in deterministic (group, column) order
+        as its prepare resolves. Returns [[(path, future-of-dispatched-plan)]]
+        per group, unresolved."""
+        from ..kernels.pipeline import prepare_chunk_plan
+        from ..utils.native import get_native
+        from .chunk import ChunkWindow, chunk_byte_range
+
+        groups = [list(self._selected_chunks(i, columns)) for i in indices]
+
+        def prep(cc, column):
+            offset, total = chunk_byte_range(cc)
+            win = ChunkWindow(self._pread(offset, total), offset)
+            return prepare_chunk_plan(
+                win, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
             )
-        return plans
+
+        dispatcher = _dispatch_pool()
+        pool = _host_pool()
+        staged = []
+        if pool is None or sum(len(g) for g in groups) <= 1:
+            # Single-core host: prepare serially; device dispatch (transfer
+            # RPCs, which release the GIL) still overlaps the next prepare.
+            for chunks in groups:
+                out = []
+                for path, cc, column in chunks:
+                    plan = prep(cc, column)
+                    out.append((path, dispatcher.submit(plan.dispatch_device)))
+                staged.append(out)
+            return staged
+        get_native()  # thread-safe lazy init before fan-out
+        prep_futs = [
+            [(path, pool.submit(prep, cc, column)) for path, cc, column in chunks]
+            for chunks in groups
+        ]
+        for group in prep_futs:
+            out = []
+            for path, fut in group:
+                plan = fut.result()
+                out.append((path, dispatcher.submit(plan.dispatch_device)))
+            staged.append(out)
+        return staged
+
+    def _plan_row_group(self, i: int, columns=None):
+        """Plan every selected chunk of a row group for device decode.
+
+        The host-only prepare phase (one pread per chunk, page walk,
+        decompress, level decode, prescan) fans out over worker threads —
+        decompression and the native prescans release the GIL — while device
+        dispatch runs on the dispatch thread, in deterministic column order,
+        overlapped with the next chunk's prepare.
+        """
+        return {
+            path: fut.result() for path, fut in self._plan_row_group_async(i, columns)
+        }
+
+    def _pread(self, offset: int, size: int) -> bytes:
+        """Positional read that never moves the shared file cursor."""
+        try:
+            fd = self._f.fileno()
+        except (AttributeError, OSError, io.UnsupportedOperation):
+            fd = None
+        pread = getattr(os, "pread", None)  # POSIX-only
+        if fd is not None and pread is not None:
+            try:
+                buf = pread(fd, size, offset)
+                if len(buf) == size:
+                    return buf
+            except OSError:
+                pass
+        with self._f_lock:
+            pos = self._f.tell()
+            try:
+                self._f.seek(offset)
+                return self._f.read(size)
+            finally:
+                self._f.seek(pos)
 
     def _selected_chunks(self, i: int, columns=None):
         """Yield (path, ColumnChunk, Column) for the selected leaves of group i."""

@@ -83,30 +83,35 @@ def bytes_to_words64(data: bytes) -> np.ndarray:
     return np.frombuffer(buf, dtype="<u8")
 
 
-@partial(jax.jit, static_argnames=("width", "num_values"))
+@partial(jax.jit, static_argnames=("width", "num_values", "run_pad"))
 def expand_hybrid_device(
-    packed_words: jnp.ndarray,
-    run_meta: jnp.ndarray,  # (4, R) uint32 — see row layout below
+    buf: jnp.ndarray,  # uint32: [run_meta (4*run_pad) | packed words]
     width: int,
     num_values: int,
+    run_pad: int,
 ) -> jnp.ndarray:
     """Expand a prescanned hybrid RLE/bit-packed stream on device.
 
-    run_meta packs the four per-run vectors into ONE upload (the host<->device
-    link pays a fixed per-transfer latency that dwarfs these tiny tables):
-      row 0  is_rle      0/1
-      row 1  out_start   exclusive cumsum of counts (int32 bit pattern)
-      row 2  rle_value   broadcast value of RLE runs
-      row 3  bit_start   bit offset of bit-packed payload (int32 bit pattern)
+    buf packs the four per-run vectors AND the packed payload words into ONE
+    upload (the host<->device link pays a fixed per-transfer latency that
+    dwarfs these tiny tables). Layout, with run_pad static:
+      buf[0*run_pad:1*run_pad]  is_rle      0/1
+      buf[1*run_pad:2*run_pad]  out_start   exclusive cumsum of counts (int32)
+      buf[2*run_pad:3*run_pad]  rle_value   broadcast value of RLE runs
+      buf[3*run_pad:4*run_pad]  bit_start   bit offset of payload (int32)
+      buf[4*run_pad:]           packed payload words (+1 guard word)
 
     For output index i: its run r = searchsorted(out_start, i, 'right')-1.
     RLE runs broadcast their value; bit-packed runs extract bits at
     bit_start[r] + (i - out_start[r]) * width.
     """
-    run_is_rle = run_meta[0] != 0
-    run_out_start = jax.lax.bitcast_convert_type(run_meta[1], jnp.int32)
-    run_rle_value = run_meta[2]
-    run_bp_bit_start = jax.lax.bitcast_convert_type(run_meta[3], jnp.int32)
+    run_is_rle = buf[:run_pad] != 0
+    run_out_start = jax.lax.bitcast_convert_type(buf[run_pad : 2 * run_pad], jnp.int32)
+    run_rle_value = buf[2 * run_pad : 3 * run_pad]
+    run_bp_bit_start = jax.lax.bitcast_convert_type(
+        buf[3 * run_pad : 4 * run_pad], jnp.int32
+    )
+    packed_words = buf[4 * run_pad :]
     i = jnp.arange(num_values, dtype=jnp.int32)
     r = jnp.searchsorted(run_out_start, i, side="right").astype(jnp.int32) - 1
     within = i - run_out_start[r]
@@ -124,9 +129,8 @@ def expand_hybrid_device(
 
 @partial(jax.jit, static_argnames=("nbits", "num_values", "m_pad", "p_pad"))
 def delta_packed_decode_device(
-    words: jnp.ndarray,  # packed wire bytes as uint32/uint64 words (+guard)
-    meta32: jnp.ndarray,  # (3*m_pad + p_pad,) uint32 — packed 32-bit tables
-    meta_wide: jnp.ndarray,  # (m_pad + p_pad,) uint32/uint64 — packed wide tables
+    meta32: jnp.ndarray,  # uint32 — packed 32-bit tables (+ words when nbits=32)
+    wide: jnp.ndarray,  # uint32/uint64 — packed wide tables (+ words when nbits=64)
     nbits: int,
     num_values: int,
     m_pad: int,
@@ -148,20 +152,30 @@ def delta_packed_decode_device(
     smaller than the decoded column (the reason device decode beats
     host-decode-plus-upload on the host<->device link).
 
-    The per-miniblock and per-page tables travel as TWO packed uploads
-    (per-transfer latency on the link dwarfs their size):
-      meta32    [widths(m) | bit_starts(m) | out_starts(m) | page_start(p)]
-                (int32 fields as bit patterns)
-      meta_wide [mins(m) | page_first(p)]  in the value dtype's width
+    Everything travels in at most TWO packed uploads — one when nbits=32 —
+    because per-transfer latency on the link dwarfs their size:
+      meta32  [widths(m) | bit_starts(m) | out_starts(m) | page_start(p)]
+              (int32 fields as bit patterns); for nbits=32 the wire words
+              are appended after these four tables and `wide` is empty
+      wide    [mins(m) | page_first(p)] in the value dtype's width; for
+              nbits=64 the wire words (uint64) are appended after
     """
     mb_width = meta32[:m_pad]
     mb_bit_start = jax.lax.bitcast_convert_type(meta32[m_pad : 2 * m_pad], jnp.int32)
     mb_out_start = jax.lax.bitcast_convert_type(
         meta32[2 * m_pad : 3 * m_pad], jnp.int32
     )
-    page_start = jax.lax.bitcast_convert_type(meta32[3 * m_pad :], jnp.int32)
-    mb_min = meta_wide[:m_pad]
-    page_first = meta_wide[m_pad:]
+    page_start = jax.lax.bitcast_convert_type(
+        meta32[3 * m_pad : 3 * m_pad + p_pad], jnp.int32
+    )
+    if nbits == 32:
+        mb_min = meta32[3 * m_pad + p_pad : 4 * m_pad + p_pad]
+        page_first = meta32[4 * m_pad + p_pad : 4 * m_pad + 2 * p_pad]
+        words = meta32[4 * m_pad + 2 * p_pad :]
+    else:
+        mb_min = wide[:m_pad]
+        page_first = wide[m_pad : m_pad + p_pad]
+        words = wide[m_pad + p_pad :]
     i = jnp.arange(num_values, dtype=jnp.int32)
     m = jnp.searchsorted(mb_out_start, i, side="right").astype(jnp.int32) - 1
     w = mb_width[m]

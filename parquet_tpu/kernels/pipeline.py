@@ -151,25 +151,24 @@ class _HybridBatch:
         assert total == self.out_count
         n_pad = _bucket(max(total, 1))
         run_pad = _bucket(len(counts), 64)
-        # One packed (4, run_pad) upload — see expand_hybrid_device row layout.
-        meta = np.zeros((4, run_pad), dtype=np.uint32)
-        meta[1] = np.int32(n_pad + 1).view(np.uint32)  # padding sentinel starts
-        k = len(counts)
-        meta[0, :k] = np.concatenate(self.is_rle)
-        meta[1, :k] = out_start.astype(np.int32).view(np.uint32)
-        meta[2, :k] = np.concatenate(self.values).astype(np.uint32)
-        meta[3, :k] = np.concatenate(self.bit_starts).astype(np.int32).view(np.uint32)
+        # ONE packed upload: [is_rle | out_start | rle_value | bit_start | words]
+        # — see expand_hybrid_device layout.
         packed = b"".join(self.packed)
         words = bytes_to_words32(packed)
         w_pad = _bucket(len(words), 1024)
-        words_p = np.zeros(w_pad, dtype=np.uint32)
-        words_p[: len(words)] = words
-        dev = expand_hybrid_device(
-            jnp.asarray(words_p),
-            jnp.asarray(meta),
-            width,
-            n_pad,
+        buf = np.zeros(4 * run_pad + w_pad, dtype=np.uint32)
+        buf[run_pad : 2 * run_pad] = np.int32(n_pad + 1).view(np.uint32)  # sentinel
+        k = len(counts)
+        buf[:k] = np.concatenate(self.is_rle)
+        buf[run_pad : run_pad + k] = out_start.astype(np.int32).view(np.uint32)
+        buf[2 * run_pad : 2 * run_pad + k] = np.concatenate(self.values).astype(
+            np.uint32
         )
+        buf[3 * run_pad : 3 * run_pad + k] = (
+            np.concatenate(self.bit_starts).astype(np.int32).view(np.uint32)
+        )
+        buf[4 * run_pad : 4 * run_pad + len(words)] = words
+        dev = expand_hybrid_device(jnp.asarray(buf), width, n_pad, run_pad)
         return dev[:total]
 
 
@@ -221,10 +220,16 @@ class _DeltaBatch:
         p = len(self.page_starts)
         p_pad = _bucket(p, 64)
         sentinel = np.int32(n_pad + 1).view(np.uint32)
-        # Packed uploads — see delta_packed_decode_device field layout.
-        meta32 = np.zeros(3 * m_pad + p_pad, dtype=np.uint32)
+        stream = b"".join(self.streams)
+        words = bytes_to_words32(stream) if nbits == 32 else bytes_to_words64(stream)
+        w_pad = _bucket(len(words), 1024)
+        # Packed uploads — see delta_packed_decode_device field layout. The
+        # wire words ride in the same upload as the tables: one transfer for
+        # 32-bit values, two for 64-bit (tables at 32, words at 64).
+        tail32 = (2 * m_pad + 2 * p_pad + w_pad) if nbits == 32 else 0
+        meta32 = np.zeros(3 * m_pad + p_pad + tail32, dtype=np.uint32)
         meta32[2 * m_pad : 3 * m_pad] = sentinel  # out_starts padding
-        meta32[3 * m_pad :] = sentinel  # page_start padding
+        meta32[3 * m_pad : 3 * m_pad + p_pad] = sentinel  # page_start padding
         if m:
             meta32[:m] = np.concatenate(self.widths)
             meta32[m_pad : m_pad + m] = (
@@ -236,19 +241,24 @@ class _DeltaBatch:
         meta32[3 * m_pad : 3 * m_pad + p] = (
             np.asarray(self.page_starts, dtype=np.int32).view(np.uint32)
         )
-        meta_wide = np.zeros(m_pad + p_pad, dtype=ud)
-        if m:
-            meta_wide[:m] = np.concatenate(self.mins).astype(ud)
-        meta_wide[m_pad : m_pad + p] = np.array(self.page_firsts, dtype=ud)
-        stream = b"".join(self.streams)
-        words = bytes_to_words32(stream) if nbits == 32 else bytes_to_words64(stream)
-        w_pad = _bucket(len(words), 1024)
-        words_p = np.zeros(w_pad, dtype=words.dtype)
-        words_p[: len(words)] = words
+        if nbits == 32:
+            base = 3 * m_pad + p_pad
+            if m:
+                meta32[base : base + m] = np.concatenate(self.mins).astype(ud)
+            meta32[base + m_pad : base + m_pad + p] = np.array(
+                self.page_firsts, dtype=ud
+            )
+            meta32[base + m_pad + p_pad : base + m_pad + p_pad + len(words)] = words
+            wide = np.zeros(0, dtype=np.uint32)
+        else:
+            wide = np.zeros(m_pad + p_pad + w_pad, dtype=np.uint64)
+            if m:
+                wide[:m] = np.concatenate(self.mins).astype(ud)
+            wide[m_pad : m_pad + p] = np.array(self.page_firsts, dtype=ud)
+            wide[m_pad + p_pad : m_pad + p_pad + len(words)] = words
         dev = delta_packed_decode_device(
-            jnp.asarray(words_p),
             jnp.asarray(meta32),
-            jnp.asarray(meta_wide),
+            jnp.asarray(wide),
             nbits,
             n_pad,
             m_pad,
@@ -296,6 +306,55 @@ class _ChunkPlan:
         self.dev_hybrid: list[jnp.ndarray] = []  # per batch, page order
         self.dev_delta: list[jnp.ndarray] = []  # per batch, page order
         self.stats: TpuDecodeStats | None = None
+        # host-side batches awaiting device dispatch (set by prepare phase)
+        self.hybrid_batches: list[_HybridBatch] = []
+        self.delta_batches: list[_DeltaBatch] = []
+        self.dev_plain: jnp.ndarray | None = None
+        self._dispatched = False
+
+    # -- device dispatch (async; nothing synchronizes here) --------------------
+    #
+    # The only phase that touches jax: keep it on the dispatching thread so
+    # the jax-free prepare phase can run on worker threads.
+
+    def dispatch_device(self) -> "_ChunkPlan":
+        if self._dispatched:
+            return self
+        self._dispatched = True
+        d = self.dictionary
+        if isinstance(d, np.ndarray) and d.ndim == 1:
+            # Floats travel as bit patterns: TPU f64 transfer is not
+            # bit-exact (observed 1-ulp corruption through the axon
+            # runtime), and a gather is dtype-agnostic anyway.
+            if d.dtype.kind == "f":
+                u = np.uint32 if d.dtype.itemsize == 4 else np.uint64
+                self.dict_dev = jnp.asarray(d.view(u))
+            else:
+                self.dict_dev = jnp.asarray(d)
+        # Homogeneous PLAIN numeric chunks are pure uploads; doing them here
+        # (not in device_column) keeps them on the dispatch thread, overlapped
+        # with the next chunk's host prepare.
+        kinds = {k for _, _, _, k, _ in self.page_infos if k != "empty"}
+        if kinds == {"values"} and self.column.type in _NUMERIC_DTYPE:
+            parts = [p for _, _, _, k, p in self.page_infos if k == "values"]
+            host = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            self.dev_plain = _upload_typed(host)
+        stats = self.stats
+        for batch in self.hybrid_batches:
+            self.dev_hybrid.append(batch.dispatch())
+            if stats is not None:
+                stats.device_values += batch.out_count
+                stats.device_batches += 1
+        for batch in self.delta_batches:
+            dev = batch.dispatch()
+            if dev is not None:
+                self.dev_delta.append(dev)
+                if stats is not None:
+                    stats.device_values += batch.out_count
+                    stats.device_batches += 1
+        self.hybrid_batches = []
+        self.delta_batches = []
+        return self
 
     # -- fetch + host reassembly (byte-identical to core.chunk.read_chunk) ----
 
@@ -401,9 +460,12 @@ class _ChunkPlan:
             return out
 
         if "values" in kinds and kinds <= {"values", "empty"} and column.type in _NUMERIC_DTYPE:
-            parts = [p for _, _, _, k, p in self.page_infos if k == "values"]
-            host = parts[0] if len(parts) == 1 else np.concatenate(parts)
-            out.values = _upload_typed(host)
+            if self.dev_plain is not None:
+                out.values = self.dev_plain
+            else:
+                parts = [p for _, _, _, k, p in self.page_infos if k == "values"]
+                host = parts[0] if len(parts) == 1 else np.concatenate(parts)
+                out.values = _upload_typed(host)
             return out
 
         # Mixed, unsupported, or fully empty shapes: host decode, then upload.
@@ -433,6 +495,25 @@ def plan_chunk_tpu(
     for a host ChunkData (byte-identical to core.chunk.read_chunk) or
     .device_column() to keep the decoded values in HBM.
     """
+    return prepare_chunk_plan(
+        f, chunk, column, validate_crc=validate_crc, alloc=alloc, stats=stats
+    ).dispatch_device()
+
+
+def prepare_chunk_plan(
+    f,
+    chunk,
+    column: Column,
+    validate_crc: bool = False,
+    alloc=None,
+    stats: TpuDecodeStats | None = None,
+) -> _ChunkPlan:
+    """Host-only prepare: page walk, decompress, level decode, prescan.
+
+    Touches no jax state, so it is safe to run on worker threads; the
+    returned plan's batches go to the device via plan.dispatch_device() on
+    the dispatching thread.
+    """
     md = chunk.meta_data
     codec = md.codec or 0
     expected = md.num_values or 0
@@ -440,8 +521,8 @@ def plan_chunk_tpu(
     plan.stats = stats
     ptype = column.type
 
-    hybrid_batches: list[_HybridBatch] = []
-    delta_batches: list[_DeltaBatch] = []
+    hybrid_batches = plan.hybrid_batches
+    delta_batches = plan.delta_batches
 
     for raw in iter_chunk_pages(f, chunk):
         header = raw.header
@@ -455,16 +536,6 @@ def plan_chunk_tpu(
                 _check_crc(header, raw.payload)
             block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
             plan.dictionary = decode_dict_page(header, block, column)
-            d = plan.dictionary
-            if isinstance(d, np.ndarray) and d.ndim == 1:
-                # Floats travel as bit patterns: TPU f64 transfer is not
-                # bit-exact (observed 1-ulp corruption through the axon
-                # runtime), and a gather is dtype-agnostic anyway.
-                if d.dtype.kind == "f":
-                    u = np.uint32 if d.dtype.itemsize == 4 else np.uint64
-                    plan.dict_dev = jnp.asarray(d.view(u))
-                else:
-                    plan.dict_dev = jnp.asarray(d)
             continue
         if pt == int(PageType.INDEX_PAGE):
             continue
@@ -547,20 +618,6 @@ def plan_chunk_tpu(
             if stats is not None:
                 stats.host_fallback_pages += 1
 
-    # -- device dispatch (async; nothing synchronizes here) --------------------
-    for batch in hybrid_batches:
-        dev = batch.dispatch()
-        plan.dev_hybrid.append(dev)
-        if stats is not None:
-            stats.device_values += batch.out_count
-            stats.device_batches += 1
-    for batch in delta_batches:
-        dev = batch.dispatch()
-        if dev is not None:
-            plan.dev_delta.append(dev)
-            if stats is not None:
-                stats.device_values += batch.out_count
-                stats.device_batches += 1
     return plan
 
 
@@ -581,10 +638,13 @@ def _split_page(raw, header, pt, codec, column: Column):
         dfl = None
         non_null = n
         if column.max_def > 0:
-            dfl, used = decode_levels_v1(buf[pos:], n, column.max_def)
+            dfl, used, cv = decode_levels_v1(buf[pos:], n, column.max_def, want_const=True)
             pos += used
-            non_null = int((dfl == column.max_def).sum())
-        return n, dfl, rep, non_null, h.encoding, bytes(buf[pos:])
+            if cv is not None:
+                non_null = n if cv == column.max_def else 0
+            else:
+                non_null = int((dfl == column.max_def).sum())
+        return n, dfl, rep, non_null, h.encoding, buf[pos:]
 
     h = header.data_page_header_v2
     n = h.num_values or 0
@@ -597,9 +657,14 @@ def _split_page(raw, header, pt, codec, column: Column):
     dfl = None
     non_null = n
     if column.max_def > 0:
-        dfl = decode_levels_v2(buf[rep_len : rep_len + def_len], n, column.max_def)
-        non_null = int((dfl == column.max_def).sum())
-    values_buf = bytes(buf[rep_len + def_len :])
+        dfl, cv = decode_levels_v2(
+            buf[rep_len : rep_len + def_len], n, column.max_def, want_const=True
+        )
+        if cv is not None:
+            non_null = n if cv == column.max_def else 0
+        else:
+            non_null = int((dfl == column.max_def).sum())
+    values_buf = buf[rep_len + def_len :]
     if h.is_compressed is None or h.is_compressed:
         un = (header.uncompressed_page_size or 0) - rep_len - def_len
         values_buf = decompress_block(values_buf, codec, max(un, 0))

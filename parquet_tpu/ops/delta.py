@@ -187,7 +187,7 @@ def prescan_delta_packed(data, nbits: int, max_total: int | None = None) -> Delt
     if lib is not None and lib.has_prescan_delta and max_total is not None:
         try:
             widths, byte_starts, out_starts, mins, first, total, consumed = (
-                lib.prescan_delta_packed(bytes(data), nbits, max_total)
+                lib.prescan_delta_packed(data, nbits, max_total)
             )
         except (OverflowError, ValueError) as e:
             raise DeltaError(f"delta: {e}") from e
@@ -276,7 +276,7 @@ def decode_delta(data, nbits: int, max_total: int | None = None) -> tuple[np.nda
     lib = get_native()
     if lib is not None and lib.has_delta_decode and nbits in (32, 64):
         try:
-            return lib.delta_decode(bytes(data), nbits, max_total)
+            return lib.delta_decode(data, nbits, max_total)
         except OverflowError as e:
             raise DeltaError(f"delta: {e}") from e
         except ValueError as e:

@@ -82,7 +82,7 @@ def _decode_plain_byte_array(buf: memoryview, num_values: int):
     lib = get_native()
     if lib is not None and lib.has_byte_array_scan and num_values > 0:
         try:
-            offsets, flat, consumed = lib.byte_array_gather(bytes(buf), num_values)
+            offsets, flat, consumed = lib.byte_array_gather(buf, num_values)
         except ValueError as e:
             raise PlainError(str(e)) from e
         return ByteArrayData(offsets=offsets, data=flat), consumed

@@ -82,7 +82,7 @@ def prescan_hybrid(data, num_values: int, width: int) -> RunTable:
 
     lib = get_native()
     if lib is not None and lib.has_prescan_hybrid and num_values > 0:
-        raw = bytes(data)
+        raw = data
         try:
             is_rle, counts, values, offsets, consumed = lib.prescan_hybrid(
                 raw, num_values, width
@@ -91,22 +91,30 @@ def prescan_hybrid(data, num_values: int, width: int) -> RunTable:
             raise HybridError(f"hybrid: {e}") from e
         # Compact the packed buffer to just the bit-packed payloads so device
         # buffers sized by len(packed) don't scale with RLE-heavy streams.
-        parts = []
         new_offsets = np.zeros(len(counts), dtype=np.int64)
-        packed_len = 0
-        for i in range(len(counts)):
-            if not is_rle[i]:
-                nbytes = (int(counts[i]) // 8) * width
-                off = int(offsets[i])
-                parts.append(raw[off : off + nbytes])
-                new_offsets[i] = packed_len
-                packed_len += nbytes
+        bp_idx = np.flatnonzero(~is_rle)
+        if len(bp_idx) == 0:
+            packed = b""
+        else:
+            nb = (counts[bp_idx] // 8) * width
+            offs = offsets[bp_idx]
+            if len(bp_idx) > 1:
+                new_offsets[bp_idx[1:]] = np.cumsum(nb[:-1])
+            if len(bp_idx) == 1 or bool(np.all(offs[1:] == offs[:-1] + nb[:-1])):
+                # payload regions are back-to-back (the no-RLE common case):
+                # one zero-copy slice of the input
+                mv = memoryview(raw) if not isinstance(raw, memoryview) else raw
+                packed = mv[int(offs[0]) : int(offs[0] + nb.sum())]
+            else:
+                packed = b"".join(
+                    raw[o : o + n] for o, n in zip(offs.tolist(), nb.tolist())
+                )
         return RunTable(
             is_rle=is_rle,
             counts=counts,
             rle_values=values,
             bp_offsets=new_offsets,
-            packed=b"".join(parts),
+            packed=packed,
             consumed=consumed,
         )
     buf = memoryview(data) if not isinstance(data, memoryview) else data
@@ -200,7 +208,7 @@ def decode_hybrid(data, num_values: int, width: int, dtype=np.uint32) -> np.ndar
     if lib is not None and lib.has_hybrid_decode and 0 <= width <= 64:
         nbits = 32 if width <= 32 else 64
         try:
-            out, _ = lib.hybrid_decode(bytes(data), num_values, width, nbits)
+            out, _ = lib.hybrid_decode(data, num_values, width, nbits)
         except ValueError as e:
             raise HybridError(f"hybrid: {e}") from e
         want = np.dtype(dtype)

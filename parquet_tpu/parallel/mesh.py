@@ -65,7 +65,9 @@ def build_page_grid(tables, takes, width: int, out_per_page: int) -> PageGrid:
     bit_starts = np.zeros((n_pages, max_runs), dtype=np.int32)
     counts = np.zeros(n_pages, dtype=np.int32)
     for p, (t, take) in enumerate(zip(tables, takes)):
-        w = np.frombuffer(t.packed + b"\x00" * ((-len(t.packed)) % 4 + 4), dtype="<u4")
+        w = np.frombuffer(
+            bytes(t.packed) + b"\x00" * ((-len(t.packed)) % 4 + 4), dtype="<u4"
+        )
         words[p, : len(w)] = w
         r = len(t.counts)
         out_start = np.zeros(r, dtype=np.int64)

@@ -17,6 +17,22 @@ _cached = None
 _probed = False
 
 
+def _ptr(data):
+    """(address, length, keepalive) for any contiguous readable buffer.
+
+    Lets the hot-path wrappers accept bytes, bytearray, memoryview, or numpy
+    arrays without the `bytes(data)` copy a c_char_p signature would force
+    (decompressed pages are ~1 MiB each; those copies were measurable).
+    """
+    import numpy as np
+
+    if isinstance(data, bytes):
+        # ctypes converts bytes to a char pointer for c_void_p params directly
+        return data, len(data), data
+    arr = np.frombuffer(data, dtype=np.uint8)
+    return arr.ctypes.data, arr.size, arr
+
+
 class NativeLib:
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
@@ -26,34 +42,34 @@ class NativeLib:
             lib.ptq_snappy_max_compressed_length.argtypes = [ctypes.c_size_t]
             lib.ptq_snappy_compress.restype = ctypes.c_ssize_t
             lib.ptq_snappy_compress.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
             ]
             lib.ptq_snappy_decompress.restype = ctypes.c_ssize_t
             lib.ptq_snappy_decompress.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
             ]
         self.has_byte_array_scan = hasattr(lib, "ptq_byte_array_gather")
         if self.has_byte_array_scan:
             lib.ptq_byte_array_gather.restype = ctypes.c_ssize_t
             lib.ptq_byte_array_gather.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_int64,
                 ctypes.c_void_p,
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
             ]
         self.has_hybrid_decode = hasattr(lib, "ptq_hybrid_decode")
         if self.has_hybrid_decode:
             lib.ptq_hybrid_decode.restype = ctypes.c_ssize_t
             lib.ptq_hybrid_decode.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_int64,
                 ctypes.c_int,
@@ -64,7 +80,7 @@ class NativeLib:
         if self.has_delta_decode:
             lib.ptq_delta_decode.restype = ctypes.c_ssize_t
             lib.ptq_delta_decode.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_int,
                 ctypes.c_int64,
@@ -73,7 +89,7 @@ class NativeLib:
             ]
             lib.ptq_delta_peek_total.restype = ctypes.c_ssize_t
             lib.ptq_delta_peek_total.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_void_p,
             ]
@@ -81,21 +97,21 @@ class NativeLib:
         if self.has_bytearray_take:
             lib.ptq_bytearray_take.restype = ctypes.c_ssize_t
             lib.ptq_bytearray_take.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_void_p,
                 ctypes.c_int64,
                 ctypes.c_void_p,
                 ctypes.c_int64,
                 ctypes.c_void_p,
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
             ]
         self.has_prescan_delta = hasattr(lib, "ptq_prescan_delta_packed")
         if self.has_prescan_delta:
             lib.ptq_prescan_delta_packed.restype = ctypes.c_ssize_t
             lib.ptq_prescan_delta_packed.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_int,
                 ctypes.c_int64,
@@ -112,7 +128,7 @@ class NativeLib:
         if self.has_parse_page_header:
             lib.ptq_parse_page_header.restype = ctypes.c_ssize_t
             lib.ptq_parse_page_header.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_void_p,
             ]
@@ -120,7 +136,7 @@ class NativeLib:
         if self.has_prescan_hybrid:
             lib.ptq_prescan_hybrid.restype = ctypes.c_ssize_t
             lib.ptq_prescan_hybrid.argtypes = [
-                ctypes.c_char_p,
+                ctypes.c_void_p,
                 ctypes.c_size_t,
                 ctypes.c_int64,
                 ctypes.c_int,
@@ -132,34 +148,45 @@ class NativeLib:
                 ctypes.c_void_p,
             ]
 
-    def snappy_compress(self, data: bytes) -> bytes:
-        cap = self._lib.ptq_snappy_max_compressed_length(len(data))
+    def snappy_compress(self, data) -> bytes:
+        addr, n_in, _keep = _ptr(data)
+        cap = self._lib.ptq_snappy_max_compressed_length(n_in)
         out = ctypes.create_string_buffer(cap)
-        n = self._lib.ptq_snappy_compress(data, len(data), out, cap)
+        n = self._lib.ptq_snappy_compress(addr, n_in, out, cap)
         if n < 0:
             raise ValueError("native snappy: compression failed")
         return out.raw[:n]
 
-    def snappy_decompress(self, data: bytes, uncompressed_size: int) -> bytes:
-        out = ctypes.create_string_buffer(max(uncompressed_size, 1))
-        n = self._lib.ptq_snappy_decompress(data, len(data), out, uncompressed_size)
+    def snappy_decompress(self, data, uncompressed_size: int):
+        """Returns a memoryview over a freshly decoded buffer (no memset, no
+        trailing copy — the hot path of every snappy page)."""
+        import numpy as np
+
+        addr, n_in, _keep = _ptr(data)
+        # +16 slack: the C decoder's wide match copies may scribble up to 15
+        # bytes past the decoded length.
+        out = np.empty(max(uncompressed_size, 1) + 16, dtype=np.uint8)
+        n = self._lib.ptq_snappy_decompress(
+            addr, n_in, ctypes.c_void_p(out.ctypes.data), uncompressed_size
+        )
         if n < 0:
             raise ValueError("native snappy: corrupt input")
-        return out.raw[:n]
+        return memoryview(out)[:n]
 
-    def byte_array_gather(self, data: bytes, num_values: int):
+    def byte_array_gather(self, data, num_values: int):
         """PLAIN byte_array scan: returns (offsets int64[n+1], flat bytes, consumed)."""
         import numpy as np
 
+        addr, n_in, _keep = _ptr(data)
         offsets = np.empty(num_values + 1, dtype=np.int64)
-        out = ctypes.create_string_buffer(max(len(data), 1))
+        out = ctypes.create_string_buffer(max(n_in, 1))
         consumed = self._lib.ptq_byte_array_gather(
-            data,
-            len(data),
+            addr,
+            n_in,
             num_values,
             offsets.ctypes.data_as(ctypes.c_void_p),
             out,
-            len(data),
+            n_in,
         )
         if consumed < 0:
             raise ValueError("native: corrupt byte_array stream")
@@ -167,16 +194,17 @@ class NativeLib:
         flat = ctypes.string_at(out, int(offsets[-1]))
         return offsets, flat, int(consumed)
 
-    def hybrid_decode(self, data: bytes, num_values: int, width: int, nbits: int):
+    def hybrid_decode(self, data, num_values: int, width: int, nbits: int):
         """One-shot hybrid RLE/bit-pack decode. Returns (values, consumed);
         values is uint32 (nbits==32) or uint64 (nbits==64)."""
         import numpy as np
 
+        addr, n_in, _keep = _ptr(data)
         out = np.empty(num_values, dtype=np.uint32 if nbits == 32 else np.uint64)
         p = out.ctypes.data_as(ctypes.c_void_p)
         consumed = self._lib.ptq_hybrid_decode(
-            data,
-            len(data),
+            addr,
+            n_in,
             num_values,
             width,
             p if nbits == 32 else None,
@@ -192,8 +220,9 @@ class NativeLib:
         caller can report the same error as the NumPy path."""
         import numpy as np
 
+        addr, n_in, _keep = _ptr(data)
         total = np.zeros(1, dtype=np.int64)
-        if self._lib.ptq_delta_peek_total(data, len(data), total.ctypes.data_as(ctypes.c_void_p)) < 0:
+        if self._lib.ptq_delta_peek_total(addr, n_in, total.ctypes.data_as(ctypes.c_void_p)) < 0:
             raise ValueError("native: corrupt delta header")
         cap = int(total[0])
         if max_total is not None and cap > max(max_total, 0):
@@ -204,8 +233,8 @@ class NativeLib:
         # max_total already enforced above on the peeked count; the C-side
         # bound (-3) is unreachable from here, so pass "no bound".
         consumed = self._lib.ptq_delta_decode(
-            data,
-            len(data),
+            addr,
+            n_in,
             nbits,
             -1,
             out.ctypes.data_as(ctypes.c_void_p),
@@ -222,10 +251,11 @@ class NativeLib:
         offsets = np.ascontiguousarray(offsets, dtype=np.int64)
         indices = np.ascontiguousarray(indices, dtype=np.int64)
         new_offsets = np.ascontiguousarray(new_offsets, dtype=np.int64)
+        addr, n_in, _keep = _ptr(data)
         out = ctypes.create_string_buffer(max(total, 1))
         rc = self._lib.ptq_bytearray_take(
-            data,
-            len(data),
+            addr,
+            n_in,
             offsets.ctypes.data_as(ctypes.c_void_p),
             len(offsets) - 1,
             indices.ctypes.data_as(ctypes.c_void_p),
@@ -243,6 +273,7 @@ class NativeLib:
         with bp_offsets absolute into `data`, or None if the run table overflows."""
         import numpy as np
 
+        addr, n_in, _keep = _ptr(data)
         max_runs = 4096
         while True:
             is_rle = np.empty(max_runs, dtype=np.uint8)
@@ -251,8 +282,8 @@ class NativeLib:
             offsets = np.empty(max_runs, dtype=np.int64)
             consumed = np.zeros(1, dtype=np.int64)
             n = self._lib.ptq_prescan_hybrid(
-                data,
-                len(data),
+                addr,
+                n_in,
                 num_values,
                 width,
                 is_rle.ctypes.data_as(ctypes.c_void_p),
@@ -292,7 +323,8 @@ class NativeLib:
         # consumes at least its one width byte from the stream, so M <= len:
         # a lying header with a huge count must not drive the allocation
         # (validation-before-allocation discipline).
-        max_entries = min(max(max_total, 8) // 8 + 2, len(data) + 2)
+        addr, n_in, _keep = _ptr(data)
+        max_entries = min(max(max_total, 8) // 8 + 2, n_in + 2)
         widths = np.empty(max_entries, dtype=np.uint32)
         byte_starts = np.empty(max_entries, dtype=np.int64)
         out_starts = np.empty(max_entries, dtype=np.int32)
@@ -301,8 +333,8 @@ class NativeLib:
         total = np.zeros(1, dtype=np.int64)
         consumed = np.zeros(1, dtype=np.int64)
         m = self._lib.ptq_prescan_delta_packed(
-            data,
-            len(data),
+            addr,
+            n_in,
             nbits,
             max_total,
             widths.ctypes.data_as(ctypes.c_void_p),
@@ -340,9 +372,10 @@ class NativeLib:
         to the Python reader for its exact error)."""
         import numpy as np
 
+        addr, n_in, _keep = _ptr(window)
         out = np.empty(23, dtype=np.int64)
         rc = self._lib.ptq_parse_page_header(
-            window, len(window), out.ctypes.data_as(ctypes.c_void_p)
+            addr, n_in, out.ctypes.data_as(ctypes.c_void_p)
         )
         if rc == -2:
             return None

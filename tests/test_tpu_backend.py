@@ -1,7 +1,7 @@
 """TPU decoder backend: byte-identical parity vs the host path.
 
 The write-side oracle of the north star (BASELINE.json): for every supported
-shape, FileReader(backend="tpu") must produce byte-identical ChunkData to the
+shape, FileReader(backend="tpu_roundtrip") must produce byte-identical ChunkData to the
 host path. On CPU the device ops run through the same XLA code path (jit on the
 cpu backend); bench.py exercises the same code on the real chip.
 """
@@ -41,7 +41,7 @@ def assert_chunks_identical(a, b):
 def both_backends(path):
     with FileReader(path, backend="host") as r:
         host = {i: r.read_row_group(i) for i in range(r.num_row_groups)}
-    with FileReader(path, backend="tpu") as r:
+    with FileReader(path, backend="tpu_roundtrip") as r:
         tpu = {i: r.read_row_group(i) for i in range(r.num_row_groups)}
     assert host.keys() == tpu.keys()
     for i in host:
@@ -146,7 +146,7 @@ class TestTpuParity:
         })
         path = str(tmp_path / "rows.parquet")
         pq.write_table(t, path, compression="snappy")
-        with FileReader(path, backend="tpu") as r:
+        with FileReader(path, backend="tpu_roundtrip") as r:
             rows = list(r.iter_rows())
         assert rows == t.to_pylist()
 

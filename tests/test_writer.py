@@ -464,7 +464,7 @@ class TestColumnEncodings:
                         max_page_size=4096) as w:
             w.write_column("ts", v)
             w.flush_row_group()
-        with FileReader(path, backend="tpu") as r:
+        with FileReader(path, backend="tpu_roundtrip") as r:
             np.testing.assert_array_equal(r.read_row_group(0)[("ts",)].values, v)
 
     def test_use_dictionary_bare_string(self, tmp_path):
