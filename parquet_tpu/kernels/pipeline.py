@@ -3,7 +3,7 @@
 The north-star architecture (BASELINE.json): the host walks pages, parses
 Thrift headers, decompresses blocks and decodes R/D levels; the *value* streams
 of a whole chunk are fused into one batch of device tensors and decoded by the
-kernels in device_ops.py / pallas_ops.py. Users opt in per reader:
+kernels in device_ops.py. Users opt in per reader:
 FileReader(..., backend="tpu") — the WithDecoderBackend(TPU) analogue.
 
 Batching model per chunk:
