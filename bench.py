@@ -187,13 +187,153 @@ def decode_all_tpu_to_host(path):
         return [r.read_row_group(i) for i in range(r.num_row_groups)]
 
 
-def timed(fn, repeats: int, label: str) -> float:
+# -- the BASELINE.md 5-config matrix ------------------------------------------
+#
+# Per-config rows/s + bytes/s (encoded and decoded) + byte-equality, per the
+# first-milestone deliverable table in BASELINE.md. Each config runs in its
+# own subprocess (same isolation rationale as the phases below) and orders
+# device timing BEFORE any device->host fetch so the verification fetch can't
+# poison the measured transfer path.
+
+MATRIX_ROWS = int(os.environ.get("PQT_MATRIX_ROWS", 1_000_000))
+
+
+def _matrix_table(cfg: int, rows: int):
+    import pyarrow as pa
+
+    rng = np.random.default_rng(cfg)
+    if cfg == 1:  # PLAIN int64, flat, uncompressed, DataPage V1
+        return pa.table({"v": pa.array(rng.integers(0, 1 << 60, rows), pa.int64())})
+    if cfg == 2:  # hybrid (dict-index) int32, SNAPPY, DataPage V2
+        return pa.table({"v": pa.array(rng.integers(0, 1000, rows).astype(np.int32))})
+    if cfg == 3:  # dict STRING, 100K-key dictionary
+        keys = np.array([f"key_{i:06d}" for i in range(100_000)])
+        return pa.table({"v": pa.array(keys[rng.integers(0, len(keys), rows)])})
+    if cfg == 4:  # DELTA_BINARY_PACKED int64 timestamps, GZIP
+        ts = 1_600_000_000_000_000 + np.cumsum(rng.integers(0, 1000, rows))
+        return pa.table({"v": pa.array(ts.astype(np.int64))})
+    if cfg == 5:  # nested LIST<int32> via the floor-equivalent reader
+        lengths = rng.integers(0, 5, rows)
+        flat = rng.integers(0, 1 << 30, int(lengths.sum())).astype(np.int32)
+        offsets = np.zeros(rows + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        return pa.table(
+            {"v": pa.ListArray.from_arrays(pa.array(offsets, pa.int32()), pa.array(flat))}
+        )
+    raise ValueError(cfg)
+
+
+def _matrix_write_opts(cfg: int) -> dict:
+    if cfg == 1:
+        return dict(compression="none", column_encoding={"v": "PLAIN"}, use_dictionary=False, data_page_version="1.0")
+    if cfg == 2:
+        return dict(compression="snappy", use_dictionary=["v"], data_page_version="2.0")
+    if cfg == 3:
+        return dict(compression="snappy", use_dictionary=["v"], data_page_version="1.0")
+    if cfg == 4:
+        return dict(compression="gzip", column_encoding={"v": "DELTA_BINARY_PACKED"}, use_dictionary=False, data_page_version="1.0")
+    return dict(compression="snappy", data_page_version="1.0")
+
+
+def _matrix_file(cfg: int) -> Path:
+    import pyarrow.parquet as pq
+
+    path = Path(f"/tmp/pqt_matrix_{cfg}_{MATRIX_ROWS}.parquet")
+    if not path.exists():
+        pq.write_table(
+            _matrix_table(cfg, MATRIX_ROWS), path, row_group_size=1 << 20, **_matrix_write_opts(cfg)
+        )
+    return path
+
+
+def _decoded_bytes(chunks_list) -> int:
+    from parquet_tpu.core.arrays import ByteArrayData
+
+    total = 0
+    for chunks in chunks_list:
+        for c in chunks.values():
+            v = c.values
+            if isinstance(v, ByteArrayData):
+                total += len(v.data) + v.offsets.nbytes
+            else:
+                total += np.asarray(v).nbytes
+    return total
+
+
+def _phase_matrix(cfg: int) -> None:
+    """One matrix config: device + baseline timings, then byte-equality.
+
+    Timing reuses the headline delivery functions (deliver_device /
+    deliver_baseline) so the matrix and headline measure the identical
+    delivery point."""
+    from parquet_tpu.core.reader import FileReader
+
+    path = _matrix_file(cfg)
+    rows = MATRIX_ROWS
+
+    deliver_device(path)  # warm (compile cache + connection)
+    t_dev = timed(lambda: deliver_device(path), REPEATS, f"cfg{cfg} device", rows=rows)
+    t_base = timed(
+        lambda: deliver_baseline(path), REPEATS, f"cfg{cfg} baseline", rows=rows
+    )
+    t_rows = None
+    if cfg == 5:
+        # the floor-equivalent read: nested LIST assembly on host over the
+        # decoded leaf (BASELINE.md config 5's mixed host/TPU shape)
+        def assembled():
+            with FileReader(path) as r:
+                return sum(1 for _ in r.iter_rows())
+
+        t_rows = timed(assembled, REPEATS, f"cfg{cfg} assembled-rows", rows=rows)
+
+    # verification LAST (fetches poison the transfer path)
+    with FileReader(path, backend="host") as r:
+        host = [r.read_row_group(i) for i in range(r.num_row_groups)]
+    with FileReader(path, backend="tpu_roundtrip") as r:
+        rt = [r.read_row_group(i) for i in range(r.num_row_groups)]
+    try:
+        _verify_host_paths(host, rt)
+        equal = True
+    except AssertionError as e:
+        log(f"bench: cfg{cfg} parity FAILED: {e}")
+        equal = False
+    enc = path.stat().st_size
+    dec = _decoded_bytes(host)
+    out = {
+        "config": cfg,
+        "rows_s_device": round(rows / t_dev, 1),
+        "rows_s_baseline": round(rows / t_base, 1),
+        "vs_baseline": round(t_base / t_dev, 3),
+        "encoded_MB_s": round(enc / t_dev / 1e6, 1),
+        "decoded_MB_s": round(dec / t_dev / 1e6, 1),
+        "byte_equal": bool(equal),
+    }
+    if t_rows is not None:
+        out["rows_s_assembled"] = round(rows / t_rows, 1)
+    print(json.dumps(out))
+
+
+def run_matrix() -> list:
+    results = []
+    for cfg in (1, 2, 3, 4, 5):
+        _matrix_file(cfg)  # build outside the timed subprocess
+        r = _run_phase(f"matrix{cfg}")
+        if r is not None:
+            log(f"bench: matrix config {cfg}: {json.dumps(r)}")
+            results.append(r)
+        else:
+            log(f"bench: matrix config {cfg} FAILED")
+    return results
+
+
+def timed(fn, repeats: int, label: str, rows: int | None = None) -> float:
+    rows = ROWS if rows is None else rows
     best = float("inf")
     for i in range(repeats):
         t0 = time.perf_counter()
         fn()
         dt = time.perf_counter() - t0
-        log(f"bench:   {label} run {i + 1}/{repeats}: {dt:.3f}s ({ROWS / dt / 1e6:.2f} M rows/s)")
+        log(f"bench:   {label} run {i + 1}/{repeats}: {dt:.3f}s ({rows / dt / 1e6:.2f} M rows/s)")
         best = min(best, dt)
     return best
 
@@ -318,6 +458,16 @@ def main() -> None:
             f"tpu {ROWS / r_t['t'] / 1e6:.2f} M rows/s | ratio {r_h['t'] / r_t['t']:.2f}x"
         )
 
+    # BASELINE.md 5-config matrix (per-config JSON on stderr + BENCH_MATRIX.json)
+    if os.environ.get("PQT_BENCH_MATRIX", "1") != "0":
+        results = run_matrix()
+        try:
+            Path(__file__).parent.joinpath("BENCH_MATRIX.json").write_text(
+                json.dumps(results, indent=1) + "\n"
+            )
+        except OSError as e:  # pragma: no cover
+            log(f"bench: could not write BENCH_MATRIX.json: {e}")
+
     # headline: columns delivered into HBM, each path in a clean process
     r_base = _run_phase("baseline")
     r_dev = _run_phase("device")
@@ -364,16 +514,21 @@ def _verify_host_paths(host, tpu) -> None:
                     av.view((np.uint8, av.dtype.itemsize)),
                     bv.view((np.uint8, bv.dtype.itemsize)),
                 ), path
-    log("bench: byte-identical host vs tpu decode ✓")
+            for attr in ("def_levels", "rep_levels"):
+                la, lb = getattr(rg_h[path], attr), getattr(rg_t[path], attr)
+                assert (la is None) == (lb is None), (path, attr)
+                assert la is None or np.array_equal(la, lb), (path, attr)
+    log("bench: byte-identical host vs tpu decode (values + levels) ✓")
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
         name = sys.argv[2]
-        p = build_file()
-        if name == "verify":
-            _phase_verify(p)
+        if name.startswith("matrix"):
+            _phase_matrix(int(name[len("matrix") :]))
+        elif name == "verify":
+            _phase_verify(build_file())
         else:
-            _phase_timed(name, p)
+            _phase_timed(name, build_file())
     else:
         main()

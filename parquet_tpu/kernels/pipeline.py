@@ -322,8 +322,10 @@ class _ChunkPlan:
             return self
         self._dispatched = True
         d = self.dictionary
-        if isinstance(d, np.ndarray) and d.ndim == 1:
-            # Floats travel as bit patterns: TPU f64 transfer is not
+        if self.hybrid_batches and isinstance(d, np.ndarray) and d.ndim == 1:
+            # Upload the dictionary only when device-decoded indices will
+            # gather against it (device_column); host reassembly gathers on
+            # host. Floats travel as bit patterns: TPU f64 transfer is not
             # bit-exact (observed 1-ulp corruption through the axon
             # runtime), and a gather is dtype-agnostic anyway.
             if d.dtype.kind == "f":
@@ -521,8 +523,9 @@ def prepare_chunk_plan(
     plan.stats = stats
     ptype = column.type
 
-    hybrid_batches = plan.hybrid_batches
-    delta_batches = plan.delta_batches
+    # Device-routable pages stage here until the whole chunk is walked; batch
+    # building (or demotion to host decode) happens in _commit_routes.
+    pending: list[tuple] = []
 
     for raw in iter_chunk_pages(f, chunk):
         header = raw.header
@@ -572,9 +575,7 @@ def prepare_chunk_plan(
                 if stats is not None:
                     stats.host_fallback_pages += 1
                 continue
-            if not hybrid_batches or not hybrid_batches[-1].fits(table, width):
-                hybrid_batches.append(_HybridBatch(width))
-            hybrid_batches[-1].add_page(table, non_null)
+            pending.append(("dict", len(plan.page_infos), table, width, non_null, None))
             plan.page_infos.append((n, dfl, rep, "dict", non_null))
         elif enc == int(Encoding.DELTA_BINARY_PACKED) and ptype in (
             Type.INT32,
@@ -591,9 +592,7 @@ def prepare_chunk_plan(
                 if stats is not None:
                     stats.host_fallback_pages += 1
                 continue
-            if not delta_batches or not delta_batches[-1].fits(table):
-                delta_batches.append(_DeltaBatch(nbits))
-            delta_batches[-1].add_page(table, values_buf)
+            pending.append(("delta", len(plan.page_infos), table, nbits, non_null, values_buf))
             plan.page_infos.append((n, dfl, rep, "delta", table.total))
         elif enc == int(Encoding.PLAIN) and ptype in _NUMERIC_DTYPE:
             dt = _NUMERIC_DTYPE[ptype]
@@ -618,7 +617,56 @@ def prepare_chunk_plan(
             if stats is not None:
                 stats.host_fallback_pages += 1
 
+    _commit_routes(plan, pending, stats)
     return plan
+
+
+def _commit_routes(plan: _ChunkPlan, pending: list, stats) -> None:
+    """Build device batches — or demote to host decode if the chunk's pages
+    are not homogeneous.
+
+    Device decode only pays when the whole chunk's values stay on device; a
+    chunk that mixes device-kinds with host-kinds (e.g. pyarrow's mid-chunk
+    dictionary->PLAIN fallback once the dict page overflows) would need its
+    device-decoded pages FETCHED back during reassembly — the exact
+    round-trip regression backend="tpu" routing exists to avoid. Deciding
+    after the full page walk keeps the cliff out: mixed chunks decode
+    entirely on host and device_column does one typed upload.
+    """
+    kinds = {k for _, _, _, k, _ in plan.page_infos}
+    kinds.discard("empty")
+    pending_kinds = {p[0] for p in pending}
+    homogeneous = kinds == pending_kinds and len(pending_kinds) == 1
+    if homogeneous:
+        hybrid_batches = plan.hybrid_batches
+        delta_batches = plan.delta_batches
+        for kind, _idx, table, arg, non_null, buf in pending:
+            if kind == "dict":
+                width = arg
+                if not hybrid_batches or not hybrid_batches[-1].fits(table, width):
+                    hybrid_batches.append(_HybridBatch(width))
+                hybrid_batches[-1].add_page(table, non_null)
+            else:
+                nbits = arg
+                if not delta_batches or not delta_batches[-1].fits(table):
+                    delta_batches.append(_DeltaBatch(nbits))
+                delta_batches[-1].add_page(table, buf)
+        return
+    # Demote: host-decode the would-be device pages in place.
+    from ..ops.rle_hybrid import expand_runs
+
+    for kind, idx, table, arg, non_null, buf in pending:
+        n, dfl, rep, _k, _p = plan.page_infos[idx]
+        if kind == "dict":
+            vals = expand_runs(table, non_null, arg, np.uint32)
+            plan.page_infos[idx] = (n, dfl, rep, "indices", vals)
+        else:
+            from ..ops.delta import decode_delta
+
+            vals, _ = decode_delta(buf, arg, max_total=non_null)
+            plan.page_infos[idx] = (n, dfl, rep, "values", vals[:non_null])
+        if stats is not None:
+            stats.host_fallback_pages += 1
 
 
 def _split_page(raw, header, pt, codec, column: Column):
@@ -710,14 +758,17 @@ def _upload_typed(host: np.ndarray) -> jnp.ndarray:
     return jnp.asarray(host)
 
 
-def _materialize(dictionary, dict_dev, indices: np.ndarray):
+def _materialize(dictionary, dict_dev, indices):
+    """Expand dictionary indices for HOST delivery.
+
+    Always gathers on the host: by the time finalize() runs, the indices are
+    host arrays (device batches are fetched in one batched transfer up
+    front), and bouncing them through the device for the gather costs an
+    upload + a fetch per page — measured ~100ms/page on the transfer link —
+    for work NumPy does in microseconds. dict_dev exists solely for
+    device-resident delivery (device_column)."""
     if isinstance(dictionary, ByteArrayData):
         return dictionary.take(np.asarray(indices, dtype=np.int64))
-    if dict_dev is not None:
-        out = np.asarray(dict_gather_device(dict_dev, jnp.asarray(indices)))
-        if dictionary.dtype.kind == "f":  # gathered as bit patterns; view back
-            out = out.view(dictionary.dtype)
-        return out
     return np.asarray(dictionary)[np.asarray(indices)]
 
 

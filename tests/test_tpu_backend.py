@@ -348,6 +348,34 @@ class TestDecodeToDevice:
                 assert stats.host_fallback_pages > 0, p
                 assert_chunks_identical(host[p], plan.finalize())
 
+    def test_mixed_chunk_demotes_to_host(self, tmp_path):
+        """A chunk that mixes dictionary-coded and PLAIN pages (pyarrow's
+        mid-chunk fallback when the dict page overflows) must decode fully on
+        host — no device batches whose results reassembly would have to fetch
+        back (the mixed-chunk round-trip regression)."""
+        from parquet_tpu.kernels.pipeline import plan_chunk_tpu
+
+        rng = np.random.default_rng(3)
+        # mostly-unique strings overflow a tiny dictionary page quickly
+        t = pa.table({"s": pa.array([f"v{int(x):08d}" for x in rng.integers(0, 1 << 30, 20_000)])})
+        path = str(tmp_path / "mixed.parquet")
+        pq.write_table(t, path, use_dictionary=["s"], dictionary_pagesize_limit=4096)
+        with FileReader(path, backend="host") as r:
+            host = r.read_row_group(0)
+        with FileReader(path) as r:
+            cc = r.row_group(0).columns[0]
+            p = tuple(cc.meta_data.path_in_schema)
+            plan = plan_chunk_tpu(r._f, cc, r.schema.column(p))
+            kinds = {k for _, _, _, k, _ in plan.page_infos if k != "empty"}
+            if len(kinds) <= 1:
+                pytest.skip(
+                    "pyarrow no longer mixes page encodings under "
+                    f"dictionary_pagesize_limit (kinds={kinds}); regression "
+                    "guard needs a new trigger"
+                )
+            assert not plan.dev_hybrid and not plan.dev_delta
+            assert_chunks_identical(host[p], plan.finalize())
+
     def test_values_live_on_device(self, tmp_path):
         import jax
 
