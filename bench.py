@@ -27,7 +27,9 @@ BASELINE.md notes the reference publishes no numbers), so the baseline is the
 host-decode-plus-upload path above — the stand-in for "pure host decode" in
 the north star, measured at the same delivery point.
 
-Env knobs: PQT_BENCH_ROWS (default 2_000_000), PQT_BENCH_REPEATS (default 3).
+Env knobs: PQT_BENCH_ROWS (default 2_000_000), PQT_BENCH_REPEATS (default 3),
+PQT_BENCH_MATRIX=0 to skip the BASELINE.md 5-config matrix (on by default),
+PQT_MATRIX_ROWS (default 1_000_000) rows per matrix config.
 """
 
 from __future__ import annotations
@@ -236,9 +238,14 @@ def _matrix_write_opts(cfg: int) -> dict:
 
 
 def _matrix_file(cfg: int) -> Path:
+    import hashlib
+
     import pyarrow.parquet as pq
 
-    path = Path(f"/tmp/pqt_matrix_{cfg}_{MATRIX_ROWS}.parquet")
+    # cache key includes the write options so editing a config invalidates
+    # the cached fixture instead of silently benchmarking the stale file
+    tag = hashlib.sha1(repr(sorted(_matrix_write_opts(cfg).items())).encode()).hexdigest()[:10]
+    path = Path(f"/tmp/pqt_matrix_{cfg}_{MATRIX_ROWS}_{tag}.parquet")
     if not path.exists():
         pq.write_table(
             _matrix_table(cfg, MATRIX_ROWS), path, row_group_size=1 << 20, **_matrix_write_opts(cfg)

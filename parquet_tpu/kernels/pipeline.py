@@ -386,10 +386,10 @@ class _ChunkPlan:
                 take = payload
                 idx = hybrid_flat[hpos : hpos + take]
                 hpos += take
-                pages_values.append(_materialize(self.dictionary, self.dict_dev, idx))
+                pages_values.append(_materialize(self.dictionary, idx))
             elif kind == "indices":
                 pages_values.append(
-                    _materialize(self.dictionary, self.dict_dev, payload)
+                    _materialize(self.dictionary, payload)
                 )
             elif kind == "delta":
                 if payload:
@@ -568,12 +568,9 @@ def prepare_chunk_plan(
                 # One page alone exceeds the int32 bit-offset range of the
                 # device kernel: decode it on host (adversarially large pages;
                 # real writers page at ~1 MiB, data_store.go:149-154).
-                from ..ops.rle_hybrid import expand_runs
-
-                idx = expand_runs(table, non_null, width, np.uint32)
-                plan.page_infos.append((n, dfl, rep, "indices", idx))
-                if stats is not None:
-                    stats.host_fallback_pages += 1
+                plan.page_infos.append(
+                    (n, dfl, rep, *_host_decode_dict_page(table, width, non_null, stats))
+                )
                 continue
             pending.append(("dict", len(plan.page_infos), table, width, non_null, None))
             plan.page_infos.append((n, dfl, rep, "dict", non_null))
@@ -585,12 +582,9 @@ def prepare_chunk_plan(
             table = prescan_delta_packed(values_buf, nbits, max_total=non_null)
             if table.consumed * 8 > _BATCH_BITS_CAP:
                 # Same int32-range guard as the hybrid path: host decode.
-                from ..ops.delta import decode_delta
-
-                vals, _ = decode_delta(values_buf, nbits, max_total=non_null)
-                plan.page_infos.append((n, dfl, rep, "values", vals[:non_null]))
-                if stats is not None:
-                    stats.host_fallback_pages += 1
+                plan.page_infos.append(
+                    (n, dfl, rep, *_host_decode_delta_page(values_buf, nbits, non_null, stats))
+                )
                 continue
             pending.append(("delta", len(plan.page_infos), table, nbits, non_null, values_buf))
             plan.page_infos.append((n, dfl, rep, "delta", table.total))
@@ -653,20 +647,35 @@ def _commit_routes(plan: _ChunkPlan, pending: list, stats) -> None:
                 delta_batches[-1].add_page(table, buf)
         return
     # Demote: host-decode the would-be device pages in place.
-    from ..ops.rle_hybrid import expand_runs
-
     for kind, idx, table, arg, non_null, buf in pending:
         n, dfl, rep, _k, _p = plan.page_infos[idx]
         if kind == "dict":
-            vals = expand_runs(table, non_null, arg, np.uint32)
-            plan.page_infos[idx] = (n, dfl, rep, "indices", vals)
+            plan.page_infos[idx] = (
+                n, dfl, rep, *_host_decode_dict_page(table, arg, non_null, stats)
+            )
         else:
-            from ..ops.delta import decode_delta
+            plan.page_infos[idx] = (
+                n, dfl, rep, *_host_decode_delta_page(buf, arg, non_null, stats)
+            )
 
-            vals, _ = decode_delta(buf, arg, max_total=non_null)
-            plan.page_infos[idx] = (n, dfl, rep, "values", vals[:non_null])
-        if stats is not None:
-            stats.host_fallback_pages += 1
+
+def _host_decode_dict_page(table, width: int, non_null: int, stats):
+    """Host fallback for a dict-coded page: ('indices', expanded indices)."""
+    from ..ops.rle_hybrid import expand_runs
+
+    if stats is not None:
+        stats.host_fallback_pages += 1
+    return "indices", expand_runs(table, non_null, width, np.uint32)
+
+
+def _host_decode_delta_page(values_buf, nbits: int, non_null: int, stats):
+    """Host fallback for a delta page: ('values', decoded values)."""
+    from ..ops.delta import decode_delta
+
+    if stats is not None:
+        stats.host_fallback_pages += 1
+    vals, _ = decode_delta(values_buf, nbits, max_total=non_null)
+    return "values", vals[:non_null]
 
 
 def _split_page(raw, header, pt, codec, column: Column):
@@ -758,15 +767,15 @@ def _upload_typed(host: np.ndarray) -> jnp.ndarray:
     return jnp.asarray(host)
 
 
-def _materialize(dictionary, dict_dev, indices):
+def _materialize(dictionary, indices):
     """Expand dictionary indices for HOST delivery.
 
     Always gathers on the host: by the time finalize() runs, the indices are
     host arrays (device batches are fetched in one batched transfer up
     front), and bouncing them through the device for the gather costs an
     upload + a fetch per page — measured ~100ms/page on the transfer link —
-    for work NumPy does in microseconds. dict_dev exists solely for
-    device-resident delivery (device_column)."""
+    for work NumPy does in microseconds. The device dictionary (dict_dev)
+    exists solely for device-resident delivery (device_column)."""
     if isinstance(dictionary, ByteArrayData):
         return dictionary.take(np.asarray(indices, dtype=np.int64))
     return np.asarray(dictionary)[np.asarray(indices)]

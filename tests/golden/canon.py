@@ -1,0 +1,75 @@
+"""Canonical JSON-safe encoding shared by the golden-corpus generator and
+the tests that read the frozen fixtures.
+
+Both sides (the external implementation's decoded rows at generation time,
+our reader's decoded rows at test time) pass through canon() before
+comparison, so representation differences that are NOT semantic — pyarrow
+returns MAP columns as lists of (key, value) tuples where we return dicts,
+float32 promotes to Python float, etc. — are normalized away while every
+semantic bit (float bit patterns via hex, exact bytes via base64, timestamp
+instants + zone-awareness) is preserved.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime as dt
+import decimal
+import json
+import math
+
+
+def _pair_key(pair):
+    return json.dumps(pair, sort_keys=True, default=str)
+
+
+def canon(v):
+    if v is None or isinstance(v, (bool, str)):
+        return v
+    if isinstance(v, int):
+        return int(v)
+    if isinstance(v, float):
+        # exact bit pattern; NaN hex differs by payload, collapse to one tag
+        return {"f": "nan"} if math.isnan(v) else {"f": v.hex()}
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return {"b64": base64.b64encode(bytes(v)).decode()}
+    if isinstance(v, dt.datetime):
+        # tz-aware and naive-UTC represent the same instant across readers
+        if v.tzinfo is not None:
+            v = v.astimezone(dt.timezone.utc).replace(tzinfo=None)
+        return {"dt": v.isoformat()}
+    if isinstance(v, dt.date):
+        return {"d": v.isoformat()}
+    if isinstance(v, dt.time):
+        return {"t": v.isoformat()}
+    if isinstance(v, decimal.Decimal):
+        return {"dec": str(v)}
+    if isinstance(v, dict):
+        if not v:
+            return []  # empty MAP: pyarrow renders [], we render {}
+        return {
+            "pairs": sorted(
+                ([canon(k), canon(x)] for k, x in v.items()), key=_pair_key
+            )
+        }
+    if isinstance(v, (list, tuple)):
+        seq = list(v)
+        if seq and all(isinstance(e, tuple) and len(e) == 2 for e in seq):
+            # a MAP rendered as key/value tuples (pyarrow's to_pylist form)
+            return {
+                "pairs": sorted(
+                    ([canon(k), canon(x)] for k, x in seq), key=_pair_key
+                )
+            }
+        return [canon(e) for e in seq]
+    # numpy scalars: defer to their Python equivalents
+    item = getattr(v, "item", None)
+    if item is not None:
+        return canon(item())
+    raise TypeError(f"canon: unsupported type {type(v)!r}")
+
+
+def canon_rows(rows):
+    return [
+        {k: canon(v) for k, v in row.items()} for row in rows
+    ]

@@ -1,0 +1,217 @@
+"""Generate the frozen golden-corpus fixtures (run once, outputs committed).
+
+The fixtures under data/ are written by an EXTERNAL implementation (pyarrow /
+Arrow C++) in a separate generation step and committed as binary files, with
+the externally-decoded rows frozen alongside as canon()-encoded JSON. Tests
+then read the binaries with OUR reader and compare against the frozen
+expectations — independent of any same-process pyarrow write at test time,
+the analogue of the reference's apache/parquet-testing + Impala-file suites
+(reference: parquet_test.go:11-38, parquet_compatibility_test.go:77).
+
+Regenerate (only when adding fixtures — existing binaries must stay frozen):
+    python tests/golden/generate.py
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import decimal
+import json
+from pathlib import Path
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from canon import canon_rows
+
+HERE = Path(__file__).resolve().parent
+DATA = HERE / "data"
+EXPECTED = HERE / "expected"
+
+N = 1500
+rng = np.random.default_rng(20260730)
+
+
+def _alltypes_table() -> pa.Table:
+    return pa.table(
+        {
+            "i32": pa.array(rng.integers(-(2**31), 2**31, N).astype(np.int32)),
+            "i64": pa.array(rng.integers(-(2**62), 2**62, N).astype(np.int64)),
+            "f32": pa.array(rng.standard_normal(N).astype(np.float32)),
+            "f64": pa.array(rng.standard_normal(N)),
+            "flag": pa.array(rng.random(N) < 0.5),
+            "name": pa.array([f"name_{i % 97}" for i in range(N)]),
+            "blob": pa.array([rng.bytes(i % 23) for i in range(N)], pa.binary()),
+        }
+    )
+
+
+def _fixtures():
+    yield (
+        "alltypes_plain_v1_none",
+        _alltypes_table(),
+        dict(compression="none", use_dictionary=False, data_page_version="1.0",
+             column_encoding={c: "PLAIN" for c in
+                              ("i32", "i64", "f32", "f64", "name", "blob")}),
+    )
+    yield (
+        "alltypes_dict_snappy_v1",
+        _alltypes_table(),
+        dict(compression="snappy", use_dictionary=True, data_page_version="1.0"),
+    )
+    yield (
+        "alltypes_v2_gzip",
+        _alltypes_table(),
+        dict(compression="gzip", use_dictionary=True, data_page_version="2.0"),
+    )
+    yield (
+        "alltypes_zstd_v2_nodict",
+        _alltypes_table(),
+        dict(compression="zstd", use_dictionary=False, data_page_version="2.0",
+             column_encoding={c: "PLAIN" for c in
+                              ("i32", "i64", "f32", "f64", "name", "blob")}),
+    )
+    yield (
+        "delta_binary_packed",
+        pa.table(
+            {
+                "d32": pa.array(
+                    np.cumsum(rng.integers(-50, 50, N)).astype(np.int32)
+                ),
+                "d64": pa.array(
+                    (1_600_000_000_000_000 + np.cumsum(rng.integers(0, 1000, N))).astype(np.int64)
+                ),
+            }
+        ),
+        dict(compression="snappy", use_dictionary=False,
+             column_encoding={"d32": "DELTA_BINARY_PACKED", "d64": "DELTA_BINARY_PACKED"}),
+    )
+    yield (
+        "delta_byte_array",
+        pa.table(
+            {
+                "sorted_keys": pa.array(sorted(f"key_{int(x):09d}" for x in rng.integers(0, 1 << 30, N))),
+                "dlba": pa.array([f"value-{i}-{'x' * (i % 17)}" for i in range(N)]),
+            }
+        ),
+        dict(compression="none", use_dictionary=False,
+             column_encoding={"sorted_keys": "DELTA_BYTE_ARRAY", "dlba": "DELTA_LENGTH_BYTE_ARRAY"}),
+    )
+    yield (
+        "int96_timestamps",
+        pa.table(
+            {
+                "ts": pa.array(
+                    [
+                        dt.datetime(1999, 12, 31, 23, 59, 59, tzinfo=dt.timezone.utc),
+                        None,
+                        dt.datetime(2026, 7, 30, 12, 0, 0, 123456, tzinfo=dt.timezone.utc),
+                        dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc),
+                        dt.datetime(1883, 11, 18, 12, 4, 0, tzinfo=dt.timezone.utc),
+                    ]
+                    * 100,
+                    pa.timestamp("ns", tz="UTC"),
+                )
+            }
+        ),
+        dict(use_deprecated_int96_timestamps=True, compression="snappy"),
+    )
+    lengths = rng.integers(0, 4, N)
+    flat = rng.integers(-(2**30), 2**30, int(lengths.sum())).astype(np.int32)
+    offsets = np.zeros(N + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    lists = pa.ListArray.from_arrays(pa.array(offsets, pa.int32()), pa.array(flat))
+    yield (
+        "nested_lists_maps",
+        pa.table(
+            {
+                "ints": lists,
+                "deep": pa.array(
+                    [[[i, i + 1], []], None, [[i]]][i % 3] if i % 5 else None
+                    for i in range(N)
+                ),
+                "m": pa.array(
+                    [
+                        [(f"k{j}", i + j) for j in range(i % 4)] if i % 7 else None
+                        for i in range(N)
+                    ],
+                    pa.map_(pa.string(), pa.int64()),
+                ),
+                "rec": pa.array(
+                    [{"a": i, "b": f"s{i % 11}"} if i % 3 else None for i in range(N)],
+                    pa.struct([("a", pa.int64()), ("b", pa.string())]),
+                ),
+            }
+        ),
+        dict(compression="snappy"),
+    )
+    yield (
+        "nulls_heavy",
+        pa.table(
+            {
+                "mostly_null": pa.array(
+                    [None if i % 10 else i for i in range(N)], pa.int64()
+                ),
+                "all_null": pa.array([None] * N, pa.float64()),
+                "opt_str": pa.array([None if i % 3 == 0 else f"s{i}" for i in range(N)]),
+            }
+        ),
+        dict(compression="gzip", data_page_version="2.0"),
+    )
+    yield (
+        "decimal_flba_date_time",
+        pa.table(
+            {
+                "dec": pa.array(
+                    [decimal.Decimal(int(x)) / 100 for x in rng.integers(-(10**10), 10**10, N)],
+                    pa.decimal128(18, 2),
+                ),
+                "fsb": pa.array([rng.bytes(8) for _ in range(N)], pa.binary(8)),
+                "day": pa.array(
+                    [dt.date(2020, 1, 1) + dt.timedelta(days=int(i)) for i in range(N)]
+                ),
+                "tod": pa.array(
+                    [dt.time(i % 24, (i * 7) % 60, (i * 13) % 60, (i * 1001) % 1000000) for i in range(N)],
+                    pa.time64("us"),
+                ),
+            }
+        ),
+        dict(compression="snappy"),
+    )
+    yield (
+        "dict_overflow_mixed_pages",
+        pa.table(
+            {"s": pa.array([f"v{int(x):09d}" for x in rng.integers(0, 1 << 30, 8000)])}
+        ),
+        dict(use_dictionary=["s"], dictionary_pagesize_limit=4096, compression="snappy"),
+    )
+    yield (
+        "multi_rowgroup_small_pages",
+        _alltypes_table(),
+        dict(compression="snappy", row_group_size=256, data_page_size=512),
+    )
+
+
+def main() -> None:
+    DATA.mkdir(exist_ok=True)
+    EXPECTED.mkdir(exist_ok=True)
+    manifest = {}
+    for name, table, opts in _fixtures():
+        path = DATA / f"{name}.parquet"
+        if path.exists():
+            print(f"frozen, skipping: {name}")
+            continue
+        pq.write_table(table, path, **opts)
+        rows = pq.read_table(path).to_pylist()
+        (EXPECTED / f"{name}.json").write_text(
+            json.dumps(canon_rows(rows), separators=(",", ":"))
+        )
+        manifest[name] = {"rows": len(rows), "bytes": path.stat().st_size}
+        print(f"wrote {name}: {len(rows)} rows, {path.stat().st_size} bytes")
+    if manifest:
+        print(json.dumps(manifest, indent=1))
+
+
+if __name__ == "__main__":
+    main()
