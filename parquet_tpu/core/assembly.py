@@ -117,8 +117,9 @@ def logical_kind(node: Column):
     """The single dispatch point for value-level logical conversions.
 
     Returns one of None | 'int96' | 'decimal' | 'date' | ('timestamp', unit,
-    utc) | ('time', unit). Both convert_logical and the flat fast path consult
-    this, so a new conversion cannot silently diverge between the two paths.
+    utc) | ('time', unit, utc). Both convert_logical and the flat fast path
+    consult this, so a new conversion cannot silently diverge between the two
+    paths.
     """
     ct = node.converted_type
     lt = node.logical_type
@@ -138,11 +139,12 @@ def logical_kind(node: Column):
         return ("timestamp", "MICROS", True)
     if lt is not None and lt.TIME is not None:
         u = lt.TIME.unit
-        return ("time", u.unit_name() if u is not None else "MICROS")
+        return ("time", u.unit_name() if u is not None else "MICROS",
+                bool(lt.TIME.isAdjustedToUTC))
     if ct == ConvertedType.TIME_MILLIS:
-        return ("time", "MILLIS")
+        return ("time", "MILLIS", True)
     if ct == ConvertedType.TIME_MICROS:
-        return ("time", "MICROS")
+        return ("time", "MICROS", True)
     return None
 
 
@@ -338,6 +340,21 @@ class RecordAssembler:
         return convert_logical(node, v)
 
 
+_NANOTIME_CTOR = None
+
+
+def _nanotime():
+    """floor.Time.from_nanos, imported once (core cannot import floor at
+    module load — floor imports core — and a per-value import would sit in
+    the decode hot loop)."""
+    global _NANOTIME_CTOR
+    if _NANOTIME_CTOR is None:
+        from ..floor.time import Time
+
+        _NANOTIME_CTOR = Time.from_nanos
+    return _NANOTIME_CTOR
+
+
 def _to_micros(v: int, unit: str) -> int:
     if unit == "MILLIS":
         return v * 1000
@@ -387,6 +404,10 @@ def convert_logical(node: Column, v):
             microseconds=_to_micros(int(v), unit)
         )
     if kind[0] == "time":
+        if kind[1] == "NANOS":
+            # datetime.time cannot hold nanoseconds; the floor Time type
+            # keeps them (reference: floor/time.go:10-13)
+            return _nanotime()(int(v), utc=kind[2])
         micros = _to_micros(int(v), kind[1])
         return dt.time(
             hour=micros // 3_600_000_000,

@@ -1,2 +1,12 @@
 from .api import Reader, Writer  # noqa: F401
 from .autoschema import AutoSchemaError, schema_from_dataclass  # noqa: F401
+from .interfaces import (  # noqa: F401
+    FieldNotPresentError,
+    MarshalList,
+    MarshalMap,
+    MarshalObject,
+    UnmarshalList,
+    UnmarshalMap,
+    UnmarshalObject,
+)
+from .time import Time  # noqa: F401

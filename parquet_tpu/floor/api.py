@@ -37,6 +37,8 @@ import types as _types
 from ..core.reader import FileReader
 from ..core.writer import FileWriter
 from .autoschema import schema_from_dataclass
+from .interfaces import MarshalObject, UnmarshalObject
+from .time import Time
 
 __all__ = ["Writer", "Reader"]
 
@@ -48,6 +50,8 @@ def _to_storage(v):
     """Python value -> parquet storage value (recursive)."""
     if v is None:
         return None
+    if isinstance(v, Time):  # nanosecond TIME (reference: floor/time.go)
+        return v.nanos
     if isinstance(v, dt.datetime):
         if v.tzinfo is None:
             v = v.replace(tzinfo=dt.timezone.utc)
@@ -87,7 +91,11 @@ class Writer:
         self._w = FileWriter(sink, schema, **writer_kw)
 
     def write(self, obj) -> None:
-        if hasattr(obj, "to_parquet"):  # Marshaller hook
+        if hasattr(obj, "marshal_parquet"):  # Marshaller object model
+            mo = MarshalObject()
+            obj.marshal_parquet(mo)
+            row = mo.data
+        elif hasattr(obj, "to_parquet"):  # whole-object hook
             row = obj.to_parquet()
         elif dataclasses.is_dataclass(obj) and not isinstance(obj, type):
             row = _to_storage(obj)
@@ -134,6 +142,15 @@ class Reader:
         return self._r.num_rows
 
     def __iter__(self):
+        rt = self.record_type
+        if rt is not None and hasattr(rt, "unmarshal_parquet"):
+            # Unmarshaller object model: gets the wire-shaped raw row
+            # (reference: floor/reader.go:88-90 + interfaces/unmarshaller.go)
+            for row in self._r.iter_rows(raw=True):
+                inst = rt.__new__(rt)
+                inst.unmarshal_parquet(UnmarshalObject(row))
+                yield inst
+            return
         for row in self._r.iter_rows():
             yield self._scan(row)
 
@@ -141,7 +158,7 @@ class Reader:
         rt = self.record_type
         if rt is None:
             return row
-        if hasattr(rt, "from_parquet"):  # Unmarshaller hook
+        if hasattr(rt, "from_parquet"):  # whole-object hook
             return rt.from_parquet(row)
         return _build(rt, row)
 
@@ -190,7 +207,15 @@ def _from_storage(hint, v):
         if isinstance(v, dt.date):
             return v
         return _EPOCH_DATE + dt.timedelta(days=int(v))
+    if hint is Time:
+        if isinstance(v, Time):
+            return v
+        if isinstance(v, dt.time):
+            return Time.from_time(v)
+        return Time.from_nanos(int(v))
     if hint is dt.time:
+        if isinstance(v, Time):
+            return v.to_time().replace(tzinfo=None)
         if isinstance(v, dt.time):
             return v
         micros = int(v)

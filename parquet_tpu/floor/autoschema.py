@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import datetime as dt
+
+from .time import Time
 import types
 import typing
 
@@ -74,6 +76,14 @@ def _time_spec() -> _TypeSpec:
     )
 
 
+def _nanotime_spec() -> _TypeSpec:
+    # floor.Time keeps nanosecond precision (reference: floor/time.go:10-13)
+    return _TypeSpec(
+        Type.INT64,
+        logical=LogicalType(TIME=TimeType(isAdjustedToUTC=True, unit=TimeUnit.nanos())),
+    )
+
+
 _SCALARS = {
     int: lambda: Type.INT64,
     float: lambda: Type.DOUBLE,
@@ -83,6 +93,7 @@ _SCALARS = {
     dt.datetime: lambda: timestamp("micros"),
     dt.date: _date_spec,
     dt.time: _time_spec,
+    Time: _nanotime_spec,
     np.int64: lambda: Type.INT64,
     np.int32: lambda: Type.INT32,
     np.int16: lambda: int_type(16),

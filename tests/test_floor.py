@@ -152,3 +152,191 @@ class TestFloorRoundtrip:
         w = floor.Writer(path, Trip)
         with pytest.raises(TypeError):
             w.write(42)
+
+
+class TestMarshallerObjectModel:
+    """The reference's explicit Marshaller/Unmarshaller object model
+    (reference: floor/interfaces/marshaller.go:13-175, unmarshaller.go:105-293)."""
+
+    def test_marshal_unmarshal_roundtrip(self, tmp_path):
+        from parquet_tpu import floor as fl
+        from parquet_tpu import parse_schema
+
+        class Record:
+            def __init__(self, rid=None, name=None, tags=None, attrs=None):
+                self.rid, self.name, self.tags, self.attrs = rid, name, tags, attrs
+
+            def marshal_parquet(self, obj):
+                obj.add_field("rid").set_int64(self.rid)
+                obj.add_field("name").set_string(self.name)
+                lst = obj.add_field("tags").list()
+                for t in self.tags:
+                    lst.add().set_string(t)
+                m = obj.add_field("attrs").map()
+                for k, v in self.attrs.items():
+                    ke, ve = m.add()
+                    ke.set_string(k)
+                    ve.set_int64(v)
+
+            def unmarshal_parquet(self, obj):
+                self.rid = obj.get_field("rid").int64()
+                self.name = obj.get_field("name").string()
+                self.tags = [e.string() for e in obj.get_field("tags").list_()]
+                self.attrs = {
+                    k.string(): v.int64() for k, v in obj.get_field("attrs").map_()
+                }
+
+            def __eq__(self, other):
+                return (self.rid, self.name, self.tags, self.attrs) == (
+                    other.rid, other.name, other.tags, other.attrs,
+                )
+
+        schema = parse_schema("""
+        message record {
+          required int64 rid;
+          required binary name (STRING);
+          optional group tags (LIST) {
+            repeated group list { optional binary element (STRING); }
+          }
+          optional group attrs (MAP) {
+            repeated group key_value {
+              required binary key (STRING);
+              optional int64 value;
+            }
+          }
+        }""")
+        path = str(tmp_path / "m.parquet")
+        recs = [
+            Record(1, "a", ["x", "y"], {"k1": 10}),
+            Record(2, "b", [], {}),
+        ]
+        with fl.Writer(path, schema=schema) as w:
+            for r in recs:
+                w.write(r)
+        back = list(fl.Reader(path, Record))
+        assert back == recs
+        # cross-check with pyarrow
+        import pyarrow.parquet as pq
+
+        t = pq.read_table(path).to_pylist()
+        assert t[0]["rid"] == 1 and t[0]["tags"] == ["x", "y"]
+
+    def test_field_not_present(self):
+        from parquet_tpu import floor as fl
+
+        obj = fl.UnmarshalObject({"a": 1, "b": None})
+        assert obj.get_field("a").int64() == 1
+        with pytest.raises(fl.FieldNotPresentError):
+            obj.get_field("b")
+        with pytest.raises(fl.FieldNotPresentError):
+            obj.get_field("missing")
+
+    def test_unmarshal_accepts_athena_bag(self, tmp_path):
+        """LIST written with Athena's bag/array_element naming reads through
+        both the ergonomic reader and the Unmarshal object model
+        (reference: floor/reader.go:392-397)."""
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+        from parquet_tpu import floor as fl
+
+        sch = parse_schema("""
+        message athena {
+          optional group xs (LIST) {
+            repeated group bag { optional int32 array_element; }
+          }
+        }""")
+        path = str(tmp_path / "athena.parquet")
+        with FileWriter(path, schema=sch) as w:
+            w.write_row({"xs": {"bag": [{"array_element": 7}, {"array_element": 8}]}})
+            w.write_row({"xs": {"bag": []}})
+        with FileReader(path) as r:
+            assert [row["xs"] for row in r.iter_rows()] == [[7, 8], []]
+        with FileReader(path) as r:
+            (raw, raw2) = list(r.iter_rows(raw=True))
+        lst = fl.UnmarshalObject(raw).get_field("xs").list_()
+        assert [e.int32() for e in lst] == [7, 8]
+
+
+class TestNanoTime:
+    """TIME(NANOS) fidelity via floor.Time (reference: floor/time.go:10-13)."""
+
+    def test_time_type_basics(self):
+        from parquet_tpu.floor import Time
+
+        t = Time(13, 45, 30, 123456789)
+        assert (t.hour, t.minute, t.second, t.nanosecond) == (13, 45, 30, 123456789)
+        assert t.isoformat() == "13:45:30.123456789"
+        assert Time.from_nanos(t.nanos) == t
+        assert t.to_time() == dt.time(13, 45, 30, 123456, tzinfo=dt.timezone.utc)
+        assert Time(1) < Time(2)
+        with pytest.raises(ValueError):
+            Time.from_nanos(-1)
+
+    def test_nanos_survive_roundtrip(self, tmp_path):
+        from parquet_tpu.floor import Time
+
+        @dataclass
+        class R:
+            t: Time
+
+        path = str(tmp_path / "nt.parquet")
+        val = Time(23, 59, 59, 999999999)
+        with floor.Writer(path, R) as w:
+            w.write(R(t=val))
+        (back,) = list(floor.Reader(path, R))
+        assert back.t == val  # no precision loss
+        # schema carries TIME(NANOS)
+        from parquet_tpu import FileReader
+
+        with FileReader(path) as r:
+            lt = r.schema.column(("t",)).logical_type
+            assert lt.TIME is not None and lt.TIME.unit.NANOS is not None
+
+    def test_pyarrow_time64_ns_reads_as_nanotime(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from parquet_tpu import FileReader
+        from parquet_tpu.floor import Time
+
+        path = str(tmp_path / "pa_ns.parquet")
+        # 1ns past 12:00:00 — unrepresentable in datetime.time
+        pq.write_table(
+            pa.table({"t": pa.array([43200 * 10**9 + 1], pa.time64("ns"))}), path
+        )
+        with FileReader(path) as r:
+            (row,) = list(r.iter_rows())
+        # pyarrow writes TIME with isAdjustedToUTC=false
+        assert row["t"] == Time.from_nanos(43200 * 10**9 + 1, utc=False)
+
+    def test_time_units_in_object_model(self):
+        from parquet_tpu.floor import MarshalObject, Time, UnmarshalObject
+
+        mo = MarshalObject()
+        noon = Time(12, 0, 0)
+        mo.add_field("ms").set_time(noon, unit="MILLIS")
+        mo.add_field("us").set_time(noon, unit="MICROS")
+        mo.add_field("ns").set_time(noon)
+        assert mo.data == {
+            "ms": 43_200_000,
+            "us": 43_200_000_000,
+            "ns": 43_200_000_000_000,
+        }
+        uo = UnmarshalObject(mo.data)
+        assert uo.get_field("ms").time(unit="MILLIS") == noon
+        assert uo.get_field("us").time(unit="MICROS") == noon
+        assert uo.get_field("ns").time() == noon
+
+    def test_non_utc_time_column_roundtrip(self, tmp_path):
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+        from parquet_tpu.floor import Time
+
+        sch = parse_schema(
+            "message m { required int64 t (TIME(NANOS, false)); }"
+        )
+        path = str(tmp_path / "local.parquet")
+        with FileWriter(path, schema=sch) as w:
+            w.write_row({"t": 1234})
+        with FileReader(path) as r:
+            (row,) = list(r.iter_rows())
+        assert row["t"] == Time.from_nanos(1234, utc=False)
+        assert row["t"] != Time.from_nanos(1234, utc=True)
