@@ -27,6 +27,7 @@ from ..meta.parquet_types import (
 )
 from ..meta.thrift import CompactReader, ThriftError
 from ..utils.trace import stage
+from .alloc import decoded_nbytes
 from .arrays import ByteArrayData
 from .compress import decompress_block
 from .page import (
@@ -320,6 +321,8 @@ def read_chunk(
                 raw.payload, codec, header.uncompressed_page_size or 0
             )
             dictionary = decode_dict_page(header, block, column)
+            if alloc is not None:
+                alloc.register_buffers(dictionary)
         elif ptype == int(PageType.DATA_PAGE):
             if validate_crc:
                 _check_crc(header, raw.payload)
@@ -328,8 +331,12 @@ def read_chunk(
                     raw.payload, codec, header.uncompressed_page_size or 0
                 )
             dict_size = len(dictionary) if dictionary is not None else None
+            est = _precharge(
+                alloc, header.data_page_header, len(block)
+            )
             with stage("decode", len(block)):
                 page = decode_data_page_v1(header, block, column, dict_size)
+            _account_page(alloc, est, page, dictionary)
             page.materialize(dictionary)
             pages.append(page)
             seen_data_values += page.num_values
@@ -337,8 +344,12 @@ def read_chunk(
             if validate_crc:
                 _check_crc(header, raw.payload)
             dict_size = len(dictionary) if dictionary is not None else None
+            est = _precharge(
+                alloc, header.data_page_header_v2, header.uncompressed_page_size or 0
+            )
             with stage("decode", header.uncompressed_page_size or 0):
                 page = decode_data_page_v2(header, raw.payload, column, dict_size, codec)
+            _account_page(alloc, est, page, dictionary)
             page.materialize(dictionary)
             pages.append(page)
             seen_data_values += page.num_values
@@ -346,13 +357,47 @@ def read_chunk(
             continue  # skip, like the reference
         else:
             raise ChunkError(f"chunk: unknown page type {ptype}")
-        if alloc is not None:
-            alloc.register(header.uncompressed_page_size or 0)
     if seen_data_values != expected:
         raise ChunkError(
             f"chunk: pages hold {seen_data_values} values, metadata says {expected}"
         )
     return _concat_pages(column, pages, dictionary)
+
+
+def _precharge(alloc, page_header, block_len: int):
+    """Bound a page's decode allocations BEFORE they happen: levels (2+2 B)
+    plus indices/values (<= 8 B) per header-claimed value, plus the block
+    itself. A header claiming a huge num_values trips the ceiling here, not
+    in the allocator (validation-before-allocation, reference: alloc.go
+    test())."""
+    if alloc is None:
+        return 0
+    n = (page_header.num_values or 0) if page_header is not None else 0
+    est = n * 12 + block_len
+    alloc.register(est)
+    return est
+
+
+def _account_page(alloc, est: int, page: DecodedPage, dictionary) -> None:
+    """Swap the pre-charge for the page's actual decoded footprint, charging
+    the upcoming dictionary gather before materialize() allocates it (a few
+    RLE bytes can gather to n x longest-dict-entry bytes)."""
+    if alloc is None:
+        return
+    alloc.release(est)
+    gather = 0
+    if page.indices is not None and isinstance(dictionary, ByteArrayData):
+        lengths = np.diff(dictionary.offsets)
+        gather = int(lengths[page.indices].sum()) + (len(page.indices) + 1) * 8
+    elif page.indices is not None and dictionary is not None:
+        gather = len(page.indices) * np.asarray(dictionary).itemsize
+    alloc.register(
+        gather
+        + sum(
+            decoded_nbytes(b)
+            for b in (page.values, page.indices, page.def_levels, page.rep_levels)
+        )
+    )
 
 
 def _concat_pages(

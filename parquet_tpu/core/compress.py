@@ -59,8 +59,21 @@ class _Gzip(_Codec):
         return c.compress(bytes(data)) + c.flush()
 
     def decompress(self, data, uncompressed_size):
-        # wbits=47: auto-detect gzip or zlib headers.
-        return zlib.decompress(bytes(data), wbits=47)
+        # wbits=47: auto-detect gzip or zlib headers. Decompression stops at
+        # the advertised size: a bomb that inflates past it raises without
+        # ever materializing the excess (validation-before-allocation).
+        # d.eof also guards integrity: it only turns true once the stream's
+        # trailer (gzip CRC32/ISIZE) has been read and verified, so a
+        # truncated stream that happens to yield the advertised size still
+        # fails here.
+        d = zlib.decompressobj(wbits=47)
+        out = d.decompress(bytes(data), max(uncompressed_size, 1))
+        if d.unconsumed_tail or not d.eof:
+            raise CompressionError(
+                "gzip stream truncated or inflates past advertised size "
+                f"{uncompressed_size}"
+            )
+        return out
 
 
 class _PyArrowSnappy(_Codec):

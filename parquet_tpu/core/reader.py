@@ -226,6 +226,12 @@ class FileReader:
         indices = list(
             range(self.num_row_groups) if row_groups is None else row_groups
         )
+        if self.alloc is not None:
+            # A memory ceiling is per-row-group (released between groups on
+            # the host path); cross-group pipelining would account all
+            # groups' decoded buffers at once and spuriously trip it, so
+            # ceiling-capped readers stage one group at a time.
+            return [self.read_row_group_device(i, columns) for i in indices]
         staged = self._plan_row_groups_async(indices, columns)
         return [
             {path: fut.result().device_column() for path, fut in group}

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ..meta.parquet_types import Encoding, PageType, Type
+from ..core.alloc import decoded_nbytes
 from ..core.arrays import ByteArrayData
 from ..core.chunk import ChunkData, ChunkError, iter_chunk_pages, _check_crc
 from ..core.compress import decompress_block
@@ -539,6 +540,8 @@ def prepare_chunk_plan(
                 _check_crc(header, raw.payload)
             block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
             plan.dictionary = decode_dict_page(header, block, column)
+            if alloc is not None:
+                alloc.register_buffers(plan.dictionary)
             continue
         if pt == int(PageType.INDEX_PAGE):
             continue
@@ -552,6 +555,17 @@ def prepare_chunk_plan(
         )
         if stats is not None:
             stats.pages += 1
+        if alloc is not None:
+            # actual levels + the eventual decoded value footprint (a lying
+            # header cannot understate these: non_null comes from the real
+            # level stream, dict indices decode at 4 B/value, delta totals
+            # are plausibility-bounded by the prescan)
+            alloc.register(
+                decoded_nbytes(dfl)
+                + decoded_nbytes(rep)
+                + len(values_buf)
+                + non_null * 8
+            )
 
         # -- route the value stream --------------------------------------------
         if enc in (int(Encoding.RLE_DICTIONARY), int(Encoding.PLAIN_DICTIONARY)):

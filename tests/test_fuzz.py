@@ -9,6 +9,7 @@ a frozen corpus of inputs that were found to misbehave during development.
 """
 
 import io
+from pathlib import Path
 
 import numpy as np
 import pyarrow as pa
@@ -278,3 +279,79 @@ class TestTrace:
         from parquet_tpu.utils import trace
 
         assert trace._active is None  # nothing leaks between tests
+
+
+class TestAllocCeiling:
+    """The decoded-memory ceiling must bound ACTUAL decoded buffers, not the
+    (attacker-controlled) advertised page sizes (reference: alloc.go:10-89)."""
+
+    def test_rle_expansion_bomb(self, tmp_path):
+        """A few KB of RLE dict indices expanding to tens of MB of decoded
+        values must trip a small ceiling, on both decode backends."""
+        from parquet_tpu.core.alloc import AllocError
+
+        t = pa.table({"s": pa.array(["same-value"] * 1_000_000)})
+        path = str(tmp_path / "bomb.parquet")
+        pq.write_table(t, path, use_dictionary=["s"], compression="snappy")
+        assert Path(path).stat().st_size < 200_000  # tiny on disk
+        for backend in ("host", "tpu_roundtrip"):
+            with FileReader(path, backend=backend, max_memory=1 << 20) as r:
+                with pytest.raises(AllocError):
+                    for i in range(r.num_row_groups):
+                        r.read_row_group(i)
+
+    def test_ceiling_released_per_row_group(self, tmp_path):
+        from parquet_tpu.core.alloc import AllocError
+
+        t = pa.table({"x": pa.array(np.arange(200_000, dtype=np.int64))})
+        path = str(tmp_path / "rg.parquet")
+        pq.write_table(t, path, row_group_size=50_000, use_dictionary=False)
+        # each group decodes to ~400KB; a 1MB ceiling passes only if the
+        # budget is released between groups
+        with FileReader(path, max_memory=1 << 20) as r:
+            total = sum(1 for _ in r.iter_rows())
+        assert total == 200_000
+        with FileReader(path, max_memory=100_000) as r:
+            with pytest.raises(AllocError):
+                list(r.iter_rows())
+
+    def test_gzip_inflation_stops_at_advertised_size(self):
+        """A gzip stream inflating far past the advertised size must raise
+        without materializing the excess."""
+        import zlib
+
+        from parquet_tpu.core.compress import CompressionError, decompress_block
+        from parquet_tpu.meta import CompressionCodec
+
+        c = zlib.compressobj(wbits=31)
+        bomb = c.compress(b"\x00" * (64 << 20)) + c.flush()  # 64MB of zeros
+        with pytest.raises(CompressionError):
+            decompress_block(bomb, CompressionCodec.GZIP, 100)
+
+    def test_dictionary_gather_bomb_single_page(self, tmp_path):
+        """One page, tiny on disk, whose dict gather would materialize
+        hundreds of MB: the gather is charged BEFORE materialization."""
+        from parquet_tpu.core.alloc import AllocError
+
+        t = pa.table({"s": pa.array(["x" * 1000] * 200_000)})
+        path = str(tmp_path / "gather.parquet")
+        pq.write_table(
+            t, path, use_dictionary=["s"], compression="snappy", data_page_size=1 << 30
+        )
+        assert Path(path).stat().st_size < 100_000
+        with FileReader(path, max_memory=1 << 20) as r:
+            with pytest.raises(AllocError):
+                r.read_row_group(0)
+
+    def test_gzip_truncated_trailer_rejected(self):
+        """A gzip stream with its CRC trailer cut off must not decode
+        silently even when the body yields exactly the advertised size."""
+        import zlib
+
+        from parquet_tpu.core.compress import CompressionError, decompress_block
+        from parquet_tpu.meta import CompressionCodec
+
+        c = zlib.compressobj(wbits=31)
+        full = c.compress(b"hello world") + c.flush()
+        with pytest.raises(CompressionError):
+            decompress_block(full[:-8], CompressionCodec.GZIP, 11)
