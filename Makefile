@@ -1,0 +1,21 @@
+# Developer entry points. `make check` is the local quality gate mirrored by
+# .github/workflows/ci.yml (ruff runs there; this image has no linter, so the
+# syntax gate is compileall).
+
+.PHONY: check test native bench dryrun
+
+check: native
+	python -m compileall -q parquet_tpu tests bench.py __graft_entry__.py
+	python -m pytest tests/ -q
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py
+
+dryrun:
+	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -20,13 +20,24 @@ class ShredError(ValueError):
     pass
 
 
+def _value_size(v) -> int:
+    """Approximate encoded size of one leaf value (reference: the per-type
+    sizeOf of the typed stores, interfaces.go:67-81). Strings/bytes charge
+    their real length — a flat per-value constant made string-heavy row
+    groups overshoot the target size badly."""
+    if isinstance(v, (str, bytes)):
+        return len(v) + 4
+    return 8
+
+
 class _LeafBuffer:
-    __slots__ = ("values", "def_levels", "rep_levels")
+    __slots__ = ("values", "def_levels", "rep_levels", "data_size")
 
     def __init__(self):
         self.values: list = []
         self.def_levels: list[int] = []
         self.rep_levels: list[int] = []
+        self.data_size = 0  # approximate bytes of buffered values
 
 
 class Shredder:
@@ -76,6 +87,7 @@ class Shredder:
                 )
             buf = self.buffers[node.path]
             buf.values.append(value)
+            buf.data_size += _value_size(value)
             buf.def_levels.append(node.max_def)
             buf.rep_levels.append(rep)
             return

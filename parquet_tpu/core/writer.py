@@ -250,8 +250,13 @@ class FileWriter:
     def _estimated_size(self) -> int:
         total = 0
         for b in self._shredder.buffers.values():
-            total += 8 * len(b.values) + 2 * len(b.def_levels)
+            total += b.data_size + 2 * len(b.def_levels)
         return total
+
+    def estimated_buffered_size(self) -> int:
+        """Approximate bytes of the not-yet-flushed row group (the sizing
+        input of the auto-flush; public for tools sizing output parts)."""
+        return self._estimated_size()
 
     # -- row group flush -------------------------------------------------------
 

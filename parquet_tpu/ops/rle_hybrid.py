@@ -173,28 +173,61 @@ def prescan_hybrid(data, num_values: int, width: int) -> RunTable:
 
 
 def expand_runs(table: RunTable, num_values: int, width: int, dtype=np.uint32) -> np.ndarray:
-    """Vectorized expansion of a prescanned run table into a value array."""
-    out = np.empty(num_values, dtype=dtype)
-    pos = 0
-    n_runs = len(table.counts)
-    for i in range(n_runs):
-        count = int(table.counts[i])
-        take = min(count, num_values - pos)
-        if take <= 0:
-            break
-        if table.is_rle[i]:
-            out[pos : pos + take] = dtype(table.rle_values[i])
-        else:
-            off = int(table.bp_offsets[i])
-            vals = unpack_bits(
-                table.packed[off : off + (count // 8) * width], take, width, dtype=dtype
+    """Vectorized expansion of a prescanned run table into a value array.
+
+    No per-run Python loop (adversarial streams can hold millions of
+    one-value runs): RLE positions broadcast via np.repeat of the run
+    table, bit-packed positions gather from one unpack of the whole packed
+    buffer — both O(values) in C.
+    """
+    counts = table.counts.astype(np.int64)
+    k = len(counts)
+    if k == 0 or num_values == 0:
+        if num_values > 0:
+            raise HybridError(
+                f"hybrid: stream produced 0 values, expected {num_values}"
             )
-            out[pos : pos + take] = vals
-        pos += take
-    if pos < num_values:
+        return np.empty(0, dtype=dtype)
+    ends = np.cumsum(counts)
+    if int(ends[-1]) < num_values:
         raise HybridError(
-            f"hybrid: stream produced {pos} values, expected {num_values}"
+            f"hybrid: stream produced {int(ends[-1])} values, expected {num_values}"
         )
+    # clamp to the first k' runs covering num_values; partial last run
+    kp = int(np.searchsorted(ends, num_values, side="left")) + 1
+    takes = counts[:kp].copy()
+    takes[kp - 1] = num_values - (int(ends[kp - 2]) if kp > 1 else 0)
+    is_rle = np.asarray(table.is_rle[:kp], dtype=bool)
+    out = np.empty(num_values, dtype=dtype)
+    run_of = np.repeat(np.arange(kp), takes)  # run index at each position
+    rle_pos = is_rle[run_of]
+    if rle_pos.any():
+        out[rle_pos] = table.rle_values[:kp].astype(dtype)[run_of[rle_pos]]
+    if not rle_pos.all():
+        # one unpack of every bit-packed payload (payloads are dense:
+        # counts are multiples of 8), then a gather by global bp index
+        bp_counts = np.where(is_rle, 0, counts[:kp])
+        bp_total = int(bp_counts.sum())
+        if width == 0:
+            out[~rle_pos] = 0
+        else:
+            first_off = int(table.bp_offsets[:kp][~is_rle][0])
+            bp_vals = unpack_bits(
+                table.packed[first_off : first_off + (bp_total // 8) * width],
+                bp_total,
+                width,
+                dtype=dtype,
+            )
+            bp_base = np.zeros(kp, dtype=np.int64)
+            np.cumsum(bp_counts[:-1], out=bp_base[1:])
+            starts = np.zeros(kp, dtype=np.int64)
+            np.cumsum(takes[:-1], out=starts[1:])
+            # index math only over the bit-packed positions: temporaries
+            # scale with the bp count, not num_values (a stream that is one
+            # huge RLE run plus 8 bp values should not allocate 16B/value)
+            bp_pos = np.flatnonzero(~rle_pos)
+            bp_runs = run_of[bp_pos]
+            out[bp_pos] = bp_vals[bp_base[bp_runs] + (bp_pos - starts[bp_runs])]
     return out
 
 

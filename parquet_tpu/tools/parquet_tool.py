@@ -95,34 +95,59 @@ def cmd_meta(args) -> int:
     return 0
 
 
+def _parse_size(s: str) -> int:
+    """'10M', '512K', '1G', or plain bytes; rejects malformed/non-positive."""
+    raw = s.strip().upper()
+    mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}.get(raw[-1:], 1)
+    try:
+        n = int(raw[:-1] if mult != 1 else raw) * mult
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"invalid size {s!r} (use bytes or K/M/G)")
+    if n <= 0:
+        raise argparse.ArgumentTypeError(f"size must be positive, got {s!r}")
+    return n
+
+
 def cmd_split(args) -> int:
-    """Re-shard into parts of ~n rows each (reference: cmds/split.go:31-117
-    splits by target file size; rows are the stable unit here)."""
+    """Re-shard into parts bounded by rows (-n) or by target file size
+    (--size, the reference's unit: cmds/split.go:31-117 rolls to the next
+    part once the current file reaches the target)."""
     pattern = args.out
     if "%d" not in pattern:
         print("split: output pattern must contain %d", file=sys.stderr)
         return 2
+    if (args.n is None) == (args.size is None):
+        print("split: pass exactly one of -n or --size", file=sys.stderr)
+        return 2
+    target_size = args.size
     with FileReader(args.file) as r:
         schema = r.schema
         codec = args.codec
         part = 0
         rows_in_part = 0
         writer = None
-        try:
-            for row in r.iter_rows(raw=True):
-                if writer is None:
-                    writer = FileWriter(pattern % part, schema, codec=codec)
-                writer.write_row(row)
-                rows_in_part += 1
-                if rows_in_part >= args.n:
-                    writer.close()
-                    writer = None
-                    part += 1
-                    rows_in_part = 0
-            if writer is not None:
+        for row in r.iter_rows(raw=True):
+            if writer is None:
+                writer = FileWriter(pattern % part, schema, codec=codec)
+            writer.write_row(row)
+            rows_in_part += 1
+            if target_size is None:
+                full = rows_in_part >= args.n
+            else:
+                # flushed bytes + the buffered row group's estimate, so a
+                # part rolls over without waiting for an auto-flush; sampled
+                # every 64 rows like the writer's own auto-flush throttle
+                full = rows_in_part % 64 == 0 and (
+                    writer.current_file_size + writer.estimated_buffered_size()
+                    >= target_size
+                )
+            if full:
                 writer.close()
-        finally:
-            pass
+                writer = None
+                part += 1
+                rows_in_part = 0
+        if writer is not None:
+            writer.close()
     print(f"wrote {part + (1 if rows_in_part else 0)} parts")
     return 0
 
@@ -154,8 +179,13 @@ def main(argv=None) -> int:
     pr.add_argument("file")
     pr.set_defaults(fn=cmd_rowcount)
 
-    pp = sub.add_parser("split", help="split into parts of N rows")
-    pp.add_argument("-n", type=int, required=True, help="rows per part")
+    pp = sub.add_parser("split", help="split into parts by rows or file size")
+    pp.add_argument("-n", type=int, help="rows per part")
+    pp.add_argument(
+        "--size",
+        type=_parse_size,
+        help="target bytes per part (suffixes K/M/G), like the reference",
+    )
     pp.add_argument("--codec", default="snappy")
     pp.add_argument("file")
     pp.add_argument("out", help="output pattern containing %%d")

@@ -106,3 +106,56 @@ class TestCsv2Parquet:
         src.write_text("a,b\n1,2\n3\n")
         rc = csv_main(["-o", str(tmp_path / "o.parquet"), str(src)])
         assert rc == 1
+
+
+class TestSplitBySize:
+    def test_split_by_target_size(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from parquet_tpu.tools.parquet_tool import main
+
+        src = str(tmp_path / "src.parquet")
+        pq.write_table(
+            pa.table({"s": pa.array([f"row_{i:06d}" * 4 for i in range(20_000)])}),
+            src,
+            compression="none",
+        )
+        out = str(tmp_path / "part_%d.parquet")
+        assert main(["split", "--size", "64K", "--codec", "uncompressed", src, out]) == 0
+        parts = sorted(tmp_path.glob("part_*.parquet"))
+        assert len(parts) > 2  # actually split
+        total = 0
+        from parquet_tpu.core.reader import FileReader
+
+        for p in parts:
+            with FileReader(p) as r:
+                total += r.num_rows
+            # each part lands in the target's ballpark (last may be smaller)
+            assert p.stat().st_size < 3 * (64 << 10)
+        assert total == 20_000
+
+    def test_split_requires_exactly_one_mode(self, tmp_path, capsys):
+        from parquet_tpu.tools.parquet_tool import main
+
+        assert main(["split", "src", "out_%d"]) == 2
+        assert main(["split", "-n", "5", "--size", "1M", "src", "out_%d"]) == 2
+
+    def test_writer_string_size_estimate(self, tmp_path):
+        """String-heavy rows must auto-flush near the row-group target
+        instead of overshooting by the string length / 8 factor."""
+        from parquet_tpu.core.reader import FileReader
+        from parquet_tpu.core.writer import FileWriter
+        from parquet_tpu.schema.dsl import parse_schema
+
+        sch = parse_schema("message m { required binary s (STRING); }")
+        path = str(tmp_path / "big_strings.parquet")
+        with FileWriter(path, sch, row_group_size=1 << 20) as w:
+            for i in range(4000):
+                w.write_row({"s": "x" * 1000})  # ~4MB of string data
+        with FileReader(path) as r:
+            # size checks fire every 1000 rows; ~1MB/1000 rows -> a flush at
+            # 2000 rows. The old flat 8B/value estimate saw ~32KB and never
+            # flushed (1 row group).
+            assert r.num_row_groups == 2
+            assert r.num_rows == 4000
