@@ -23,7 +23,7 @@ from pathlib import Path
 from ..meta.file_meta import ParquetFileError, read_file_metadata
 from ..meta.parquet_types import FileMetaData, RowGroup
 from .alloc import AllocTracker
-from .assembly import RecordAssembler, fast_flat_rows
+from .assembly import RecordAssembler, fast_rows
 from .chunk import ChunkData, read_chunk
 from .schema import Schema
 from ..utils.trace import stage
@@ -349,7 +349,7 @@ class FileReader:
         for i in indices:
             chunks = self.read_row_group(i)
             with stage("assemble"):
-                rows = fast_flat_rows(chunks, raw)
+                rows = fast_rows(self.schema, chunks, raw)
             if rows is not None:
                 yield from rows
             else:
