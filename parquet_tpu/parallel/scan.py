@@ -29,16 +29,23 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["scan_row_groups", "column_stats"]
+__all__ = [
+    "scan_row_groups",
+    "column_stats",
+    "process_row_groups",
+    "mesh_reduce_stats",
+    "distributed_column_stats",
+]
 
 
-def scan_row_groups(reader, devices, map_fn, reduce_fn, columns=None):
+def scan_row_groups(reader, devices, map_fn, reduce_fn, columns=None, indices=None):
     """Decode row groups onto mesh devices round-robin and map-reduce.
 
     `map_fn(cols)` receives {leaf path: DeviceColumn} with arrays resident on
     the device that decoded the shard and returns a pytree of jax arrays;
-    `reduce_fn(acc, x)` folds two such pytrees. Returns the folded result
-    (None if the file has no row groups).
+    `reduce_fn(acc, x)` folds two such pytrees. `indices` restricts the scan
+    to those row groups (default: all — a multi-host caller passes its own
+    slice). Returns the folded result (None when no groups were scanned).
 
     Dispatch is asynchronous: all shards' uploads + decode programs are in
     flight before the first result is consumed.
@@ -46,9 +53,13 @@ def scan_row_groups(reader, devices, map_fn, reduce_fn, columns=None):
     devices = list(devices)
     if not devices:
         raise ValueError("scan: no devices given")
+    if indices is None:
+        indices = range(reader.num_row_groups)
     shard_results = []
-    for i in range(reader.num_row_groups):
-        dev = devices[i % len(devices)]
+    for k, i in enumerate(indices):
+        # round-robin by LOCAL position: global indices striped across hosts
+        # must still spread over every local device
+        dev = devices[k % len(devices)]
         with jax.default_device(dev):
             cols = reader.read_row_group_device(i, columns=columns)
             shard_results.append(map_fn(cols))
@@ -84,35 +95,27 @@ def _dtype_limits(dtype):
     return jnp.asarray(info.min, dtype), jnp.asarray(info.max, dtype)
 
 
-def column_stats(reader, devices, columns=None):
-    """Global per-column {min, max, count} over the whole file.
+def _stats_map_fn(cols):
+    return {p: _chunk_stats(dc) for p, dc in cols.items() if dc.values is not None}
 
-    Numeric columns only (dictionary-encoded byte-array columns have no
-    device values array; project them out with `columns=`). Per-shard stats
-    are computed on the decoding device; only those scalars reach the fold.
-    """
 
-    def map_fn(cols):
-        return {p: _chunk_stats(dc) for p, dc in cols.items() if dc.values is not None}
+def _stats_reduce_fn(a, b):
+    out = {}
+    for p in a.keys() | b.keys():
+        if p not in a:
+            out[p] = b[p]
+        elif p not in b:
+            out[p] = a[p]
+        else:
+            out[p] = {
+                "min": jnp.minimum(a[p]["min"], b[p]["min"]),
+                "max": jnp.maximum(a[p]["max"], b[p]["max"]),
+                "count": a[p]["count"] + b[p]["count"],
+            }
+    return out
 
-    def reduce_fn(a, b):
-        out = {}
-        for p in a.keys() | b.keys():
-            if p not in a:
-                out[p] = b[p]
-            elif p not in b:
-                out[p] = a[p]
-            else:
-                out[p] = {
-                    "min": jnp.minimum(a[p]["min"], b[p]["min"]),
-                    "max": jnp.maximum(a[p]["max"], b[p]["max"]),
-                    "count": a[p]["count"] + b[p]["count"],
-                }
-        return out
 
-    folded = scan_row_groups(reader, devices, map_fn, reduce_fn, columns=columns)
-    if folded is None:
-        return {}
+def _stats_materialize(folded) -> dict:
     # count == 0: every shard contributed only the fold identity (inverted
     # dtype extremes) — there are no values, so there are no bounds.
     return {
@@ -123,3 +126,148 @@ def column_stats(reader, devices, columns=None):
         }
         for p, s in folded.items()
     }
+
+
+def column_stats(reader, devices, columns=None):
+    """Global per-column {min, max, count} over the whole file.
+
+    Numeric columns only (dictionary-encoded byte-array columns have no
+    device values array; project them out with `columns=`). Per-shard stats
+    are computed on the decoding device; only those scalars reach the fold.
+    """
+    folded = scan_row_groups(
+        reader, devices, _stats_map_fn, _stats_reduce_fn, columns=columns
+    )
+    return {} if folded is None else _stats_materialize(folded)
+
+
+# -- multi-host scale-out ------------------------------------------------------
+#
+# Above, the distribution unit is a row group over the LOCAL devices of one
+# process. Across hosts, row groups shard by process index (each host touches
+# only its slice of the file — the reference's one-goroutine reader never
+# distributes I/O at all), local stats fold on-host, and the tiny per-host
+# partials reduce over the global mesh: psum/pmin/pmax ride ICI within a pod
+# slice and DCN between slices, which is exactly where a collective of a few
+# scalars belongs (the decoded data itself never crosses hosts).
+
+
+def process_row_groups(num_row_groups: int, process_index=None, process_count=None):
+    """The row-group indices owned by this process (round-robin by host)."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    return list(range(pi, num_row_groups, pc))
+
+
+def mesh_reduce_stats(stats: dict, mesh, replicas_per_participant: int = 1) -> dict:
+    """All-reduce per-column {min, max, count} over every device of `mesh`.
+
+    Each participant's partial is replicated across its `replicas_per_
+    participant` mesh positions (a host with 4 local devices contributes 4
+    identical copies), so the psum'd count divides by that factor; min/max
+    are idempotent. Keys MUST match across participants — build them from
+    the shared schema, not from which chunks happened to decode.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    r = replicas_per_participant
+    if mesh.devices.size % max(r, 1) != 0:
+        raise ValueError(
+            f"mesh size {mesh.devices.size} not divisible by {r} replicas"
+        )
+
+    def reduce_one(s):
+        return {
+            "min": jax.lax.pmin(s["min"], axis),
+            "max": jax.lax.pmax(s["max"], axis),
+            "count": jax.lax.psum(s["count"], axis) // r,
+        }
+
+    def step(tree):
+        return {p: reduce_one(s) for p, s in tree.items()}
+
+    reducer = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(),
+        check_vma=False,
+    )
+    # one leading mesh-axis element per device: replicate this host's partial
+    # and lay it out on the mesh (local partials live on a single device)
+    n = mesh.devices.size
+    sharding = NamedSharding(mesh, P(axis))
+    tiled = jax.tree.map(
+        lambda a: jax.device_put(
+            np.broadcast_to(np.asarray(a), (n,) + np.asarray(a).shape), sharding
+        ),
+        stats,
+    )
+    out = reducer(tiled)
+    # out_specs=P() leaves a size-1 leading axis on some jax versions
+    return jax.tree.map(
+        lambda a: a.reshape(a.shape[1:]) if a.ndim and a.shape[0] == 1 else a, out
+    )
+
+
+def _stats_key_nodes(reader, columns) -> list:
+    """The numeric leaves every participant reports on — derived from the
+    schema + projection so all hosts enter the collective with IDENTICAL
+    pytree structure regardless of which chunks they decoded."""
+    selected = reader._resolve_columns(columns) if columns else None
+    return [
+        leaf
+        for leaf in reader.schema.leaves
+        if _numeric_jnp_dtype(leaf) is not None
+        and (selected is None or leaf.path in selected)
+    ]
+
+
+def _stats_identity(leaf):
+    lo, hi = _dtype_limits(_numeric_jnp_dtype(leaf))
+    return {"min": hi, "max": lo, "count": jnp.asarray(0, dtype=jnp.int64)}
+
+
+def distributed_column_stats(reader, columns=None, mesh=None):
+    """Whole-file column stats in a multi-host program.
+
+    Each process decodes only its own row groups (process_row_groups) on its
+    local devices, folds locally, and contributes one partial per numeric
+    leaf — fold identities for anything it didn't decode, so every host's
+    pytree matches. Partials reduce globally over `mesh` (default: every
+    device in the program, one participant per process replicated over its
+    local devices). Single-process programs with no explicit mesh skip the
+    collective."""
+    devices = jax.local_devices()
+    indices = process_row_groups(reader.num_row_groups)
+    key_nodes = _stats_key_nodes(reader, columns)
+    acc = scan_row_groups(
+        reader, devices, _stats_map_fn, _stats_reduce_fn,
+        columns=columns, indices=indices,
+    )
+    # identical key set on every participant (SPMD: the collective's pytree
+    # structure must not depend on local data)
+    full = {leaf.path: _stats_identity(leaf) for leaf in key_nodes}
+    if acc:
+        full.update({p: s for p, s in acc.items() if p in full})
+    acc = full
+    if jax.process_count() > 1 or mesh is not None:
+        if mesh is None:
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()), ("hosts",))
+        replicas = mesh.devices.size // jax.process_count()
+        acc = mesh_reduce_stats(acc, mesh, replicas_per_participant=replicas)
+    return _stats_materialize(acc)
+
+
+def _numeric_jnp_dtype(leaf):
+    from ..meta.parquet_types import Type
+
+    return {
+        Type.INT32: jnp.int32,
+        Type.INT64: jnp.int64,
+        Type.FLOAT: jnp.float32,
+        Type.DOUBLE: jnp.float64,
+    }.get(leaf.type)

@@ -103,3 +103,76 @@ class TestShardedScan:
             stats = column_stats(r, jax.devices(), columns=["b"])
         assert stats[("b",)]["min"] == False  # noqa: E712
         assert stats[("b",)]["max"] == True  # noqa: E712
+
+
+class TestDistributedStats:
+    """Multi-host shape of the stats scan: per-process row-group sharding +
+    global mesh reduction (simulated with replicas on the virtual mesh)."""
+
+    def test_process_row_groups_partition(self):
+        from parquet_tpu.parallel.scan import process_row_groups
+
+        shards = [process_row_groups(10, pi, 4) for pi in range(4)]
+        assert sorted(i for s in shards for i in s) == list(range(10))
+        assert shards[1] == [1, 5, 9]
+
+    def test_single_process_stats(self, tmp_path):
+        from parquet_tpu.parallel.scan import distributed_column_stats
+
+        t = pa.table(
+            {
+                "x": pa.array(np.arange(50_000, dtype=np.int64)),
+                "f": pa.array(np.linspace(-5, 5, 50_000)),
+            }
+        )
+        path = str(tmp_path / "d.parquet")
+        pq.write_table(t, path, row_group_size=8_000, use_dictionary=False)
+        with FileReader(path) as r:
+            out = distributed_column_stats(r)
+        assert out[("x",)] == {"min": 0, "max": 49_999, "count": 50_000}
+        assert out[("f",)]["count"] == 50_000
+        assert abs(out[("f",)]["min"] + 5) < 1e-9
+
+    def test_mesh_reduce_partials(self):
+        """Eight replicated partials reduce to one global result, identical
+        everywhere — the DCN/ICI collective of the multi-host path."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        from parquet_tpu.parallel.scan import mesh_reduce_stats
+
+        mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("hosts",))
+        partial = {
+            ("x",): {
+                "min": jnp.asarray(3, jnp.int64),
+                "max": jnp.asarray(9, jnp.int64),
+                "count": jnp.asarray(5, jnp.int64),
+            }
+        }
+        out = mesh_reduce_stats(partial, mesh)
+        assert int(out[("x",)]["count"]) == 40  # psum over 8 participants
+        # with the 8 positions declared as replicas of ONE participant the
+        # count divides back out
+        out1 = mesh_reduce_stats(partial, mesh, replicas_per_participant=8)
+        assert int(out1[("x",)]["count"]) == 5
+        assert int(out[("x",)]["min"]) == 3 and int(out[("x",)]["max"]) == 9
+
+    def test_forced_mesh_reduction_end_to_end(self, tmp_path):
+        """distributed_column_stats with an explicit mesh exercises the
+        collective even in a single-process program."""
+        import jax
+        from jax.sharding import Mesh
+
+        from parquet_tpu.parallel.scan import distributed_column_stats
+
+        t = pa.table({"x": pa.array(np.arange(10_000, dtype=np.int64))})
+        path = str(tmp_path / "m.parquet")
+        pq.write_table(t, path, row_group_size=2_500, use_dictionary=False)
+        mesh = Mesh(np.array(jax.devices("cpu")[:4]), ("hosts",))
+        with FileReader(path) as r:
+            out = distributed_column_stats(r, mesh=mesh)
+        # this single process owns all 4 mesh positions (replicas), so the
+        # psum'd count divides back to the true count
+        assert out[("x",)]["count"] == 10_000
+        assert out[("x",)]["min"] == 0 and out[("x",)]["max"] == 9_999
