@@ -32,10 +32,20 @@ class ByteArrayData:
     def __getitem__(self, i: int) -> bytes:
         return self.data[self.offsets[i] : self.offsets[i + 1]]
 
-    def to_list(self) -> list[bytes]:
-        o = self.offsets
+    def to_list(self, cache: bool = False) -> list[bytes]:
+        """Per-value bytes. The write path asks repeatedly on the same chunk
+        (dictionary build, PLAIN encode, stats) and opts into memoization
+        with cache=True; read-path callers stay cache-free so a decoded
+        column's memory isn't silently doubled for one traversal."""
+        cached = getattr(self, "_list_cache", None)
+        if cached is not None:
+            return cached
+        o = self.offsets.tolist()
         d = self.data
-        return [d[o[i] : o[i + 1]] for i in range(len(o) - 1)]
+        out = [d[o[i] : o[i + 1]] for i in range(len(o) - 1)]
+        if cache:
+            self._list_cache = out
+        return out
 
     @classmethod
     def from_list(cls, items) -> "ByteArrayData":

@@ -59,7 +59,7 @@ def compute_statistics(ptype: Type, values, null_count: int) -> Statistics:
         st.max_value = bytes([int(arr.max())])
     elif ptype in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
         if isinstance(values, ByteArrayData):
-            items = values.to_list()
+            items = values.to_list(cache=True)
         elif isinstance(values, np.ndarray) and values.ndim == 2:
             items = [v.tobytes() for v in values]
         else:

@@ -216,8 +216,69 @@ class FileWriter:
             self.flush_row_group()
 
     def write_rows(self, rows) -> None:
+        """Bulk ingestion; flat schemas take a batched columnar shred that
+        skips the per-row recursive walk (one pass per column per batch)."""
+        root = self.schema.root
+        if any(
+            not c.is_leaf or c.max_rep > 0 or c.max_def > 1 for c in root.children
+        ):
+            for row in rows:
+                self.write_row(row)
+            return
+        self._check_open()
+        if self._columnar_rows is not None:
+            raise WriterError(
+                "writer: cannot mix write_row and write_column in one row group"
+            )
+        BATCH = 4096
+        batch: list = []
         for row in rows:
-            self.write_row(row)
+            batch.append(row)
+            if len(batch) >= BATCH:
+                self._write_flat_batch(batch)
+                batch.clear()
+        if batch:
+            self._write_flat_batch(batch)
+
+    def _write_flat_batch(self, batch: list) -> None:
+        from .shred import ShredError, _value_size
+
+        # Phase 1 — validate + stage every column WITHOUT touching buffers,
+        # so a bad row leaves the writer consistent (a partial append would
+        # silently misalign columns and close() would write a corrupt file).
+        staged = []
+        for leaf in self.schema.root.children:
+            name = leaf.name
+            if leaf.max_def == 1:
+                vals = []
+                defs = []
+                for row in batch:
+                    v = row.get(name)
+                    if v is None:
+                        defs.append(0)
+                    else:
+                        defs.append(1)
+                        vals.append(v)
+            else:
+                vals = []
+                for row in batch:
+                    v = row.get(name)
+                    if v is None:
+                        raise ShredError(
+                            f"shred: required field {leaf.path_str} is None"
+                        )
+                    vals.append(v)
+                defs = [0] * len(batch)
+            staged.append((self._shredder.buffers[leaf.path], vals, defs))
+        # Phase 2 — commit (list extends cannot fail on valid staged data)
+        for buf, vals, defs in staged:
+            buf.values.extend(vals)
+            buf.def_levels.extend(defs)
+            buf.rep_levels.extend([0] * len(batch))
+            buf.data_size += sum(_value_size(v) for v in vals)
+        self._shredder.num_rows += len(batch)
+        if self._estimated_size() >= self.row_group_size:
+            self.flush_row_group()
 
     def write_column(self, path, values, def_levels=None, rep_levels=None) -> None:
         """Columnar fast path for one leaf of the current row group.

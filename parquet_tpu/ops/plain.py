@@ -126,7 +126,7 @@ def encode_plain(values, ptype: Type, type_length: int | None = None) -> bytes:
         return v.tobytes()
     if ptype == Type.BYTE_ARRAY:
         if isinstance(values, ByteArrayData):
-            items = values.to_list()
+            items = values.to_list(cache=True)
         else:
             items = [bytes(x) for x in values]
         out = bytearray()

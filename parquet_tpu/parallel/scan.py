@@ -215,7 +215,9 @@ def _stats_key_nodes(reader, columns) -> list:
     """The numeric leaves every participant reports on — derived from the
     schema + projection so all hosts enter the collective with IDENTICAL
     pytree structure regardless of which chunks they decoded."""
-    selected = reader._resolve_columns(columns) if columns else None
+    # honor the reader's persistent projection too: a deselected column is
+    # never decoded, and reporting it as count=0 would misread as "empty"
+    selected = reader._resolve_columns(columns) if columns else reader._selected
     return [
         leaf
         for leaf in reader.schema.leaves
@@ -270,4 +272,5 @@ def _numeric_jnp_dtype(leaf):
         Type.INT64: jnp.int64,
         Type.FLOAT: jnp.float32,
         Type.DOUBLE: jnp.float64,
+        Type.BOOLEAN: jnp.bool_,
     }.get(leaf.type)
