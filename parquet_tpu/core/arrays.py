@@ -35,11 +35,14 @@ class ByteArrayData:
     def to_list(self, cache: bool = False) -> list[bytes]:
         """Per-value bytes. The write path asks repeatedly on the same chunk
         (dictionary build, PLAIN encode, stats) and opts into memoization
-        with cache=True; read-path callers stay cache-free so a decoded
-        column's memory isn't silently doubled for one traversal."""
-        cached = getattr(self, "_list_cache", None)
-        if cached is not None:
-            return cached
+        with cache=True — those callers share one list and must not mutate
+        it (the writer wraps caller-owned arrays, so the cache never pins a
+        user object). cache=False always builds a fresh list: read-path
+        callers neither retain extra memory nor alias the shared one."""
+        if cache:
+            cached = getattr(self, "_list_cache", None)
+            if cached is not None:
+                return cached
         o = self.offsets.tolist()
         d = self.data
         out = [d[o[i] : o[i + 1]] for i in range(len(o) - 1)]

@@ -13,6 +13,8 @@ dictionary cutoff 32767 uniques (chunk_writer.go:188-200, type_dict.go:101-103).
 
 from __future__ import annotations
 
+import datetime as _dt
+
 import numpy as np
 
 from ..meta.parquet_types import Type
@@ -109,6 +111,12 @@ class ColumnChunkBuilder:
                 )
             rows = []
             for v in self.values:
+                if ptype == Type.INT96 and isinstance(v, _dt.datetime):
+                    # datetime into an INT96 column converts like the
+                    # reference's floor writer (writer.go INT96 heuristics)
+                    from ..utils.int96 import datetime_to_int96
+
+                    v = datetime_to_int96(v)
                 b = self._to_bytes(v)
                 if len(b) != width:
                     raise StoreError(
@@ -144,7 +152,10 @@ class ColumnChunkBuilder:
             return np.asarray(v, dtype=bool)
         if ptype == Type.BYTE_ARRAY:
             if isinstance(v, ByteArrayData):
-                return v
+                # shallow wrapper sharing offsets/data: the write path's
+                # to_list(cache=True) memo then lives on the writer's copy,
+                # never pinning a caller-owned array
+                return ByteArrayData(offsets=v.offsets, data=v.data)
             # inline the common str/bytes cases: _to_bytes per item costs a
             # call + isinstance chain on the hot columnar write path
             return ByteArrayData.from_list(

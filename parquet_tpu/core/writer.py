@@ -246,6 +246,11 @@ class FileWriter:
         # Phase 1 — validate + stage every column WITHOUT touching buffers,
         # so a bad row leaves the writer consistent (a partial append would
         # silently misalign columns and close() would write a corrupt file).
+        for row in batch:
+            if not isinstance(row, dict):
+                raise ShredError(
+                    f"shred: row must be a dict, got {type(row).__name__}"
+                )
         staged = []
         for leaf in self.schema.root.children:
             name = leaf.name

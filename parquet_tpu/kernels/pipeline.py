@@ -698,6 +698,8 @@ def _split_page(raw, header, pt, codec, column: Column):
 
     if pt == int(PageType.DATA_PAGE):
         h = header.data_page_header
+        if h is None:
+            raise PageError("page: DATA_PAGE without data_page_header")
         n = h.num_values or 0
         block = decompress_block(raw.payload, codec, header.uncompressed_page_size or 0)
         buf = memoryview(block)
@@ -718,6 +720,8 @@ def _split_page(raw, header, pt, codec, column: Column):
         return n, dfl, rep, non_null, h.encoding, buf[pos:]
 
     h = header.data_page_header_v2
+    if h is None:
+        raise PageError("page: DATA_PAGE_V2 without data_page_header_v2")
     n = h.num_values or 0
     rep_len = h.repetition_levels_byte_length or 0
     def_len = h.definition_levels_byte_length or 0

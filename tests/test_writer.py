@@ -560,3 +560,24 @@ class TestSchemaClone:
         assert c.column("name").element.logicalType is not s.column("name").element.logicalType
         with pytest.raises(SchemaError, match="is a leaf"):
             s.sub_schema("id")
+
+
+class TestInt96Write:
+    def test_datetime_into_int96_column(self, tmp_path):
+        """Writing datetime into an INT96 column converts like the
+        reference's floor writer (reference: floor/writer.go INT96 path)."""
+        import datetime as dt
+
+        from parquet_tpu.schema.dsl import parse_schema
+
+        sch = parse_schema("message m { optional int96 ts; }")
+        ts = dt.datetime(1999, 12, 31, 23, 59, 59, 999999, tzinfo=dt.timezone.utc)
+        path = str(tmp_path / "i96.parquet")
+        with FileWriter(path, sch) as w:
+            w.write_row({"ts": ts})
+            w.write_row({"ts": None})
+        with FileReader(path) as r:
+            rows = list(r.iter_rows())
+        assert rows[0]["ts"] == ts and rows[1]["ts"] is None
+        got = pq.read_table(path).to_pylist()
+        assert got[0]["ts"].to_pydatetime().replace(tzinfo=dt.timezone.utc) == ts
