@@ -1,0 +1,297 @@
+"""Statistics-based row-group pruning + row-level predicate filtering.
+
+The reference writes chunk statistics but deliberately never consumes them
+("Page meta data is generally not made available to users and not used by
+parquet-go", reference README.md:47). A scan framework should: a predicate
+over a sorted or clustered column lets whole row groups be skipped before a
+single page is read or decoded — the cheapest decode is the one that never
+happens. This module goes beyond the reference's capability set on purpose.
+
+Filters are pyarrow-style conjunctive triples:
+
+    FileReader(path).iter_rows(filters=[("ts", ">=", t0), ("vendor", "==", "v1")])
+
+Pruning is CONSERVATIVE: a row group is skipped only when its written
+min/max/null-count statistics prove no row can match. Surviving groups are
+decoded normally and the predicate re-checked per row, so the result is
+exact regardless of how coarse (or absent) the statistics are.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import decimal
+import struct
+
+from ..meta.parquet_types import ConvertedType, Type
+from .assembly import _to_micros, logical_kind
+from .schema import Schema
+from .stats import _PACK
+
+__all__ = ["FilterError", "normalize_filters", "row_group_may_match", "row_matches"]
+
+_OPS = ("==", "!=", "<", "<=", ">", ">=", "is_null", "not_null")
+
+_EPOCH_DATE = dt.date(1970, 1, 1)
+_EPOCH_UTC = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
+
+_UNSIGNED = {
+    Type.INT32: struct.Struct("<I"),
+    Type.INT64: struct.Struct("<Q"),
+}
+
+_UNSIGNED_CT = (
+    ConvertedType.UINT_8,
+    ConvertedType.UINT_16,
+    ConvertedType.UINT_32,
+    ConvertedType.UINT_64,
+)
+
+
+class FilterError(ValueError):
+    pass
+
+
+def _is_unsigned(leaf) -> bool:
+    lt = leaf.logical_type
+    if lt is not None and lt.INTEGER is not None:
+        return not lt.INTEGER.isSigned
+    return leaf.converted_type in _UNSIGNED_CT
+
+
+def normalize_filters(schema: Schema, filters) -> list:
+    """Validate and resolve [(column, op, value)] against flat leaf columns.
+
+    Each entry carries the value in TWO domains: `row_value` for exact
+    per-row comparison (the ergonomic domain iter_rows yields — datetime,
+    date, Decimal, str) and `stat_value` for statistics pruning (the
+    physical storage domain), or None when this column's statistics cannot
+    be ordered safely (INT96, binary-backed DECIMAL, legacy binary min/max).
+    """
+    out = []
+    for f in filters:
+        if len(f) == 2:
+            name, op = f
+            value = None
+        else:
+            name, op, value = f
+        if op not in _OPS:
+            raise FilterError(f"filter: unknown op {op!r} (use one of {_OPS})")
+        path = tuple(name.split(".")) if isinstance(name, str) else tuple(name)
+        try:
+            leaf = schema.column(path)
+        except Exception as e:
+            raise FilterError(f"filter: unknown column {name!r}") from e
+        if not leaf.is_leaf or leaf.max_rep > 0:
+            raise FilterError(
+                f"filter: {name!r} is not a flat leaf column (repeated/nested "
+                "columns cannot be pruned by chunk statistics)"
+            )
+        if op in ("is_null", "not_null"):
+            if value is not None:
+                raise FilterError(f"filter: {op} takes no value")
+            out.append((path, leaf, op, None, None))
+            continue
+        row_value, stat_value = _coerce_value(leaf, value)
+        out.append((path, leaf, op, row_value, stat_value))
+    return out
+
+
+def _coerce_value(leaf, value):
+    """(row-domain value, physical stat-domain value or None)."""
+    if value is None:
+        raise FilterError("filter: comparison against None (use is_null)")
+    t = leaf.type
+    kind = logical_kind(leaf)
+    if kind is not None:
+        return _coerce_logical(leaf, kind, value)
+    if t in (Type.INT32, Type.INT64):
+        v = int(value)
+        return v, v
+    if t in (Type.FLOAT, Type.DOUBLE):
+        v = float(value)
+        return v, v
+    if t == Type.BOOLEAN:
+        v = bool(value)
+        return v, v
+    b = value.encode("utf-8") if isinstance(value, str) else bytes(value)
+    return b, b
+
+
+def _coerce_logical(leaf, kind, value):
+    """Logically-typed columns: rows yield converted Python objects; stats
+    store the physical encoding. Produce both."""
+    if kind == "int96":
+        if not isinstance(value, dt.datetime):
+            raise FilterError("filter: INT96 column takes a datetime")
+        if value.tzinfo is None:
+            value = value.replace(tzinfo=dt.timezone.utc)
+        return value, None  # INT96 byte stats have no usable ordering
+    if kind == "decimal":
+        v = decimal.Decimal(value)
+        scale = leaf.element.scale or (
+            leaf.logical_type.DECIMAL.scale if leaf.logical_type and leaf.logical_type.DECIMAL else 0
+        )
+        if leaf.type in (Type.INT32, Type.INT64):
+            unscaled = int(v.scaleb(scale or 0).to_integral_value())
+            return v, unscaled
+        return v, None  # binary-backed decimals: sign-magnitude bytes unordered
+    if kind == "date":
+        if isinstance(value, dt.datetime):
+            value = value.date()
+        if not isinstance(value, dt.date):
+            raise FilterError("filter: DATE column takes a date")
+        return value, (value - _EPOCH_DATE).days
+    if kind[0] == "timestamp":
+        _, unit, utc = kind
+        if not isinstance(value, dt.datetime):
+            raise FilterError("filter: TIMESTAMP column takes a datetime")
+        aware = value if value.tzinfo is not None else value.replace(tzinfo=dt.timezone.utc)
+        micros = (aware - _EPOCH_UTC) // dt.timedelta(microseconds=1)
+        phys = _from_micros(micros, unit)
+        row_value = aware if utc else aware.replace(tzinfo=None)
+        return row_value, phys
+    if kind[0] == "time":
+        unit = kind[1]
+        from ..floor.time import Time
+
+        if isinstance(value, Time):
+            nanos = value.nanos
+        elif isinstance(value, dt.time):
+            nanos = (
+                ((value.hour * 60 + value.minute) * 60 + value.second) * 1_000_000_000
+                + value.microsecond * 1000
+            )
+        else:
+            raise FilterError("filter: TIME column takes a time or floor.Time")
+        phys = nanos // {"MILLIS": 1_000_000, "MICROS": 1_000, "NANOS": 1}[unit]
+        if unit == "NANOS":
+            row_value = Time.from_nanos(nanos, utc=kind[2])
+        else:
+            micros = nanos // 1000
+            row_value = dt.time(
+                micros // 3_600_000_000,
+                (micros // 60_000_000) % 60,
+                (micros // 1_000_000) % 60,
+                micros % 1_000_000,
+            )
+        return row_value, phys
+    raise FilterError(f"filter: unsupported logical type on {leaf.path_str}")
+
+
+def _from_micros(micros: int, unit: str) -> int:
+    if unit == "MILLIS":
+        return micros // 1000
+    if unit == "NANOS":
+        return micros * 1000
+    return micros
+
+
+def _decode_stat(leaf, raw: bytes, legacy: bool):
+    """PLAIN-encoded chunk statistic -> comparable physical value."""
+    if raw is None:
+        return None
+    t = leaf.type
+    try:
+        if t in (Type.INT32, Type.INT64) and _is_unsigned(leaf):
+            return _UNSIGNED[t].unpack(raw)[0]
+        fmt = _PACK.get(t)
+        if fmt is not None:
+            return fmt.unpack(raw)[0]
+        if t == Type.BOOLEAN:
+            return bool(raw[0])
+    except (struct.error, IndexError):
+        return None  # malformed stats: never prune on them
+    if legacy:
+        # deprecated min/max used signed-byte comparison for binary in old
+        # writers (parquet-format ORDER caveat): unsafe to prune on
+        return None
+    return bytes(raw)  # byte arrays compare lexicographically (min/max_value)
+
+
+def row_group_may_match(rg, normalized) -> bool:
+    """False only when statistics PROVE no row of the group matches."""
+    chunks = {tuple(c.meta_data.path_in_schema or []): c for c in rg.columns or []}
+    for path, leaf, op, _row_value, value in normalized:
+        cc = chunks.get(path)
+        if cc is None or cc.meta_data is None:
+            continue
+        md = cc.meta_data
+        st = md.statistics
+        if st is None:
+            continue
+        null_count = st.null_count
+        num_values = md.num_values or 0
+        if op == "is_null":
+            if null_count == 0:
+                return False
+            continue
+        if op == "not_null":
+            if null_count is not None and null_count >= num_values:
+                return False
+            continue
+        if value is None:
+            continue  # no orderable physical form for this column's stats
+        legacy = st.min_value is None or st.max_value is None
+        lo = _decode_stat(leaf, st.min_value if not legacy else st.min, legacy)
+        hi = _decode_stat(leaf, st.max_value if not legacy else st.max, legacy)
+        if lo is None or hi is None:
+            continue
+        # NaN bounds make float stats unusable for ordering
+        if isinstance(lo, float) and (lo != lo or hi != hi):
+            continue
+        if op == "==" and (value < lo or value > hi):
+            return False
+        if op == "<" and lo >= value:
+            return False
+        if op == "<=" and lo > value:
+            return False
+        if op == ">" and hi <= value:
+            return False
+        if op == ">=" and hi < value:
+            return False
+        # "!=" can only be pruned when lo == hi == value and nothing is null
+        if op == "!=" and lo == hi == value and not null_count:
+            return False
+    return True
+
+
+def row_matches(row: dict, normalized) -> bool:
+    for path, leaf, op, value, _stat_value in normalized:
+        v = row.get(path[0]) if len(path) == 1 else _nested_get(row, path)
+        if op == "is_null":
+            if v is not None:
+                return False
+            continue
+        if op == "not_null":
+            if v is None:
+                return False
+            continue
+        if v is None:
+            return False
+        if isinstance(v, str) and isinstance(value, bytes):
+            v = v.encode("utf-8")
+        if op == "==" and not v == value:
+            return False
+        if op == "!=" and not v != value:
+            return False
+        if op == "<" and not v < value:
+            return False
+        if op == "<=" and not v <= value:
+            return False
+        if op == ">" and not v > value:
+            return False
+        if op == ">=" and not v >= value:
+            return False
+    return True
+
+
+def _nested_get(row, path):
+    v = row
+    for part in path:
+        if not isinstance(v, dict):
+            return None
+        v = v.get(part)
+        if v is None:
+            return None
+    return v

@@ -342,20 +342,51 @@ class FileReader:
 
     # -- record iteration ------------------------------------------------------
 
-    def iter_rows(self, row_groups=None, raw: bool = False):
+    def prune_row_groups(self, filters) -> list[int]:
+        """Row-group indices whose chunk statistics admit the filters —
+        groups provably excluded by written min/max/null-count never load
+        (statistics-driven pruning; the reference writes stats but never
+        consumes them, README.md:47)."""
+        from .filter import normalize_filters, row_group_may_match
+
+        normalized = normalize_filters(self.schema, filters)
+        return [
+            i
+            for i in range(self.num_row_groups)
+            if row_group_may_match(self.row_group(i), normalized)
+        ]
+
+    def iter_rows(self, row_groups=None, raw: bool = False, filters=None):
         """Yield rows as dicts. `raw=True` gives reference-style nested maps
-        (no LIST/MAP unwrapping, bytes not decoded)."""
+        (no LIST/MAP unwrapping, bytes not decoded). `filters` is a
+        conjunction of (column, op, value) triples: row groups whose
+        statistics exclude the predicate are skipped wholesale and the
+        surviving rows are predicate-checked exactly."""
+        normalized = None
+        if filters is not None:
+            from .filter import normalize_filters, row_group_may_match, row_matches
+
+            normalized = normalize_filters(self.schema, filters)
         indices = range(self.num_row_groups) if row_groups is None else row_groups
         for i in indices:
-            chunks = self.read_row_group(i)
-            with stage("assemble"):
-                rows = fast_rows(self.schema, chunks, raw)
-            if rows is not None:
-                yield from rows
-            else:
-                # Nested fallback streams one row at a time (constant memory);
-                # the timing wrapper keeps the 'assemble' stage accurate.
-                yield from _timed_rows(RecordAssembler(self.schema, chunks, raw=raw))
+            if normalized is not None and not row_group_may_match(
+                self.row_group(i), normalized
+            ):
+                continue
+            for row in self._iter_group_rows(i, raw):
+                if normalized is None or row_matches(row, normalized):
+                    yield row
+
+    def _iter_group_rows(self, i: int, raw: bool):
+        chunks = self.read_row_group(i)
+        with stage("assemble"):
+            rows = fast_rows(self.schema, chunks, raw)
+        if rows is not None:
+            yield from rows
+        else:
+            # Nested fallback streams one row at a time (constant memory);
+            # the timing wrapper keeps the 'assemble' stage accurate.
+            yield from _timed_rows(RecordAssembler(self.schema, chunks, raw=raw))
 
     def iter_row_groups(self, columns=None):
         for i in range(self.num_row_groups):

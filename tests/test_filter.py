@@ -1,0 +1,134 @@
+"""Statistics-driven row-group pruning + exact row filtering.
+
+The reference writes chunk statistics but never consumes them on read
+(reference README.md:47); iter_rows(filters=...) prunes provably-excluded
+row groups from the written min/max/null-count and re-checks surviving rows
+exactly, so results are correct even with absent or coarse statistics.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.filter import FilterError
+from parquet_tpu.core.reader import FileReader
+
+
+@pytest.fixture(scope="module")
+def sorted_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("f") / "sorted.parquet")
+    pq.write_table(
+        pa.table(
+            {
+                "x": pa.array(np.arange(100_000, dtype=np.int64)),
+                "s": pa.array([f"k{i:05d}" for i in range(100_000)]),
+                "n": pa.array([None if i % 2 else float(i) for i in range(100_000)]),
+            }
+        ),
+        path,
+        row_group_size=20_000,
+    )
+    return path
+
+
+class TestPruning:
+    def test_range_prunes_to_matching_groups(self, sorted_file):
+        with FileReader(sorted_file) as r:
+            assert r.prune_row_groups([("x", ">=", 60_000)]) == [3, 4]
+            assert r.prune_row_groups([("x", "<", 20_000)]) == [0]
+            assert r.prune_row_groups([("x", "==", 50_000)]) == [2]
+            assert r.prune_row_groups([("x", ">", 99_999)]) == []
+            # strings prune lexicographically on the raw bytes
+            assert r.prune_row_groups([("s", ">=", "k08000"), ("s", "<", "k08100")]) == [0]
+
+    def test_null_ops(self, sorted_file):
+        with FileReader(sorted_file) as r:
+            assert r.prune_row_groups([("n", "is_null")]) == [0, 1, 2, 3, 4]
+            assert r.prune_row_groups([("x", "is_null")]) == []  # no nulls written
+
+    def test_exact_rows_after_pruning(self, sorted_file):
+        with FileReader(sorted_file) as r:
+            rows = list(r.iter_rows(filters=[("x", ">=", 39_998), ("x", "<", 40_003)]))
+        assert [row["x"] for row in rows] == [39_998, 39_999, 40_000, 40_001, 40_002]
+
+    def test_row_level_filtering_is_exact(self, sorted_file):
+        with FileReader(sorted_file) as r:
+            nn = [row["x"] for row in r.iter_rows(filters=[("n", "not_null"), ("x", "<", 10)])]
+            assert nn == [0, 2, 4, 6, 8]
+            assert sum(1 for _ in r.iter_rows(filters=[("n", "is_null"), ("x", "<", 100)])) == 50
+
+    def test_unknown_column_and_op_rejected(self, sorted_file):
+        with FileReader(sorted_file) as r:
+            with pytest.raises(FilterError):
+                r.prune_row_groups([("nope", "==", 1)])
+            with pytest.raises(FilterError):
+                r.prune_row_groups([("x", "~", 1)])
+
+    def test_missing_statistics_never_prune(self, tmp_path):
+        path = str(tmp_path / "nostats.parquet")
+        pq.write_table(
+            pa.table({"x": pa.array(np.arange(1000, dtype=np.int64))}),
+            path,
+            row_group_size=500,
+            write_statistics=False,
+        )
+        with FileReader(path) as r:
+            assert r.prune_row_groups([("x", "==", 5)]) == [0, 1]  # conservative
+            rows = list(r.iter_rows(filters=[("x", "==", 5)]))
+        assert [row["x"] for row in rows] == [5]  # still exact
+
+
+class TestTypedFilters:
+    def test_unsigned_int_column(self, tmp_path):
+        """uint64 stats decode unsigned; values past 2^63 must not flip the
+        bounds negative and prune matching groups."""
+        path = str(tmp_path / "u.parquet")
+        pq.write_table(pa.table({"u": pa.array([5, 2**63 + 10], pa.uint64())}), path)
+        with FileReader(path) as r:
+            rows = list(r.iter_rows(filters=[("u", "==", 5)]))
+        assert [x["u"] for x in rows] == [5]
+
+    def test_timestamp_column(self, tmp_path):
+        import datetime as dt
+
+        ts = [
+            dt.datetime(2020, 1, 1, tzinfo=dt.timezone.utc) + dt.timedelta(hours=i)
+            for i in range(1000)
+        ]
+        path = str(tmp_path / "ts.parquet")
+        pq.write_table(
+            pa.table({"ts": pa.array(ts, pa.timestamp("us", tz="UTC"))}),
+            path,
+            row_group_size=250,
+        )
+        with FileReader(path) as r:
+            assert r.prune_row_groups([("ts", ">=", ts[800])]) == [3]
+            got = [g["ts"] for g in r.iter_rows(filters=[("ts", ">=", ts[997])])]
+        assert got == ts[997:]
+
+    def test_date_and_decimal_columns(self, tmp_path):
+        import datetime as dt
+        import decimal
+
+        path = str(tmp_path / "dd.parquet")
+        pq.write_table(
+            pa.table(
+                {
+                    "d": pa.array(
+                        [dt.date(2020, 1, 1) + dt.timedelta(days=i) for i in range(100)]
+                    ),
+                    "dec": pa.array(
+                        [decimal.Decimal(i) / 100 for i in range(100)], pa.decimal128(9, 2)
+                    ),
+                }
+            ),
+            path,
+            row_group_size=25,
+        )
+        with FileReader(path) as r:
+            assert r.prune_row_groups([("d", ">=", dt.date(2020, 3, 20))]) == [3]
+            # binary-backed decimal: stats unprunable (conservative) but row
+            # filtering stays exact
+            got = list(r.iter_rows(filters=[("dec", ">=", decimal.Decimal("0.97"))]))
+        assert len(got) == 3
