@@ -1,0 +1,36 @@
+"""Statistics-driven filtered scan: row groups provably outside the
+predicate never load or decode; surviving rows are checked exactly.
+(The reference writes chunk statistics but never consumes them on read.)"""
+
+import sys as _sys
+from pathlib import Path as _Path
+
+_sys.path.insert(0, str(_Path(__file__).resolve().parent.parent))
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import parquet_tpu as ptq
+
+path = "/tmp/example_filtered.parquet"
+pq.write_table(
+    pa.table(
+        {
+            "ts": pa.array(np.arange(1_000_000, dtype=np.int64)),
+            "fare": pa.array(np.random.default_rng(0).uniform(2, 80, 1_000_000)),
+        }
+    ),
+    path,
+    row_group_size=100_000,
+)
+
+with ptq.FileReader(path) as r:
+    keep = r.prune_row_groups([("ts", ">=", 850_000)])
+    print(f"row groups: {r.num_row_groups}, surviving pruning: {keep}")
+    n = 0
+    total = 0.0
+    for row in r.iter_rows(filters=[("ts", ">=", 850_000), ("fare", ">", 75.0)]):
+        n += 1
+        total += row["fare"]
+    print(f"{n} matching rows, mean fare {total / max(n, 1):.2f}")
