@@ -139,6 +139,26 @@ def deliver_device(path):
     return out
 
 
+def deliver_pyarrow(path):
+    """External-implementation baseline: pyarrow (Arrow C++) decodes, then
+    the decoded Arrow buffers upload to the device — the strongest host
+    decoder a JAX user could reach for today, at the same delivery point."""
+    import jax
+    import jax.numpy as jnp
+    import pyarrow.parquet as pq_mod
+
+    t = pq_mod.read_table(path)
+    arrays = []
+    for name in t.column_names:
+        col = t.column(name).combine_chunks()
+        for chunk in col.chunks if hasattr(col, "chunks") else [col]:
+            for buf in chunk.buffers():
+                if buf is not None and buf.size:
+                    arrays.append(jnp.asarray(np.frombuffer(buf, dtype=np.uint8)))
+    jax.block_until_ready(arrays)
+    return arrays
+
+
 def verify_deliveries(path) -> None:
     """Both paths must deliver the same logical columns."""
     from parquet_tpu.core.arrays import ByteArrayData
@@ -283,6 +303,9 @@ def _phase_matrix(cfg: int) -> None:
     t_base = timed(
         lambda: deliver_baseline(path), REPEATS, f"cfg{cfg} baseline", rows=rows
     )
+    t_pa = timed(
+        lambda: deliver_pyarrow(path), REPEATS, f"cfg{cfg} pyarrow", rows=rows
+    )
     t_rows = None
     if cfg == 5:
         # the floor-equivalent read: nested LIST assembly on host over the
@@ -310,7 +333,9 @@ def _phase_matrix(cfg: int) -> None:
         "config": cfg,
         "rows_s_device": round(rows / t_dev, 1),
         "rows_s_baseline": round(rows / t_base, 1),
+        "rows_s_pyarrow": round(rows / t_pa, 1),
         "vs_baseline": round(t_base / t_dev, 3),
+        "vs_pyarrow": round(t_pa / t_dev, 3),
         "encoded_MB_s": round(enc / t_dev / 1e6, 1),
         "decoded_MB_s": round(dec / t_dev / 1e6, 1),
         "byte_equal": bool(equal),
@@ -400,6 +425,7 @@ _PHASE_FNS = {
     "tpu_host": decode_all_tpu_to_host,
     "baseline": deliver_baseline,
     "device": deliver_device,
+    "pyarrow": deliver_pyarrow,
 }
 
 
@@ -481,6 +507,13 @@ def main() -> None:
     if not (r_base and r_dev):
         raise SystemExit("bench: to-HBM phases failed")
     t_base, t_dev = r_base["t"], r_dev["t"]
+    r_pa = _run_phase("pyarrow")
+    if r_pa:
+        log(
+            f"bench: external check: pyarrow decode+upload "
+            f"{ROWS / r_pa['t'] / 1e6:.2f} M rows/s | device/pyarrow ratio "
+            f"{r_pa['t'] / t_dev:.2f}x"
+        )
 
     rate = ROWS / t_dev
     vs = t_base / t_dev
