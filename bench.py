@@ -434,7 +434,7 @@ def _phase_timed(name: str, path) -> None:
     fn(path)  # warmup: compile (disk-cached) + connection establishment
     # the two headline phases take extra samples: the tunnel's run-to-run
     # drift is the dominant noise in the reported ratio
-    reps = max(REPEATS, 5) if name in ("baseline", "device") else REPEATS
+    reps = max(REPEATS, 5) if name in ("baseline", "device", "pyarrow") else REPEATS
     t = timed(lambda: fn(path), reps, name)
     print(json.dumps({"t": t}))
 

@@ -38,6 +38,7 @@ int32 (device_ops.py); batches are split at MAX_DEVICE_BATCH_BITS.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import NamedTuple
 
 import numpy as np
 
@@ -79,6 +80,28 @@ def _bucket(n: int, floor: int = 1024) -> int:
     while b < n:
         b <<= 1
     return b
+
+
+class _FrozenHybrid(NamedTuple):
+    """Upload-ready hybrid batch (built in prepare; dispatched by transfer)."""
+
+    buf: np.ndarray
+    width: int
+    n_pad: int
+    run_pad: int
+    total: int
+
+
+class _FrozenDelta(NamedTuple):
+    """Upload-ready delta batch (built in prepare; dispatched by transfer)."""
+
+    meta32: np.ndarray
+    wide: np.ndarray
+    nbits: int
+    n_pad: int
+    m_pad: int
+    p_pad: int
+    total: int
 
 
 @dataclass
@@ -142,9 +165,12 @@ class _HybridBatch:
         self.packed_bits += len(table.packed) * 8
         self.out_count += take
 
-    def dispatch(self) -> jnp.ndarray:
-        """One device expansion covering every page in this batch."""
-        width = self.width
+    def freeze(self) -> tuple:
+        """Build the packed upload buffer (host-only; runs in the prepare
+        phase so the dispatch thread stays pure transfer/launch I/O).
+
+        ONE packed upload: [is_rle | out_start | rle_value | bit_start |
+        words] — see expand_hybrid_device layout."""
         counts = np.concatenate(self.counts)
         out_start = np.zeros(len(counts), dtype=np.int64)
         np.cumsum(counts[:-1], out=out_start[1:])
@@ -152,8 +178,6 @@ class _HybridBatch:
         assert total == self.out_count
         n_pad = _bucket(max(total, 1))
         run_pad = _bucket(len(counts), 64)
-        # ONE packed upload: [is_rle | out_start | rle_value | bit_start | words]
-        # — see expand_hybrid_device layout.
         packed = b"".join(self.packed)
         words = bytes_to_words32(packed)
         w_pad = _bucket(len(words), 1024)
@@ -169,8 +193,14 @@ class _HybridBatch:
             np.concatenate(self.bit_starts).astype(np.int32).view(np.uint32)
         )
         buf[4 * run_pad : 4 * run_pad + len(words)] = words
-        dev = expand_hybrid_device(jnp.asarray(buf), width, n_pad, run_pad)
-        return dev[:total]
+        return _FrozenHybrid(buf, self.width, n_pad, run_pad, total)
+
+    @staticmethod
+    def dispatch_frozen(frozen: "_FrozenHybrid") -> jnp.ndarray:
+        dev = expand_hybrid_device(
+            jnp.asarray(frozen.buf), frozen.width, frozen.n_pad, frozen.run_pad
+        )
+        return dev[: frozen.total]
 
 
 class _DeltaBatch:
@@ -209,7 +239,9 @@ class _DeltaBatch:
         self.stream_bytes += table.consumed
         self.out_count += table.total
 
-    def dispatch(self) -> jnp.ndarray | None:
+    def freeze(self) -> tuple | None:
+        """Build the packed uploads (host-only; prepare phase — see
+        _HybridBatch.freeze)."""
         if not self.page_starts:
             return None
         nbits = self.nbits
@@ -257,15 +289,19 @@ class _DeltaBatch:
                 wide[:m] = np.concatenate(self.mins).astype(ud)
             wide[m_pad : m_pad + p] = np.array(self.page_firsts, dtype=ud)
             wide[m_pad + p_pad : m_pad + p_pad + len(words)] = words
+        return _FrozenDelta(meta32, wide, nbits, n_pad, m_pad, p_pad, total)
+
+    @staticmethod
+    def dispatch_frozen(frozen: "_FrozenDelta") -> jnp.ndarray:
         dev = delta_packed_decode_device(
-            jnp.asarray(meta32),
-            jnp.asarray(wide),
-            nbits,
-            n_pad,
-            m_pad,
-            p_pad,
+            jnp.asarray(frozen.meta32),
+            jnp.asarray(frozen.wide),
+            frozen.nbits,
+            frozen.n_pad,
+            frozen.m_pad,
+            frozen.p_pad,
         )
-        return dev[:total]
+        return dev[: frozen.total]
 
 
 # -- the chunk plan ------------------------------------------------------------
@@ -310,6 +346,11 @@ class _ChunkPlan:
         # host-side batches awaiting device dispatch (set by prepare phase)
         self.hybrid_batches: list[_HybridBatch] = []
         self.delta_batches: list[_DeltaBatch] = []
+        # frozen upload buffers (built at the END of prepare, host-only, so
+        # the dispatch thread does nothing but transfers + kernel launches)
+        self.frozen_hybrid: list[tuple] = []
+        self.frozen_delta: list[tuple] = []
+        self.plain_host = None
         self.dev_plain: jnp.ndarray | None = None
         self._dispatched = False
 
@@ -323,7 +364,7 @@ class _ChunkPlan:
             return self
         self._dispatched = True
         d = self.dictionary
-        if self.hybrid_batches and isinstance(d, np.ndarray) and d.ndim == 1:
+        if self.frozen_hybrid and isinstance(d, np.ndarray) and d.ndim == 1:
             # Upload the dictionary only when device-decoded indices will
             # gather against it (device_column); host reassembly gathers on
             # host. Floats travel as bit patterns: TPU f64 transfer is not
@@ -334,29 +375,24 @@ class _ChunkPlan:
                 self.dict_dev = jnp.asarray(d.view(u))
             else:
                 self.dict_dev = jnp.asarray(d)
-        # Homogeneous PLAIN numeric chunks are pure uploads; doing them here
-        # (not in device_column) keeps them on the dispatch thread, overlapped
-        # with the next chunk's host prepare.
-        kinds = {k for _, _, _, k, _ in self.page_infos if k != "empty"}
-        if kinds == {"values"} and self.column.type in _NUMERIC_DTYPE:
-            parts = [p for _, _, _, k, p in self.page_infos if k == "values"]
-            host = parts[0] if len(parts) == 1 else np.concatenate(parts)
-            self.dev_plain = _upload_typed(host)
+        # Homogeneous PLAIN numeric chunks are pure uploads (buffer already
+        # concatenated at prepare time).
+        if self.plain_host is not None:
+            self.dev_plain = _upload_typed(self.plain_host)
+            self.plain_host = None
         stats = self.stats
-        for batch in self.hybrid_batches:
-            self.dev_hybrid.append(batch.dispatch())
+        for frozen in self.frozen_hybrid:
+            self.dev_hybrid.append(_HybridBatch.dispatch_frozen(frozen))
             if stats is not None:
-                stats.device_values += batch.out_count
+                stats.device_values += frozen.total
                 stats.device_batches += 1
-        for batch in self.delta_batches:
-            dev = batch.dispatch()
-            if dev is not None:
-                self.dev_delta.append(dev)
-                if stats is not None:
-                    stats.device_values += batch.out_count
-                    stats.device_batches += 1
-        self.hybrid_batches = []
-        self.delta_batches = []
+        for frozen in self.frozen_delta:
+            self.dev_delta.append(_DeltaBatch.dispatch_frozen(frozen))
+            if stats is not None:
+                stats.device_values += frozen.total
+                stats.device_batches += 1
+        self.frozen_hybrid = []
+        self.frozen_delta = []
         return self
 
     # -- fetch + host reassembly (byte-identical to core.chunk.read_chunk) ----
@@ -644,6 +680,12 @@ def _commit_routes(plan: _ChunkPlan, pending: list, stats) -> None:
     kinds = {k for _, _, _, k, _ in plan.page_infos}
     kinds.discard("empty")
     pending_kinds = {p[0] for p in pending}
+    # Homogeneous PLAIN numeric chunks: pre-concatenate the upload buffer
+    # here (host-only) so dispatch is a single transfer.
+    if kinds == {"values"} and not pending and plan.column.type in _NUMERIC_DTYPE:
+        parts = [p for _, _, _, k, p in plan.page_infos if k == "values"]
+        plan.plain_host = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        return
     homogeneous = kinds == pending_kinds and len(pending_kinds) == 1
     if homogeneous:
         hybrid_batches = plan.hybrid_batches
@@ -659,6 +701,12 @@ def _commit_routes(plan: _ChunkPlan, pending: list, stats) -> None:
                 if not delta_batches or not delta_batches[-1].fits(table):
                     delta_batches.append(_DeltaBatch(nbits))
                 delta_batches[-1].add_page(table, buf)
+        plan.frozen_hybrid = [b.freeze() for b in hybrid_batches]
+        plan.frozen_delta = [
+            f for f in (b.freeze() for b in delta_batches) if f is not None
+        ]
+        plan.hybrid_batches = []
+        plan.delta_batches = []
         return
     # Demote: host-decode the would-be device pages in place.
     for kind, idx, table, arg, non_null, buf in pending:
@@ -671,6 +719,14 @@ def _commit_routes(plan: _ChunkPlan, pending: list, stats) -> None:
             plan.page_infos[idx] = (
                 n, dfl, rep, *_host_decode_delta_page(buf, arg, non_null, stats)
             )
+    # a demotion can leave the chunk all-'values' numeric: pre-concat so its
+    # upload still happens on the dispatch thread, not in device_column
+    kinds_after = {k for _, _, _, k, _ in plan.page_infos}
+    kinds_after.discard("empty")
+    if kinds_after == {"values"} and plan.column.type in _NUMERIC_DTYPE:
+        parts = [p for _, _, _, k, p in plan.page_infos if k == "values"]
+        if parts:
+            plan.plain_host = parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
 def _host_decode_dict_page(table, width: int, non_null: int, stats):
