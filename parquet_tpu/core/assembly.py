@@ -274,6 +274,53 @@ def _map_column_values(top: Column, kv: Column, key: Column, value: Column,
     ]
 
 
+def _struct_column_values(top: Column, chunks, raw: bool):
+    """Vectorized assembly of a non-repeated group of scalar leaves.
+
+    Every selected leaf expands to a row-aligned list; the struct itself is
+    None on rows where its definition level shows the group absent (read
+    from any selected leaf's def levels). Returns None when the shape
+    doesn't fit (repeated/nested children)."""
+    if top.max_rep != 0:
+        return None
+    leaves = []
+    for child in top.children:
+        if not child.is_leaf or child.max_rep != 0:
+            return None
+        if child.path in chunks:
+            leaves.append(child)
+    if not leaves:
+        return None
+    first = chunks[leaves[0].path]
+    if first.def_levels is None and top.max_def > 0:
+        return None
+    n = first.num_values
+    cols = []
+    for leaf in leaves:
+        chunk = chunks[leaf.path]
+        if chunk.num_values != n:
+            return None
+        vals = _leaf_python_values(leaf, chunk, raw)
+        if leaf.max_def > 0 and chunk.def_levels is not None:
+            present = chunk.def_levels == leaf.max_def
+            if int(present.sum()) != len(vals):
+                raise AssemblyError(
+                    f"assembly: {leaf.path_str}: {len(vals)} values for "
+                    f"{int(present.sum())} present entries"
+                )
+            full = np.empty(n, dtype=object)
+            full[present] = vals
+            vals = full.tolist()
+        cols.append((leaf.name, vals))
+    names = [name for name, _ in cols]
+    rows = [dict(zip(names, row)) for row in zip(*(v for _, v in cols))]
+    if top.max_def > 0:
+        # struct is null where the def level sits below its own max_def
+        null_mask = (first.def_levels < top.max_def).tolist()
+        rows = [None if is_null else r for is_null, r in zip(null_mask, rows)]
+    return rows
+
+
 def fast_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
     """Vectorized assembly for flat schemas plus canonical LIST-of-scalars
     and MAP-of-scalars columns (the overwhelmingly common nested shapes).
@@ -303,12 +350,15 @@ def fast_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
                 vals = _list_column_values(top, mid, leaf, chunks[paths[0]], raw)
             else:
                 mn = _canonical_map_nodes(top, chunks)
-                if mn is None or len(paths) != 2:
+                if mn is not None and len(paths) == 2:
+                    kv, key, value = mn
+                    vals = _map_column_values(
+                        top, kv, key, value, chunks[key.path], chunks[value.path], raw
+                    )
+                elif not top.is_leaf:
+                    vals = _struct_column_values(top, chunks, raw)
+                else:
                     return None
-                kv, key, value = mn
-                vals = _map_column_values(
-                    top, kv, key, value, chunks[key.path], chunks[value.path], raw
-                )
             if vals is None:
                 return None
             columns.append((top.name, vals))

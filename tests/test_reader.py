@@ -341,17 +341,34 @@ class TestFastNestedAssembly:
         assert fast is not None and fast == slow
         assert fast[2]["m"] == {"a": 1, "b": None}
 
-    def test_struct_falls_back(self, tmp_path):
+    def test_struct_of_scalars_vectorized(self, tmp_path):
+        recs = [
+            None if i % 7 == 0 else {"a": i, "b": None if i % 3 == 0 else f"s{i%11}"}
+            for i in range(5000)
+        ]
+        t = pa.table(
+            {"r": pa.array(recs, pa.struct([("a", pa.int64()), ("b", pa.string())]))}
+        )
+        fast, slow = self._roundtrip_both(t, tmp_path)
+        assert fast is not None and fast == slow
+        assert fast[0]["r"] is None and fast[1]["r"] == {"a": 1, "b": "s1"}
+
+    def test_deep_nesting_falls_back(self, tmp_path):
         from parquet_tpu.core.assembly import fast_rows
 
         t = pa.table(
-            {"r": pa.array([{"a": 1, "b": "x"}] * 10, pa.struct([("a", pa.int64()), ("b", pa.string())]))}
+            {
+                "r": pa.array(
+                    [{"xs": [1, 2]}] * 10,
+                    pa.struct([("xs", pa.list_(pa.int64()))]),
+                )
+            }
         )
         import pyarrow.parquet as pq
 
-        path = str(tmp_path / "s.parquet")
+        path = str(tmp_path / "deep.parquet")
         pq.write_table(t, path)
         with FileReader(path) as r:
             assert fast_rows(r.schema, r.read_row_group(0), False) is None
             rows = list(r.iter_rows())  # assembler fallback still works
-        assert rows[0]["r"] == {"a": 1, "b": "x"}
+        assert rows[0]["r"] == {"xs": [1, 2]}
