@@ -90,13 +90,9 @@ class ColumnChunkBuilder:
             return self._coerce_array(self._columnar_values)
         ptype = self.column.type
         if ptype in _NUMERIC:
-            try:
-                return np.asarray(self.values, dtype=_NUMERIC[ptype])
-            except (ValueError, OverflowError) as e:
-                raise StoreError(
-                    f"store: bad value for {ptype.name} column "
-                    f"{self.column.path_str}: {e}"
-                ) from e
+            # same exact-roundtrip validation as the columnar path: a float
+            # 1.5 must not silently truncate into an int64 column
+            return self._coerce_array(self.values)
         if ptype == Type.BOOLEAN:
             return np.asarray(self.values, dtype=bool)
         if ptype == Type.BYTE_ARRAY:
@@ -132,11 +128,28 @@ class ColumnChunkBuilder:
     def _coerce_array(self, v):
         ptype = self.column.type
         if ptype in _NUMERIC:
-            arr = np.asarray(v)
+            try:
+                arr = np.asarray(v)
+            except (ValueError, OverflowError, TypeError) as e:
+                raise StoreError(
+                    f"store: bad value for {ptype.name} column "
+                    f"{self.column.path_str}: {e}"
+                ) from e
+            if arr.ndim != 1 or arr.dtype.kind not in "iufb":
+                raise StoreError(
+                    f"store: {ptype.name} column {self.column.path_str} takes "
+                    f"a flat numeric array, got ndim={arr.ndim} dtype={arr.dtype}"
+                )
             want = _NUMERIC[ptype]
             if arr.dtype != want:
                 with np.errstate(invalid="ignore"):
-                    cast = arr.astype(want)
+                    try:
+                        cast = arr.astype(want)
+                    except (ValueError, OverflowError, TypeError) as e:
+                        raise StoreError(
+                            f"store: bad value for {ptype.name} column "
+                            f"{self.column.path_str}: {e}"
+                        ) from e
                 # Any implicit cast must round-trip exactly (catches integer
                 # overflow, fractional floats into int columns, NaN into ints,
                 # and lossy f64 -> f32).

@@ -581,3 +581,32 @@ class TestInt96Write:
         assert rows[0]["ts"] == ts and rows[1]["ts"] is None
         got = pq.read_table(path).to_pylist()
         assert got[0]["ts"].to_pydatetime().replace(tzinfo=dt.timezone.utc) == ts
+
+
+class TestWriterInputValidation:
+    """Adversarial user values must raise clean StoreError/ShredError —
+    never silently truncate (1.5 into an int64 column) or leak TypeError."""
+
+    @pytest.mark.parametrize(
+        "bad",
+        [{"a": "not-int"}, {"a": 1.5}, {"a": 2**70}, {"a": [1]}, {"a": {"x": 1}},
+         {"a": float("nan")}],
+        ids=["str", "fractional", "overflow", "list", "dict", "nan"],
+    )
+    def test_bad_int64_values_rejected(self, tmp_path, bad):
+        from parquet_tpu.schema.dsl import parse_schema
+
+        sch = parse_schema("message m { required int64 a; }")
+        w = FileWriter(str(tmp_path / "bad.parquet"), sch)
+        with pytest.raises(ValueError):
+            w.write_rows([bad])
+            w.close()
+
+    def test_exact_valued_floats_and_bools_accepted(self, tmp_path):
+        from parquet_tpu.schema.dsl import parse_schema
+
+        sch = parse_schema("message m { required int64 a; }")
+        path = str(tmp_path / "ok.parquet")
+        with FileWriter(path, sch) as w:
+            w.write_rows([{"a": 7}, {"a": True}, {"a": 2.0}])
+        assert pq.read_table(path).column("a").to_pylist() == [7, 1, 2]
