@@ -386,6 +386,13 @@ def logical_kind(node: Column):
     lt = node.logical_type
     if node.type == Type.INT96:
         return "int96"
+    if lt is not None and lt.INTEGER is not None and not lt.INTEGER.isSigned:
+        if node.type == Type.INT32:
+            return ("uint", 32)
+        if node.type == Type.INT64:
+            return ("uint", 64)
+    if ct in (ConvertedType.UINT_32, ConvertedType.UINT_64):
+        return ("uint", 32 if node.type == Type.INT32 else 64)
     if ct == ConvertedType.DECIMAL or (lt is not None and lt.DECIMAL is not None):
         return "decimal"
     if ct == ConvertedType.DATE or (lt is not None and lt.DATE is not None):
@@ -645,6 +652,10 @@ def convert_logical(node: Column, v):
         v = v.item()
     if kind is None:
         return v
+    if kind[0] == "uint":
+        # UINT(32/64) logical annotation on a signed physical type: the bit
+        # pattern reinterprets unsigned (pyarrow to_pylist parity)
+        return int(v) & ((1 << kind[1]) - 1)
     if kind == "decimal":
         lt = node.logical_type
         scale = node.element.scale
@@ -660,6 +671,10 @@ def convert_logical(node: Column, v):
         return dt.date(1970, 1, 1) + dt.timedelta(days=int(v))
     if kind[0] == "timestamp":
         _, unit, utc = kind
+        if unit == "NANOS":
+            # datetime caps at microseconds; numpy datetime64[ns] carries the
+            # full precision (the reference's time.Time is nanosecond-native)
+            return np.datetime64(int(v), "ns")
         tz = dt.timezone.utc if utc else None
         return dt.datetime(1970, 1, 1, tzinfo=tz) + dt.timedelta(
             microseconds=_to_micros(int(v), unit)

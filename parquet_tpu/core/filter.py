@@ -121,6 +121,11 @@ def _coerce_value(leaf, value):
 def _coerce_logical(leaf, kind, value):
     """Logically-typed columns: rows yield converted Python objects; stats
     store the physical encoding. Produce both."""
+    if kind[0] == "uint":
+        v = int(value)
+        if v < 0:
+            raise FilterError("filter: unsigned column takes a non-negative int")
+        return v, v
     if kind == "int96":
         if not isinstance(value, dt.datetime):
             raise FilterError("filter: INT96 column takes a datetime")
@@ -149,7 +154,12 @@ def _coerce_logical(leaf, kind, value):
         aware = value if value.tzinfo is not None else value.replace(tzinfo=dt.timezone.utc)
         micros = (aware - _EPOCH_UTC) // dt.timedelta(microseconds=1)
         phys = _from_micros(micros, unit)
-        row_value = aware if utc else aware.replace(tzinfo=None)
+        if unit == "NANOS":
+            import numpy as np
+
+            row_value = np.datetime64(micros * 1000, "ns")  # rows yield datetime64[ns]
+        else:
+            row_value = aware if utc else aware.replace(tzinfo=None)
         return row_value, phys
     if kind[0] == "time":
         unit = kind[1]

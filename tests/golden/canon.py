@@ -23,6 +23,16 @@ def _pair_key(pair):
     return json.dumps(pair, sort_keys=True, default=str)
 
 
+def _trim_fraction(iso: str) -> str:
+    """Normalize trailing zeros in fractional seconds: pandas trims to the
+    shortest form while numpy datetime64 always prints nine digits."""
+    if "." not in iso:
+        return iso
+    head, frac = iso.rsplit(".", 1)
+    frac = frac.rstrip("0")
+    return head if not frac else f"{head}.{frac}"
+
+
 def canon(v):
     if v is None or isinstance(v, (bool, str)):
         return v
@@ -37,11 +47,19 @@ def canon(v):
         # tz-aware and naive-UTC represent the same instant across readers
         if v.tzinfo is not None:
             v = v.astimezone(dt.timezone.utc).replace(tzinfo=None)
-        return {"dt": v.isoformat()}
+        return {"dt": _trim_fraction(v.isoformat())}
+    if type(v).__name__ == "datetime64":  # numpy ns-precision timestamps
+        return {"dt": _trim_fraction(str(v))}
     if isinstance(v, dt.date):
         return {"d": v.isoformat()}
     if isinstance(v, dt.time):
-        return {"t": v.isoformat()}
+        return {"t": v.replace(tzinfo=None).isoformat()}
+    # floor.Time (nanosecond TIME): compare at microsecond fidelity — the
+    # most pyarrow's to_pylist retains (full-precision behavior is covered
+    # by unit tests)
+    to_time = getattr(v, "to_time", None)
+    if to_time is not None and hasattr(v, "nanos"):
+        return {"t": to_time().replace(tzinfo=None).isoformat()}
     if isinstance(v, decimal.Decimal):
         return {"dec": str(v)}
     if isinstance(v, dict):

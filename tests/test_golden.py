@@ -63,3 +63,20 @@ def test_golden_rewrite_readable_by_pyarrow(name, tmp_path):
         w.write_rows(rows)
     back = pq.read_table(out).to_pylist()
     assert canon_rows(back) == _expected(name)
+
+
+def test_golden_kv_metadata_exposed():
+    with FileReader(GOLDEN / "data" / "kv_metadata_and_empty_tail.parquet") as r:
+        kv = r.key_value_metadata
+    assert kv.get("origin") == "golden-corpus" and kv.get("answer") == "42"
+
+
+def test_golden_nanotime_precision():
+    """The ns-time fixture's odd nanosecond values survive exactly through
+    floor.Time (datetime.time would truncate them)."""
+    from parquet_tpu.floor import Time
+
+    with FileReader(GOLDEN / "data" / "time_units.parquet") as r:
+        (first, *_) = list(r.iter_rows())
+    t = first["t_ns"]
+    assert isinstance(t, Time) and t.nanos % 2 == 1  # generator forced odd
