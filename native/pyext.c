@@ -1,0 +1,161 @@
+/* CPython extension for write-path hot loops that ctypes cannot reach
+ * (they take Python object sequences, so a ctypes boundary would pay the
+ * per-item conversion it exists to avoid).
+ *
+ * Built by native/Makefile into parquet_tpu/_native_ext.so; every caller
+ * degrades to the pure-Python implementation when the module is absent.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+/* encode_items(seq) -> (flat_bytes, lengths_int64_le_bytes)
+ *
+ * One C pass over a sequence of str/bytes: str encodes UTF-8, bytes copies
+ * verbatim. Raises TypeError on any other item type (callers fall back to
+ * the general Python path).
+ */
+static PyObject *encode_items(PyObject *self, PyObject *arg) {
+  PyObject *fast = PySequence_Fast(arg, "encode_items expects a sequence");
+  if (fast == NULL) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject **items = PySequence_Fast_ITEMS(fast);
+
+  PyObject *lengths = PyBytes_FromStringAndSize(NULL, n * 8);
+  if (lengths == NULL) {
+    Py_DECREF(fast);
+    return NULL;
+  }
+  int64_t *lens = (int64_t *)PyBytes_AS_STRING(lengths);
+
+  /* pass 1: sizes (PyUnicode_AsUTF8AndSize caches the UTF-8 form on the
+   * unicode object, so pass 2 reuses it without re-encoding) */
+  int64_t total = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *it = items[i];
+    Py_ssize_t len;
+    if (PyUnicode_Check(it)) {
+      if (PyUnicode_AsUTF8AndSize(it, &len) == NULL) goto fail;
+    } else if (PyBytes_Check(it)) {
+      len = PyBytes_GET_SIZE(it);
+    } else {
+      PyErr_Format(PyExc_TypeError,
+                   "encode_items: item %zd is %.80s, expected str or bytes", i,
+                   Py_TYPE(it)->tp_name);
+      goto fail;
+    }
+    lens[i] = (int64_t)len;
+    total += (int64_t)len;
+  }
+
+  PyObject *flat = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)total);
+  if (flat == NULL) goto fail;
+  char *dst = PyBytes_AS_STRING(flat);
+
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *it = items[i];
+    const char *src;
+    Py_ssize_t len;
+    if (PyUnicode_Check(it)) {
+      src = PyUnicode_AsUTF8AndSize(it, &len);
+      if (src == NULL) {
+        Py_DECREF(flat);
+        goto fail;
+      }
+    } else {
+      src = PyBytes_AS_STRING(it);
+      len = PyBytes_GET_SIZE(it);
+    }
+    memcpy(dst, src, (size_t)len);
+    dst += len;
+  }
+
+  Py_DECREF(fast);
+  PyObject *out = PyTuple_Pack(2, flat, lengths);
+  Py_DECREF(flat);
+  Py_DECREF(lengths);
+  return out;
+
+fail:
+  Py_DECREF(lengths);
+  Py_DECREF(fast);
+  return NULL;
+}
+
+/* dict_indices(list_of_bytes, max_uniques) -> (uniques_list, indices_u32_bytes)
+ * or None when the unique count exceeds max_uniques.
+ *
+ * The write-side dictionary decision over byte values: one C pass with a
+ * Python dict as the hash table (C-API calls, no interpreter dispatch).
+ */
+static PyObject *dict_indices(PyObject *self, PyObject *args) {
+  PyObject *seq;
+  Py_ssize_t max_uniques;
+  if (!PyArg_ParseTuple(args, "On", &seq, &max_uniques)) return NULL;
+  PyObject *fast = PySequence_Fast(seq, "dict_indices expects a sequence");
+  if (fast == NULL) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject **items = PySequence_Fast_ITEMS(fast);
+
+  PyObject *indices = PyBytes_FromStringAndSize(NULL, n * 4);
+  PyObject *table = PyDict_New();
+  PyObject *uniques = PyList_New(0);
+  if (indices == NULL || table == NULL || uniques == NULL) goto fail;
+  uint32_t *idx = (uint32_t *)PyBytes_AS_STRING(indices);
+
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *it = items[i];
+    PyObject *found = PyDict_GetItemWithError(table, it); /* borrowed */
+    if (found != NULL) {
+      idx[i] = (uint32_t)PyLong_AsUnsignedLong(found);
+      continue;
+    }
+    if (PyErr_Occurred()) goto fail; /* unhashable */
+    Py_ssize_t next = PyList_GET_SIZE(uniques);
+    if (next > max_uniques) {
+      /* too many uniques: dictionary encoding does not pay */
+      Py_DECREF(indices);
+      Py_DECREF(table);
+      Py_DECREF(uniques);
+      Py_DECREF(fast);
+      Py_RETURN_NONE;
+    }
+    PyObject *num = PyLong_FromSsize_t(next);
+    if (num == NULL || PyDict_SetItem(table, it, num) < 0) {
+      Py_XDECREF(num);
+      goto fail;
+    }
+    Py_DECREF(num);
+    if (PyList_Append(uniques, it) < 0) goto fail;
+    idx[i] = (uint32_t)next;
+  }
+
+  Py_DECREF(table);
+  Py_DECREF(fast);
+  PyObject *out = PyTuple_Pack(2, uniques, indices);
+  Py_DECREF(uniques);
+  Py_DECREF(indices);
+  return out;
+
+fail:
+  Py_XDECREF(indices);
+  Py_XDECREF(table);
+  Py_XDECREF(uniques);
+  Py_DECREF(fast);
+  return NULL;
+}
+
+static PyMethodDef methods[] = {
+    {"encode_items", encode_items, METH_O,
+     "encode_items(seq) -> (flat_bytes, int64le_lengths_bytes)"},
+    {"dict_indices", dict_indices, METH_VARARGS,
+     "dict_indices(seq, max_uniques) -> (uniques, u32le_indices_bytes) | None"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native_ext",
+                                       NULL, -1, methods};
+
+PyMODINIT_FUNC PyInit__native_ext(void) { return PyModule_Create(&moduledef); }

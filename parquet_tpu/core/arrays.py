@@ -16,7 +16,12 @@ from dataclasses import dataclass
 
 import numpy as np
 
-__all__ = ["ByteArrayData"]
+__all__ = ["ByteArrayData", "byte_array_from_items"]
+
+try:  # CPython extension (native/pyext.c); every caller degrades without it
+    from .. import _native_ext as _ext
+except ImportError:  # pragma: no cover
+    _ext = None
 
 
 @dataclass
@@ -56,6 +61,7 @@ class ByteArrayData:
         offsets = np.zeros(len(items) + 1, dtype=np.int64)
         np.cumsum(lengths, out=offsets[1:])
         return cls(offsets=offsets, data=b"".join(items))
+
 
     def take(self, indices: np.ndarray) -> "ByteArrayData":
         """Gather rows by index (dictionary expansion), fully vectorized.
@@ -97,3 +103,33 @@ class ByteArrayData:
         return (
             np.array_equal(self.offsets, other.offsets) and self.data == other.data
         )
+
+
+def byte_array_from_items(items, to_bytes=None) -> ByteArrayData:
+    """Sequence of str/bytes (or anything `to_bytes` can convert) -> column.
+
+    The common all-str/bytes case runs as one C pass (native/_native_ext);
+    exotic item types fall back to per-item conversion."""
+    if _ext is not None:
+        try:
+            flat, lens_b = _ext.encode_items(items)
+        except TypeError:
+            pass
+        else:
+            lengths = np.frombuffer(lens_b, dtype="<i8")
+            offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
+            np.cumsum(lengths, out=offsets[1:])
+            return ByteArrayData(offsets=offsets, data=flat)
+    if to_bytes is None:
+        to_bytes = _default_to_bytes
+    return ByteArrayData.from_list([to_bytes(x) for x in items])
+
+
+def _default_to_bytes(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode("utf-8")
+    if isinstance(v, (bytearray, memoryview)):
+        return bytes(v)
+    raise TypeError(f"cannot convert {type(v).__name__} to bytes")

@@ -18,7 +18,7 @@ import datetime as _dt
 import numpy as np
 
 from ..meta.parquet_types import Type
-from .arrays import ByteArrayData
+from .arrays import ByteArrayData, byte_array_from_items, _ext
 from .schema import Column
 
 __all__ = ["ColumnChunkBuilder", "StoreError", "MAX_PAGE_SIZE_DEFAULT", "DICT_MAX_UNIQUES"]
@@ -96,9 +96,7 @@ class ColumnChunkBuilder:
         if ptype == Type.BOOLEAN:
             return np.asarray(self.values, dtype=bool)
         if ptype == Type.BYTE_ARRAY:
-            return ByteArrayData.from_list(
-                [self._to_bytes(v) for v in self.values]
-            )
+            return byte_array_from_items(self.values, to_bytes=self._to_bytes)
         if ptype in (Type.INT96, Type.FIXED_LEN_BYTE_ARRAY):
             width = 12 if ptype == Type.INT96 else (self.column.type_length or 0)
             if width <= 0:
@@ -169,16 +167,7 @@ class ColumnChunkBuilder:
                 # to_list(cache=True) memo then lives on the writer's copy,
                 # never pinning a caller-owned array
                 return ByteArrayData(offsets=v.offsets, data=v.data)
-            # inline the common str/bytes cases: _to_bytes per item costs a
-            # call + isinstance chain on the hot columnar write path
-            return ByteArrayData.from_list(
-                [
-                    x
-                    if type(x) is bytes
-                    else (x.encode("utf-8") if type(x) is str else self._to_bytes(x))
-                    for x in v
-                ]
-            )
+            return byte_array_from_items(v, to_bytes=self._to_bytes)
         arr = np.asarray(v, dtype=np.uint8)
         if arr.ndim != 2:
             raise StoreError("store: fixed-width columnar input must be (n, width)")
@@ -205,24 +194,32 @@ class ColumnChunkBuilder:
         if n == 0:
             return None
         if isinstance(typed, ByteArrayData):
-            uniq: dict[bytes, int] = {}
-            indices = np.empty(n, dtype=np.uint32)
-            uniq_get = uniq.get
-            # one bulk slice pass (to_list) beats re-slicing per value, and
-            # the dict probe loop beats np.unique on object arrays (measured
-            # ~4x) because hashing short bytes is cheaper than C comparisons
-            # in a mergesort
-            for i, key in enumerate(typed.to_list(cache=True)):
-                idx = uniq_get(key)
-                if idx is None:
-                    idx = len(uniq)
-                    if idx > DICT_MAX_UNIQUES:
-                        return None
-                    uniq[key] = idx
-                indices[i] = idx
-            dict_values = ByteArrayData.from_list(list(uniq.keys()))
+            if _ext is not None:
+                res = _ext.dict_indices(typed.to_list(cache=True), DICT_MAX_UNIQUES)
+                if res is None:
+                    return None  # more uniques than the cutoff: dict never pays
+                uniques, idx_b = res
+                indices = np.frombuffer(idx_b, dtype="<u4")
+            else:
+                # one bulk slice pass (to_list) beats re-slicing per value,
+                # and the dict probe loop beats np.unique on object arrays
+                # (measured ~4x): hashing short bytes is cheaper than C
+                # comparisons in a mergesort
+                uniq: dict[bytes, int] = {}
+                indices = np.empty(n, dtype=np.uint32)
+                uniq_get = uniq.get
+                for i, key in enumerate(typed.to_list(cache=True)):
+                    idx = uniq_get(key)
+                    if idx is None:
+                        idx = len(uniq)
+                        if idx > DICT_MAX_UNIQUES:
+                            return None
+                        uniq[key] = idx
+                    indices[i] = idx
+                uniques = list(uniq.keys())
+            dict_values = ByteArrayData.from_list(uniques)
             plain_size = len(typed.data) + 4 * n
-            dict_size = len(dict_values.data) + 4 * len(uniq) + n * 4
+            dict_size = len(dict_values.data) + 4 * len(uniques) + n * 4
         elif isinstance(typed, np.ndarray) and typed.ndim == 1 and ptype != Type.BOOLEAN:
             # Bit-pattern uniqueness so NaN payloads dedup correctly
             # (reference CHANGELOG.md:31 NaN-in-dict fix).

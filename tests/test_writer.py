@@ -610,3 +610,41 @@ class TestWriterInputValidation:
         with FileWriter(path, sch) as w:
             w.write_rows([{"a": 7}, {"a": True}, {"a": 2.0}])
         assert pq.read_table(path).column("a").to_pylist() == [7, 1, 2]
+
+
+class TestNativeExtension:
+    """CPython extension hot loops (native/pyext.c) must agree with the
+    pure-Python fallbacks exactly."""
+
+    def test_encode_items_parity(self):
+        import numpy as np
+
+        from parquet_tpu.core.arrays import ByteArrayData, byte_array_from_items
+
+        items = ["héllo", "", "x" * 300, "日本語", "plain"] * 50 + [b"\x00raw\xff"]
+        got = byte_array_from_items(items)
+        want = ByteArrayData.from_list(
+            [x if isinstance(x, bytes) else x.encode("utf-8") for x in items]
+        )
+        assert np.array_equal(got.offsets, want.offsets) and got.data == want.data
+
+    def test_encode_items_exotic_fallback(self):
+        from parquet_tpu.core.arrays import byte_array_from_items
+
+        got = byte_array_from_items([memoryview(b"ab"), bytearray(b"cd")])
+        assert got.data == b"abcd"
+
+    def test_dict_indices_parity(self):
+        pytest.importorskip("parquet_tpu._native_ext")
+        import numpy as np
+
+        from parquet_tpu import _native_ext as ext
+
+        vals = [f"k{i % 37}".encode() for i in range(10_000)]
+        uniques, idx_b = ext.dict_indices(vals, 32767)
+        idx = np.frombuffer(idx_b, dtype="<u4")
+        assert len(uniques) == 37
+        assert all(uniques[idx[i]] == vals[i] for i in range(0, 10_000, 997))
+        # cutoff: exceeding max_uniques returns None
+        many = [str(i).encode() for i in range(100)]
+        assert ext.dict_indices(many, 50) is None
