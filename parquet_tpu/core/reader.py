@@ -243,6 +243,100 @@ class FileReader:
         Returns [(path, future-of-dispatched-plan)] without resolving."""
         return self._plan_row_groups_async([i], columns)[0]
 
+    def iter_device_batches(
+        self, batch_size: int, columns=None, drop_remainder: bool = True
+    ):
+        """Stream the file as fixed-size device-resident batches.
+
+        The TPU-native consumption pattern: each yielded batch is
+        {leaf path: jax.Array} with exactly `batch_size` rows (static shape —
+        a jitted train step compiles once), values already decoded in HBM.
+        Dictionary-encoded byte-array columns yield their int32 indices
+        (embedding-lookup style). Unsupported shapes raise: raw byte-array
+        columns (no device form), nullable columns (non-null cells would
+        shift rows between columns), repeated/LIST columns (leaf slots are
+        not rows) — project them out with `columns=` or transform upstream.
+
+        While the consumer runs on group i's batches, group i+1 is already
+        preparing and dispatching (one-group lookahead); memory stays
+        bounded by two row groups plus the carry. With drop_remainder=False
+        the final short batch is yielded as-is (dynamic shape: callers pad
+        or accept a recompile).
+        """
+        if batch_size <= 0:
+            raise ValueError("batch_size must be positive")
+        return self._iter_device_batches(batch_size, columns, drop_remainder)
+
+    def _iter_device_batches(self, batch_size: int, columns, drop_remainder: bool):
+        import jax.numpy as jnp
+
+        def _array_of(path, dc):
+            arr = dc.values if dc.values is not None else dc.indices
+            if arr is None:
+                raise ParquetFileError(
+                    f"parquet: column {'.'.join(path)} has no device array form "
+                    "(raw byte-array columns cannot batch; project them out)"
+                )
+            if dc.rep_levels is not None:
+                raise ParquetFileError(
+                    f"parquet: column {'.'.join(path)} is repeated; its leaf "
+                    "slots are not rows, so it cannot batch (project it out)"
+                )
+            if arr.shape[0] != dc.num_values:
+                raise ParquetFileError(
+                    f"parquet: column {'.'.join(path)} contains nulls; "
+                    "device batches need null-free columns (filter or fill "
+                    "upstream, or project the column out)"
+                )
+            return arr
+
+        groups = list(range(self.num_row_groups))
+        # a memory ceiling forbids the lookahead's two-groups residency
+        lookahead = self.alloc is None
+
+        def stage(i):
+            if lookahead:
+                return self._plan_row_group_async(i, columns)
+            return None
+
+        staged_next = stage(groups[0]) if groups and lookahead else None
+        carry: dict = {}
+        carry_n = 0
+        for gi, i in enumerate(groups):
+            if lookahead:
+                staged = staged_next
+                staged_next = (
+                    stage(groups[gi + 1]) if gi + 1 < len(groups) else None
+                )
+                group = {path: fut.result().device_column() for path, fut in staged}
+            else:
+                group = self.read_row_group_device(i, columns=columns)
+            arrs = {path: _array_of(path, dc) for path, dc in group.items()}
+            if not arrs:
+                continue
+            lengths = {a.shape[0] for a in arrs.values()}
+            if len(lengths) != 1:
+                raise ParquetFileError(
+                    f"parquet: columns disagree on row count in group {i}: "
+                    f"{sorted(lengths)}"
+                )
+            n = lengths.pop()
+            if carry_n:
+                cat = {p: jnp.concatenate([carry[p], a]) for p, a in arrs.items()}
+            else:
+                cat = arrs
+            total = carry_n + n
+            # cursor slicing: each batch is one static-shape slice; the tail
+            # is sliced once per row group, not once per batch
+            off = 0
+            while total - off >= batch_size:
+                yield {p: a[off : off + batch_size] for p, a in cat.items()}
+                off += batch_size
+            carry_n = total - off
+            carry = {p: a[off:] for p, a in cat.items()} if carry_n else {}
+        if carry_n and not drop_remainder:
+            yield carry
+
     def _plan_row_groups_async(self, indices, columns=None):
         """Stage chunks of several row groups at once.
 

@@ -388,3 +388,108 @@ class TestDecodeToDevice:
         # usable directly by jitted compute without a host trip
         total = jax.jit(lambda a: a.sum())(dc.values)
         assert int(total) == int(np.arange(1000).sum())
+
+
+class TestDeviceBatches:
+    """iter_device_batches: the file as fixed-size HBM-resident batches."""
+
+    def _file(self, tmp_path, n=10_000, rg=3_000):
+        t = pa.table({
+            "x": pa.array(np.arange(n, dtype=np.int64)),
+            "v": pa.array([f"k{i%7}" for i in range(n)]),
+        })
+        path = str(tmp_path / "b.parquet")
+        pq.write_table(t, path, row_group_size=rg, use_dictionary=["v"])
+        return path
+
+    def test_static_shapes_and_values(self, tmp_path):
+        import jax
+
+        path = self._file(tmp_path)
+        with FileReader(path) as r:
+            batches = list(r.iter_device_batches(1024))
+        assert len(batches) == 10_000 // 1024
+        seen = []
+        for b in batches:
+            assert isinstance(b[("x",)], jax.Array)
+            assert b[("x",)].shape == (1024,) and b[("v",)].shape == (1024,)
+            seen.append(np.asarray(b[("x",)]))
+        flat = np.concatenate(seen)
+        assert np.array_equal(flat, np.arange(len(flat)))  # order preserved
+
+    def test_remainder_modes(self, tmp_path):
+        path = self._file(tmp_path, n=2_500, rg=1_000)
+        with FileReader(path) as r:
+            dropped = list(r.iter_device_batches(1_000))
+            assert [b[("x",)].shape[0] for b in dropped] == [1_000, 1_000]
+        with FileReader(path) as r:
+            kept = list(r.iter_device_batches(1_000, drop_remainder=False))
+            assert [b[("x",)].shape[0] for b in kept] == [1_000, 1_000, 500]
+            assert int(np.asarray(kept[-1][("x",)])[-1]) == 2_499
+
+    def test_batch_spans_row_groups(self, tmp_path):
+        path = self._file(tmp_path, n=5_000, rg=700)  # batches cross rg edges
+        with FileReader(path) as r:
+            batches = list(r.iter_device_batches(1_999, drop_remainder=False))
+        flat = np.concatenate([np.asarray(b[("x",)]) for b in batches])
+        assert np.array_equal(flat, np.arange(5_000))
+
+    def test_raw_byte_array_rejected_and_projectable(self, tmp_path):
+        t = pa.table({
+            "x": pa.array(np.arange(1000, dtype=np.int64)),
+            "s": pa.array([f"unique-{i}" for i in range(1000)]),  # no dict win
+        })
+        path = str(tmp_path / "raw.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        with FileReader(path) as r:
+            with pytest.raises(ValueError):
+                list(r.iter_device_batches(100))
+        with FileReader(path) as r:
+            batches = list(r.iter_device_batches(100, columns=["x"]))
+        assert len(batches) == 10 and set(batches[0]) == {("x",)}
+
+    def test_feeds_jitted_step(self, tmp_path):
+        import jax
+
+        path = self._file(tmp_path, n=4_096, rg=2_048)
+
+        @jax.jit
+        def step(batch):
+            return batch[("x",)].sum()
+
+        with FileReader(path) as r:
+            total = sum(int(step(b)) for b in r.iter_device_batches(512))
+        assert total == sum(range(4_096))
+
+    def test_nullable_column_rejected(self, tmp_path):
+        t = pa.table({
+            "x": pa.array(np.arange(1000, dtype=np.int64)),
+            "n": pa.array([None if i % 5 == 0 else i for i in range(1000)], pa.int64()),
+        })
+        path = str(tmp_path / "nulls.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        with FileReader(path) as r:
+            with pytest.raises(ValueError, match="nulls"):
+                list(r.iter_device_batches(100))
+        # projecting the nullable column out makes it batchable again
+        with FileReader(path) as r:
+            assert len(list(r.iter_device_batches(100, columns=["x"]))) == 10
+
+    def test_repeated_column_rejected(self, tmp_path):
+        t = pa.table({
+            "x": pa.array(np.arange(100, dtype=np.int64)),
+            "l": pa.array([[i, i + 1] for i in range(100)], pa.list_(pa.int32())),
+        })
+        path = str(tmp_path / "lst.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            with pytest.raises(ValueError, match="repeated"):
+                list(r.iter_device_batches(10))
+        with FileReader(path) as r:
+            assert len(list(r.iter_device_batches(10, columns=["x"]))) == 10
+
+    def test_invalid_batch_size_raises_eagerly(self, tmp_path):
+        path = self._file(tmp_path, n=100, rg=100)
+        with FileReader(path) as r:
+            with pytest.raises(ValueError):
+                r.iter_device_batches(0)  # raises at call, not first next()
