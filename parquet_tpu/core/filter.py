@@ -24,7 +24,7 @@ import decimal
 import struct
 
 from ..meta.parquet_types import ConvertedType, Type
-from .assembly import _to_micros, logical_kind
+from .assembly import logical_kind
 from .schema import Schema
 from .stats import _PACK
 
