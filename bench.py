@@ -251,7 +251,11 @@ def _matrix_write_opts(cfg: int) -> dict:
     if cfg == 2:
         return dict(compression="snappy", use_dictionary=["v"], data_page_version="2.0")
     if cfg == 3:
-        return dict(compression="snappy", use_dictionary=["v"], data_page_version="1.0")
+        # raise pyarrow's 1MB dictionary-page ceiling: the config SPEC is a
+        # dictionary-encoded column with 100K keys (~1.1MB of values), and
+        # the default limit silently spills half the pages to PLAIN
+        return dict(compression="snappy", use_dictionary=["v"], data_page_version="1.0",
+                    dictionary_pagesize_limit=16 << 20)
     if cfg == 4:
         return dict(compression="gzip", column_encoding={"v": "DELTA_BINARY_PACKED"}, use_dictionary=False, data_page_version="1.0")
     return dict(compression="snappy", data_page_version="1.0")
