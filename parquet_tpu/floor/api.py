@@ -126,8 +126,9 @@ class Writer:
 class Reader:
     """High-level reader: rows -> dataclass instances (or dicts)."""
 
-    def __init__(self, source, record_type=None, **reader_kw):
+    def __init__(self, source, record_type=None, filters=None, **reader_kw):
         self.record_type = record_type
+        self.filters = filters  # (column, op, value) conjunction; stats-pruned
         self._r = FileReader(source, **reader_kw)
         self._hints = (
             typing.get_type_hints(record_type) if record_type is not None else None
@@ -145,13 +146,18 @@ class Reader:
         rt = self.record_type
         if rt is not None and hasattr(rt, "unmarshal_parquet"):
             # Unmarshaller object model: gets the wire-shaped raw row
-            # (reference: floor/reader.go:88-90 + interfaces/unmarshaller.go)
-            for row in self._r.iter_rows(raw=True):
+            # (reference: floor/reader.go:88-90 + interfaces/unmarshaller.go).
+            # Raw rows carry the wire shape, so only row-group PRUNING
+            # applies here; exact row filtering needs the ergonomic domain.
+            row_groups = (
+                self._r.prune_row_groups(self.filters) if self.filters else None
+            )
+            for row in self._r.iter_rows(raw=True, row_groups=row_groups):
                 inst = rt.__new__(rt)
                 inst.unmarshal_parquet(UnmarshalObject(row))
                 yield inst
             return
-        for row in self._r.iter_rows():
+        for row in self._r.iter_rows(filters=self.filters):
             yield self._scan(row)
 
     def _scan(self, row: dict):

@@ -340,3 +340,18 @@ class TestNanoTime:
             (row,) = list(r.iter_rows())
         assert row["t"] == Time.from_nanos(1234, utc=False)
         assert row["t"] != Time.from_nanos(1234, utc=True)
+
+
+class TestFloorFilters:
+    def test_filters_flow_through_reader(self, tmp_path):
+        @dataclass
+        class R:
+            x: int
+            s: str
+
+        path = str(tmp_path / "ff.parquet")
+        with floor.Writer(path, R) as w:
+            for i in range(10_000):
+                w.write(R(x=i, s=f"s{i % 5}"))
+        got = list(floor.Reader(path, R, filters=[("x", ">=", 9_995), ("s", "==", "s1")]))
+        assert got == [R(x=9_996, s="s1")]
