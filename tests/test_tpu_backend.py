@@ -493,3 +493,32 @@ class TestDeviceBatches:
         with FileReader(path) as r:
             with pytest.raises(ValueError):
                 r.iter_device_batches(0)  # raises at call, not first next()
+
+
+class TestWorkerPoolPath:
+    """The multi-worker prepare branch never runs on a 1-core host by
+    default; force it so the pool + dispatch-thread interplay is tested."""
+
+    def test_parallel_prepare_parity(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PQT_HOST_THREADS", "4")
+        import parquet_tpu.core.reader as reader_mod
+
+        # fresh pool under the forced knob
+        monkeypatch.setattr(reader_mod, "_pool", None)
+        t = pa.table({
+            "a": pa.array(rng.integers(0, 50, 30_000).astype(np.int64)),
+            "b": pa.array([f"k{i%11}" for i in range(30_000)]),
+            "c": pa.array(np.cumsum(rng.integers(0, 9, 30_000)).astype(np.int64)),
+            "d": pa.array(rng.standard_normal(30_000)),
+        })
+        path = str(tmp_path / "pool.parquet")
+        pq.write_table(
+            t, path, row_group_size=7_000, compression="snappy",
+            use_dictionary=["b"], column_encoding={"c": "DELTA_BINARY_PACKED"},
+        )
+        assert reader_mod._host_pool() is not None  # the branch under test
+        both_backends(path)
+        with FileReader(path) as r:
+            groups = r.read_row_groups_device()
+        assert sum(g[("a",)].num_values for g in groups) == 30_000
+        monkeypatch.setattr(reader_mod, "_pool", None)  # don't leak the pool
