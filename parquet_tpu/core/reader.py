@@ -244,7 +244,11 @@ class FileReader:
         return self._plan_row_groups_async([i], columns)[0]
 
     def iter_device_batches(
-        self, batch_size: int, columns=None, drop_remainder: bool = True
+        self,
+        batch_size: int,
+        columns=None,
+        drop_remainder: bool = True,
+        sharding=None,
     ):
         """Stream the file as fixed-size device-resident batches.
 
@@ -262,12 +266,19 @@ class FileReader:
         bounded by two row groups plus the carry. With drop_remainder=False
         the final short batch is yielded as-is (dynamic shape: callers pad
         or accept a recompile).
+
+        `sharding` (a jax.sharding.Sharding, e.g. NamedSharding(mesh,
+        P("data"))) lays every batch out across a device mesh — the
+        data-parallel input pipeline: decode once, shard over ICI. The
+        batch size must divide evenly over the sharded axis.
         """
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
-        return self._iter_device_batches(batch_size, columns, drop_remainder)
+        return self._iter_device_batches(batch_size, columns, drop_remainder, sharding)
 
-    def _iter_device_batches(self, batch_size: int, columns, drop_remainder: bool):
+    def _iter_device_batches(
+        self, batch_size: int, columns, drop_remainder: bool, sharding=None
+    ):
         import jax.numpy as jnp
 
         def _array_of(path, dc):
@@ -330,7 +341,14 @@ class FileReader:
             # is sliced once per row group, not once per batch
             off = 0
             while total - off >= batch_size:
-                yield {p: a[off : off + batch_size] for p, a in cat.items()}
+                batch = {p: a[off : off + batch_size] for p, a in cat.items()}
+                if sharding is not None:
+                    import jax
+
+                    batch = {
+                        p: jax.device_put(a, sharding) for p, a in batch.items()
+                    }
+                yield batch
                 off += batch_size
             carry_n = total - off
             carry = {p: a[off:] for p, a in cat.items()} if carry_n else {}

@@ -32,7 +32,8 @@ def _json_default(v):
 
 
 def cmd_cat(args) -> int:
-    with FileReader(args.file) as r:
+    cols = args.columns.split(",") if args.columns else None
+    with FileReader(args.file, columns=cols) as r:
         for row in r.iter_rows(raw=args.raw):
             print(json.dumps(row, default=_json_default))
     return 0
@@ -40,7 +41,8 @@ def cmd_cat(args) -> int:
 
 def cmd_head(args) -> int:
     n = args.n
-    with FileReader(args.file) as r:
+    cols = args.columns.split(",") if args.columns else None
+    with FileReader(args.file, columns=cols) as r:
         for i, row in enumerate(r.iter_rows(raw=args.raw)):
             if i >= n:
                 break
@@ -159,12 +161,14 @@ def main(argv=None) -> int:
     pc = sub.add_parser("cat", help="print all rows as JSON lines")
     pc.add_argument("file")
     pc.add_argument("--raw", action="store_true", help="raw nested-map row shape")
+    pc.add_argument("--columns", help="comma-separated column projection")
     pc.set_defaults(fn=cmd_cat)
 
     ph = sub.add_parser("head", help="print the first N rows")
     ph.add_argument("-n", type=int, default=5)
     ph.add_argument("file")
     ph.add_argument("--raw", action="store_true")
+    ph.add_argument("--columns", help="comma-separated column projection")
     ph.set_defaults(fn=cmd_head)
 
     pm = sub.add_parser("meta", help="print file + column metadata")

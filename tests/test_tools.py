@@ -159,3 +159,14 @@ class TestSplitBySize:
             # flushed (1 row group).
             assert r.num_row_groups == 2
             assert r.num_rows == 4000
+
+
+class TestColumnProjection:
+    def test_cat_and_head_columns(self, sample, capsys):
+        import json
+
+        from parquet_tpu.tools.parquet_tool import main
+
+        assert main(["head", "-n", "2", "--columns", "id", sample]) == 0
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2 and set(json.loads(lines[0])) == {"id"}

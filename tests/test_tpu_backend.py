@@ -522,3 +522,29 @@ class TestWorkerPoolPath:
             groups = r.read_row_groups_device()
         assert sum(g[("a",)].num_values for g in groups) == 30_000
         monkeypatch.setattr(reader_mod, "_pool", None)  # don't leak the pool
+
+
+def test_sharded_batches_over_mesh(tmp_path):
+    """Batches lay out over a data-parallel mesh axis and feed a
+    shard_map-style jitted step (the distributed input pipeline)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    t = pa.table({"x": pa.array(np.arange(8_192, dtype=np.int64))})
+    path = str(tmp_path / "shard.parquet")
+    pq.write_table(t, path, row_group_size=4_096, use_dictionary=False)
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def step(b):
+        return b[("x",)].sum()
+
+    total = 0
+    with FileReader(path) as r:
+        for b in r.iter_device_batches(2_048, sharding=sharding):
+            arr = b[("x",)]
+            assert arr.sharding == sharding and arr.shape == (2_048,)
+            total += int(step(b))
+    assert total == sum(range(8_192))
