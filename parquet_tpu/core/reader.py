@@ -353,6 +353,10 @@ class FileReader:
             carry_n = total - off
             carry = {p: a[off:] for p, a in cat.items()} if carry_n else {}
         if carry_n and not drop_remainder:
+            if sharding is not None:
+                import jax
+
+                carry = {p: jax.device_put(a, sharding) for p, a in carry.items()}
             yield carry
 
     def _plan_row_groups_async(self, indices, columns=None):

@@ -548,3 +548,20 @@ def test_sharded_batches_over_mesh(tmp_path):
             assert arr.sharding == sharding and arr.shape == (2_048,)
             total += int(step(b))
     assert total == sum(range(8_192))
+
+
+def test_sharded_remainder_batch_keeps_sharding(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    t = pa.table({"x": pa.array(np.arange(2_560, dtype=np.int64))})
+    path = str(tmp_path / "shard_rem.parquet")
+    pq.write_table(t, path, use_dictionary=False)
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    with FileReader(path) as r:
+        batches = list(
+            r.iter_device_batches(1_024, drop_remainder=False, sharding=sharding)
+        )
+    assert [b[("x",)].shape[0] for b in batches] == [1_024, 1_024, 512]
+    assert all(b[("x",)].sharding == sharding for b in batches)  # incl. the tail
