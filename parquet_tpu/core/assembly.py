@@ -125,10 +125,8 @@ def fast_flat_rows(chunks: dict[tuple, ChunkData], raw: bool):
     ]
 
 
-def _canonical_list_nodes(top: Column, chunks) -> tuple | None:
-    """(mid, leaf) when `top` is a canonical LIST of scalars whose single
-    leaf chunk is present: 3-level {top (LIST) -> repeated mid -> leaf} or
-    2-level legacy {top -> repeated leaf}. None otherwise."""
+def _list_wrapper(top: Column):
+    """The repeated middle group of a canonical LIST wrapper, or None."""
     ct = top.converted_type
     lt = top.logical_type
     is_list = ct == ConvertedType.LIST or (lt is not None and lt.LIST is not None)
@@ -136,6 +134,16 @@ def _canonical_list_nodes(top: Column, chunks) -> tuple | None:
         return None
     mid = top.children[0]
     if mid.repetition != FieldRepetitionType.REPEATED or mid.max_rep != 1:
+        return None
+    return mid
+
+
+def _canonical_list_nodes(top: Column, chunks) -> tuple | None:
+    """(mid, leaf) when `top` is a canonical LIST of scalars whose single
+    leaf chunk is present: 3-level {top (LIST) -> repeated mid -> leaf} or
+    2-level legacy {top -> repeated leaf}. None otherwise."""
+    mid = _list_wrapper(top)
+    if mid is None:
         return None
     if mid.is_leaf:
         return (mid, mid) if mid.path in chunks else None  # 2-level legacy
@@ -182,11 +190,79 @@ def _list_column_values(top: Column, mid: Column, leaf: Column,
     counts = np.bincount(row_of[has_elem], minlength=n_rows)
     offsets = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
-    first_def = dfl[row_start]
-    elems_list = elems.tolist()
+    return _rows_from_entries(top, dfl[row_start], elems.tolist(), offsets)
+
+
+def _canonical_list_of_struct_nodes(top: Column, chunks) -> tuple | None:
+    """(mid, elem, leaves) when `top` is a canonical LIST whose element is a
+    group of scalar leaves, all present in chunks; None otherwise."""
+    mid = _list_wrapper(top)
+    if mid is None or mid.is_leaf or len(mid.children) != 1:
+        return None
+    elem = mid.children[0]
+    if elem.is_leaf or elem.max_rep != 1:
+        return None
+    leaves = [c for c in elem.children if c.path in chunks]
+    if not leaves or any(not c.is_leaf or c.max_rep != 1 for c in leaves):
+        return None
+    return mid, elem, leaves
+
+
+def _list_of_struct_column_values(top: Column, mid: Column, elem: Column,
+                                  leaves: list, chunks, raw: bool):
+    """Vectorized assembly of LIST<struct-of-scalars> (e.g. list[Point]).
+
+    Entry structure (row boundaries, element presence, struct nullity) comes
+    from the FIRST leaf's level arrays; each leaf contributes a row-aligned
+    element array; elements zip into dicts at C speed.
+    """
+    first = chunks[leaves[0].path]
+    dfl0, rep0 = first.def_levels, first.rep_levels
+    if dfl0 is None or rep0 is None:
+        return None
+    row_start = np.flatnonzero(rep0 == 0)
+    n_rows = len(row_start)
+    if n_rows == 0:
+        return []
+    has_elem = dfl0 >= mid.max_def  # entry carries a (maybe-null) element
+    elem_present = dfl0 >= elem.max_def  # the struct itself is non-null
+    n_elem = int(has_elem.sum())
+    cols = []
+    for leaf in leaves:
+        chunk = chunks[leaf.path]
+        dfl = chunk.def_levels
+        if dfl is None or len(dfl) != len(dfl0):
+            return None
+        vals = _leaf_python_values(leaf, chunk, raw)
+        present = dfl[has_elem] == leaf.max_def
+        if len(vals) != int(present.sum()):
+            raise AssemblyError(
+                f"assembly: {leaf.path_str}: {len(vals)} values for "
+                f"{int(present.sum())} present entries"
+            )
+        full = np.empty(n_elem, dtype=object)
+        full[present] = vals
+        cols.append((leaf.name, full.tolist()))
+    names = [name for name, _ in cols]
+    structs = [dict(zip(names, row)) for row in zip(*(v for _, v in cols))]
+    # null struct elements (def between mid and elem thresholds)
+    null_elem = ~elem_present[has_elem]
+    if null_elem.any():
+        for i in np.flatnonzero(null_elem).tolist():
+            structs[i] = None
+    row_of = np.cumsum(rep0 == 0) - 1
+    counts = np.bincount(row_of[has_elem], minlength=n_rows)
+    offsets = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return _rows_from_entries(top, dfl0[row_start], structs, offsets)
+
+
+def _rows_from_entries(top: Column, first_def, elems_list: list, offsets) -> list:
+    """Slice per-entry element values into per-row lists, applying null-row
+    detection from the first entry's definition level (shared tail of the
+    LIST / MAP / LIST<struct> vectorized paths)."""
     off = offsets.tolist()
     if top.max_def == 0 or bool((first_def >= top.max_def).all()):
-        # no null lists (REQUIRED list, or simply none present)
         return [elems_list[a:b] for a, b in zip(off[:-1], off[1:])]
     null_row = (first_def < top.max_def).tolist()
     return [
@@ -262,16 +338,9 @@ def _map_column_values(top: Column, kv: Column, key: Column, value: Column,
     counts = np.bincount(row_of[has_kv], minlength=n_rows)
     offsets = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
-    first_def = kdfl[row_start]
-    vlist = velems.tolist()
-    off = offsets.tolist()
-    if top.max_def == 0 or bool((first_def >= top.max_def).all()):
-        return [dict(zip(keys[a:b], vlist[a:b])) for a, b in zip(off[:-1], off[1:])]
-    null_row = (first_def < top.max_def).tolist()
-    return [
-        None if is_null else dict(zip(keys[a:b], vlist[a:b]))
-        for is_null, a, b in zip(null_row, off[:-1], off[1:])
-    ]
+    pairs = list(zip(keys, velems.tolist()))
+    rows = _rows_from_entries(top, kdfl[row_start], pairs, offsets)
+    return [None if r is None else dict(r) for r in rows]
 
 
 def _struct_column_values(top: Column, chunks, raw: bool):
@@ -354,6 +423,14 @@ def fast_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
                     kv, key, value = mn
                     vals = _map_column_values(
                         top, kv, key, value, chunks[key.path], chunks[value.path], raw
+                    )
+                elif (
+                    (ls := _canonical_list_of_struct_nodes(top, chunks)) is not None
+                    and len(paths) == len(ls[2])
+                ):
+                    mid, elem, leaves = ls
+                    vals = _list_of_struct_column_values(
+                        top, mid, elem, leaves, chunks, raw
                     )
                 elif not top.is_leaf:
                     vals = _struct_column_values(top, chunks, raw)

@@ -279,6 +279,7 @@ class FileReader:
     def _iter_device_batches(
         self, batch_size: int, columns, drop_remainder: bool, sharding=None
     ):
+        import jax
         import jax.numpy as jnp
 
         def _array_of(path, dc):
@@ -343,8 +344,6 @@ class FileReader:
             while total - off >= batch_size:
                 batch = {p: a[off : off + batch_size] for p, a in cat.items()}
                 if sharding is not None:
-                    import jax
-
                     batch = {
                         p: jax.device_put(a, sharding) for p, a in batch.items()
                     }
@@ -354,9 +353,15 @@ class FileReader:
             carry = {p: a[off:] for p, a in cat.items()} if carry_n else {}
         if carry_n and not drop_remainder:
             if sharding is not None:
-                import jax
-
-                carry = {p: jax.device_put(a, sharding) for p, a in carry.items()}
+                try:
+                    carry = {
+                        p: jax.device_put(a, sharding) for p, a in carry.items()
+                    }
+                except ValueError:
+                    # tail not divisible over the mesh axis: deliver it
+                    # unsharded rather than dying on the last batch (callers
+                    # already handle the tail's dynamic shape)
+                    pass
             yield carry
 
     def _plan_row_groups_async(self, indices, columns=None):

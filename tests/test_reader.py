@@ -372,3 +372,26 @@ class TestFastNestedAssembly:
             assert fast_rows(r.schema, r.read_row_group(0), False) is None
             rows = list(r.iter_rows())  # assembler fallback still works
         assert rows[0]["r"] == {"xs": [1, 2]}
+
+    def test_list_of_struct_vectorized(self, tmp_path):
+        """LIST<struct-of-scalars> (e.g. list[Point]) with null lists, empty
+        lists, null elements and null leaf values."""
+        rng = np.random.default_rng(5)
+        rows = []
+        for i in range(8000):
+            if i % 13 == 0:
+                rows.append(None)
+            elif i % 5 == 0:
+                rows.append([])
+            else:
+                rows.append([
+                    None if (i + j) % 11 == 0
+                    else {"x": float(j), "y": None if j % 3 == 0 else int(rng.integers(0, 9))}
+                    for j in range(i % 4)
+                ])
+        t = pa.table({
+            "pts": pa.array(rows, pa.list_(pa.struct([("x", pa.float64()), ("y", pa.int64())]))),
+        })
+        fast, slow = self._roundtrip_both(t, tmp_path)
+        assert fast is not None and fast == slow
+        assert [r["pts"] for r in fast] == rows

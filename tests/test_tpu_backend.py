@@ -565,3 +565,22 @@ def test_sharded_remainder_batch_keeps_sharding(tmp_path):
         )
     assert [b[("x",)].shape[0] for b in batches] == [1_024, 1_024, 512]
     assert all(b[("x",)].sharding == sharding for b in batches)  # incl. the tail
+
+
+def test_sharded_indivisible_remainder_delivered_unsharded(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    t = pa.table({"x": pa.array(np.arange(2_500, dtype=np.int64))})
+    path = str(tmp_path / "shard_odd.parquet")
+    pq.write_table(t, path, use_dictionary=False)
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    with FileReader(path) as r:
+        batches = list(
+            r.iter_device_batches(1_024, drop_remainder=False, sharding=sharding)
+        )
+    assert [b[("x",)].shape[0] for b in batches] == [1_024, 1_024, 452]
+    assert batches[0][("x",)].sharding == sharding
+    # 452 % 8 != 0: the tail arrives, just without the mesh layout
+    assert int(np.asarray(batches[-1][("x",)])[-1]) == 2_499
