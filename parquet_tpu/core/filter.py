@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import datetime as dt
 import decimal
+import math
 import struct
 
 from ..meta.parquet_types import ConvertedType, Type
@@ -64,9 +65,15 @@ def normalize_filters(schema: Schema, filters) -> list:
 
     Each entry carries the value in TWO domains: `row_value` for exact
     per-row comparison (the ergonomic domain iter_rows yields — datetime,
-    date, Decimal, str) and `stat_value` for statistics pruning (the
-    physical storage domain), or None when this column's statistics cannot
-    be ordered safely (INT96, binary-backed DECIMAL, legacy binary min/max).
+    date, Decimal, str) and a `(stat_lo, stat_hi)` bracket for statistics
+    pruning (the physical storage domain), or (None, None) when this
+    column's statistics cannot be ordered safely (INT96, binary-backed
+    DECIMAL, legacy binary min/max). The bracket satisfies
+    stat_lo <= value <= stat_hi with both ends representable physically, so
+    an inexact coercion (fractional decimal beyond the column's scale, a
+    sub-unit timestamp) straddles the value and pruning stays conservative
+    in BOTH comparison directions; stat_lo != stat_hi means no stored value
+    can equal the filter value exactly.
     """
     out = []
     for f in filters:
@@ -90,15 +97,36 @@ def normalize_filters(schema: Schema, filters) -> list:
         if op in ("is_null", "not_null"):
             if value is not None:
                 raise FilterError(f"filter: {op} takes no value")
-            out.append((path, leaf, op, None, None))
+            out.append((path, leaf, op, None, None, None))
             continue
-        row_value, stat_value = _coerce_value(leaf, value)
-        out.append((path, leaf, op, row_value, stat_value))
+        row_value, stat_lo, stat_hi = _coerce_value(leaf, value)
+        out.append((path, leaf, op, row_value, stat_lo, stat_hi))
     return out
 
 
+def _int_bracket(value):
+    """Exact row value + integer floor/ceil bracket for an integer-backed
+    physical domain. Accepts int, float, Decimal, or numeric-string values."""
+    if isinstance(value, str):
+        try:
+            v = int(value)
+        except ValueError as e:
+            raise FilterError(f"filter: integer column takes a number, got {value!r}") from e
+        return v, v, v
+    try:
+        f = math.floor(value)
+        c = math.ceil(value)
+    except (TypeError, ValueError, OverflowError, ArithmeticError) as e:
+        # inf/nan (float or Decimal) and non-numeric values all land here
+        raise FilterError(f"filter: cannot compare an integer column against {value!r}") from e
+    # keep the caller's exact value for per-row comparison when inexact
+    # (int vs float/Decimal compare exactly in Python)
+    row = int(value) if f == c else value
+    return row, f, c
+
+
 def _coerce_value(leaf, value):
-    """(row-domain value, physical stat-domain value or None)."""
+    """(row-domain value, physical stat floor, physical stat ceil)."""
     if value is None:
         raise FilterError("filter: comparison against None (use is_null)")
     t = leaf.type
@@ -106,61 +134,70 @@ def _coerce_value(leaf, value):
     if kind is not None:
         return _coerce_logical(leaf, kind, value)
     if t in (Type.INT32, Type.INT64):
-        v = int(value)
-        return v, v
+        return _int_bracket(value)
     if t in (Type.FLOAT, Type.DOUBLE):
         v = float(value)
-        return v, v
+        return v, v, v
     if t == Type.BOOLEAN:
         v = bool(value)
-        return v, v
+        return v, v, v
     b = value.encode("utf-8") if isinstance(value, str) else bytes(value)
-    return b, b
+    return b, b, b
 
 
 def _coerce_logical(leaf, kind, value):
     """Logically-typed columns: rows yield converted Python objects; stats
     store the physical encoding. Produce both."""
     if kind[0] == "uint":
-        v = int(value)
-        if v < 0:
+        row, lo, hi = _int_bracket(value)
+        if row < 0:
             raise FilterError("filter: unsigned column takes a non-negative int")
-        return v, v
+        return row, lo, hi
     if kind == "int96":
         if not isinstance(value, dt.datetime):
             raise FilterError("filter: INT96 column takes a datetime")
         if value.tzinfo is None:
             value = value.replace(tzinfo=dt.timezone.utc)
-        return value, None  # INT96 byte stats have no usable ordering
+        return value, None, None  # INT96 byte stats have no usable ordering
     if kind == "decimal":
-        v = decimal.Decimal(value)
+        try:
+            v = decimal.Decimal(value)
+        except (decimal.InvalidOperation, TypeError, ValueError) as e:
+            raise FilterError(f"filter: DECIMAL column takes a number, got {value!r}") from e
         scale = leaf.element.scale or (
             leaf.logical_type.DECIMAL.scale if leaf.logical_type and leaf.logical_type.DECIMAL else 0
         )
         if leaf.type in (Type.INT32, Type.INT64):
-            unscaled = int(v.scaleb(scale or 0).to_integral_value())
-            return v, unscaled
-        return v, None  # binary-backed decimals: sign-magnitude bytes unordered
+            try:
+                unscaled = v.scaleb(scale or 0)
+                lo = int(unscaled.to_integral_value(rounding=decimal.ROUND_FLOOR))
+                hi = int(unscaled.to_integral_value(rounding=decimal.ROUND_CEILING))
+            except (decimal.InvalidOperation, OverflowError, ValueError) as e:
+                # non-finite (NaN/Infinity) values have no integer bracket
+                raise FilterError(f"filter: cannot compare DECIMAL column against {value!r}") from e
+            return v, lo, hi
+        return v, None, None  # binary-backed decimals: sign-magnitude bytes unordered
     if kind == "date":
         if isinstance(value, dt.datetime):
             value = value.date()
         if not isinstance(value, dt.date):
             raise FilterError("filter: DATE column takes a date")
-        return value, (value - _EPOCH_DATE).days
+        days = (value - _EPOCH_DATE).days
+        return value, days, days
     if kind[0] == "timestamp":
         _, unit, utc = kind
         if not isinstance(value, dt.datetime):
             raise FilterError("filter: TIMESTAMP column takes a datetime")
         aware = value if value.tzinfo is not None else value.replace(tzinfo=dt.timezone.utc)
         micros = (aware - _EPOCH_UTC) // dt.timedelta(microseconds=1)
-        phys = _from_micros(micros, unit)
+        lo, hi = _unit_bracket(micros, unit)
         if unit == "NANOS":
             import numpy as np
 
             row_value = np.datetime64(micros * 1000, "ns")  # rows yield datetime64[ns]
         else:
             row_value = aware if utc else aware.replace(tzinfo=None)
-        return row_value, phys
+        return row_value, lo, hi
     if kind[0] == "time":
         unit = kind[1]
         from ..floor.time import Time
@@ -174,8 +211,13 @@ def _coerce_logical(leaf, kind, value):
             )
         else:
             raise FilterError("filter: TIME column takes a time or floor.Time")
-        phys = nanos // {"MILLIS": 1_000_000, "MICROS": 1_000, "NANOS": 1}[unit]
-        if unit == "NANOS":
+        div = {"MILLIS": 1_000_000, "MICROS": 1_000, "NANOS": 1}[unit]
+        lo, hi = nanos // div, -(-nanos // div)
+        if unit == "NANOS" or nanos % 1000:
+            # NANOS rows yield Time; a sub-microsecond filter value on a
+            # MILLIS/MICROS column keeps exact nanos too (dt.time would
+            # truncate and flip comparisons) — row_matches converts the
+            # row's dt.time to Time before comparing
             row_value = Time.from_nanos(nanos, utc=kind[2])
         else:
             micros = nanos // 1000
@@ -185,16 +227,17 @@ def _coerce_logical(leaf, kind, value):
                 (micros // 1_000_000) % 60,
                 micros % 1_000_000,
             )
-        return row_value, phys
+        return row_value, lo, hi
     raise FilterError(f"filter: unsupported logical type on {leaf.path_str}")
 
 
-def _from_micros(micros: int, unit: str) -> int:
+def _unit_bracket(micros: int, unit: str) -> tuple:
+    """Floor/ceil of a microsecond instant in the column's stored unit."""
     if unit == "MILLIS":
-        return micros // 1000
+        return micros // 1000, -(-micros // 1000)
     if unit == "NANOS":
-        return micros * 1000
-    return micros
+        return micros * 1000, micros * 1000
+    return micros, micros
 
 
 def _decode_stat(leaf, raw: bytes, legacy: bool):
@@ -204,6 +247,11 @@ def _decode_stat(leaf, raw: bytes, legacy: bool):
     t = leaf.type
     try:
         if t in (Type.INT32, Type.INT64) and _is_unsigned(leaf):
+            if legacy:
+                # deprecated min/max were computed with SIGNED comparison by
+                # old writers; decoding them unsigned inverts the ordering for
+                # values with the top bit set — unusable for pruning
+                return None
             return _UNSIGNED[t].unpack(raw)[0]
         fmt = _PACK.get(t)
         if fmt is not None:
@@ -222,7 +270,7 @@ def _decode_stat(leaf, raw: bytes, legacy: bool):
 def row_group_may_match(rg, normalized) -> bool:
     """False only when statistics PROVE no row of the group matches."""
     chunks = {tuple(c.meta_data.path_in_schema or []): c for c in rg.columns or []}
-    for path, leaf, op, _row_value, value in normalized:
+    for path, leaf, op, _row_value, vlo, vhi in normalized:
         cc = chunks.get(path)
         if cc is None or cc.meta_data is None:
             continue
@@ -240,7 +288,7 @@ def row_group_may_match(rg, normalized) -> bool:
             if null_count is not None and null_count >= num_values:
                 return False
             continue
-        if value is None:
+        if vlo is None:
             continue  # no orderable physical form for this column's stats
         legacy = st.min_value is None or st.max_value is None
         lo = _decode_stat(leaf, st.min_value if not legacy else st.min, legacy)
@@ -250,24 +298,27 @@ def row_group_may_match(rg, normalized) -> bool:
         # NaN bounds make float stats unusable for ordering
         if isinstance(lo, float) and (lo != lo or hi != hi):
             continue
-        if op == "==" and (value < lo or value > hi):
+        # [vlo, vhi] brackets the filter value in the stat domain; vlo != vhi
+        # means the value falls between representable stored values, so each
+        # comparison uses the end that keeps pruning conservative.
+        if op == "==" and (vlo != vhi or vhi < lo or vlo > hi):
+            return False  # inexact value: NO stored value can equal it
+        if op == "<" and lo >= vhi:
             return False
-        if op == "<" and lo >= value:
+        if op == "<=" and lo > vlo:
             return False
-        if op == "<=" and lo > value:
+        if op == ">" and hi <= vlo:
             return False
-        if op == ">" and hi <= value:
-            return False
-        if op == ">=" and hi < value:
+        if op == ">=" and hi < vhi:
             return False
         # "!=" can only be pruned when lo == hi == value and nothing is null
-        if op == "!=" and lo == hi == value and not null_count:
+        if op == "!=" and vlo == vhi and lo == hi == vlo and not null_count:
             return False
     return True
 
 
 def row_matches(row: dict, normalized) -> bool:
-    for path, leaf, op, value, _stat_value in normalized:
+    for path, leaf, op, value, _vlo, _vhi in normalized:
         v = row.get(path[0]) if len(path) == 1 else _nested_get(row, path)
         if op == "is_null":
             if v is not None:
@@ -281,6 +332,13 @@ def row_matches(row: dict, normalized) -> bool:
             return False
         if isinstance(v, str) and isinstance(value, bytes):
             v = v.encode("utf-8")
+        elif isinstance(v, dt.time) and not isinstance(value, dt.time):
+            # sub-microsecond TIME filter value on a MILLIS/MICROS column:
+            # lift the row into exact-nanos Time space for the comparison
+            from ..floor.time import Time
+
+            if isinstance(value, Time):
+                v = Time.from_time(v, utc=value.utc)
         if op == "==" and not v == value:
             return False
         if op == "!=" and not v != value:

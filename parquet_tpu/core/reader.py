@@ -485,8 +485,20 @@ class FileReader:
         surviving rows are predicate-checked exactly."""
         normalized = None
         if filters is not None:
-            from .filter import normalize_filters, row_group_may_match, row_matches
+            from .filter import (
+                FilterError,
+                normalize_filters,
+                row_group_may_match,
+                row_matches,
+            )
 
+            if raw:
+                # row_matches compares in the converted domain (datetime,
+                # Decimal, str); raw rows are wire-shaped (ints, undecoded
+                # bytes, nested wrappers), so the predicate would silently
+                # mismatch — mirror floor.Reader, which only prunes for the
+                # unmarshal path
+                raise FilterError("filters cannot be combined with raw=True")
             normalized = normalize_filters(self.schema, filters)
         indices = range(self.num_row_groups) if row_groups is None else row_groups
         for i in indices:

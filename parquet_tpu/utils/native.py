@@ -163,9 +163,7 @@ class NativeLib:
         import numpy as np
 
         addr, n_in, _keep = _ptr(data)
-        # +16 slack: the C decoder's wide match copies may scribble up to 15
-        # bytes past the decoded length.
-        out = np.empty(max(uncompressed_size, 1) + 16, dtype=np.uint8)
+        out = np.empty(max(uncompressed_size, 1), dtype=np.uint8)
         n = self._lib.ptq_snappy_decompress(
             addr, n_in, ctypes.c_void_p(out.ctypes.data), uncompressed_size
         )

@@ -107,6 +107,159 @@ class TestTypedFilters:
             got = [g["ts"] for g in r.iter_rows(filters=[("ts", ">=", ts[997])])]
         assert got == ts[997:]
 
+    def test_decimal_int_backed_directional_rounding(self, tmp_path):
+        """Inexact filter values must not round toward the value when pruning:
+        DECIMAL(9,2) group holding 1.01; '> 1.006' must keep the group (half-
+        even to_integral_value would coerce 100.6 -> 101 and wrongly prune)."""
+        import decimal
+
+        from parquet_tpu.core.writer import FileWriter
+        from parquet_tpu.schema.dsl import parse_schema
+
+        path = str(tmp_path / "dec.parquet")
+        schema = parse_schema("message m { required int32 x (DECIMAL(9,2)); }")
+        with FileWriter(path, schema) as w:
+            w.write_rows([{"x": 101}])  # unscaled: 1.01
+        with FileReader(path) as r:
+            assert r.prune_row_groups([("x", ">", decimal.Decimal("1.006"))]) == [0]
+            got = [v["x"] for v in r.iter_rows(filters=[("x", ">", decimal.Decimal("1.006"))])]
+            assert got == [decimal.Decimal("1.01")]
+            # other direction: '< 1.014' must also keep the group and match
+            assert r.prune_row_groups([("x", "<", decimal.Decimal("1.014"))]) == [0]
+            got = [v["x"] for v in r.iter_rows(filters=[("x", "<", decimal.Decimal("1.014"))])]
+            assert got == [decimal.Decimal("1.01")]
+            # '==' an unrepresentable value proves emptiness — prune to nothing
+            assert r.prune_row_groups([("x", "==", decimal.Decimal("1.006"))]) == []
+
+    def test_timestamp_millis_directional_rounding(self, tmp_path):
+        """TIMESTAMP(MILLIS) group holding t=1ms; '< 1.5ms' must keep the
+        group (floor division would coerce to 1 and 'lo >= value' prune)."""
+        import datetime as dt
+
+        from parquet_tpu.core.writer import FileWriter
+        from parquet_tpu.schema.dsl import parse_schema
+
+        path = str(tmp_path / "ms.parquet")
+        schema = parse_schema("message m { required int64 ts (TIMESTAMP_MILLIS); }")
+        with FileWriter(path, schema) as w:
+            w.write_rows([{"ts": 1}])  # 1ms after epoch
+        t_15 = dt.datetime(1970, 1, 1, 0, 0, 0, 1500, tzinfo=dt.timezone.utc)
+        with FileReader(path) as r:
+            assert r.prune_row_groups([("ts", "<", t_15)]) == [0]
+            assert sum(1 for _ in r.iter_rows(filters=[("ts", "<", t_15)])) == 1
+            # and '>' just under the stored value keeps the group too
+            t_05 = dt.datetime(1970, 1, 1, 0, 0, 0, 500, tzinfo=dt.timezone.utc)
+            assert r.prune_row_groups([("ts", ">", t_05)]) == [0]
+            assert sum(1 for _ in r.iter_rows(filters=[("ts", ">", t_05)])) == 1
+
+    def test_legacy_unsigned_stats_never_prune(self):
+        """Deprecated min/max were written with signed comparison: for a
+        legacy UINT32 chunk holding {1, 0x80000000} they store min=2^31,
+        max=1 — decoded unsigned the bounds invert, so they are unusable."""
+        import struct
+
+        from parquet_tpu.core.filter import normalize_filters, row_group_may_match
+        from parquet_tpu.meta.parquet_types import (
+            ColumnChunk,
+            ColumnMetaData,
+            RowGroup,
+            Statistics,
+        )
+        from parquet_tpu.schema.dsl import parse_schema
+
+        schema = parse_schema("message m { required int32 u (UINT_32); }")
+        st = Statistics(
+            min=struct.pack("<I", 0x80000000), max=struct.pack("<I", 1), null_count=0
+        )
+        rg = RowGroup(
+            columns=[
+                ColumnChunk(
+                    meta_data=ColumnMetaData(
+                        path_in_schema=["u"], num_values=2, statistics=st
+                    )
+                )
+            ],
+            num_rows=2,
+        )
+        normalized = normalize_filters(schema, [("u", "==", 1)])
+        assert row_group_may_match(rg, normalized)  # must NOT prune
+        # with modern min_value/max_value the same bytes ARE unsigned-ordered
+        st2 = Statistics(
+            min_value=struct.pack("<I", 1),
+            max_value=struct.pack("<I", 0x80000000),
+            null_count=0,
+        )
+        rg.columns[0].meta_data.statistics = st2
+        assert row_group_may_match(rg, normalize_filters(schema, [("u", "==", 1)]))
+        assert not row_group_may_match(
+            rg, normalize_filters(schema, [("u", "==", 2**31 + 5)])
+        )
+
+    def test_time_sub_microsecond_filter_value(self, tmp_path):
+        """A nanos-precision filter value on a TIME(MICROS) column must
+        compare exactly in both the stat and row domains (truncating it to
+        dt.time would flip '<' and '==')."""
+        from parquet_tpu.core.writer import FileWriter
+        from parquet_tpu.floor.time import Time
+        from parquet_tpu.schema.dsl import parse_schema
+
+        path = str(tmp_path / "t.parquet")
+        schema = parse_schema("message m { required int64 t (TIME_MICROS); }")
+        with FileWriter(path, schema) as w:
+            w.write_rows([{"t": 1}])  # 1 microsecond after midnight
+        with FileReader(path) as r:
+            got = list(r.iter_rows(filters=[("t", "<", Time.from_nanos(1500))]))
+            assert len(got) == 1  # 1000ns < 1500ns
+            assert list(r.iter_rows(filters=[("t", ">", Time.from_nanos(1500))])) == []
+            # '==' an unrepresentable instant matches nothing
+            assert list(r.iter_rows(filters=[("t", "==", Time.from_nanos(1500))])) == []
+
+    def test_nonfinite_and_bogus_values_raise_filter_error(self, sorted_file):
+        import decimal
+
+        with FileReader(sorted_file) as r:
+            for bad in (
+                decimal.Decimal("Infinity"),
+                decimal.Decimal("NaN"),
+                float("nan"),
+                float("inf"),
+                object(),
+            ):
+                with pytest.raises(FilterError):
+                    r.prune_row_groups([("x", ">", bad)])
+            # numeric strings keep working on integer columns
+            assert r.prune_row_groups([("x", "==", "50000")]) == [2]
+
+    def test_unsigned_string_value_and_decimal_nonfinite(self, tmp_path):
+        import decimal
+
+        pq.write_table(
+            pa.table({"u": pa.array([5, 9], pa.uint64())}), str(tmp_path / "u.parquet")
+        )
+        with FileReader(str(tmp_path / "u.parquet")) as r:
+            assert [x["u"] for x in r.iter_rows(filters=[("u", "==", "5")])] == [5]
+            with pytest.raises(FilterError):
+                r.prune_row_groups([("u", "==", -1)])
+        # int-backed DECIMAL: non-finite values must raise FilterError too
+        from parquet_tpu.core.writer import FileWriter
+        from parquet_tpu.schema.dsl import parse_schema
+
+        path = str(tmp_path / "dnf.parquet")
+        with FileWriter(path, parse_schema("message m { required int32 x (DECIMAL(9,2)); }")) as w:
+            w.write_rows([{"x": 101}])
+        with FileReader(path) as r:
+            for bad in (decimal.Decimal("NaN"), decimal.Decimal("Infinity"), float("inf")):
+                with pytest.raises(FilterError):
+                    r.prune_row_groups([("x", ">", bad)])
+
+    def test_raw_rows_with_filters_rejected(self, sorted_file):
+        """raw=True rows are wire-shaped; the converted-domain predicate
+        cannot be applied to them (mirrors floor.Reader's unmarshal-only
+        pruning)."""
+        with FileReader(sorted_file) as r:
+            with pytest.raises(FilterError):
+                next(r.iter_rows(raw=True, filters=[("x", "==", 1)]))
+
     def test_date_and_decimal_columns(self, tmp_path):
         import datetime as dt
         import decimal
