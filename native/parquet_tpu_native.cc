@@ -16,6 +16,7 @@
 #include <cstring>
 #include <cstddef>
 #include <sys/types.h>  // ssize_t
+#include <zlib.h>       // gzip pages in the whole-chunk prepare walk
 
 extern "C" {
 
@@ -806,6 +807,453 @@ ssize_t ptq_parse_page_header(const uint8_t* src, size_t src_len, int64_t* out) 
   }
   out[0] = static_cast<int64_t>(r.pos);
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Whole-chunk prepare walk (one native call per chunk).
+//
+// The per-page Python loop (header parse -> decompress -> level decode ->
+// prescan -> route) is the dominant host cost of the device decode pipeline
+// on wide files (reference page walk: chunk_reader.go:182-263). This fuses
+// the entire walk: the caller hands the chunk's bytes plus output buffers
+// and gets back packed per-page tables ready for vectorized batch assembly.
+// Any input the walk cannot handle (unknown codec, corrupt stream, capacity
+// overflow) returns a negative code and the caller falls back to the Python
+// walk, which reproduces the exact error semantics.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// gzip/zlib inflate with exact-size output (bomb guard: an output larger than
+// `expect` fails instead of allocating; mirrors core/compress.py _Gzip).
+bool gzip_inflate(const uint8_t* src, size_t src_len, uint8_t* dst, size_t expect) {
+  z_stream s;
+  std::memset(&s, 0, sizeof(s));
+  if (inflateInit2(&s, 15 + 32) != Z_OK) return false;  // auto gzip/zlib header
+  s.next_in = const_cast<Bytef*>(src);
+  s.avail_in = static_cast<uInt>(src_len);
+  s.next_out = dst;
+  s.avail_out = static_cast<uInt>(expect);
+  int rc = inflate(&s, Z_FINISH);
+  bool ok = (rc == Z_STREAM_END && s.total_out == expect && s.avail_in == 0);
+  inflateEnd(&s);
+  return ok;
+}
+
+inline int level_bit_width(int max_level) {
+  int w = 0;
+  while (max_level) { w++; max_level >>= 1; }  // bit_length
+  return w;
+}
+
+// Decompress one page block into scratch. Returns 0 ok, -1 corrupt/unknown
+// codec, -5 scratch too small (same code contract as ptq_chunk_prepare).
+int decompress_page(int codec, const uint8_t* src, size_t src_len,
+                    uint8_t* scratch, size_t scratch_cap, size_t expect) {
+  if (expect > scratch_cap) return -5;
+  if (codec == 1) {
+    if (ptq_snappy_decompress(reinterpret_cast<const char*>(src), src_len,
+                              reinterpret_cast<char*>(scratch), expect) !=
+        static_cast<ssize_t>(expect))
+      return -1;
+    return 0;
+  }
+  if (codec == 2) return gzip_inflate(src, src_len, scratch, expect) ? 0 : -1;
+  return -1;
+}
+
+// Hybrid-decode a level stream into uint16, validating every value
+// <= max_level (parity with ops/levels.py _check) and counting values equal
+// to `target`. Returns bytes consumed, or -1 on corrupt input.
+ssize_t decode_levels16(const uint8_t* src, size_t src_len, int64_t n,
+                        int max_level, uint16_t* out, int target,
+                        int64_t* eq_count) {
+  const int width = level_bit_width(max_level);
+  const size_t vbytes = (width + 7) / 8;
+  size_t pos = 0;
+  int64_t produced = 0;
+  int64_t eq = 0;
+  while (produced < n) {
+    uint64_t header = 0;
+    int shift = 0;
+    for (;;) {
+      if (pos >= src_len || shift > 63) return -1;
+      uint8_t b = src[pos++];
+      if (shift == 63 && (b & 0x7e)) return -1;
+      header |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    if (header & 1) {
+      uint64_t groups = header >> 1;
+      if (groups == 0 || groups > (1ull << 40)) return -1;
+      uint64_t count = groups * 8;
+      uint64_t nbytes = groups * static_cast<uint64_t>(width);
+      if (pos + nbytes > src_len) return -1;
+      int64_t take = n - produced;
+      if (static_cast<uint64_t>(take) > count) take = static_cast<int64_t>(count);
+      BitReader r;
+      br_init(&r, src + pos, nbytes);
+      for (int64_t i = 0; i < take; i++) {
+        uint64_t v = br_read(&r, width);
+        if (v > static_cast<uint64_t>(max_level)) return -1;
+        out[produced + i] = static_cast<uint16_t>(v);
+        eq += (static_cast<int>(v) == target);
+      }
+      pos += nbytes;
+      produced += take;
+    } else {
+      uint64_t count = header >> 1;
+      if (count == 0 || count > (1ull << 40) || pos + vbytes > src_len) return -1;
+      uint64_t v = 0;
+      for (size_t i = 0; i < vbytes; i++) v |= static_cast<uint64_t>(src[pos + i]) << (8 * i);
+      if (width < 64 && v >= (1ull << width)) return -1;
+      if (v > static_cast<uint64_t>(max_level)) return -1;
+      pos += vbytes;
+      int64_t take = n - produced;
+      if (static_cast<uint64_t>(take) > count) take = static_cast<int64_t>(count);
+      uint16_t v16 = static_cast<uint16_t>(v);
+      for (int64_t i = 0; i < take; i++) out[produced + i] = v16;
+      if (static_cast<int>(v) == target) eq += take;
+      produced += take;
+    }
+  }
+  if (eq_count) *eq_count = eq;
+  return static_cast<ssize_t>(pos);
+}
+
+}  // namespace
+
+// Page-table column layout (int64[n_pages][18]); absent fields are 0 unless
+// noted. Routes: 0 host-decoded ("other"), 1 dict indices (hybrid run table),
+// 2 delta-bp (miniblock table), 3 PLAIN numeric (bytes in values_out),
+// 4 empty (no non-null values).
+enum {
+  PC_KIND = 0,      // 0 data page, 1 dictionary page, 2 index page
+  PC_N = 1,         // num_values incl. nulls
+  PC_NONNULL = 2,
+  PC_ENC = 3,
+  PC_ROUTE = 4,
+  PC_VOFF = 5,      // offset of this page's value bytes in values_out
+  PC_VLEN = 6,
+  PC_LVLBASE = 7,   // start index of this page's levels in def_out/rep_out
+  PC_RUNS = 8,      // first hybrid run index (route 1)
+  PC_RUNE = 9,
+  PC_PACKS = 10,    // packed_out byte range of this page's bit-packed payloads
+  PC_PACKE = 11,
+  PC_MINIS = 12,    // first delta miniblock entry (route 2)
+  PC_MINIE = 13,
+  PC_DSTART = 14,   // delta_out byte offset of this page's stream
+  PC_DCONS = 15,    // bytes of delta stream consumed
+  PC_EXTRA = 16,    // route 1: dict index bit width; route 2: stream total
+  PC_DFIRST = 17,   // route 2: first value (uint64 bit pattern)
+};
+#define PT_COLS 18
+
+// Returns n_pages >= 0 on success. Negative: -1 corrupt/unsupported (caller
+// falls back to the Python walk for exact errors), -2 page table full,
+// -3 hybrid run table full, -4 delta miniblock table full, -5 level/value
+// capacity exceeded (metadata understated the chunk).
+ssize_t ptq_chunk_prepare(
+    const uint8_t* src, size_t src_len,
+    int codec,               // 0 UNCOMPRESSED, 1 SNAPPY, 2 GZIP
+    int max_def, int max_rep,
+    int type_size,           // PLAIN itemsize for numeric types, else 0
+    int delta_nbits,         // 32/64 when delta-bp is device-eligible, else 0
+    int64_t expected_values, // level buffer capacity (metadata num_values)
+    int64_t* pages, size_t max_pages,
+    uint16_t* def_out, uint16_t* rep_out,
+    uint8_t* values_out, size_t values_cap,
+    uint8_t* packed_out, size_t packed_cap,
+    uint8_t* delta_out, size_t delta_cap,
+    uint8_t* scratch, size_t scratch_cap,
+    uint8_t* h_is_rle, int64_t* h_counts, uint64_t* h_values,
+    int64_t* h_byteoff, size_t max_runs,
+    uint32_t* d_widths, int64_t* d_bytestart, int32_t* d_outstart,
+    uint64_t* d_mins, size_t max_minis,
+    int64_t* totals /* [8]: lvl_total, values_used, packed_used, delta_used,
+                       runs, minis, has_dict, reserved */) {
+  size_t pos = 0;
+  size_t n_pages = 0;
+  int64_t lvl_total = 0;
+  size_t values_used = 0, packed_used = 0, delta_used = 0;
+  size_t runs = 0, minis = 0;
+  bool has_dict = false;
+  int64_t slots[23];
+
+  while (pos < src_len) {
+    ssize_t hrc = ptq_parse_page_header(src + pos, src_len - pos, slots);
+    if (hrc != 0) return -1;  // truncated-within-chunk IS corrupt here
+    size_t hlen = static_cast<size_t>(slots[0]);
+    int64_t psize = slots[3];
+    if (psize < 0 || pos + hlen + static_cast<uint64_t>(psize) > src_len) return -1;
+    int64_t usize = slots[2] == INT64_MIN ? 0 : slots[2];
+    if (usize < 0) return -1;
+    const uint8_t* payload = src + pos + hlen;
+    size_t payload_len = static_cast<size_t>(psize);
+    pos += hlen + payload_len;
+    if (n_pages >= max_pages) return -2;
+    int64_t* P = pages + n_pages * PT_COLS;
+    std::memset(P, 0, PT_COLS * sizeof(int64_t));
+
+    int64_t ptype = slots[1];
+    if (ptype == 2) {  // DICTIONARY_PAGE
+      // Must be the FIRST page: later routes assume their values_out regions
+      // are contiguous, and a mid-chunk dict page would interleave. The spec
+      // puts it first; anything else takes the Python walk.
+      if (has_dict || n_pages != 0 || slots[10] != 1) return -1;
+      has_dict = true;
+      const uint8_t* block = payload;
+      size_t block_len = payload_len;
+      if (codec != 0) {
+        int rc = decompress_page(codec, payload, payload_len, scratch,
+                                 scratch_cap, static_cast<size_t>(usize));
+        if (rc != 0) return rc;
+        block = scratch;
+        block_len = static_cast<size_t>(usize);
+      }
+      if (values_used + block_len > values_cap) return -5;
+      std::memcpy(values_out + values_used, block, block_len);
+      P[PC_KIND] = 1;
+      P[PC_N] = slots[11] == INT64_MIN ? 0 : slots[11];  // dict num_values
+      P[PC_ENC] = slots[12] == INT64_MIN ? 0 : slots[12];
+      P[PC_VOFF] = static_cast<int64_t>(values_used);
+      P[PC_VLEN] = static_cast<int64_t>(block_len);
+      values_used += block_len;
+      n_pages++;
+      continue;
+    }
+    if (ptype == 1) {  // INDEX_PAGE: skipped (parity with the Python walk)
+      P[PC_KIND] = 2;
+      n_pages++;
+      continue;
+    }
+    if (ptype != 0 && ptype != 3) return -1;
+
+    // -- data page: levels ---------------------------------------------------
+    int64_t n, enc;
+    const uint8_t* vsrc;      // value stream start
+    size_t vlen;              // value stream length
+    int64_t non_null;
+    if (ptype == 0) {  // DATA_PAGE (V1): block = levels + values, compressed whole
+      if (slots[5] != 1) return -1;
+      n = slots[6] == INT64_MIN ? 0 : slots[6];
+      enc = slots[7] == INT64_MIN ? -1 : slots[7];
+      if (n < 0) return -1;
+      const uint8_t* block = payload;
+      size_t block_len = payload_len;
+      if (codec != 0) {
+        int rc = decompress_page(codec, payload, payload_len, scratch,
+                                 scratch_cap, static_cast<size_t>(usize));
+        if (rc != 0) return rc;
+        block = scratch;
+        block_len = static_cast<size_t>(usize);
+      }
+      size_t cur = 0;
+      if (lvl_total + n > expected_values) return -5;
+      if (max_rep > 0) {
+        if (block_len < cur + 4) return -1;
+        uint32_t sz;
+        std::memcpy(&sz, block + cur, 4);
+        if (cur + 4 + sz > block_len) return -1;
+        ssize_t used = decode_levels16(block + cur + 4, sz, n, max_rep,
+                                       rep_out + lvl_total, -1, nullptr);
+        if (used < 0) return -1;
+        cur += 4 + sz;
+      }
+      non_null = n;
+      if (max_def > 0) {
+        if (block_len < cur + 4) return -1;
+        uint32_t sz;
+        std::memcpy(&sz, block + cur, 4);
+        if (cur + 4 + sz > block_len) return -1;
+        int64_t eq = 0;
+        ssize_t used = decode_levels16(block + cur + 4, sz, n, max_def,
+                                       def_out + lvl_total, max_def, &eq);
+        if (used < 0) return -1;
+        cur += 4 + sz;
+        non_null = eq;
+      }
+      vsrc = block + cur;
+      vlen = block_len - cur;
+    } else {  // DATA_PAGE_V2: levels raw, values optionally compressed
+      if (slots[14] != 1) return -1;
+      n = slots[15] == INT64_MIN ? 0 : slots[15];
+      enc = slots[18] == INT64_MIN ? -1 : slots[18];
+      if (n < 0) return -1;
+      int64_t def_len = slots[19] == INT64_MIN ? 0 : slots[19];
+      int64_t rep_len = slots[20] == INT64_MIN ? 0 : slots[20];
+      int64_t is_comp = slots[21];  // absent -> compressed (parity: None => true)
+      if (def_len < 0 || rep_len < 0 ||
+          static_cast<uint64_t>(def_len) + static_cast<uint64_t>(rep_len) >
+              payload_len)
+        return -1;
+      if (lvl_total + n > expected_values) return -5;
+      if (max_rep > 0) {
+        if (decode_levels16(payload, static_cast<size_t>(rep_len), n, max_rep,
+                            rep_out + lvl_total, -1, nullptr) < 0)
+          return -1;
+      }
+      non_null = n;
+      if (max_def > 0) {
+        int64_t eq = 0;
+        if (decode_levels16(payload + rep_len, static_cast<size_t>(def_len), n,
+                            max_def, def_out + lvl_total, max_def, &eq) < 0)
+          return -1;
+        non_null = eq;
+      }
+      const uint8_t* vreg = payload + rep_len + def_len;
+      size_t vreg_len = payload_len - static_cast<size_t>(rep_len + def_len);
+      if (codec != 0 && (is_comp == INT64_MIN || is_comp != 0)) {
+        int64_t vexpect = usize - rep_len - def_len;
+        if (vexpect < 0) vexpect = 0;
+        int rc = decompress_page(codec, vreg, vreg_len, scratch, scratch_cap,
+                                 static_cast<size_t>(vexpect));
+        if (rc != 0) return rc;
+        vsrc = scratch;
+        vlen = static_cast<size_t>(vexpect);
+      } else {
+        vsrc = vreg;
+        vlen = vreg_len;
+      }
+    }
+
+    P[PC_KIND] = 0;
+    P[PC_N] = n;
+    P[PC_NONNULL] = non_null;
+    P[PC_ENC] = enc;
+    P[PC_LVLBASE] = lvl_total;
+    lvl_total += n;
+
+    // -- route the value stream ---------------------------------------------
+    if (enc == 8 || enc == 2) {  // RLE_DICTIONARY / PLAIN_DICTIONARY
+      if (!has_dict) return -1;
+      if (non_null == 0) {
+        P[PC_ROUTE] = 4;
+        n_pages++;
+        continue;
+      }
+      if (vlen < 1) return -1;
+      int width = vsrc[0];
+      if (width > 32) return -1;
+      const uint8_t* stream = vsrc + 1;
+      size_t stream_len = vlen - 1;
+      // Inline prescan: clamp counts so the page contributes exactly
+      // non_null outputs; copy bit-packed payloads (only) into packed_out so
+      // batch bit offsets are global (mirrors prescan_hybrid's compaction +
+      // _HybridBatch.add_page's clamping in one pass).
+      const size_t vbytes = (width + 7) / 8;
+      size_t spos = 0;
+      int64_t produced = 0;
+      size_t run0 = runs, pack0 = packed_used;
+      while (produced < non_null) {
+        uint64_t header = 0;
+        int shift = 0;
+        for (;;) {
+          if (spos >= stream_len || shift > 63) return -1;
+          uint8_t b = stream[spos++];
+          if (shift == 63 && (b & 0x7e)) return -1;
+          header |= static_cast<uint64_t>(b & 0x7f) << shift;
+          if (!(b & 0x80)) break;
+          shift += 7;
+        }
+        if (runs >= max_runs) return -3;
+        int64_t take;
+        if (header & 1) {
+          uint64_t groups = header >> 1;
+          if (groups == 0 || groups > (1ull << 40)) return -1;
+          uint64_t count = groups * 8;
+          uint64_t nbytes = groups * static_cast<uint64_t>(width);
+          if (spos + nbytes > stream_len) return -1;
+          take = non_null - produced;
+          if (static_cast<uint64_t>(take) > count) take = static_cast<int64_t>(count);
+          if (packed_used + nbytes > packed_cap) return -5;
+          std::memcpy(packed_out + packed_used, stream + spos, nbytes);
+          h_is_rle[runs] = 0;
+          h_counts[runs] = take;
+          h_values[runs] = 0;
+          h_byteoff[runs] = static_cast<int64_t>(packed_used);
+          packed_used += nbytes;
+          spos += nbytes;
+        } else {
+          uint64_t count = header >> 1;
+          if (count == 0 || count > (1ull << 40) || spos + vbytes > stream_len)
+            return -1;
+          uint64_t v = 0;
+          for (size_t i = 0; i < vbytes; i++)
+            v |= static_cast<uint64_t>(stream[spos + i]) << (8 * i);
+          if (width < 64 && v >= (1ull << width)) return -1;
+          spos += vbytes;
+          take = non_null - produced;
+          if (static_cast<uint64_t>(take) > count) take = static_cast<int64_t>(count);
+          h_is_rle[runs] = 1;
+          h_counts[runs] = take;
+          h_values[runs] = v;
+          h_byteoff[runs] = 0;
+        }
+        runs++;
+        produced += take;
+      }
+      P[PC_ROUTE] = 1;
+      P[PC_RUNS] = static_cast<int64_t>(run0);
+      P[PC_RUNE] = static_cast<int64_t>(runs);
+      P[PC_PACKS] = static_cast<int64_t>(pack0);
+      P[PC_PACKE] = static_cast<int64_t>(packed_used);
+      P[PC_EXTRA] = width;
+    } else if (enc == 5 && delta_nbits != 0) {  // DELTA_BINARY_PACKED
+      uint64_t first = 0;
+      int64_t total = 0, consumed = 0;
+      size_t mini0 = minis;
+      // prescan against max_minis - minis remaining slots
+      ssize_t m = ptq_prescan_delta_packed(
+          vsrc, vlen, delta_nbits, non_null, d_widths + minis,
+          d_bytestart + minis, d_outstart + minis, d_mins + minis,
+          max_minis - minis, &first, &total, &consumed);
+      if (m == -2) return -4;
+      if (m < 0) return -1;
+      // byte starts are relative to the page's stream: rebase into delta_out
+      if (delta_used + static_cast<size_t>(consumed) > delta_cap) return -5;
+      std::memcpy(delta_out + delta_used, vsrc, static_cast<size_t>(consumed));
+      for (ssize_t i = 0; i < m; i++)
+        d_bytestart[mini0 + i] += static_cast<int64_t>(delta_used);
+      P[PC_ROUTE] = 2;
+      P[PC_MINIS] = static_cast<int64_t>(mini0);
+      P[PC_MINIE] = static_cast<int64_t>(mini0 + m);
+      P[PC_DSTART] = static_cast<int64_t>(delta_used);
+      P[PC_DCONS] = consumed;
+      P[PC_EXTRA] = total;
+      P[PC_DFIRST] = static_cast<int64_t>(first);
+      delta_used += static_cast<size_t>(consumed);
+      minis += static_cast<size_t>(m);
+    } else if (enc == 0 && type_size > 0) {  // PLAIN numeric
+      size_t need = static_cast<size_t>(non_null) * type_size;
+      if (vlen < need) return -1;  // "plain payload too short"
+      if (values_used + need > values_cap) return -5;
+      std::memcpy(values_out + values_used, vsrc, need);
+      P[PC_ROUTE] = 3;
+      P[PC_VOFF] = static_cast<int64_t>(values_used);
+      P[PC_VLEN] = static_cast<int64_t>(need);
+      values_used += need;
+    } else {  // anything else: stream bytes for the Python host decoder
+      if (values_used + vlen > values_cap) return -5;
+      std::memcpy(values_out + values_used, vsrc, vlen);
+      P[PC_ROUTE] = 0;
+      P[PC_VOFF] = static_cast<int64_t>(values_used);
+      P[PC_VLEN] = static_cast<int64_t>(vlen);
+      values_used += vlen;
+    }
+    n_pages++;
+  }
+
+  totals[0] = lvl_total;
+  totals[1] = static_cast<int64_t>(values_used);
+  totals[2] = static_cast<int64_t>(packed_used);
+  totals[3] = static_cast<int64_t>(delta_used);
+  totals[4] = static_cast<int64_t>(runs);
+  totals[5] = static_cast<int64_t>(minis);
+  totals[6] = has_dict ? 1 : 0;
+  totals[7] = 0;
+  return static_cast<ssize_t>(n_pages);
 }
 
 }  // extern "C"

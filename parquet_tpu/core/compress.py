@@ -140,6 +140,14 @@ def codec_supported(codec: CompressionCodec) -> bool:
     return int(codec) in _REGISTRY
 
 
+def is_builtin_codec(codec) -> bool:
+    """True while `codec` still resolves to a stock implementation — the
+    native whole-chunk walk inlines UNCOMPRESSED/SNAPPY/GZIP and must stand
+    down when register_codec has overridden one of them."""
+    impl = _REGISTRY.get(int(codec))
+    return isinstance(impl, (_Uncompressed, _Gzip, _NativeSnappy, _PyArrowSnappy))
+
+
 def _get(codec) -> _Codec:
     impl = _REGISTRY.get(int(codec))
     if impl is None:

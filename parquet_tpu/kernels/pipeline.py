@@ -338,6 +338,10 @@ class _ChunkPlan:
         self.column = column
         self.expected = expected
         self.page_infos: list[tuple] = []  # (n, def, rep, kind, payload)
+        # whole-chunk level arrays from the native walk (page slices view
+        # them); when set, finalize/device_column skip the per-page concat
+        self.native_def: np.ndarray | None = None
+        self.native_rep: np.ndarray | None = None
         self.dictionary = None
         self.dict_dev = None
         self.dev_hybrid: list[jnp.ndarray] = []  # per batch, page order
@@ -443,8 +447,11 @@ class _ChunkPlan:
                 f"metadata says {self.expected}"
             )
         values = _concat_values(pages_values, column)
-        def_levels = np.concatenate(all_def) if all_def else None
-        rep_levels = np.concatenate(all_rep) if all_rep else None
+        if self.native_def is not None or self.native_rep is not None:
+            def_levels, rep_levels = self.native_def, self.native_rep
+        else:
+            def_levels = np.concatenate(all_def) if all_def else None
+            rep_levels = np.concatenate(all_rep) if all_rep else None
         return ChunkData(
             column=column,
             num_values=num_values_total,
@@ -462,10 +469,13 @@ class _ChunkPlan:
         device path doesn't cover (byte-array delta pages, booleans, ...)."""
         column = self.column
         kinds = {k for _, _, _, k, _ in self.page_infos if k != "empty"}
-        all_def = [d for _, d, _, _, _ in self.page_infos if d is not None]
-        all_rep = [r for _, _, r, _, _ in self.page_infos if r is not None]
-        def_levels = np.concatenate(all_def) if all_def else None
-        rep_levels = np.concatenate(all_rep) if all_rep else None
+        if self.native_def is not None or self.native_rep is not None:
+            def_levels, rep_levels = self.native_def, self.native_rep
+        else:
+            all_def = [d for _, d, _, _, _ in self.page_infos if d is not None]
+            all_rep = [r for _, _, r, _, _ in self.page_infos if r is not None]
+            def_levels = np.concatenate(all_def) if all_def else None
+            rep_levels = np.concatenate(all_rep) if all_rep else None
         n_total = sum(n for n, *_ in self.page_infos)
         out = DeviceColumn(
             num_values=n_total, def_levels=def_levels, rep_levels=rep_levels
@@ -539,6 +549,382 @@ def plan_chunk_tpu(
     ).dispatch_device()
 
 
+# Page-table column indices of the native whole-chunk walk (layout defined in
+# native/parquet_tpu_native.cc ptq_chunk_prepare).
+_PC_KIND, _PC_N, _PC_NONNULL, _PC_ENC, _PC_ROUTE = 0, 1, 2, 3, 4
+_PC_VOFF, _PC_VLEN, _PC_LVLBASE = 5, 6, 7
+_PC_RUNS, _PC_RUNE, _PC_PACKS, _PC_PACKE = 8, 9, 10, 11
+_PC_MINIS, _PC_MINIE, _PC_DSTART, _PC_DCONS = 12, 13, 14, 15
+_PC_EXTRA, _PC_DFIRST = 16, 17
+
+
+def _native_prepare(f, chunk, column, validate_crc, alloc, stats):
+    """Whole-chunk native prepare: ONE C call walks every page (header parse,
+    decompress, level decode, value prescan) and returns packed tables; batch
+    assembly is then a handful of vectorized NumPy ops instead of a per-page
+    Python loop (the dominant host cost — reference page walk:
+    chunk_reader.go:182-263). Returns a ready _ChunkPlan or None when the
+    chunk needs the Python walk (CRC validation, memory ceiling, non-builtin
+    codec, corrupt input — the Python path reproduces exact error semantics)."""
+    if validate_crc or alloc is not None:
+        return None
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is None or not lib.has_chunk_prepare:
+        return None
+    md = chunk.meta_data
+    codec = int(md.codec or 0)
+    from ..core.compress import is_builtin_codec
+
+    if codec not in (0, 1, 2) or not is_builtin_codec(codec):
+        return None
+    if codec == 1 and not lib.has_snappy:
+        return None
+    from ..core.chunk import chunk_byte_range
+
+    try:
+        offset, total = chunk_byte_range(chunk)
+    except Exception:
+        return None
+    f.seek(offset)
+    buf = f.read(total)
+    if len(buf) != total:
+        return None  # truncated: Python walk raises the exact error
+    ptype = column.type
+    np_dt = _NUMERIC_DTYPE.get(ptype)
+    type_size = np.dtype(np_dt).itemsize if np_dt is not None else 0
+    delta_nbits = 32 if ptype == Type.INT32 else (64 if ptype == Type.INT64 else 0)
+    expected = int(md.num_values or 0)
+    if expected < 0:
+        return None
+    res = lib.chunk_prepare(
+        buf,
+        codec,
+        column.max_def,
+        column.max_rep,
+        type_size,
+        delta_nbits,
+        expected,
+        int(md.total_uncompressed_size or 0),
+    )
+    if res is None:
+        return None
+    try:
+        return _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits)
+    except (PageError, ChunkError):
+        raise
+    except Exception:
+        return None  # unexpected table shape: let the Python walk decide
+
+
+def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
+    plan = _ChunkPlan(column, expected)
+    plan.stats = stats
+    pages = res["pages"].tolist()
+    values_buf = res["values"]
+    def_all = res["def"]
+    rep_all = res["rep"]
+    n_data = sum(1 for P in pages if P[_PC_KIND] == 0)
+    if stats is not None:
+        stats.pages += n_data
+    data_pages = []
+    for P in pages:
+        if P[_PC_KIND] == 1:  # dictionary page
+            from ..meta.parquet_types import DictionaryPageHeader, PageHeader
+
+            header = PageHeader(
+                type=int(PageType.DICTIONARY_PAGE),
+                dictionary_page_header=DictionaryPageHeader(
+                    num_values=P[_PC_N], encoding=P[_PC_ENC]
+                ),
+            )
+            block = memoryview(values_buf)[P[_PC_VOFF] : P[_PC_VOFF] + P[_PC_VLEN]]
+            plan.dictionary = decode_dict_page(header, block, column)
+        elif P[_PC_KIND] == 0:
+            data_pages.append(P)
+    if column.max_def > 0 and data_pages:
+        plan.native_def = def_all
+    if column.max_rep > 0 and data_pages:
+        plan.native_rep = rep_all
+
+    def _levels(P):
+        base, n = P[_PC_LVLBASE], P[_PC_N]
+        dfl = def_all[base : base + n] if column.max_def > 0 else None
+        rep = rep_all[base : base + n] if column.max_rep > 0 else None
+        return dfl, rep
+
+    routes = {P[_PC_ROUTE] for P in data_pages if P[_PC_ROUTE] != 4}
+
+    if routes == {3} or not routes:  # PLAIN numeric (and/or empty pages)
+        first = None
+        nbytes = 0
+        for P in data_pages:
+            dfl, rep = _levels(P)
+            if P[_PC_ROUTE] == 4:
+                plan.page_infos.append((P[_PC_N], dfl, rep, "empty", None))
+                continue
+            vals = np.frombuffer(
+                values_buf, dtype=np_dt, count=P[_PC_NONNULL], offset=P[_PC_VOFF]
+            )
+            plan.page_infos.append((P[_PC_N], dfl, rep, "values", vals))
+            if first is None:
+                first = P[_PC_VOFF]
+            nbytes += P[_PC_VLEN]
+        if first is not None and np_dt is not None:
+            # routes wrote values_out sequentially: one zero-copy view is the
+            # whole chunk's upload buffer (no per-page concatenation)
+            plan.plain_host = np.frombuffer(
+                values_buf, dtype=np_dt, count=nbytes // np.dtype(np_dt).itemsize,
+                offset=first,
+            )
+        return plan
+
+    if routes == {1}:  # dictionary-encoded chunk
+        frozen = _freeze_hybrid_from_tables(data_pages, res)
+        if frozen is not None:
+            plan.frozen_hybrid = frozen
+            for P in data_pages:
+                dfl, rep = _levels(P)
+                if P[_PC_ROUTE] == 4:
+                    plan.page_infos.append((P[_PC_N], dfl, rep, "empty", None))
+                else:
+                    plan.page_infos.append(
+                        (P[_PC_N], dfl, rep, "dict", P[_PC_NONNULL])
+                    )
+            return plan
+        # oversized page: fall through to the demote path below
+
+    if routes == {2} and all(
+        P[_PC_DCONS] * 8 <= _BATCH_BITS_CAP
+        for P in data_pages
+        if P[_PC_ROUTE] == 2
+    ):  # delta-bp chunk (an oversized page demotes the whole chunk, as below)
+        frozen = _freeze_delta_from_tables(data_pages, res, delta_nbits)
+        if frozen is not None:
+            plan.frozen_delta = frozen
+            for P in data_pages:
+                dfl, rep = _levels(P)
+                if P[_PC_ROUTE] == 4:
+                    plan.page_infos.append((P[_PC_N], dfl, rep, "empty", None))
+                else:
+                    plan.page_infos.append(
+                        (P[_PC_N], dfl, rep, "delta", P[_PC_EXTRA])
+                    )
+            return plan
+
+    # Mixed-route chunk (or an oversized device page): host-decode in place,
+    # same policy as _commit_routes — device decode only pays when the whole
+    # chunk stays on device.
+    from ..core.page import _decode_values
+
+    dict_size = len(plan.dictionary) if plan.dictionary is not None else None
+    for P in data_pages:
+        dfl, rep = _levels(P)
+        route = P[_PC_ROUTE]
+        if route == 4:
+            plan.page_infos.append((P[_PC_N], dfl, rep, "empty", None))
+            continue
+        if route == 1:
+            idx = _expand_dict_from_tables(P, res)
+            plan.page_infos.append((P[_PC_N], dfl, rep, "indices", idx))
+            if stats is not None:
+                stats.host_fallback_pages += 1
+        elif route == 2:
+            from ..ops.delta import decode_delta
+
+            stream = res["delta_stream"][
+                P[_PC_DSTART] : P[_PC_DSTART] + P[_PC_DCONS]
+            ]
+            vals, _ = decode_delta(
+                memoryview(stream), delta_nbits, max_total=P[_PC_NONNULL]
+            )
+            plan.page_infos.append(
+                (P[_PC_N], dfl, rep, "values", vals[: P[_PC_NONNULL]])
+            )
+            if stats is not None:
+                stats.host_fallback_pages += 1
+        elif route == 3:
+            vals = np.frombuffer(
+                values_buf, dtype=np_dt, count=P[_PC_NONNULL], offset=P[_PC_VOFF]
+            )
+            plan.page_infos.append((P[_PC_N], dfl, rep, "values", vals))
+        else:  # route 0: host decoder on the raw stream
+            stream = memoryview(values_buf)[P[_PC_VOFF] : P[_PC_VOFF] + P[_PC_VLEN]]
+            values, indices = _decode_values(
+                stream, P[_PC_NONNULL], P[_PC_ENC], column, dict_size
+            )
+            if indices is not None:
+                plan.page_infos.append((P[_PC_N], dfl, rep, "indices", indices))
+            else:
+                plan.page_infos.append((P[_PC_N], dfl, rep, "values", values))
+            if stats is not None:
+                stats.host_fallback_pages += 1
+    kinds_after = {k for _, _, _, k, _ in plan.page_infos}
+    kinds_after.discard("empty")
+    if kinds_after == {"values"} and column.type in _NUMERIC_DTYPE:
+        parts = [p for _, _, _, k, p in plan.page_infos if k == "values"]
+        if parts:
+            plan.plain_host = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    return plan
+
+
+def _freeze_hybrid_from_tables(data_pages, res) -> list | None:
+    """Vectorized _HybridBatch.freeze over the native walk's global run
+    tables. Pages group sequentially per index width under the bit cap (same
+    policy as _commit_routes); returns None when a single page exceeds the
+    cap (demote-all, matching the Python walk)."""
+    cap = _BATCH_BITS_CAP
+    groups: list[list] = []  # [width, rs, re, ps, pe, bits]
+    cur = None
+    for P in data_pages:
+        if P[_PC_ROUTE] != 1:
+            continue
+        width = P[_PC_EXTRA]
+        bits = (P[_PC_PACKE] - P[_PC_PACKS]) * 8
+        if bits > cap:
+            return None
+        if cur is None or cur[0] != width or cur[5] + bits > cap:
+            cur = [width, P[_PC_RUNS], P[_PC_RUNE], P[_PC_PACKS], P[_PC_PACKE], bits]
+            groups.append(cur)
+        else:
+            cur[2] = P[_PC_RUNE]
+            cur[4] = P[_PC_PACKE]
+            cur[5] += bits
+    frozen = []
+    h_counts = res["h_counts"]
+    h_is_rle = res["h_is_rle"]
+    h_values = res["h_values"]
+    h_byteoff = res["h_byteoff"]
+    packed_all = res["packed"]
+    for width, rs, re, ps, pe, _bits in groups:
+        counts = h_counts[rs:re]
+        k = len(counts)
+        total = int(counts.sum())
+        n_pad = _bucket(max(total, 1))
+        run_pad = _bucket(k, 64)
+        words = bytes_to_words32(bytes(packed_all[ps:pe]))
+        w_pad = _bucket(len(words), 1024)
+        buf = np.zeros(4 * run_pad + w_pad, dtype=np.uint32)
+        buf[run_pad : 2 * run_pad] = np.int32(n_pad + 1).view(np.uint32)  # sentinel
+        buf[:k] = h_is_rle[rs:re]
+        out_start = np.zeros(k, dtype=np.int64)
+        np.cumsum(counts[:-1], out=out_start[1:])
+        buf[run_pad : run_pad + k] = out_start.astype(np.int32).view(np.uint32)
+        buf[2 * run_pad : 2 * run_pad + k] = h_values[rs:re].astype(np.uint32)
+        buf[3 * run_pad : 3 * run_pad + k] = (
+            ((h_byteoff[rs:re] - ps) * 8).astype(np.int32).view(np.uint32)
+        )
+        buf[4 * run_pad : 4 * run_pad + len(words)] = words
+        frozen.append(_FrozenHybrid(buf, width, n_pad, run_pad, total))
+    return frozen
+
+
+def _freeze_delta_from_tables(data_pages, res, nbits: int) -> list:
+    """Vectorized _DeltaBatch.freeze over the native walk's global miniblock
+    tables (pages group sequentially under the bit cap)."""
+    cap = _BATCH_BITS_CAP
+    groups: list[list] = []  # [pages, ms, me, lo, hi, bits]
+    cur = None
+    for P in data_pages:
+        if P[_PC_ROUTE] != 2 or P[_PC_EXTRA] == 0:
+            continue  # empty streams contribute nothing (add_page parity)
+        bits = P[_PC_DCONS] * 8
+        if cur is None or cur[5] + bits > cap:
+            cur = [[P], P[_PC_MINIS], P[_PC_MINIE], P[_PC_DSTART],
+                   P[_PC_DSTART] + P[_PC_DCONS], bits]
+            groups.append(cur)
+        else:
+            cur[0].append(P)
+            cur[2] = P[_PC_MINIE]
+            cur[4] = P[_PC_DSTART] + P[_PC_DCONS]
+            cur[5] += bits
+    frozen = []
+    ud = np.uint32 if nbits == 32 else np.uint64
+    d_widths = res["d_widths"]
+    d_bytestart = res["d_bytestart"]
+    d_outstart = res["d_outstart"]
+    d_mins = res["d_mins"]
+    stream_all = res["delta_stream"]
+    for plist, ms, me, lo, hi, _bits in groups:
+        totals = np.array([P[_PC_EXTRA] for P in plist], dtype=np.int64)
+        bases = np.zeros(len(plist), dtype=np.int64)
+        np.cumsum(totals[:-1], out=bases[1:])
+        total = int(totals.sum())
+        minis_per_page = np.array(
+            [P[_PC_MINIE] - P[_PC_MINIS] for P in plist], dtype=np.int64
+        )
+        m = me - ms
+        n_pad = _bucket(total)
+        m_pad = _bucket(max(m, 1), 64)
+        p = len(plist)
+        p_pad = _bucket(p, 64)
+        sentinel = np.int32(n_pad + 1).view(np.uint32)
+        stream = bytes(stream_all[lo:hi])
+        words = bytes_to_words32(stream) if nbits == 32 else bytes_to_words64(stream)
+        w_pad = _bucket(len(words), 1024)
+        tail32 = (2 * m_pad + 2 * p_pad + w_pad) if nbits == 32 else 0
+        meta32 = np.zeros(3 * m_pad + p_pad + tail32, dtype=np.uint32)
+        meta32[2 * m_pad : 3 * m_pad] = sentinel
+        meta32[3 * m_pad : 3 * m_pad + p_pad] = sentinel
+        out_starts = d_outstart[ms:me].astype(np.int64) + np.repeat(
+            bases + 1, minis_per_page
+        )
+        if m:
+            meta32[:m] = d_widths[ms:me]
+            meta32[m_pad : m_pad + m] = (
+                ((d_bytestart[ms:me] - lo) * 8).astype(np.int32).view(np.uint32)
+            )
+            meta32[2 * m_pad : 2 * m_pad + m] = (
+                out_starts.astype(np.int32).view(np.uint32)
+            )
+        meta32[3 * m_pad : 3 * m_pad + p] = bases.astype(np.int32).view(np.uint32)
+        firsts = np.array([P[_PC_DFIRST] for P in plist], dtype=np.int64).view(
+            np.uint64
+        )
+        if nbits == 32:
+            base = 3 * m_pad + p_pad
+            if m:
+                meta32[base : base + m] = d_mins[ms:me].astype(ud)
+            meta32[base + m_pad : base + m_pad + p] = firsts.astype(ud)
+            meta32[base + m_pad + p_pad : base + m_pad + p_pad + len(words)] = words
+            wide = np.zeros(0, dtype=np.uint32)
+        else:
+            wide = np.zeros(m_pad + p_pad + w_pad, dtype=np.uint64)
+            if m:
+                wide[:m] = d_mins[ms:me]
+            wide[m_pad : m_pad + p] = firsts
+            wide[m_pad + p_pad : m_pad + p_pad + len(words)] = words
+        frozen.append(_FrozenDelta(meta32, wide, nbits, n_pad, m_pad, p_pad, total))
+    return frozen
+
+
+def _expand_dict_from_tables(P, res) -> np.ndarray:
+    """Host expansion of one dict page straight from the global run tables
+    (mirrors _host_decode_dict_page without re-prescanning the stream)."""
+    from ..ops.rle_hybrid import RunTable, expand_runs
+
+    rs, re, ps = P[_PC_RUNS], P[_PC_RUNE], P[_PC_PACKS]
+    width = P[_PC_EXTRA]
+    is_rle = res["h_is_rle"][rs:re].astype(bool)
+    counts = res["h_counts"][rs:re]
+    if len(counts) and not is_rle[-1] and width > 0:
+        # the native walk clamps the final run's count to the page's value
+        # count; expand_runs wants the FULL bit-packed count (its dense-unpack
+        # math needs multiples of 8) and clamps via `takes` itself
+        counts = counts.copy()
+        counts[-1] = ((P[_PC_PACKE] - int(res["h_byteoff"][re - 1])) // width) * 8
+    table = RunTable(
+        is_rle=is_rle,
+        counts=counts,
+        rle_values=res["h_values"][rs:re],
+        bp_offsets=res["h_byteoff"][rs:re] - ps,
+        packed=bytes(res["packed"][ps : P[_PC_PACKE]]),
+        consumed=0,
+    )
+    return expand_runs(table, P[_PC_NONNULL], width, np.uint32)
+
+
 def prepare_chunk_plan(
     f,
     chunk,
@@ -551,8 +937,13 @@ def prepare_chunk_plan(
 
     Touches no jax state, so it is safe to run on worker threads; the
     returned plan's batches go to the device via plan.dispatch_device() on
-    the dispatching thread.
+    the dispatching thread. The whole-chunk native walk handles the common
+    shapes in one C call; anything it declines takes the per-page Python
+    walk below (the error-semantics reference).
     """
+    plan = _native_prepare(f, chunk, column, validate_crc, alloc, stats)
+    if plan is not None:
+        return plan
     md = chunk.meta_data
     codec = md.codec or 0
     expected = md.num_values or 0

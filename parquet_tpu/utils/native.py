@@ -147,6 +147,20 @@ class NativeLib:
                 ctypes.c_size_t,
                 ctypes.c_void_p,
             ]
+        self.has_chunk_prepare = hasattr(lib, "ptq_chunk_prepare")
+        if self.has_chunk_prepare:
+            lib.ptq_chunk_prepare.restype = ctypes.c_ssize_t
+            lib.ptq_chunk_prepare.argtypes = (
+                [ctypes.c_void_p, ctypes.c_size_t]  # src
+                + [ctypes.c_int] * 5  # codec, max_def, max_rep, type_size, delta_nbits
+                + [ctypes.c_int64]  # expected_values
+                + [ctypes.c_void_p, ctypes.c_size_t]  # pages
+                + [ctypes.c_void_p, ctypes.c_void_p]  # def_out, rep_out
+                + [ctypes.c_void_p, ctypes.c_size_t] * 4  # values/packed/delta/scratch
+                + [ctypes.c_void_p] * 4 + [ctypes.c_size_t]  # hybrid tables
+                + [ctypes.c_void_p] * 4 + [ctypes.c_size_t]  # delta tables
+                + [ctypes.c_void_p]  # totals
+            )
 
     def snappy_compress(self, data) -> bytes:
         addr, n_in, _keep = _ptr(data)
@@ -305,6 +319,94 @@ class NativeLib:
                 int(consumed[0]),
             )
 
+
+    def chunk_prepare(
+        self,
+        data,
+        codec: int,
+        max_def: int,
+        max_rep: int,
+        type_size: int,
+        delta_nbits: int,
+        expected_values: int,
+        uncompressed_cap: int,
+    ):
+        """Whole-chunk prepare walk (ptq_chunk_prepare): one native call does
+        header parse + decompress + level decode + value-stream prescan for
+        every page. Returns a dict of packed tables, or None when the chunk
+        needs the Python walk (corrupt / unsupported / capacity-exceeded —
+        the Python path reproduces the exact error semantics)."""
+        import numpy as np
+
+        addr, n_in, _keep = _ptr(data)
+        cap = max(uncompressed_cap, n_in) + 64
+        lv = max(expected_values, 1)
+        max_pages, max_runs, max_minis = 1024, 4096, 4096
+        # output buffers sized from metadata; np.empty is virtual until touched
+        def_out = np.empty(lv, dtype=np.uint16) if max_def > 0 else np.empty(0, np.uint16)
+        rep_out = np.empty(lv, dtype=np.uint16) if max_rep > 0 else np.empty(0, np.uint16)
+        values_out = np.empty(cap, dtype=np.uint8)
+        packed_out = np.empty(cap, dtype=np.uint8)
+        delta_out = np.empty(cap, dtype=np.uint8) if delta_nbits else np.empty(0, np.uint8)
+        scratch = np.empty(cap, dtype=np.uint8)
+        totals = np.zeros(8, dtype=np.int64)
+        p = ctypes.c_void_p
+        while True:
+            pages = np.empty((max_pages, 18), dtype=np.int64)
+            h_is_rle = np.empty(max_runs, dtype=np.uint8)
+            h_counts = np.empty(max_runs, dtype=np.int64)
+            h_values = np.empty(max_runs, dtype=np.uint64)
+            h_byteoff = np.empty(max_runs, dtype=np.int64)
+            d_widths = np.empty(max_minis, dtype=np.uint32)
+            d_bytestart = np.empty(max_minis, dtype=np.int64)
+            d_outstart = np.empty(max_minis, dtype=np.int32)
+            d_mins = np.empty(max_minis, dtype=np.uint64)
+            rc = self._lib.ptq_chunk_prepare(
+                addr, n_in, codec, max_def, max_rep, type_size, delta_nbits,
+                expected_values,
+                pages.ctypes.data_as(p), max_pages,
+                def_out.ctypes.data_as(p), rep_out.ctypes.data_as(p),
+                values_out.ctypes.data_as(p), cap,
+                packed_out.ctypes.data_as(p), cap,
+                delta_out.ctypes.data_as(p), len(delta_out),
+                scratch.ctypes.data_as(p), cap,
+                h_is_rle.ctypes.data_as(p), h_counts.ctypes.data_as(p),
+                h_values.ctypes.data_as(p), h_byteoff.ctypes.data_as(p), max_runs,
+                d_widths.ctypes.data_as(p), d_bytestart.ctypes.data_as(p),
+                d_outstart.ctypes.data_as(p), d_mins.ctypes.data_as(p), max_minis,
+                totals.ctypes.data_as(p),
+            )
+            if rc == -2 and max_pages < (1 << 24):
+                max_pages *= 8
+                continue
+            if rc == -3 and max_runs < n_in + 8:
+                max_runs = min(max_runs * 8, n_in + 8)
+                continue
+            if rc == -4 and max_minis < n_in + 8:
+                max_minis = min(max_minis * 8, n_in + 8)
+                continue
+            if rc < 0:
+                return None
+            n = int(rc)
+            R = int(totals[4])
+            M = int(totals[5])
+            return {
+                "pages": pages[:n],
+                "def": def_out[: int(totals[0])] if max_def > 0 else None,
+                "rep": rep_out[: int(totals[0])] if max_rep > 0 else None,
+                "values": values_out[: int(totals[1])],
+                "packed": packed_out[: int(totals[2])],
+                "delta_stream": delta_out[: int(totals[3])],
+                "h_is_rle": h_is_rle[:R],
+                "h_counts": h_counts[:R],
+                "h_values": h_values[:R],
+                "h_byteoff": h_byteoff[:R],
+                "d_widths": d_widths[:M],
+                "d_bytestart": d_bytestart[:M],
+                "d_outstart": d_outstart[:M],
+                "d_mins": d_mins[:M],
+                "has_dict": bool(totals[6]),
+            }
 
     def prescan_delta_packed(self, data: bytes, nbits: int, max_total: int):
         """Header-only delta prescan. Returns (widths, byte_starts, out_starts,
