@@ -892,13 +892,41 @@ ssize_t decode_levels16(const uint8_t* src, size_t src_len, int64_t n,
       if (pos + nbytes > src_len) return -1;
       int64_t take = n - produced;
       if (static_cast<uint64_t>(take) > count) take = static_cast<int64_t>(count);
-      BitReader r;
-      br_init(&r, src + pos, nbytes);
-      for (int64_t i = 0; i < take; i++) {
-        uint64_t v = br_read(&r, width);
-        if (v > static_cast<uint64_t>(max_level)) return -1;
-        out[produced + i] = static_cast<uint16_t>(v);
-        eq += (static_cast<int>(v) == target);
+      if (width <= 4 && (8 % width) == 0) {
+        // levels are almost always width 1 or 2: unpack whole bytes instead
+        // of feeding a bit reader one value at a time (the nested-column
+        // hot loop — every leaf value decodes max_rep + max_def levels)
+        const int per = 8 / width;
+        const uint16_t mask = static_cast<uint16_t>((1u << width) - 1);
+        const uint8_t* bp = src + pos;
+        uint16_t* op = out + produced;
+        int64_t full = take / per;
+        uint64_t bad = 0;
+        for (int64_t b = 0; b < full; b++) {
+          uint16_t byte = bp[b];
+          for (int j = 0; j < per; j++) {
+            uint16_t v = (byte >> (j * width)) & mask;
+            op[b * per + j] = v;
+            bad |= (v > max_level);
+            eq += (v == target);
+          }
+        }
+        for (int64_t i = full * per; i < take; i++) {
+          uint16_t v = (bp[i / per] >> ((i % per) * width)) & mask;
+          op[i] = v;
+          bad |= (v > max_level);
+          eq += (v == target);
+        }
+        if (bad) return -1;
+      } else {
+        BitReader r;
+        br_init(&r, src + pos, nbytes);
+        for (int64_t i = 0; i < take; i++) {
+          uint64_t v = br_read(&r, width);
+          if (v > static_cast<uint64_t>(max_level)) return -1;
+          out[produced + i] = static_cast<uint16_t>(v);
+          eq += (static_cast<int>(v) == target);
+        }
       }
       pos += nbytes;
       produced += take;

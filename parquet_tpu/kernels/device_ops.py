@@ -229,3 +229,89 @@ def delta_packed_decode_device(
 def dict_gather_device(dictionary: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
     """Dictionary expansion: one gather (reference: type_dict.go lookup loop)."""
     return dictionary[indices]
+
+
+@partial(jax.jit, static_argnames=("rows_pad",))
+def merge_mixed_numeric_device(
+    idx_all: jnp.ndarray,        # int32[D_pad]: dict-row indices, output order
+    dictionary: jnp.ndarray,     # dict values (uint bit patterns for floats)
+    plain: jnp.ndarray,          # plain values, page pools concatenated
+    page_kind: jnp.ndarray,      # int32[P_pad]: 1 dict page, 0 plain page
+    page_row_start: jnp.ndarray, # int32[P_pad + 1]: first output row per page
+    page_aux: jnp.ndarray,       # int32[P_pad]: base into idx_all / plain
+    rows_pad: int,
+) -> jnp.ndarray:
+    """Merge a mixed dict/PLAIN numeric chunk in output-index space: dict
+    rows gather through idx_all -> dictionary, PLAIN rows read their upload
+    directly — one fused program, one dispatch (a per-page slice/concat loop
+    costs one host->device dispatch per page over the transfer link). Rows
+    past the true count carry padding; the caller slices them off."""
+    rows = jnp.arange(rows_pad, dtype=jnp.int32)
+    pg = jnp.searchsorted(page_row_start[1:], rows, side="right").astype(jnp.int32)
+    pg = jnp.minimum(pg, page_kind.shape[0] - 1)
+    rel = rows - page_row_start[pg]
+    is_dict = page_kind[pg] == 1
+    src = jnp.clip(page_aux[pg] + rel, 0, None)
+    dv = dictionary[
+        jnp.clip(idx_all[jnp.minimum(src, idx_all.shape[0] - 1)], 0,
+                 dictionary.shape[0] - 1)
+    ]
+    pv = plain[jnp.minimum(src, plain.shape[0] - 1)]
+    return jnp.where(is_dict, dv, pv)
+
+
+@partial(jax.jit, static_argnames=("rows_pad", "total_bytes_pad"))
+def merge_mixed_bytes_device(
+    idx_all: jnp.ndarray,        # int32[D_pad]: dict-row indices, output order
+    doff: jnp.ndarray,           # int64[n_dict + 1]: dictionary offsets
+    src_data: jnp.ndarray,       # uint8: [dict payload | plain page pools]
+    po32: jnp.ndarray,           # int32[E_pad]: concatenated plain offset arrays
+    page_kind: jnp.ndarray,      # int32[P_pad]: 1 dict page, 0 plain page
+    page_row_start: jnp.ndarray, # int32[P_pad + 1]: first output row per page
+    page_aux: jnp.ndarray,       # int32[P_pad]: dict: base into idx_all;
+                                 #              plain: base ENTRY into po32
+    page_src_base: jnp.ndarray,  # int64[P_pad]: plain: pool byte base in src_data
+    n_rows: jnp.ndarray,         # int32 scalar: true row count (shape-free)
+    rows_pad: int,               # static bucketed row capacity
+    total_bytes_pad: int,        # static bucketed output byte capacity
+):
+    """Materialize a mixed dict/PLAIN byte-array chunk on device.
+
+    Dict pages contribute rows via index gather against the dictionary's
+    offsets; PLAIN pages contribute rows via their (int32-compressed) offset
+    arrays — only raw page bytes, int32 offsets and tiny per-page tables
+    ever cross the host->device link; the per-row source map, the offsets
+    cumsum and the final byte materialization are one fused device program.
+    Returns (data uint8[total_bytes_pad], offsets int64[rows_pad + 1]);
+    entries past n_rows and bytes past offsets[n_rows] are padding (static
+    shapes bound the compile count, SURVEY §7.1).
+    """
+    rows = jnp.arange(rows_pad, dtype=jnp.int32)
+    pg = jnp.searchsorted(page_row_start[1:], rows, side="right").astype(jnp.int32)
+    pg = jnp.minimum(pg, page_kind.shape[0] - 1)
+    rel = rows - page_row_start[pg]
+    is_dict = page_kind[pg] == 1
+    idx = idx_all[
+        jnp.clip(jnp.where(is_dict, page_aux[pg] + rel, 0), 0, idx_all.shape[0] - 1)
+    ]
+    idx = jnp.clip(idx, 0, doff.shape[0] - 2)
+    dstart = doff[idx]
+    dlen = doff[idx + 1] - doff[idx]
+    e = jnp.clip(jnp.where(is_dict, 0, page_aux[pg] + rel), 0, po32.shape[0] - 2)
+    p0 = po32[e].astype(jnp.int64)
+    p1 = po32[e + 1].astype(jnp.int64)
+    pstart = p0 + page_src_base[pg]
+    plen = p1 - p0
+    starts = jnp.where(is_dict, dstart, pstart)
+    lengths = jnp.where(rows < n_rows, jnp.where(is_dict, dlen, plen), 0)
+    lengths = jnp.maximum(lengths, 0)
+    off = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int64), jnp.cumsum(lengths, dtype=jnp.int64)]
+    )
+    pos = jnp.arange(total_bytes_pad, dtype=jnp.int64)
+    row = jnp.searchsorted(off[1:], pos, side="right")
+    row = jnp.minimum(row, rows_pad - 1)
+    src = starts[row] + (pos - off[row])
+    src = jnp.clip(src, 0, src_data.shape[0] - 1)
+    data = jnp.where(pos < off[-1], src_data[src], jnp.uint8(0))
+    return data, off

@@ -82,6 +82,66 @@ def _bucket(n: int, floor: int = 1024) -> int:
     return b
 
 
+def _pad_device(arr):
+    """Zero-pad a device array to its power-of-two bucket so kernels taking
+    it compile a bounded number of times (one device op; no host copy)."""
+    import jax.numpy as jnp
+
+    n = int(arr.shape[0])
+    pad = _bucket(max(n, 1)) - n
+    if pad:
+        arr = jnp.concatenate([arr, jnp.zeros(pad, dtype=arr.dtype)])
+    return arr
+
+
+def _page_merge_tables(page_infos, plain_entries):
+    """Padded per-page tables for the mixed-merge device kernels:
+    (page_kind, page_row_start, aux, n_rows). `plain_entries(payload)` maps a
+    'values' payload to (aux entries consumed, rows contributed)."""
+    kinds_t: list[int] = []
+    row_starts: list[int] = [0]
+    aux: list[int] = []
+    idx_base = plain_base = rowpos = 0
+    for _n, _d, _r, kind, payload in page_infos:
+        if kind == "dict":
+            kinds_t.append(1)
+            aux.append(idx_base)
+            idx_base += payload
+            rowpos += payload
+            row_starts.append(rowpos)
+        elif kind == "values":
+            adv, rows = plain_entries(payload)
+            kinds_t.append(0)
+            aux.append(plain_base)
+            plain_base += adv
+            rowpos += rows
+            row_starts.append(rowpos)
+    P = len(kinds_t)
+    P_pad = _bucket(max(P, 1), 16)
+    page_kind = np.zeros(P_pad, dtype=np.int32)
+    page_kind[:P] = kinds_t
+    prs = np.full(P_pad + 1, rowpos, dtype=np.int32)
+    prs[: P + 1] = row_starts
+    aux_np = np.zeros(P_pad, dtype=np.int32)
+    aux_np[:P] = aux
+    return page_kind, prs, aux_np, rowpos
+
+
+def _skewed_dict_bound(dictionary, dict_rows: int, plain_bytes: int):
+    """(padded byte bound, acceptable?) for the ragged byte merge: the output
+    pads to the worst-case dictionary entry per row, so a skewed dictionary
+    (one huge entry) must keep the host fallback — 4x the expected size or
+    64 MB, whichever is larger."""
+    dict_lens = np.diff(dictionary.offsets)
+    n_dict = len(dictionary.offsets) - 1
+    max_len = int(dict_lens.max()) if n_dict and dict_rows else 0
+    mean_len = float(dict_lens.mean()) if n_dict else 0.0
+    bound = plain_bytes + dict_rows * max_len
+    est = plain_bytes + int(dict_rows * mean_len) + 1
+    ok = bound < (1 << 31) and bound <= max(64 << 20, 4 * est)
+    return bound, ok
+
+
 class _FrozenHybrid(NamedTuple):
     """Upload-ready hybrid batch (built in prepare; dispatched by transfer)."""
 
@@ -517,6 +577,60 @@ class _ChunkPlan:
                 out.values = _upload_typed(host)
             return out
 
+        # Mixed dict+PLAIN numeric chunk (pyarrow's default 1MB dictionary
+        # ceiling makes this the common large-dictionary case): dict pages
+        # keep their device expansion+gather, PLAIN pages ride the raw
+        # upload, and one fused kernel merges both in output-index space —
+        # no value ever round-trips to the host.
+        if (
+            column.type in _NUMERIC_DTYPE
+            # DOUBLE excluded: the TPU x64 emulation can neither bitcast
+            # f64<->u64 (compile error) nor hold f64 bit-exactly, so mixed
+            # doubles take the host-merge fallback below (FLOAT is fine —
+            # u32 bitcasts are native)
+            and column.type != Type.DOUBLE
+            and kinds <= {"dict", "values", "empty"}
+            and "dict" in kinds
+            and self.dev_hybrid
+            and self.dict_dev is not None
+            and self.dev_plain is not None
+        ):
+            from .device_ops import merge_mixed_numeric_device
+
+            page_kind, prs, aux_np, n_rows = _page_merge_tables(
+                self.page_infos, lambda p: (len(p), len(p))
+            )
+            # merge in the uint bit-pattern domain; floats bitcast once after
+            plain_u = self.dev_plain
+            if plain_u.dtype.kind == "f":
+                plain_u = jax.lax.bitcast_convert_type(
+                    plain_u, jnp.uint32 if plain_u.dtype.itemsize == 4 else jnp.uint64
+                )
+            merged = merge_mixed_numeric_device(
+                _pad_device(self._dev_indices()),
+                _pad_device(self.dict_dev),
+                _pad_device(plain_u),
+                jnp.asarray(page_kind),
+                jnp.asarray(prs),
+                jnp.asarray(aux_np),
+                _bucket(max(n_rows, 1)),
+            )[:n_rows]
+            out.values = _device_bitcast(merged, column)
+            return out
+
+        # Mixed dict+PLAIN byte-array chunk (config-3 shape under pyarrow's
+        # default dictionary ceiling): dict pages ship indices + the (small)
+        # dictionary, PLAIN pages ship their raw bytes, and one ragged device
+        # gather materializes the merged (data, offsets) column in HBM.
+        if (
+            kinds <= {"dict", "values", "empty"}
+            and "dict" in kinds
+            and self.dev_hybrid
+            and isinstance(self.dictionary, ByteArrayData)
+            and self._merge_ragged_bytes(out)
+        ):
+            return out
+
         # Mixed, unsupported, or fully empty shapes: host decode, then upload.
         data = self.finalize()
         if isinstance(data.values, ByteArrayData):
@@ -526,6 +640,93 @@ class _ChunkPlan:
             out.values = _upload_typed(np.asarray(data.values))
         return out
 
+    def _dev_indices(self) -> jnp.ndarray:
+        """All dispatched dict-index batches as one int32 device array."""
+        return (
+            self.dev_hybrid[0]
+            if len(self.dev_hybrid) == 1
+            else jnp.concatenate(self.dev_hybrid)
+        ).astype(jnp.int32)
+
+    def _merge_ragged_bytes(self, out: DeviceColumn) -> bool:
+        """Device merge of a mixed dict/PLAIN byte-array chunk. Returns False
+        (leaving `out` untouched) when the shape is unsuitable — a skewed
+        dictionary whose max-length padding bound would blow HBM, or PLAIN
+        pages that did not decode to ByteArrayData.
+
+        Only raw page bytes, int32 plain-offset arrays and tiny per-page
+        tables cross the link; merge_mixed_bytes_device derives everything
+        else on device (the host baseline ships the fully-expanded column
+        plus int64 offsets — roughly 40%% more bytes for string data)."""
+        from .device_ops import merge_mixed_bytes_device
+
+        d = self.dictionary
+        dict_rows = plain_rows = plain_bytes = 0
+        for _n, _d, _r, kind, payload in self.page_infos:
+            if kind == "dict":
+                dict_rows += payload
+            elif kind == "values":
+                if not isinstance(payload, ByteArrayData):
+                    return False
+                plain_rows += len(payload.offsets) - 1
+                plain_bytes += len(payload.data)
+        bound, ok = _skewed_dict_bound(d, dict_rows, plain_bytes)
+        n_rows = dict_rows + plain_rows
+        if n_rows == 0 or not ok:
+            return False
+        if len(d.data) + plain_bytes >= (1 << 31):
+            return False  # int32 plain offsets would overflow
+        # -- compact host tables ----------------------------------------------
+        page_kind, prs, aux_np, _nr = _page_merge_tables(
+            self.page_infos, lambda p: (len(p.offsets), len(p.offsets) - 1)
+        )
+        P_pad = len(page_kind)
+        pools = [np.frombuffer(d.data, dtype=np.uint8)]
+        base = len(d.data)
+        po_parts: list[np.ndarray] = []
+        src_base: list[int] = []
+        for _n, _dl, _rl, kind, payload in self.page_infos:
+            if kind == "dict":
+                src_base.append(0)
+            elif kind == "values":
+                src_base.append(base)
+                po_parts.append(payload.offsets.astype(np.int32))
+                pools.append(np.frombuffer(payload.data, dtype=np.uint8))
+                base += len(payload.data)
+        srcb = np.zeros(P_pad, dtype=np.int64)
+        srcb[: len(src_base)] = src_base
+        po32 = np.concatenate(po_parts) if po_parts else np.zeros(2, dtype=np.int32)
+        E_pad = _bucket(len(po32), 1024)
+        po32p = np.zeros(E_pad, dtype=np.int32)
+        po32p[: len(po32)] = po32
+        pool = pools[0] if len(pools) == 1 else np.concatenate(pools)
+        S_pad = _bucket(max(len(pool), 1), 1024)
+        poolp = np.empty(S_pad, dtype=np.uint8)  # tail garbage is masked out
+        poolp[: len(pool)] = pool
+        doff_pad = _bucket(len(d.offsets), 1024)
+        doffp = np.empty(doff_pad, dtype=np.int64)
+        doffp[: len(d.offsets)] = d.offsets
+        doffp[len(d.offsets) :] = d.offsets[-1] if len(d.offsets) else 0
+        # -- device inputs -----------------------------------------------------
+        idx_all = _pad_device(self._dev_indices())
+        rows_pad = _bucket(n_rows, 1024)
+        data, off = merge_mixed_bytes_device(
+            idx_all,
+            jnp.asarray(doffp),
+            jnp.asarray(poolp),
+            jnp.asarray(po32p),
+            jnp.asarray(page_kind),
+            jnp.asarray(prs),
+            jnp.asarray(aux_np),
+            jnp.asarray(srcb),
+            jnp.int32(n_rows),
+            rows_pad,
+            _bucket(max(bound, 1)),
+        )
+        out.data = data
+        out.offsets = off[: n_rows + 1]
+        out.dictionary = d
+        return True
 
 # -- the chunk decoder ---------------------------------------------------------
 
@@ -680,18 +881,43 @@ def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
             )
         return plan
 
-    if routes == {1}:  # dictionary-encoded chunk
+    if routes == {1} or (
+        routes == {1, 3} and np_dt is not None and column.type != Type.DOUBLE
+        # DOUBLE mixed chunks can't merge on device (no f64<->u64 bitcast in
+        # the TPU x64 emulation); freezing their batches would only upload
+        # indices that finalize() fetches straight back — demote instead
+    ):
+        # Dictionary-encoded chunk, possibly with a mid-chunk fall-back to
+        # PLAIN pages (pyarrow's 1MB dictionary ceiling): dict pages build
+        # device run batches, PLAIN pages ride the contiguous raw upload,
+        # and device_column merges in page order.
         frozen = _freeze_hybrid_from_tables(data_pages, res)
         if frozen is not None:
             plan.frozen_hybrid = frozen
+            first = None
+            nbytes = 0
             for P in data_pages:
                 dfl, rep = _levels(P)
                 if P[_PC_ROUTE] == 4:
                     plan.page_infos.append((P[_PC_N], dfl, rep, "empty", None))
+                elif P[_PC_ROUTE] == 3:
+                    vals = np.frombuffer(
+                        values_buf, dtype=np_dt, count=P[_PC_NONNULL],
+                        offset=P[_PC_VOFF],
+                    )
+                    plan.page_infos.append((P[_PC_N], dfl, rep, "values", vals))
+                    if first is None:
+                        first = P[_PC_VOFF]
+                    nbytes += P[_PC_VLEN]
                 else:
                     plan.page_infos.append(
                         (P[_PC_N], dfl, rep, "dict", P[_PC_NONNULL])
                     )
+            if first is not None:
+                plan.plain_host = np.frombuffer(
+                    values_buf, dtype=np_dt,
+                    count=nbytes // np.dtype(np_dt).itemsize, offset=first,
+                )
             return plan
         # oversized page: fall through to the demote path below
 
@@ -711,6 +937,56 @@ def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
                     plan.page_infos.append(
                         (P[_PC_N], dfl, rep, "delta", P[_PC_EXTRA])
                     )
+            return plan
+
+    if (
+        column.type == Type.BYTE_ARRAY
+        and routes <= {0, 1}
+        and 1 in routes
+        and all(
+            P[_PC_ENC] == int(Encoding.PLAIN)
+            for P in data_pages
+            if P[_PC_ROUTE] == 0
+        )
+        and plan.dictionary is not None
+        and _skewed_dict_bound(
+            plan.dictionary,
+            sum(P[_PC_NONNULL] for P in data_pages if P[_PC_ROUTE] == 1),
+            # PLAIN stream length bounds the page's data bytes; close enough
+            # for the skew gate (the merge re-checks exactly)
+            sum(P[_PC_VLEN] for P in data_pages if P[_PC_ROUTE] == 0),
+        )[1]
+    ):
+        # Dict pages with a mid-chunk PLAIN byte-array fallback: dict index
+        # batches stay device-bound; PLAIN pages host-scan their offsets
+        # (native byte_array_gather) and device_column's ragged merge joins
+        # both in output-index space.
+        frozen = _freeze_hybrid_from_tables(data_pages, res)
+        if frozen is not None:
+            from ..core.page import _decode_values
+
+            plan.frozen_hybrid = frozen
+            dict_size = (
+                len(plan.dictionary) if plan.dictionary is not None else None
+            )
+            for P in data_pages:
+                dfl, rep = _levels(P)
+                if P[_PC_ROUTE] == 4:
+                    plan.page_infos.append((P[_PC_N], dfl, rep, "empty", None))
+                elif P[_PC_ROUTE] == 1:
+                    plan.page_infos.append(
+                        (P[_PC_N], dfl, rep, "dict", P[_PC_NONNULL])
+                    )
+                else:
+                    stream = memoryview(values_buf)[
+                        P[_PC_VOFF] : P[_PC_VOFF] + P[_PC_VLEN]
+                    ]
+                    values, _idx = _decode_values(
+                        stream, P[_PC_NONNULL], P[_PC_ENC], column, dict_size
+                    )
+                    plan.page_infos.append((P[_PC_N], dfl, rep, "values", values))
+                    if stats is not None:
+                        stats.host_fallback_pages += 1
             return plan
 
     # Mixed-route chunk (or an oversized device page): host-decode in place,

@@ -348,11 +348,14 @@ class TestDecodeToDevice:
                 assert stats.host_fallback_pages > 0, p
                 assert_chunks_identical(host[p], plan.finalize())
 
-    def test_mixed_chunk_demotes_to_host(self, tmp_path):
-        """A chunk that mixes dictionary-coded and PLAIN pages (pyarrow's
-        mid-chunk fallback when the dict page overflows) must decode fully on
-        host — no device batches whose results reassembly would have to fetch
-        back (the mixed-chunk round-trip regression)."""
+    def test_mixed_string_chunk_splits_on_device(self, tmp_path):
+        """A byte-array chunk mixing dictionary-coded and PLAIN pages
+        (pyarrow's mid-chunk fallback when the dict page overflows) keeps
+        the dict pages' index batches on device; PLAIN pages upload raw and
+        a ragged device gather merges both in output-index space. The
+        finalize (roundtrip) oracle stays byte-identical."""
+        import jax
+
         from parquet_tpu.kernels.pipeline import plan_chunk_tpu
 
         rng = np.random.default_rng(3)
@@ -373,8 +376,57 @@ class TestDecodeToDevice:
                     f"dictionary_pagesize_limit (kinds={kinds}); regression "
                     "guard needs a new trigger"
                 )
-            assert not plan.dev_hybrid and not plan.dev_delta
-            assert_chunks_identical(host[p], plan.finalize())
+            assert plan.dev_hybrid  # dict pages device-bound, not demoted
+            dc = plan.device_column()
+            assert isinstance(dc.data, jax.Array) and isinstance(dc.offsets, jax.Array)
+            hv = host[p].values
+            off = np.asarray(dc.offsets)
+            np.testing.assert_array_equal(off, hv.offsets)
+            # data may carry padding past offsets[-1]; the extent must match
+            np.testing.assert_array_equal(
+                np.asarray(dc.data)[: off[-1]],
+                np.frombuffer(hv.data, dtype=np.uint8),
+            )
+        with FileReader(path, backend="tpu_roundtrip") as r:
+            assert_chunks_identical(host[p], r.read_row_group(0)[p])
+
+    def test_mixed_numeric_chunk_merges_on_device(self, tmp_path):
+        """A numeric chunk mixing dictionary pages with a mid-chunk PLAIN
+        fallback keeps dict pages on the device (expansion + gather) and
+        merges PLAIN pages in output-index order — no value round-trips to
+        the host (the split replacing the old demote-everything policy)."""
+        import jax
+
+        from parquet_tpu.kernels.pipeline import TpuDecodeStats, plan_chunk_tpu
+
+        rng = np.random.default_rng(11)
+        # mostly-unique int64s overflow a tiny dictionary page mid-chunk
+        t = pa.table({"x": pa.array(rng.integers(0, 1 << 60, 30_000).astype(np.int64))})
+        path = str(tmp_path / "mixnum.parquet")
+        pq.write_table(t, path, use_dictionary=["x"], dictionary_pagesize_limit=4096)
+        with FileReader(path, backend="host") as r:
+            host = r.read_row_group(0)
+        with FileReader(path) as r:
+            cc = r.row_group(0).columns[0]
+            p = tuple(cc.meta_data.path_in_schema)
+            stats = TpuDecodeStats()
+            plan = plan_chunk_tpu(r._f, cc, r.schema.column(p), stats=stats)
+            kinds = {k for _, _, _, k, _ in plan.page_infos if k != "empty"}
+            if kinds != {"dict", "values"}:
+                pytest.skip(
+                    "pyarrow no longer mixes page encodings under "
+                    f"dictionary_pagesize_limit (kinds={kinds})"
+                )
+            assert plan.dev_hybrid  # dict pages stayed on device
+            assert stats.host_fallback_pages == 0
+            dc = plan.device_column()
+            assert isinstance(dc.values, jax.Array)
+            np.testing.assert_array_equal(
+                np.asarray(dc.values), np.asarray(host[p].values)
+            )
+        # the roundtrip oracle agrees too
+        with FileReader(path, backend="tpu_roundtrip") as r:
+            assert_chunks_identical(host[p], r.read_row_group(0)[p])
 
     def test_values_live_on_device(self, tmp_path):
         import jax
