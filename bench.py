@@ -349,6 +349,76 @@ def _phase_matrix(cfg: int) -> None:
     print(json.dumps(out))
 
 
+def _phase_write() -> None:
+    """Write-path benchmark (matrix config "write"): rows/s writing the
+    headline-like 3-column table (dict-int64 + dict-string + delta-ts) with
+    our FileWriter vs pyarrow.write_table, both SNAPPY. Output is verified
+    by reading it back with pyarrow (cross-implementation) before timing."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.core.writer import FileWriter
+    from parquet_tpu.schema.dsl import parse_schema
+
+    rows = MATRIX_ROWS
+    rng = np.random.default_rng(99)
+    ints = rng.integers(0, 1000, rows).astype(np.int64)
+    keys = np.array([f"key_{i:05d}" for i in range(5000)])
+    strs = keys[rng.integers(0, len(keys), rows)]
+    ts = (1_600_000_000_000_000 + np.cumsum(rng.integers(0, 1000, rows))).astype(
+        np.int64
+    )
+    table = pa.table({"i": pa.array(ints), "s": pa.array(strs), "ts": pa.array(ts)})
+    schema = parse_schema(
+        "message m { required int64 i; required binary s (UTF8); "
+        "required int64 ts (TIMESTAMP_MICROS); }"
+    )
+    strs_l = strs.tolist()
+
+    def ours():
+        with FileWriter(
+            "/tmp/pqt_bench_write_ours.parquet",
+            schema,
+            codec="snappy",
+            column_encodings={"ts": "DELTA_BINARY_PACKED"},
+        ) as w:
+            w.write_column("i", ints)
+            w.write_column("s", strs_l)
+            w.write_column("ts", ts)
+
+    # correctness FIRST: pyarrow must read our output back identically
+    ours()
+    got = pq.read_table("/tmp/pqt_bench_write_ours.parquet")
+    assert got.column("i").to_pylist() == ints.tolist()
+    assert got.column("s").to_pylist() == strs_l
+    assert got.column("ts").cast(pa.int64()).to_pylist() == ts.tolist()
+    log("bench: write output verified by pyarrow readback ✓")
+
+    t_ours = timed(ours, REPEATS, "write ours", rows=rows)
+    t_pa = timed(
+        lambda: pq.write_table(
+            table, "/tmp/pqt_bench_write_pa.parquet", compression="snappy"
+        ),
+        REPEATS,
+        "write pyarrow",
+        rows=rows,
+    )
+    print(
+        json.dumps(
+            {
+                "config": "write",
+                "rows_s_ours": round(rows / t_ours, 1),
+                "rows_s_pyarrow": round(rows / t_pa, 1),
+                "vs_pyarrow": round(t_pa / t_ours, 3),
+                "written_MB": round(
+                    Path("/tmp/pqt_bench_write_ours.parquet").stat().st_size / 1e6, 1
+                ),
+                "readback_ok": True,
+            }
+        )
+    )
+
+
 def run_matrix() -> list:
     results = []
     for cfg in (1, 2, 3, 4, 5):
@@ -359,6 +429,12 @@ def run_matrix() -> list:
             results.append(r)
         else:
             log(f"bench: matrix config {cfg} FAILED")
+    r = _run_phase("write")
+    if r is not None:
+        log(f"bench: matrix config write: {json.dumps(r)}")
+        results.append(r)
+    else:
+        log("bench: write config FAILED")
     return results
 
 
@@ -573,6 +649,8 @@ if __name__ == "__main__":
         name = sys.argv[2]
         if name.startswith("matrix"):
             _phase_matrix(int(name[len("matrix") :]))
+        elif name == "write":
+            _phase_write()
         elif name == "verify":
             _phase_verify(build_file())
         else:

@@ -13,6 +13,7 @@
 // functions validate sizes before writing and return -1 on corrupt input.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <cstddef>
 #include <sys/types.h>  // ssize_t
@@ -1282,6 +1283,368 @@ ssize_t ptq_chunk_prepare(
   totals[6] = has_dict ? 1 : 0;
   totals[7] = 0;
   return static_cast<ssize_t>(n_pages);
+}
+
+// ---------------------------------------------------------------------------
+// Write-side encoders. Byte-identical to the NumPy reference encoders in
+// ops/rle_hybrid.py / ops/delta.py (the roundtrip + conformance suites are
+// the oracle); these exist because the encode loops were the write path's
+// dominant cost (reference hot loops: hybrid_encoder.go:55-70,
+// deltabp_encoder.go:58-115, chunk_writer.go:174-209).
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline bool put_uvarint(uint8_t* out, size_t cap, size_t* pos, uint64_t v) {
+  while (v >= 0x80) {
+    if (*pos >= cap) return false;
+    out[(*pos)++] = static_cast<uint8_t>(v | 0x80);
+    v >>= 7;
+  }
+  if (*pos >= cap) return false;
+  out[(*pos)++] = static_cast<uint8_t>(v);
+  return true;
+}
+
+inline bool put_zigzag(uint8_t* out, size_t cap, size_t* pos, int64_t v) {
+  uint64_t u = (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+  return put_uvarint(out, cap, pos, u);
+}
+
+struct BitWriter {
+  uint8_t* out;
+  size_t cap;
+  size_t pos;
+  unsigned __int128 acc;
+  int nbits;
+};
+
+inline void bw_init(BitWriter* w, uint8_t* out, size_t cap, size_t pos) {
+  w->out = out; w->cap = cap; w->pos = pos; w->acc = 0; w->nbits = 0;
+}
+
+inline bool bw_push(BitWriter* w, uint64_t v, int width) {
+  w->acc |= static_cast<unsigned __int128>(v) << w->nbits;
+  w->nbits += width;
+  while (w->nbits >= 8) {
+    if (w->pos >= w->cap) return false;
+    w->out[w->pos++] = static_cast<uint8_t>(w->acc);
+    w->acc >>= 8;
+    w->nbits -= 8;
+  }
+  return true;
+}
+
+inline bool bw_flush(BitWriter* w) {
+  if (w->nbits > 0) {
+    if (w->pos >= w->cap) return false;
+    w->out[w->pos++] = static_cast<uint8_t>(w->acc);
+    w->acc = 0;
+    w->nbits = 0;
+  }
+  return true;
+}
+
+// One bit-packed segment: header (groups<<1)|1 then LSB-first payload,
+// zero-padding the final partial group (mirrors _emit_bitpacked).
+bool emit_bitpacked(const uint64_t* v, int64_t n, int width, uint8_t* out,
+                    size_t cap, size_t* pos, bool* bad_value) {
+  if (n == 0) return true;
+  int64_t padded = (n + 7) & ~7ll;
+  if (!put_uvarint(out, cap, pos, ((static_cast<uint64_t>(padded) / 8) << 1) | 1))
+    return false;
+  BitWriter w;
+  bw_init(&w, out, cap, *pos);
+  for (int64_t i = 0; i < n; i++) {
+    if (width < 64 && (v[i] >> width)) { *bad_value = true; return false; }
+    if (!bw_push(&w, v[i], width)) return false;
+  }
+  for (int64_t i = n; i < padded; i++)
+    if (!bw_push(&w, 0, width)) return false;
+  if (!bw_flush(&w)) return false;
+  *pos = w.pos;
+  return true;
+}
+
+}  // namespace
+
+// Hybrid RLE/bit-pack encode of uint64 values at `width` bits. 8-aligned
+// stretches of >=8 identical values become RLE runs, everything else is
+// bit-packed in groups of 8 (mirrors ops/rle_hybrid.py encode_hybrid
+// byte-for-byte). Returns bytes written, -1 on a value that does not fit
+// the width, -2 if out_cap is too small.
+ssize_t ptq_hybrid_encode(const uint64_t* v, int64_t n, int width,
+                          uint8_t* out, size_t out_cap) {
+  if (width < 0 || width > 64 || n < 0) return -1;
+  size_t pos = 0;
+  if (n == 0) return 0;
+  if (width == 0) {
+    if (!put_uvarint(out, out_cap, &pos, static_cast<uint64_t>(n) << 1)) return -2;
+    return static_cast<ssize_t>(pos);
+  }
+  const int vbytes = (width + 7) / 8;
+  bool bad = false;
+  int64_t i = 0;
+  int64_t seg = 0;  // start of the pending bit-packed segment
+  while (i < n) {
+    int64_t j = i + 1;
+    const uint64_t cur = v[i];
+    while (j < n && v[j] == cur) j++;
+    if (j - i >= 8) {
+      // 8-align the RLE window so surrounding bit-packed segments stay
+      // multiples of 8 values (mid-stream padding would shift the stream)
+      int64_t rle_start = (i + 7) & ~7ll;
+      int64_t rle_end = j & ~7ll;
+      if (rle_end - rle_start >= 8) {
+        if (rle_start > seg &&
+            !emit_bitpacked(v + seg, rle_start - seg, width, out, out_cap,
+                            &pos, &bad))
+          return bad ? -1 : -2;
+        if (width < 64 && (cur >> width)) return -1;
+        if (!put_uvarint(out, out_cap, &pos,
+                         static_cast<uint64_t>(rle_end - rle_start) << 1))
+          return -2;
+        if (pos + vbytes > out_cap) return -2;
+        for (int b = 0; b < vbytes; b++)
+          out[pos++] = static_cast<uint8_t>(cur >> (8 * b));
+        seg = rle_end;
+      }
+    }
+    i = j;
+  }
+  if (seg < n &&
+      !emit_bitpacked(v + seg, n - seg, width, out, out_cap, &pos, &bad))
+    return bad ? -1 : -2;
+  return static_cast<ssize_t>(pos);
+}
+
+// DELTA_BINARY_PACKED encode (mirrors ops/delta.py encode_delta
+// byte-for-byte, including wrapping min-delta arithmetic and zero-width
+// trailing miniblocks). vals is int32[n] or int64[n] by nbits. Returns
+// bytes written, -1 bad args, -2 out_cap too small.
+ssize_t ptq_delta_encode(const void* vals, int64_t n, int nbits,
+                         int64_t block_size, int64_t mini_count,
+                         uint8_t* out, size_t out_cap) {
+  if (nbits != 32 && nbits != 64) return -1;
+  // mini_count capped at 512 like every decoder (and the widths[] buffer)
+  if (block_size <= 0 || mini_count <= 0 || mini_count > 512 ||
+      block_size % mini_count)
+    return -1;
+  const int64_t mini_len = block_size / mini_count;
+  if (mini_len % 8) return -1;
+  const uint64_t mask = (nbits == 64) ? ~0ull : ((1ull << nbits) - 1);
+  const int32_t* v32 = (nbits == 32) ? static_cast<const int32_t*>(vals) : nullptr;
+  const int64_t* v64 = (nbits == 64) ? static_cast<const int64_t*>(vals) : nullptr;
+  auto get = [&](int64_t i) -> uint64_t {
+    return (v32 ? static_cast<uint64_t>(static_cast<uint32_t>(v32[i]))
+                : static_cast<uint64_t>(v64[i])) & mask;
+  };
+  size_t pos = 0;
+  if (!put_uvarint(out, out_cap, &pos, static_cast<uint64_t>(block_size))) return -2;
+  if (!put_uvarint(out, out_cap, &pos, static_cast<uint64_t>(mini_count))) return -2;
+  if (!put_uvarint(out, out_cap, &pos, static_cast<uint64_t>(n))) return -2;
+  uint64_t first = n ? get(0) : 0;
+  int64_t sfirst = static_cast<int64_t>(first);
+  if (nbits < 64 && first >= (1ull << (nbits - 1)))
+    sfirst = static_cast<int64_t>(first) - (1ll << nbits);
+  if (!put_zigzag(out, out_cap, &pos, sfirst)) return -2;
+  if (n <= 1) return static_cast<ssize_t>(pos);
+
+  const int64_t n_deltas = n - 1;
+  for (int64_t bs = 0; bs < n_deltas; bs += block_size) {
+    int64_t blen = n_deltas - bs < block_size ? n_deltas - bs : block_size;
+    // signed min of the wrapping deltas
+    int64_t min_s;
+    uint64_t dmin_u = 0;
+    {
+      bool have = false;
+      min_s = 0;
+      for (int64_t k = 0; k < blen; k++) {
+        uint64_t d = (get(bs + k + 1) - get(bs + k)) & mask;
+        int64_t s = static_cast<int64_t>(d);
+        if (nbits < 64 && d >= (1ull << (nbits - 1)))
+          s = static_cast<int64_t>(d) - (1ll << nbits);
+        if (!have || s < min_s) { have = true; min_s = s; dmin_u = d; }
+      }
+    }
+    if (!put_zigzag(out, out_cap, &pos, min_s)) return -2;
+    // per-miniblock widths, then payloads
+    uint8_t widths[512];
+    size_t wpos = pos;
+    if (pos + static_cast<size_t>(mini_count) > out_cap) return -2;
+    pos += static_cast<size_t>(mini_count);
+    size_t payload_start = pos;
+    for (int64_t m = 0; m < mini_count; m++) {
+      int64_t mstart = m * mini_len;
+      int64_t mlen = blen - mstart;
+      if (mlen <= 0) { widths[m] = 0; continue; }
+      if (mlen > mini_len) mlen = mini_len;
+      uint64_t mx = 0;
+      for (int64_t k = 0; k < mlen; k++) {
+        uint64_t adj = ((get(bs + mstart + k + 1) - get(bs + mstart + k)) -
+                        dmin_u) & mask;
+        if (adj > mx) mx = adj;
+      }
+      int w = 0;
+      while (mx) { w++; mx >>= 1; }
+      widths[m] = static_cast<uint8_t>(w);
+      if (w == 0) continue;
+      BitWriter bw;
+      bw_init(&bw, out, out_cap, pos);
+      for (int64_t k = 0; k < mini_len; k++) {
+        uint64_t adj = 0;
+        if (k < mlen)
+          adj = ((get(bs + mstart + k + 1) - get(bs + mstart + k)) - dmin_u) &
+                mask;
+        if (!bw_push(&bw, adj, w)) return -2;
+      }
+      if (!bw_flush(&bw)) return -2;
+      pos = bw.pos;
+    }
+    (void)payload_start;
+    for (int64_t m = 0; m < mini_count; m++) out[wpos + m] = widths[m];
+  }
+  return static_cast<ssize_t>(pos);
+}
+
+// Dictionary build over an (offsets, data) byte-array column: open-addressed
+// FNV-1a hash, first-occurrence unique order (parity with the Python dict /
+// CPython-ext builders). Fills indices[n] and firsts[<=max_uniques+1] (row
+// of each unique's first occurrence). Returns the unique count, -2 when it
+// exceeds max_uniques (dictionary encoding does not pay), -1 bad input /
+// allocation failure.
+ssize_t ptq_bytes_dict_indices(const char* data, size_t data_len,
+                               const int64_t* offsets, int64_t n,
+                               int64_t max_uniques, uint32_t* indices,
+                               uint32_t* firsts) {
+  if (n < 0 || max_uniques < 0) return -1;
+  if (n == 0) return 0;
+  // table sized for the unique cap, not n: a high-cardinality column bails
+  // out early without a giant allocation
+  size_t want = static_cast<size_t>(
+      (max_uniques + 2) < n ? (max_uniques + 2) : n);
+  size_t tsize = 64;
+  while (tsize < want * 2) tsize <<= 1;
+  uint32_t* table = static_cast<uint32_t*>(malloc(tsize * sizeof(uint32_t)));
+  if (!table) return -1;
+  std::memset(table, 0xff, tsize * sizeof(uint32_t));  // 0xffffffff = empty
+  const size_t tmask = tsize - 1;
+  int64_t uniques = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t off = offsets[i];
+    int64_t len = offsets[i + 1] - off;
+    if (off < 0 || len < 0 || static_cast<size_t>(off + len) > data_len) {
+      free(table);
+      return -1;
+    }
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(data + off);
+    uint64_t h = 1469598103934665603ull;
+    for (int64_t b = 0; b < len; b++) h = (h ^ p[b]) * 1099511628211ull;
+    size_t slot = static_cast<size_t>(h) & tmask;
+    for (;;) {
+      uint32_t uid = table[slot];
+      if (uid == 0xffffffffu) {
+        if (uniques > max_uniques) {  // would assign id > max: doesn't pay
+          free(table);
+          return -2;
+        }
+        table[slot] = static_cast<uint32_t>(uniques);
+        firsts[uniques] = static_cast<uint32_t>(i);
+        indices[i] = static_cast<uint32_t>(uniques);
+        uniques++;
+        break;
+      }
+      int64_t fo = offsets[firsts[uid]];
+      int64_t flen = offsets[firsts[uid] + 1] - fo;
+      if (flen == len && std::memcmp(data + fo, data + off, len) == 0) {
+        indices[i] = uid;
+        break;
+      }
+      slot = (slot + 1) & tmask;
+    }
+  }
+  free(table);
+  return static_cast<ssize_t>(uniques);
+}
+
+// Lexicographic min/max over an (offsets, data) byte-array column.
+// out[0]/out[1] = row index of min/max. Returns 0, -1 on bad input / n == 0.
+ssize_t ptq_bytes_minmax(const char* data, size_t data_len,
+                         const int64_t* offsets, int64_t n, int64_t* out) {
+  if (n <= 0) return -1;
+  int64_t mn = 0, mx = 0;
+  for (int64_t i = 1; i < n; i++) {
+    int64_t io = offsets[i], il = offsets[i + 1] - io;
+    if (io < 0 || il < 0 || static_cast<size_t>(io + il) > data_len) return -1;
+    {
+      int64_t mo = offsets[mn], ml = offsets[mn + 1] - mo;
+      int64_t c = std::memcmp(data + io, data + mo, il < ml ? il : ml);
+      if (c < 0 || (c == 0 && il < ml)) mn = i;
+    }
+    {
+      int64_t mo = offsets[mx], ml = offsets[mx + 1] - mo;
+      int64_t c = std::memcmp(data + io, data + mo, il < ml ? il : ml);
+      if (c > 0 || (c == 0 && il > ml)) mx = i;
+    }
+  }
+  out[0] = mn;
+  out[1] = mx;
+  return 0;
+}
+
+// Dictionary probe over numeric bit patterns (NaN payloads dedup by bits).
+// elem_size selects uint32/uint64 elements so 32-bit columns probe their
+// buffer in place. Same contract as ptq_bytes_dict_indices: fills indices[n]
+// and firsts[<=max_uniques+1]; returns unique count, -2 over the cutoff
+// (early exit — no O(n log n) sort for high-cardinality columns), -1 error.
+ssize_t ptq_u64_dict_indices(const void* v_raw, int elem_size, int64_t n,
+                             int64_t max_uniques, uint32_t* indices,
+                             uint32_t* firsts) {
+  if (n < 0 || max_uniques < 0) return -1;
+  if (elem_size != 4 && elem_size != 8) return -1;
+  if (n == 0) return 0;
+  const uint32_t* v32 =
+      elem_size == 4 ? static_cast<const uint32_t*>(v_raw) : nullptr;
+  const uint64_t* v = elem_size == 8 ? static_cast<const uint64_t*>(v_raw) : nullptr;
+  auto at = [&](int64_t i) -> uint64_t {
+    return v ? v[i] : static_cast<uint64_t>(v32[i]);
+  };
+  size_t want = static_cast<size_t>(
+      (max_uniques + 2) < n ? (max_uniques + 2) : n);
+  size_t tsize = 64;
+  while (tsize < want * 2) tsize <<= 1;
+  uint32_t* table = static_cast<uint32_t*>(malloc(tsize * sizeof(uint32_t)));
+  if (!table) return -1;
+  std::memset(table, 0xff, tsize * sizeof(uint32_t));
+  const size_t tmask = tsize - 1;
+  int64_t uniques = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t x = at(i);
+    uint64_t h = x * 0x9e3779b97f4a7c15ull;
+    h ^= h >> 29;
+    size_t slot = static_cast<size_t>(h) & tmask;
+    for (;;) {
+      uint32_t uid = table[slot];
+      if (uid == 0xffffffffu) {
+        if (uniques > max_uniques) {
+          free(table);
+          return -2;
+        }
+        table[slot] = static_cast<uint32_t>(uniques);
+        firsts[uniques] = static_cast<uint32_t>(i);
+        indices[i] = static_cast<uint32_t>(uniques);
+        uniques++;
+        break;
+      }
+      if (at(firsts[uid]) == x) {
+        indices[i] = uid;
+        break;
+      }
+      slot = (slot + 1) & tmask;
+    }
+  }
+  free(table);
+  return static_cast<ssize_t>(uniques);
 }
 
 }  // extern "C"

@@ -194,6 +194,25 @@ class ColumnChunkBuilder:
         if n == 0:
             return None
         if isinstance(typed, ByteArrayData):
+            from ..utils.native import get_native
+
+            lib = get_native()
+            if lib is not None and lib.has_bytes_dict:
+                # C hash probe straight over (offsets, data) — no Python
+                # object per value (to_list was the dictionary build's
+                # dominant cost)
+                res = lib.bytes_dict_indices(
+                    typed.data, typed.offsets, DICT_MAX_UNIQUES
+                )
+                if res is None:
+                    return None  # more uniques than the cutoff: dict never pays
+                firsts, indices = res
+                dict_values = typed.take(firsts.astype(np.int64))
+                plain_size = len(typed.data) + 4 * n
+                dict_size = len(dict_values.data) + 4 * len(firsts) + n * 4
+                if dict_size >= plain_size:
+                    return None
+                return dict_values, indices
             if _ext is not None:
                 res = _ext.dict_indices(typed.to_list(cache=True), DICT_MAX_UNIQUES)
                 if res is None:
@@ -224,12 +243,27 @@ class ColumnChunkBuilder:
             # Bit-pattern uniqueness so NaN payloads dedup correctly
             # (reference CHANGELOG.md:31 NaN-in-dict fix).
             bits = typed.view(np.uint32 if typed.itemsize == 4 else np.uint64)
-            uniq_bits, inverse = np.unique(bits, return_inverse=True)
-            if len(uniq_bits) > DICT_MAX_UNIQUES:
-                return None
-            dict_values = uniq_bits.view(typed.dtype)
-            indices = inverse.astype(np.uint32)
-            width = max(int(len(uniq_bits) - 1).bit_length(), 1)
+            from ..utils.native import get_native
+
+            lib = get_native()
+            if lib is not None and lib.has_u64_dict:
+                # C hash probe with early exit past the cutoff — np.unique
+                # sorts the whole column before the cutoff check can fire,
+                # the worst cost exactly when dictionary encoding won't pay
+                res = lib.u64_dict_indices(bits, DICT_MAX_UNIQUES)
+                if res is None:
+                    return None
+                firsts, indices = res
+                dict_values = typed[firsts.astype(np.int64)]
+                uniq_count = len(firsts)
+            else:
+                uniq_bits, inverse = np.unique(bits, return_inverse=True)
+                if len(uniq_bits) > DICT_MAX_UNIQUES:
+                    return None
+                dict_values = uniq_bits.view(typed.dtype)
+                indices = inverse.astype(np.uint32)
+                uniq_count = len(uniq_bits)
+            width = max(int(uniq_count - 1).bit_length(), 1)
             plain_size = typed.nbytes
             dict_size = dict_values.nbytes + (n * width) // 8
         else:

@@ -59,13 +59,24 @@ def compute_statistics(ptype: Type, values, null_count: int) -> Statistics:
         st.max_value = bytes([int(arr.max())])
     elif ptype in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
         if isinstance(values, ByteArrayData):
-            items = values.to_list(cache=True)
-        elif isinstance(values, np.ndarray) and values.ndim == 2:
-            items = [v.tobytes() for v in values]
+            from ..utils.native import get_native
+
+            lib = get_native()
+            if lib is not None and lib.has_bytes_minmax:
+                # C scan over (offsets, data): no per-value Python object
+                i_mn, i_mx = lib.bytes_minmax(values.data, values.offsets)
+                mn, mx = values[i_mn], values[i_mx]
+            else:
+                items = values.to_list(cache=True)
+                mn = min(items)
+                mx = max(items)
         else:
-            items = [bytes(v) for v in values]
-        mn = min(items)
-        mx = max(items)
+            if isinstance(values, np.ndarray) and values.ndim == 2:
+                items = [v.tobytes() for v in values]
+            else:
+                items = [bytes(v) for v in values]
+            mn = min(items)
+            mx = max(items)
         if len(mn) <= _MAX_STAT_BYTES and len(mx) <= _MAX_STAT_BYTES:
             st.min_value = mn
             st.max_value = mx

@@ -542,12 +542,7 @@ class _ChunkPlan:
         )
 
         if kinds <= {"dict", "empty"} and self.dev_hybrid and self.dictionary is not None:
-            idx = (
-                self.dev_hybrid[0]
-                if len(self.dev_hybrid) == 1
-                else jnp.concatenate(self.dev_hybrid)
-            )
-            idx = idx.astype(jnp.int32)
+            idx = self._dev_indices()
             if isinstance(self.dictionary, ByteArrayData):
                 out.indices = idx
                 out.dictionary = self.dictionary

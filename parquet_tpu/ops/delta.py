@@ -308,6 +308,14 @@ def encode_delta(
     """Encode int32/int64 values as DELTA_BINARY_PACKED."""
     if nbits not in (32, 64):
         raise DeltaError(f"delta: unsupported type width {nbits}")
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is not None and lib.has_delta_encode and 0 < mini_count <= 512:
+        # byte-identical C encoder (pack_bits per miniblock dominated here);
+        # mini_count > 512 is undecodable by every reader anyway and takes
+        # the NumPy path
+        return lib.delta_encode(values, nbits, block_size, mini_count)
     mask = (1 << nbits) - 1
     udtype = np.uint32 if nbits == 32 else np.uint64
     sdtype = np.int32 if nbits == 32 else np.int64

@@ -270,6 +270,12 @@ def encode_hybrid(values, width: int) -> bytes:
         out = bytearray()
         _emit_uvarint(out, n << 1)
         return bytes(out)
+    from ..utils.native import get_native
+
+    lib = get_native()
+    if lib is not None and lib.has_hybrid_encode and 0 < width <= 64:
+        # byte-identical C encoder (the write path's hottest loop)
+        return lib.hybrid_encode(v.astype(np.uint64, copy=False), width)
     v64 = v.astype(np.uint64, copy=False)
     run_starts = np.nonzero(np.concatenate(([True], v64[1:] != v64[:-1])))[0]
     run_lengths = np.diff(np.append(run_starts, n))

@@ -147,6 +147,61 @@ class NativeLib:
                 ctypes.c_size_t,
                 ctypes.c_void_p,
             ]
+        self.has_hybrid_encode = hasattr(lib, "ptq_hybrid_encode")
+        if self.has_hybrid_encode:
+            lib.ptq_hybrid_encode.restype = ctypes.c_ssize_t
+            lib.ptq_hybrid_encode.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
+        self.has_delta_encode = hasattr(lib, "ptq_delta_encode")
+        if self.has_delta_encode:
+            lib.ptq_delta_encode.restype = ctypes.c_ssize_t
+            lib.ptq_delta_encode.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
+        self.has_bytes_dict = hasattr(lib, "ptq_bytes_dict_indices")
+        if self.has_bytes_dict:
+            lib.ptq_bytes_dict_indices.restype = ctypes.c_ssize_t
+            lib.ptq_bytes_dict_indices.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+        self.has_bytes_minmax = hasattr(lib, "ptq_bytes_minmax")
+        if self.has_bytes_minmax:
+            lib.ptq_bytes_minmax.restype = ctypes.c_ssize_t
+            lib.ptq_bytes_minmax.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+            ]
+        self.has_u64_dict = hasattr(lib, "ptq_u64_dict_indices")
+        if self.has_u64_dict:
+            lib.ptq_u64_dict_indices.restype = ctypes.c_ssize_t
+            lib.ptq_u64_dict_indices.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
         self.has_chunk_prepare = hasattr(lib, "ptq_chunk_prepare")
         if self.has_chunk_prepare:
             lib.ptq_chunk_prepare.restype = ctypes.c_ssize_t
@@ -407,6 +462,108 @@ class NativeLib:
                 "d_mins": d_mins[:M],
                 "has_dict": bool(totals[6]),
             }
+
+    def hybrid_encode(self, values, width: int) -> bytes:
+        """RLE/bit-pack hybrid encode of a uint64 array (byte-identical to
+        ops/rle_hybrid.py encode_hybrid)."""
+        import numpy as np
+
+        v = np.ascontiguousarray(values, dtype=np.uint64)
+        n = len(v)
+        vbytes = (width + 7) // 8
+        cap = 64 + (n // 8 + 2) * (5 + vbytes) + ((n + 7) // 8) * max(width, 1)
+        out = np.empty(cap, dtype=np.uint8)
+        rc = self._lib.ptq_hybrid_encode(
+            ctypes.c_void_p(v.ctypes.data), n, width,
+            ctypes.c_void_p(out.ctypes.data), cap,
+        )
+        if rc < 0:
+            raise ValueError(
+                f"native: hybrid encode failed ({'value too wide' if rc == -1 else 'capacity'})"
+            )
+        return out[: int(rc)].tobytes()
+
+    def delta_encode(self, values, nbits: int, block_size: int, mini_count: int) -> bytes:
+        """DELTA_BINARY_PACKED encode (byte-identical to ops/delta.py
+        encode_delta)."""
+        import numpy as np
+
+        dt = np.int32 if nbits == 32 else np.int64
+        v = np.ascontiguousarray(values, dtype=dt)
+        n = len(v)
+        # header + per-block (zigzag + widths) + payloads at worst full width
+        blocks = max(n // block_size + 2, 1)
+        cap = 64 + blocks * (10 + mini_count) + ((n + block_size) * nbits) // 8 + block_size
+        out = np.empty(cap, dtype=np.uint8)
+        rc = self._lib.ptq_delta_encode(
+            ctypes.c_void_p(v.ctypes.data), n, nbits, block_size, mini_count,
+            ctypes.c_void_p(out.ctypes.data), cap,
+        )
+        if rc < 0:
+            raise ValueError("native: delta encode failed")
+        return out[: int(rc)].tobytes()
+
+    def bytes_dict_indices(self, data, offsets, max_uniques: int):
+        """Dictionary probe over an (offsets, data) byte-array column.
+        Returns (first_occurrence_rows uint32[U], indices uint32[n]) or None
+        when uniques exceed max_uniques."""
+        import numpy as np
+
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        n = len(offsets) - 1
+        addr, data_len, _keep = _ptr(data)
+        indices = np.empty(max(n, 1), dtype=np.uint32)
+        firsts = np.empty(max_uniques + 2, dtype=np.uint32)
+        rc = self._lib.ptq_bytes_dict_indices(
+            addr, data_len,
+            ctypes.c_void_p(offsets.ctypes.data), n, max_uniques,
+            ctypes.c_void_p(indices.ctypes.data),
+            ctypes.c_void_p(firsts.ctypes.data),
+        )
+        if rc == -2:
+            return None
+        if rc < 0:
+            raise ValueError("native: byte-array dictionary probe failed")
+        return firsts[: int(rc)], indices[:n]
+
+    def bytes_minmax(self, data, offsets):
+        """(row of lexicographic min, row of max) over a byte-array column."""
+        import numpy as np
+
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        n = len(offsets) - 1
+        addr, data_len, _keep = _ptr(data)
+        out = np.empty(2, dtype=np.int64)
+        rc = self._lib.ptq_bytes_minmax(
+            addr, data_len, ctypes.c_void_p(offsets.ctypes.data), n,
+            ctypes.c_void_p(out.ctypes.data),
+        )
+        if rc < 0:
+            raise ValueError("native: byte-array minmax failed")
+        return int(out[0]), int(out[1])
+
+    def u64_dict_indices(self, bits, max_uniques: int):
+        """Dictionary probe over uint32/uint64 bit patterns (probed in place,
+        no widening copy); early-exits past the unique cutoff. Returns
+        (first_rows, indices) or None over the cap."""
+        import numpy as np
+
+        v = np.ascontiguousarray(bits)
+        if v.dtype not in (np.dtype(np.uint32), np.dtype(np.uint64)):
+            v = v.astype(np.uint64)
+        n = len(v)
+        indices = np.empty(max(n, 1), dtype=np.uint32)
+        firsts = np.empty(max_uniques + 2, dtype=np.uint32)
+        rc = self._lib.ptq_u64_dict_indices(
+            ctypes.c_void_p(v.ctypes.data), v.dtype.itemsize, n, max_uniques,
+            ctypes.c_void_p(indices.ctypes.data),
+            ctypes.c_void_p(firsts.ctypes.data),
+        )
+        if rc == -2:
+            return None
+        if rc < 0:
+            raise ValueError("native: u64 dictionary probe failed")
+        return firsts[: int(rc)], indices[:n]
 
     def prescan_delta_packed(self, data: bytes, nbits: int, max_total: int):
         """Header-only delta prescan. Returns (widths, byte_starts, out_starts,

@@ -458,6 +458,100 @@ class TestNativeParity:
         got, _ = native.delta_decode(enc, 64, len(v))
         assert np.array_equal(got, v)
 
+    def test_hybrid_encode_matches_numpy(self, native, numpy_only):
+        """The C hybrid encoder must be byte-identical to encode_hybrid:
+        same RLE run selection, same 8-alignment, same trailing padding."""
+        from parquet_tpu.ops.rle_hybrid import encode_hybrid
+
+        r = np.random.default_rng(13)
+        for trial in range(120):
+            w = int(r.integers(1, 33))
+            n = int(r.integers(0, 600))
+            style = trial % 4
+            if style == 0:
+                v = r.integers(0, 1 << w, n, dtype=np.uint64)
+            elif style == 1:  # one long run
+                v = np.full(n, int(r.integers(0, 1 << w)), dtype=np.uint64)
+            elif style == 2:  # short runs straddling 8-boundaries
+                reps = r.integers(0, 1 << w, max(n // 9, 1), dtype=np.uint64)
+                v = np.repeat(reps, 9)[:n]
+            else:
+                v = np.zeros(n, dtype=np.uint64)
+            ref = encode_hybrid(v, w)  # numpy path (native forced off)
+            assert native.hybrid_encode(v, w) == ref, (trial, w, n)
+
+    def test_delta_encode_matches_numpy(self, native, numpy_only):
+        from parquet_tpu.ops.delta import encode_delta as enc_py
+
+        r = np.random.default_rng(17)
+        for nbits, dt in ((32, np.int32), (64, np.int64)):
+            for n in (0, 1, 2, 100, 127, 128, 129, 513, 4096):
+                v = r.integers(np.iinfo(dt).min // 2, np.iinfo(dt).max // 2, n).astype(dt)
+                assert native.delta_encode(v, nbits, 128, 4) == enc_py(v, nbits)
+            # wrap-around deltas
+            v = np.array(
+                [np.iinfo(dt).min, np.iinfo(dt).max, 0, -1, 1], dtype=dt
+            )
+            assert native.delta_encode(v, nbits, 128, 4) == enc_py(v, nbits)
+
+    def test_delta_encode_exotic_mini_count_no_crash(self, native):
+        """mini_count > 512 exceeds every decoder's cap (and the C encoder's
+        widths buffer — a stack overflow before the guard): it must take the
+        NumPy path, not crash."""
+        from parquet_tpu.ops.delta import encode_delta as enc_py
+
+        v = np.arange(20_000, dtype=np.int64)
+        enc = enc_py(v, 64, block_size=8192, mini_count=1024)
+        assert len(enc) > 0
+        # and the C entry point itself rejects it instead of overflowing
+        import ctypes
+
+        out = np.empty(1 << 20, dtype=np.uint8)
+        rc = native._lib.ptq_delta_encode(
+            ctypes.c_void_p(v.ctypes.data), len(v), 64, 8192, 1024,
+            ctypes.c_void_p(out.ctypes.data), len(out),
+        )
+        assert rc == -1
+
+    def test_bytes_dict_probe_matches_python(self, native):
+        from parquet_tpu.core.arrays import ByteArrayData
+
+        r = np.random.default_rng(23)
+        for trial in range(20):
+            n = int(r.integers(0, 1500))
+            items = [f"v{int(x)}".encode() for x in r.integers(0, 60, n)]
+            ba = ByteArrayData.from_list(items)
+            firsts, indices = native.bytes_dict_indices(ba.data, ba.offsets, 32767)
+            uniq: dict = {}
+            for i, it in enumerate(items):
+                uniq.setdefault(it, len(uniq))
+            assert [items[f] for f in firsts] == list(uniq)
+            assert [int(i) for i in indices] == [uniq[it] for it in items]
+        # cutoff: more uniques than the cap returns None
+        items = [f"u{i}".encode() for i in range(40_000)]
+        ba = ByteArrayData.from_list(items)
+        assert native.bytes_dict_indices(ba.data, ba.offsets, 32767) is None
+
+    def test_u64_dict_probe_and_minmax(self, native):
+        r = np.random.default_rng(29)
+        v = r.integers(0, 50, 3000, dtype=np.uint64)
+        firsts, indices = native.u64_dict_indices(v, 32767)
+        uniq: dict = {}
+        for x in v.tolist():
+            uniq.setdefault(x, len(uniq))
+        assert [int(v[f]) for f in firsts] == list(uniq)
+        assert all(int(v[firsts[i]]) == int(x) for x, i in zip(v.tolist(), indices))
+        assert native.u64_dict_indices(
+            np.arange(40_000, dtype=np.uint64), 32767
+        ) is None
+        # byte-array lexicographic minmax incl. prefix ties
+        from parquet_tpu.core.arrays import ByteArrayData
+
+        items = [b"bb", b"b", b"ba", b"bbb", b"a", b"ab"]
+        ba = ByteArrayData.from_list(items)
+        i_mn, i_mx = native.bytes_minmax(ba.data, ba.offsets)
+        assert items[i_mn] == min(items) and items[i_mx] == max(items)
+
     def test_delta_rejects_oversized_claim(self, native):
         v = np.arange(100, dtype=np.int32)
         enc = encode_delta(v, 32)
