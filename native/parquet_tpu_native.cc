@@ -1572,6 +1572,9 @@ ssize_t ptq_bytes_dict_indices(const char* data, size_t data_len,
 ssize_t ptq_bytes_minmax(const char* data, size_t data_len,
                          const int64_t* offsets, int64_t n, int64_t* out) {
   if (n <= 0) return -1;
+  if (offsets[0] < 0 || offsets[1] < offsets[0] ||
+      static_cast<size_t>(offsets[1]) > data_len)
+    return -1;  // row 0 is the running min/max base: validate it up front
   int64_t mn = 0, mx = 0;
   for (int64_t i = 1; i < n; i++) {
     int64_t io = offsets[i], il = offsets[i + 1] - io;
@@ -1626,7 +1629,7 @@ ssize_t ptq_u64_dict_indices(const void* v_raw, int elem_size, int64_t n,
     for (;;) {
       uint32_t uid = table[slot];
       if (uid == 0xffffffffu) {
-        if (uniques > max_uniques) {
+        if (uniques >= max_uniques) {  // would exceed the cutoff: no dict
           free(table);
           return -2;
         }

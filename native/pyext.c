@@ -115,8 +115,8 @@ static PyObject *dict_indices(PyObject *self, PyObject *args) {
     }
     if (PyErr_Occurred()) goto fail; /* unhashable */
     Py_ssize_t next = PyList_GET_SIZE(uniques);
-    if (next > max_uniques) {
-      /* too many uniques: dictionary encoding does not pay */
+    if (next >= max_uniques) {
+      /* would exceed the cutoff: dictionary encoding does not pay */
       Py_DECREF(indices);
       Py_DECREF(table);
       Py_DECREF(uniques);
@@ -148,11 +148,223 @@ fail:
   return NULL;
 }
 
+/* rows_from_slices(elems_list, offsets_buf_int64, null_mask_or_None)
+ *   -> [elems[a:b] | None, ...]
+ *
+ * The per-row tail of the vectorized LIST/MAP assembly: one PyList_GetSlice
+ * per row instead of an interpreter-dispatched comprehension. offsets is a
+ * contiguous int64 buffer of n+1 entries; null_mask (optional) is a
+ * contiguous uint8/bool buffer of n entries — rows flagged there become None.
+ */
+static PyObject *rows_from_slices(PyObject *self, PyObject *args) {
+  PyObject *elems, *off_obj, *mask_obj;
+  if (!PyArg_ParseTuple(args, "O!OO", &PyList_Type, &elems, &off_obj, &mask_obj))
+    return NULL;
+  Py_buffer ob, mb;
+  mb.buf = NULL;
+  if (PyObject_GetBuffer(off_obj, &ob, PyBUF_CONTIG_RO) < 0) return NULL;
+  if (mask_obj != Py_None &&
+      PyObject_GetBuffer(mask_obj, &mb, PyBUF_CONTIG_RO) < 0) {
+    PyBuffer_Release(&ob);
+    return NULL;
+  }
+  Py_ssize_t n = (Py_ssize_t)(ob.len / 8) - 1;
+  const int64_t *off = (const int64_t *)ob.buf;
+  const uint8_t *mask = mb.buf ? (const uint8_t *)mb.buf : NULL;
+  Py_ssize_t ne = PyList_GET_SIZE(elems);
+  PyObject *out = NULL;
+  if (n < 0 || (mask && (Py_ssize_t)mb.len < n)) {
+    PyErr_SetString(PyExc_ValueError, "rows_from_slices: bad offsets/mask");
+    goto done;
+  }
+  out = PyList_New(n);
+  if (out == NULL) goto done;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (mask && mask[i]) {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(out, i, Py_None);
+      continue;
+    }
+    int64_t a = off[i], b = off[i + 1];
+    if (a < 0 || b < a || b > (int64_t)ne) {
+      Py_DECREF(out);
+      out = NULL;
+      PyErr_SetString(PyExc_ValueError, "rows_from_slices: offsets out of range");
+      goto done;
+    }
+    PyObject *s = PyList_GetSlice(elems, (Py_ssize_t)a, (Py_ssize_t)b);
+    if (s == NULL) {
+      Py_DECREF(out);
+      out = NULL;
+      goto done;
+    }
+    PyList_SET_ITEM(out, i, s);
+  }
+done:
+  PyBuffer_Release(&ob);
+  if (mb.buf) PyBuffer_Release(&mb);
+  return out;
+}
+
+/* dict_rows(names_tuple, columns_tuple) -> [ {name: col[i] ...}, ... ]
+ *
+ * The final zip of column value lists into row dicts (flat rows, structs,
+ * list<struct> elements): one PyDict_SetItem per cell at C speed. Each
+ * column is a value list, OR a ("slices", elems_list, offsets_buf,
+ * mask_or_None) spec that slices a LIST column's element values straight
+ * into the row dict (no intermediate per-row list-of-lists pass).
+ */
+#define COLK_LIST 0
+#define COLK_SLICES 1
+typedef struct {
+  int kind;
+  PyObject *list;      /* COLK_LIST: values; COLK_SLICES: elems */
+  const int64_t *off;  /* COLK_SLICES */
+  const uint8_t *mask; /* COLK_SLICES, may be NULL */
+  Py_buffer ob, mb;    /* held buffers to release */
+  int has_mb;
+} colspec;
+
+static PyObject *dict_rows(PyObject *self, PyObject *args) {
+  PyObject *names, *cols;
+  if (!PyArg_ParseTuple(args, "O!O!", &PyTuple_Type, &names, &PyTuple_Type,
+                        &cols))
+    return NULL;
+  Py_ssize_t k = PyTuple_GET_SIZE(names);
+  if (PyTuple_GET_SIZE(cols) != k) {
+    PyErr_SetString(PyExc_ValueError, "dict_rows: names/columns mismatch");
+    return NULL;
+  }
+  if (k > 256) {
+    PyErr_SetString(PyExc_ValueError, "dict_rows: too many columns");
+    return NULL;
+  }
+  colspec cs[256];
+  Py_ssize_t n = -1;
+  Py_ssize_t parsed = 0;
+  PyObject *out = NULL;
+  for (Py_ssize_t j = 0; j < k; j++, parsed++) {
+    PyObject *c = PyTuple_GET_ITEM(cols, j);
+    colspec *s = &cs[j];
+    s->has_mb = 0;
+    Py_ssize_t cn;
+    if (PyList_Check(c)) {
+      s->kind = COLK_LIST;
+      s->list = c;
+      cn = PyList_GET_SIZE(c);
+    } else if (PyTuple_Check(c) && PyTuple_GET_SIZE(c) == 4) {
+      s->kind = COLK_SLICES;
+      s->list = PyTuple_GET_ITEM(c, 1);
+      if (!PyList_Check(s->list)) {
+        PyErr_SetString(PyExc_TypeError, "dict_rows: slices elems must be a list");
+        goto fail;
+      }
+      if (PyObject_GetBuffer(PyTuple_GET_ITEM(c, 2), &s->ob, PyBUF_CONTIG_RO) < 0)
+        goto fail;
+      s->off = (const int64_t *)s->ob.buf;
+      cn = (Py_ssize_t)(s->ob.len / 8) - 1;
+      PyObject *m = PyTuple_GET_ITEM(c, 3);
+      s->mask = NULL;
+      if (m != Py_None) {
+        if (PyObject_GetBuffer(m, &s->mb, PyBUF_CONTIG_RO) < 0) {
+          PyBuffer_Release(&s->ob);
+          goto fail;
+        }
+        s->has_mb = 1;
+        if ((Py_ssize_t)s->mb.len < cn) {
+          PyErr_SetString(PyExc_ValueError, "dict_rows: mask too short");
+          parsed++;
+          goto fail;
+        }
+        s->mask = (const uint8_t *)s->mb.buf;
+      }
+      /* validate offsets once: monotone within elems bounds */
+      Py_ssize_t ne = PyList_GET_SIZE(s->list);
+      for (Py_ssize_t i = 0; i <= cn; i++) {
+        if (s->off[i] < 0 || s->off[i] > (int64_t)ne ||
+            (i && s->off[i] < s->off[i - 1])) {
+          PyErr_SetString(PyExc_ValueError, "dict_rows: offsets out of range");
+          parsed++;
+          goto fail;
+        }
+      }
+    } else {
+      PyErr_SetString(PyExc_TypeError,
+                      "dict_rows: column must be a list or slices spec");
+      goto fail;
+    }
+    if (n < 0)
+      n = cn;
+    else if (cn != n) {
+      PyErr_SetString(PyExc_ValueError, "dict_rows: column length mismatch");
+      parsed++;
+      goto fail;
+    }
+  }
+  if (n < 0) n = 0;
+  out = PyList_New(n);
+  if (out == NULL) goto fail;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = _PyDict_NewPresized(k);
+    if (d == NULL) goto fail_out;
+    for (Py_ssize_t j = 0; j < k; j++) {
+      colspec *s = &cs[j];
+      if (s->kind == COLK_LIST) {
+        if (PyDict_SetItem(d, PyTuple_GET_ITEM(names, j),
+                           PyList_GET_ITEM(s->list, i)) < 0) {
+          Py_DECREF(d);
+          goto fail_out;
+        }
+      } else {
+        PyObject *v;
+        if (s->mask && s->mask[i]) {
+          v = Py_None;
+          Py_INCREF(v);
+        } else {
+          v = PyList_GetSlice(s->list, (Py_ssize_t)s->off[i],
+                              (Py_ssize_t)s->off[i + 1]);
+          if (v == NULL) {
+            Py_DECREF(d);
+            goto fail_out;
+          }
+        }
+        int rc = PyDict_SetItem(d, PyTuple_GET_ITEM(names, j), v);
+        Py_DECREF(v);
+        if (rc < 0) {
+          Py_DECREF(d);
+          goto fail_out;
+        }
+      }
+    }
+    PyList_SET_ITEM(out, i, d);
+  }
+  for (Py_ssize_t j = 0; j < parsed; j++)
+    if (cs[j].kind == COLK_SLICES) {
+      PyBuffer_Release(&cs[j].ob);
+      if (cs[j].has_mb) PyBuffer_Release(&cs[j].mb);
+    }
+  return out;
+fail_out:
+  Py_DECREF(out);
+  out = NULL;
+fail:
+  for (Py_ssize_t j = 0; j < parsed; j++)
+    if (cs[j].kind == COLK_SLICES) {
+      PyBuffer_Release(&cs[j].ob);
+      if (cs[j].has_mb) PyBuffer_Release(&cs[j].mb);
+    }
+  return out;
+}
+
 static PyMethodDef methods[] = {
     {"encode_items", encode_items, METH_O,
      "encode_items(seq) -> (flat_bytes, int64le_lengths_bytes)"},
     {"dict_indices", dict_indices, METH_VARARGS,
      "dict_indices(seq, max_uniques) -> (uniques, u32le_indices_bytes) | None"},
+    {"rows_from_slices", rows_from_slices, METH_VARARGS,
+     "rows_from_slices(elems, offsets_i64, null_mask|None) -> list of slices"},
+    {"dict_rows", dict_rows, METH_VARARGS,
+     "dict_rows(names_tuple, columns_tuple) -> list of dicts"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native_ext",

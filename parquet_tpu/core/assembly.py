@@ -18,7 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
-from .arrays import ByteArrayData
+from .arrays import ByteArrayData, _ext
 from .chunk import ChunkData
 from .schema import Column, Schema
 
@@ -94,14 +94,10 @@ def _flat_column_values(node: Column, chunk: ChunkData, raw: bool) -> list:
     return vals
 
 
-def fast_flat_rows(chunks: dict[tuple, ChunkData], raw: bool):
-    """Vectorized row assembly for flat schemas (no groups, no repetition).
-
-    The recursive assembler costs ~14 us/row in Python; for the common flat
-    case rows are just per-column null-expansion + zip, which runs at C speed
-    via ndarray.tolist(). Returns None when the shape needs the full Dremel
-    walk.
-    """
+def _flat_columns(chunks: dict[tuple, ChunkData], raw: bool):
+    """(names, column value lists, n_rows) for flat schemas (no groups, no
+    repetition) — per-column null-expansion at C speed via ndarray.tolist().
+    None when the shape needs more than that."""
     cols = []
     for path, chunk in chunks.items():
         node = chunk.column
@@ -115,14 +111,22 @@ def fast_flat_rows(chunks: dict[tuple, ChunkData], raw: bool):
         elif n != chunk.num_values:
             return None
     if n is None:
+        return [], [], 0
+    names = [node.name for node, _ in cols]
+    return names, [_flat_column_values(node, chunk, raw) for node, chunk in cols], n
+
+
+def fast_flat_rows(chunks: dict[tuple, ChunkData], raw: bool):
+    """Vectorized row assembly for flat schemas (the recursive assembler
+    costs ~14 us/row; this is one zip at C speed). None when the shape needs
+    the full Dremel walk."""
+    fc = _flat_columns(chunks, raw)
+    if fc is None:
+        return None
+    names, columns, _n = fc
+    if not names:
         return []
-    columns_as_lists = [
-        (node.name, _flat_column_values(node, chunk, raw)) for node, chunk in cols
-    ]
-    names = [name for name, _ in columns_as_lists]
-    return [
-        dict(zip(names, row)) for row in zip(*(vals for _, vals in columns_as_lists))
-    ]
+    return _zip_dict_rows(names, columns)
 
 
 def _list_wrapper(top: Column):
@@ -175,22 +179,46 @@ def _list_column_values(top: Column, mid: Column, leaf: Column,
     vals = _leaf_python_values(leaf, chunk, raw)
     has_elem = dfl >= mid.max_def  # entry carries an element (maybe null)
     n_elem = int(has_elem.sum())
-    elems = np.empty(n_elem, dtype=object)  # initialized to None
-    is_val_within = (dfl[has_elem] == leaf.max_def) if mid is not leaf else None
     if mid is leaf:
-        elems[:] = vals
-    else:
-        if len(vals) != int(is_val_within.sum()):
+        if len(vals) != n_elem:
             raise AssemblyError(
                 f"assembly: {leaf.path_str}: {len(vals)} values for "
-                f"{int(is_val_within.sum())} present elements"
+                f"{n_elem} elements"
             )
-        elems[is_val_within] = vals
-    row_of = np.cumsum(rep == 0) - 1
-    counts = np.bincount(row_of[has_elem], minlength=n_rows)
+        elems = vals
+    else:
+        is_val_within = dfl[has_elem] == leaf.max_def
+        n_present = int(is_val_within.sum())
+        if len(vals) != n_present:
+            raise AssemblyError(
+                f"assembly: {leaf.path_str}: {len(vals)} values for "
+                f"{n_present} present elements"
+            )
+        if n_present == n_elem:
+            elems = vals  # no null elements: the value list IS the entry list
+        else:
+            full = np.empty(n_elem, dtype=object)  # initialized to None
+            full[is_val_within] = vals
+            elems = full.tolist()
+    # per-row element counts WITHOUT a full cumsum/bincount pass: a
+    # no-element marker (null/empty list) appears only as a row's single
+    # record, so count = segment length minus that one marker
+    seg_len = np.diff(np.append(row_start, len(rep)))
+    counts = seg_len - np.where(has_elem[row_start], 0, 1)
     offsets = np.zeros(n_rows + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
-    return _rows_from_entries(top, dfl[row_start], elems.tolist(), offsets)
+    if int(offsets[-1]) != n_elem:
+        raise AssemblyError(
+            f"assembly: {leaf.path_str}: inconsistent repetition levels"
+        )
+    first_def = dfl[row_start]
+    if _ext is not None:
+        # defer the per-row slicing: dict_rows slices elements straight into
+        # each row dict (one pass instead of slice-list + dict-zip)
+        all_present = top.max_def == 0 or bool((first_def >= top.max_def).all())
+        mask = None if all_present else (first_def < top.max_def).astype(np.uint8)
+        return ("slices", elems, offsets, mask)
+    return _rows_from_entries(top, first_def, elems, offsets)
 
 
 def _canonical_list_of_struct_nodes(top: Column, chunks) -> tuple | None:
@@ -244,7 +272,7 @@ def _list_of_struct_column_values(top: Column, mid: Column, elem: Column,
         full[present] = vals
         cols.append((leaf.name, full.tolist()))
     names = [name for name, _ in cols]
-    structs = [dict(zip(names, row)) for row in zip(*(v for _, v in cols))]
+    structs = _zip_dict_rows(names, [v for _, v in cols])
     # null struct elements (def between mid and elem thresholds)
     null_elem = ~elem_present[has_elem]
     if null_elem.any():
@@ -261,13 +289,49 @@ def _rows_from_entries(top: Column, first_def, elems_list: list, offsets) -> lis
     """Slice per-entry element values into per-row lists, applying null-row
     detection from the first entry's definition level (shared tail of the
     LIST / MAP / LIST<struct> vectorized paths)."""
+    all_present = top.max_def == 0 or bool((first_def >= top.max_def).all())
+    if _ext is not None:
+        mask = None if all_present else (first_def < top.max_def).astype(np.uint8)
+        return _ext.rows_from_slices(elems_list, np.ascontiguousarray(offsets), mask)
     off = offsets.tolist()
-    if top.max_def == 0 or bool((first_def >= top.max_def).all()):
+    if all_present:
         return [elems_list[a:b] for a, b in zip(off[:-1], off[1:])]
     null_row = (first_def < top.max_def).tolist()
     return [
         None if is_null else elems_list[a:b]
         for is_null, a, b in zip(null_row, off[:-1], off[1:])
+    ]
+
+
+def _col_len(col) -> int:
+    """Row count of a column value list or a deferred slices spec."""
+    if isinstance(col, tuple):
+        return len(col[2]) - 1
+    return len(col)
+
+
+def _zip_dict_rows(names: list, columns: list) -> list:
+    """Zip column value lists (or deferred slices specs, see
+    _list_column_values) into row dicts — C fast path when built; specs are
+    only produced when it is. Very wide tables (>256 columns, past the C
+    helper's stack table) take the Python zip."""
+    if _ext is not None and len(names) <= 256:
+        return _ext.dict_rows(tuple(names), tuple(columns))
+    columns = [
+        _rows_from_entries_spec(c) if isinstance(c, tuple) else c for c in columns
+    ]
+    return [dict(zip(names, row)) for row in zip(*columns)]
+
+
+def _rows_from_entries_spec(spec) -> list:
+    """Materialize a deferred ("slices", elems, offsets, mask) column."""
+    _tag, elems, offsets, mask = spec
+    off = offsets.tolist()
+    if mask is None:
+        return [elems[a:b] for a, b in zip(off[:-1], off[1:])]
+    return [
+        None if m else elems[a:b]
+        for m, a, b in zip(mask.tolist(), off[:-1], off[1:])
     ]
 
 
@@ -382,7 +446,7 @@ def _struct_column_values(top: Column, chunks, raw: bool):
             vals = full.tolist()
         cols.append((leaf.name, vals))
     names = [name for name, _ in cols]
-    rows = [dict(zip(names, row)) for row in zip(*(v for _, v in cols))]
+    rows = _zip_dict_rows(names, [v for _, v in cols])
     if top.max_def > 0:
         # struct is null where the def level sits below its own max_def
         null_mask = (first.def_levels < top.max_def).tolist()
@@ -390,21 +454,26 @@ def _struct_column_values(top: Column, chunks, raw: bool):
     return rows
 
 
-def fast_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
-    """Vectorized assembly for flat schemas plus canonical LIST-of-scalars
-    and MAP-of-scalars columns (the overwhelmingly common nested shapes).
-    Returns None when any column needs the full Dremel walk (deep nesting,
-    structs, non-compliant legacy maps, raw-mode nested columns — raw rows
-    carry the wire shape the vectorized path doesn't build)."""
-    flat = fast_flat_rows(chunks, raw)
-    if flat is not None:
-        return flat
+def fast_row_columns(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
+    """Column-oriented vectorized assembly for flat schemas plus canonical
+    LIST-of-scalars and MAP-of-scalars columns (the overwhelmingly common
+    nested shapes). Returns (names, columns, n_rows) where each column is a
+    row-aligned value list or a deferred ("slices", ...) spec (see
+    _list_column_values) that _zip_dict_rows materializes — callers may
+    window-slice columns to bound live row objects. None when any column
+    needs the full Dremel walk (deep nesting, structs, non-compliant legacy
+    maps, raw-mode nested columns — raw rows carry the wire shape the
+    vectorized path doesn't build)."""
+    flat_cols = _flat_columns(chunks, raw)
+    if flat_cols is not None:
+        names, columns, n = flat_cols
+        return names, columns, n
     if raw:
         return None
     by_top: dict[str, list] = {}
     for path in chunks:
         by_top.setdefault(path[0], []).append(path)
-    columns = []  # (name, python list of row values)
+    columns = []  # (name, value list | slices spec)
     n_rows = None
     for top in schema.root.children:
         paths = by_top.get(top.name)
@@ -440,15 +509,276 @@ def fast_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
                 return None
             columns.append((top.name, vals))
         if n_rows is None:
-            n_rows = len(columns[-1][1])
-        elif n_rows != len(columns[-1][1]):
+            n_rows = _col_len(columns[-1][1])
+        elif n_rows != _col_len(columns[-1][1]):
             return None  # inconsistent; let the assembler raise precisely
     if n_rows is None:
+        return [], [], 0
+    return [name for name, _ in columns], [vals for _, vals in columns], n_rows
+
+
+def slice_column(col, start: int, end: int):
+    """Row-window of a fast_row_columns column (list or slices spec)."""
+    if isinstance(col, tuple):
+        tag, elems, offsets, mask = col
+        return (tag, elems, offsets[start : end + 1],
+                None if mask is None else mask[start:end])
+    return col[start:end]
+
+
+def fast_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
+    """Vectorized row assembly (fast_row_columns + one zip). Returns None
+    when the shape needs the full Dremel walk."""
+    rc = fast_row_columns(schema, chunks, raw)
+    if rc is None:
+        return None
+    names, columns, n_rows = rc
+    if not names:
         return []
-    names = [name for name, _ in columns]
-    return [
-        dict(zip(names, row)) for row in zip(*(vals for _, vals in columns))
-    ]
+    return _zip_dict_rows(names, columns)
+
+
+# -- general level-vectorized assembly (arbitrary nesting) ---------------------
+#
+# The canonical fast paths above cover flat / LIST / MAP / struct /
+# LIST<struct> shapes; everything deeper used to drop into the per-row
+# RecordAssembler cursor walk (~10 us per element, pure Python). This
+# recursion assembles ARBITRARY nesting (struct-of-list, list-of-list,
+# map-of-struct, ...) from whole-column level math instead: every node
+# produces a value list at its own repetition "slot" granularity, repeated
+# children aggregate one level up via the same run-boundary math the
+# canonical paths use, and groups zip children at C speed. Any structural
+# inconsistency falls back to the RecordAssembler, which raises the precise
+# error (or proves the data fine).
+
+
+def _is_list_node(node: Column) -> bool:
+    ct = node.converted_type
+    lt = node.logical_type
+    return ct == ConvertedType.LIST or (lt is not None and lt.LIST is not None)
+
+
+def _is_map_node(node: Column) -> bool:
+    ct = node.converted_type
+    lt = node.logical_type
+    return ct in (ConvertedType.MAP, ConvertedType.MAP_KEY_VALUE) or (
+        lt is not None and lt.MAP is not None
+    )
+
+
+class _ShapeMismatch(Exception):
+    """Internal: the vectorized walk met a shape it can't prove; fall back."""
+
+
+def _node_values(node: Column, chunks, raw: bool):
+    """(values, defs, reps) at `node`'s slot granularity (one entry per
+    record at node.max_rep). values[i] is the assembled value assuming
+    ancestors are present — None where the node itself is null; garbage
+    (masked by ancestors) where an ancestor is null. defs/reps are the level
+    arrays of the node's first covered leaf (None when the column has no
+    def/rep dimension)."""
+    if node.is_leaf:
+        chunk = chunks.get(node.path)
+        if chunk is None:
+            raise _ShapeMismatch(node.path_str)
+        vals = _leaf_python_values(node, chunk, raw)
+        dfl = chunk.def_levels
+        rep = chunk.rep_levels
+        if node.max_def > 0 and dfl is not None:
+            present = dfl == node.max_def
+            n_present = int(present.sum())
+            if len(vals) != n_present:
+                raise AssemblyError(
+                    f"assembly: {node.path_str}: {len(vals)} values for "
+                    f"{n_present} present entries"
+                )
+            if n_present != len(dfl):
+                full = np.empty(len(dfl), dtype=object)
+                full[present] = vals
+                vals = full.tolist()
+        elif node.max_def > 0 and dfl is None:
+            raise _ShapeMismatch(node.path_str)
+        return vals, dfl, rep
+
+    if not raw and _is_list_node(node) and len(node.children) == 1:
+        mid = node.children[0]
+        if mid.repetition == FieldRepetitionType.REPEATED and _subtree_covered(mid, chunks):
+            if mid.is_leaf or len(mid.children) != 1:
+                ev, ed, er = _node_values(mid, chunks, raw)  # 2-level legacy
+            else:
+                inner = mid.children[0]
+                if inner.repetition == FieldRepetitionType.REPEATED:
+                    ev, ed, er = _aggregated_child(mid, inner, chunks, raw)
+                else:
+                    ev, ed, er = _node_values(inner, chunks, raw)  # unwrap
+            return _slots_to_lists(node, mid, ev, ed, er)
+
+    if not raw and _is_map_node(node) and len(node.children) == 1:
+        kv = node.children[0]
+        if (
+            kv.repetition == FieldRepetitionType.REPEATED
+            and not kv.is_leaf
+            and len(kv.children) == 2
+            and _subtree_covered(kv, chunks)
+        ):
+            ev, ed, er = _node_values(kv, chunks, raw)
+            pair_lists, defs, reps = _slots_to_lists(node, kv, ev, ed, er)
+            kname, vname = kv.children[0].name, kv.children[1].name
+            out = []
+            for pairs in pair_lists:
+                if pairs is None:
+                    out.append(None)
+                    continue
+                try:
+                    out.append(
+                        {p.get(kname): p.get(vname) for p in pairs}
+                    )
+                except TypeError:  # unhashable key: keep the pair list
+                    out.append(pairs)
+            return out, defs, reps
+
+    # generic group (also the raw-mode path: no unwrapping)
+    names = []
+    cols = []
+    base_d = base_r = None
+    n_slots = None
+    for c in node.children:
+        if not _subtree_covered(c, chunks):
+            continue
+        if c.repetition == FieldRepetitionType.REPEATED:
+            v, d, r = _aggregated_child(node, c, chunks, raw)
+        else:
+            v, d, r = _node_values(c, chunks, raw)
+        if n_slots is None:
+            n_slots = len(v)
+            base_d, base_r = d, r
+        elif len(v) != n_slots:
+            raise _ShapeMismatch(node.path_str)
+        names.append(c.name)
+        cols.append(v)
+    if n_slots is None:
+        raise _ShapeMismatch(node.path_str)
+    values = _zip_dict_rows(names, cols)
+    if (
+        node.repetition == FieldRepetitionType.OPTIONAL
+        and node.max_def > 0
+        and base_d is not None
+    ):
+        absent = base_d < node.max_def
+        if absent.any():
+            for i in np.flatnonzero(absent).tolist():
+                values[i] = None
+    return values, base_d, base_r
+
+
+def _aggregated_child(parent: Column, c: Column, chunks, raw: bool):
+    """A REPEATED child aggregated to the parent's slot granularity: each
+    parent slot gets the list of child elements (empty when the levels show
+    no element — reference data_store.go:294-308 loop-until-rep-drops)."""
+    cv, cd, cr = _node_values(c, chunks, raw)
+    if cr is None or cd is None:
+        raise _ShapeMismatch(c.path_str)
+    is_boundary = cr <= parent.max_rep
+    starts = np.flatnonzero(is_boundary)
+    has_elem = cd >= c.max_def
+    if bool(has_elem.all()):
+        elems = cv
+    else:
+        # fromiter keeps nested list/dict elements as objects (a 2-D
+        # broadcast would mangle equal-length list elements)
+        arr = np.fromiter(cv, dtype=object, count=len(cv))
+        elems = arr[has_elem].tolist()
+    row_of = np.cumsum(is_boundary) - 1
+    counts = np.bincount(row_of[has_elem], minlength=len(starts))
+    offsets = np.zeros(len(starts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    if _ext is not None:
+        values = _ext.rows_from_slices(elems, offsets, None)
+    else:
+        off = offsets.tolist()
+        values = [elems[a:b] for a, b in zip(off[:-1], off[1:])]
+    return values, cd[starts], cr[starts]
+
+
+def _slots_to_lists(node: Column, mid: Column, ev, ed, er):
+    """Shared tail of the LIST/MAP unwrap: aggregate element slots into
+    per-slot lists at `node`'s granularity with null-wrapper detection."""
+    if er is None or ed is None:
+        raise _ShapeMismatch(node.path_str)
+    is_boundary = er <= node.max_rep
+    starts = np.flatnonzero(is_boundary)
+    has_elem = ed >= mid.max_def
+    if bool(has_elem.all()):
+        elems = ev
+    else:
+        arr = np.fromiter(ev, dtype=object, count=len(ev))
+        elems = arr[has_elem].tolist()
+    row_of = np.cumsum(is_boundary) - 1
+    counts = np.bincount(row_of[has_elem], minlength=len(starts))
+    offsets = np.zeros(len(starts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    first_def = ed[starts]
+    all_present = node.max_def == 0 or bool((first_def >= node.max_def).all())
+    mask = None if all_present else (first_def < node.max_def).astype(np.uint8)
+    if _ext is not None:
+        values = _ext.rows_from_slices(elems, offsets, mask)
+    else:
+        off = offsets.tolist()
+        if mask is None:
+            values = [elems[a:b] for a, b in zip(off[:-1], off[1:])]
+        else:
+            values = [
+                None if m else elems[a:b]
+                for m, a, b in zip(mask.tolist(), off[:-1], off[1:])
+            ]
+    return values, first_def, er[starts]
+
+
+def _subtree_covered(node: Column, chunks) -> bool:
+    if node.is_leaf:
+        return node.path in chunks
+    return any(_subtree_covered(c, chunks) for c in node.children)
+
+
+def vector_row_columns(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
+    """General level-vectorized assembly for arbitrary nesting, in the same
+    column-oriented form as fast_row_columns (so callers window-materialize
+    identically). Returns (names, columns, n_rows), or None when the walk
+    meets a shape it cannot prove (the RecordAssembler then decides — and
+    raises its precise error if the data really is inconsistent)."""
+    try:
+        names = []
+        cols = []
+        n_rows = None
+        for top in schema.root.children:
+            if not _subtree_covered(top, chunks):
+                continue
+            if top.repetition == FieldRepetitionType.REPEATED:
+                v, _d, _r = _aggregated_child(schema.root, top, chunks, raw)
+            else:
+                v, _d, _r = _node_values(top, chunks, raw)
+            if n_rows is None:
+                n_rows = len(v)
+            elif len(v) != n_rows:
+                return None
+            names.append(top.name)
+            cols.append(v)
+        if n_rows is None:
+            return [], [], 0
+        return names, cols, n_rows
+    except _ShapeMismatch:
+        return None
+
+
+def vector_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
+    """Row-list form of vector_row_columns (None on unprovable shapes)."""
+    rc = vector_row_columns(schema, chunks, raw)
+    if rc is None:
+        return None
+    names, cols, _n = rc
+    if not names:
+        return []
+    return _zip_dict_rows(names, cols)
 
 
 def logical_kind(node: Column):

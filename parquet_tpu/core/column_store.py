@@ -208,37 +208,35 @@ class ColumnChunkBuilder:
                     return None  # more uniques than the cutoff: dict never pays
                 firsts, indices = res
                 dict_values = typed.take(firsts.astype(np.int64))
-                plain_size = len(typed.data) + 4 * n
-                dict_size = len(dict_values.data) + 4 * len(firsts) + n * 4
-                if dict_size >= plain_size:
-                    return None
-                return dict_values, indices
-            if _ext is not None:
-                res = _ext.dict_indices(typed.to_list(cache=True), DICT_MAX_UNIQUES)
-                if res is None:
-                    return None  # more uniques than the cutoff: dict never pays
-                uniques, idx_b = res
-                indices = np.frombuffer(idx_b, dtype="<u4")
+                n_uniques = len(firsts)
             else:
-                # one bulk slice pass (to_list) beats re-slicing per value,
-                # and the dict probe loop beats np.unique on object arrays
-                # (measured ~4x): hashing short bytes is cheaper than C
-                # comparisons in a mergesort
-                uniq: dict[bytes, int] = {}
-                indices = np.empty(n, dtype=np.uint32)
-                uniq_get = uniq.get
-                for i, key in enumerate(typed.to_list(cache=True)):
-                    idx = uniq_get(key)
-                    if idx is None:
-                        idx = len(uniq)
-                        if idx > DICT_MAX_UNIQUES:
-                            return None
-                        uniq[key] = idx
-                    indices[i] = idx
-                uniques = list(uniq.keys())
-            dict_values = ByteArrayData.from_list(uniques)
+                if _ext is not None:
+                    res = _ext.dict_indices(typed.to_list(cache=True), DICT_MAX_UNIQUES)
+                    if res is None:
+                        return None  # more uniques than the cutoff
+                    uniques, idx_b = res
+                    indices = np.frombuffer(idx_b, dtype="<u4")
+                else:
+                    # one bulk slice pass (to_list) beats re-slicing per value,
+                    # and the dict probe loop beats np.unique on object arrays
+                    # (measured ~4x): hashing short bytes is cheaper than C
+                    # comparisons in a mergesort
+                    uniq: dict[bytes, int] = {}
+                    indices = np.empty(n, dtype=np.uint32)
+                    uniq_get = uniq.get
+                    for i, key in enumerate(typed.to_list(cache=True)):
+                        idx = uniq_get(key)
+                        if idx is None:
+                            idx = len(uniq)
+                            if idx >= DICT_MAX_UNIQUES:
+                                return None
+                            uniq[key] = idx
+                        indices[i] = idx
+                    uniques = list(uniq.keys())
+                dict_values = ByteArrayData.from_list(uniques)
+                n_uniques = len(uniques)
             plain_size = len(typed.data) + 4 * n
-            dict_size = len(dict_values.data) + 4 * len(uniques) + n * 4
+            dict_size = len(dict_values.data) + 4 * n_uniques + n * 4
         elif isinstance(typed, np.ndarray) and typed.ndim == 1 and ptype != Type.BOOLEAN:
             # Bit-pattern uniqueness so NaN payloads dedup correctly
             # (reference CHANGELOG.md:31 NaN-in-dict fix).

@@ -353,8 +353,10 @@ class TestFastNestedAssembly:
         assert fast is not None and fast == slow
         assert fast[0]["r"] is None and fast[1]["r"] == {"a": 1, "b": "s1"}
 
-    def test_deep_nesting_falls_back(self, tmp_path):
-        from parquet_tpu.core.assembly import fast_rows
+    def test_deep_nesting_takes_vector_path(self, tmp_path):
+        """Shapes past the canonical fast paths land on the general
+        level-vectorized walk (vector_rows), not the per-row assembler."""
+        from parquet_tpu.core.assembly import fast_rows, vector_rows
 
         t = pa.table(
             {
@@ -369,8 +371,10 @@ class TestFastNestedAssembly:
         path = str(tmp_path / "deep.parquet")
         pq.write_table(t, path)
         with FileReader(path) as r:
-            assert fast_rows(r.schema, r.read_row_group(0), False) is None
-            rows = list(r.iter_rows())  # assembler fallback still works
+            chunks = r.read_row_group(0)
+            assert fast_rows(r.schema, chunks, False) is None
+            assert vector_rows(r.schema, chunks, False) is not None
+            rows = list(r.iter_rows())
         assert rows[0]["r"] == {"xs": [1, 2]}
 
     def test_list_of_struct_vectorized(self, tmp_path):
@@ -395,3 +399,99 @@ class TestFastNestedAssembly:
         fast, slow = self._roundtrip_both(t, tmp_path)
         assert fast is not None and fast == slow
         assert [r["pts"] for r in fast] == rows
+
+
+class TestVectorAssembly:
+    """The general level-vectorized assembler (vector_rows) must match the
+    per-row Dremel walk exactly on ARBITRARY nesting — list-of-list,
+    struct-of-list, map-of-struct, 3-level list<struct<list>> — in both
+    ergonomic and raw modes. The canonical fast paths must decline these
+    shapes so the coverage claim is real."""
+
+    def _both(self, table, tmp_path, raw=False):
+        import pyarrow.parquet as pq
+
+        from parquet_tpu.core.assembly import (
+            RecordAssembler,
+            fast_row_columns,
+            vector_rows,
+        )
+
+        path = str(tmp_path / "v.parquet")
+        pq.write_table(table, path, compression="snappy")
+        with FileReader(path) as r:
+            chunks = r.read_row_group(0)
+            assert fast_row_columns(r.schema, chunks, raw) is None
+            vec = vector_rows(r.schema, chunks, raw)
+            slow = list(RecordAssembler(r.schema, chunks, raw=raw))
+        assert vec is not None
+        assert vec == slow
+        return vec
+
+    def test_list_of_list(self, tmp_path):
+        rows = [
+            None if i % 7 == 0
+            else [[k for k in range(j % 3)] if j % 5 else None for j in range(i % 4)]
+            for i in range(4000)
+        ]
+        t = pa.table({"ll": pa.array(rows, pa.list_(pa.list_(pa.int64())))})
+        vec = self._both(t, tmp_path)
+        assert [r["ll"] for r in vec] == rows
+
+    def test_struct_of_list(self, tmp_path):
+        rows = [
+            None if i % 6 == 0
+            else {"a": i, "l": [j for j in range(i % 3)] if i % 4 else None}
+            for i in range(4000)
+        ]
+        t = pa.table({
+            "s": pa.array(rows, pa.struct([("a", pa.int64()), ("l", pa.list_(pa.int64()))]))
+        })
+        vec = self._both(t, tmp_path)
+        assert [r["s"] for r in vec] == rows
+
+    def test_map_of_struct(self, tmp_path):
+        def row(i):
+            if i % 9 == 0:
+                return None
+            return {
+                f"k{j}": ({"x": i + j, "y": None if j % 2 else float(j)} if j % 3 else None)
+                for j in range(i % 3)
+            }
+
+        rows = [row(i) for i in range(4000)]
+        t = pa.table({
+            "m": pa.array(rows, pa.map_(pa.string(),
+                                        pa.struct([("x", pa.int64()), ("y", pa.float64())])))
+        })
+        vec = self._both(t, tmp_path)
+        got = [r["m"] for r in vec]
+        assert got == [None if r is None else dict(r) for r in rows]
+
+    def test_three_level_list_struct_list(self, tmp_path):
+        """list<struct{p, q: list<int>}> — the VERDICT 3-level fixture."""
+        rows = [
+            None if i % 11 == 0
+            else [{"p": j, "q": [j, j + 1] if j % 2 else []} for j in range(i % 3)]
+            for i in range(4000)
+        ]
+        t = pa.table({
+            "z": pa.array(rows, pa.list_(pa.struct(
+                [("p", pa.int64()), ("q", pa.list_(pa.int64()))])))
+        })
+        vec = self._both(t, tmp_path)
+        assert [r["z"] for r in vec] == rows
+        # raw mode agrees with the assembler too
+        self._both(t, tmp_path, raw=True)
+
+    def test_iter_rows_uses_vector_path_end_to_end(self, tmp_path):
+        import pyarrow.parquet as pq
+
+        rows = [[[1, 2], [3]], None, [], [[], [4]]] * 50
+        t = pa.table({"ll": pa.array(rows, pa.list_(pa.list_(pa.int64())))})
+        path = str(tmp_path / "e2e.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            got = [x["ll"] for x in r.iter_rows()]
+        assert got == rows
+        assert got == pq.read_table(path).column("ll").to_pylist()
