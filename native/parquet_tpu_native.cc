@@ -1544,7 +1544,7 @@ ssize_t ptq_bytes_dict_indices(const char* data, size_t data_len,
     for (;;) {
       uint32_t uid = table[slot];
       if (uid == 0xffffffffu) {
-        if (uniques > max_uniques) {  // would assign id > max: doesn't pay
+        if (uniques >= max_uniques) {  // would exceed the cutoff: no dict
           free(table);
           return -2;
         }

@@ -671,46 +671,26 @@ def _node_values(node: Column, chunks, raw: bool):
     return values, base_d, base_r
 
 
-def _aggregated_child(parent: Column, c: Column, chunks, raw: bool):
-    """A REPEATED child aggregated to the parent's slot granularity: each
-    parent slot gets the list of child elements (empty when the levels show
-    no element — reference data_store.go:294-308 loop-until-rep-drops)."""
-    cv, cd, cr = _node_values(c, chunks, raw)
-    if cr is None or cd is None:
-        raise _ShapeMismatch(c.path_str)
-    is_boundary = cr <= parent.max_rep
-    starts = np.flatnonzero(is_boundary)
-    has_elem = cd >= c.max_def
-    if bool(has_elem.all()):
-        elems = cv
-    else:
-        # fromiter keeps nested list/dict elements as objects (a 2-D
-        # broadcast would mangle equal-length list elements)
-        arr = np.fromiter(cv, dtype=object, count=len(cv))
-        elems = arr[has_elem].tolist()
-    row_of = np.cumsum(is_boundary) - 1
-    counts = np.bincount(row_of[has_elem], minlength=len(starts))
-    offsets = np.zeros(len(starts) + 1, dtype=np.int64)
-    np.cumsum(counts, out=offsets[1:])
-    if _ext is not None:
-        values = _ext.rows_from_slices(elems, offsets, None)
-    else:
-        off = offsets.tolist()
-        values = [elems[a:b] for a, b in zip(off[:-1], off[1:])]
-    return values, cd[starts], cr[starts]
-
-
-def _slots_to_lists(node: Column, mid: Column, ev, ed, er):
-    """Shared tail of the LIST/MAP unwrap: aggregate element slots into
-    per-slot lists at `node`'s granularity with null-wrapper detection."""
+def _aggregate_entries(parent_rep: int, elem_def: int, null_def, ev, ed, er, where):
+    """Core of one level of repeated aggregation: group element entries
+    (ev, ed, er) into per-slot lists at `parent_rep` granularity. Elements
+    exist where ed >= elem_def; slots whose first def sits below `null_def`
+    (when given) become None instead of a list. Returns
+    (values, first_defs, first_reps)."""
     if er is None or ed is None:
-        raise _ShapeMismatch(node.path_str)
-    is_boundary = er <= node.max_rep
+        raise _ShapeMismatch(where)
+    is_boundary = er <= parent_rep
+    if len(er) and not is_boundary[0]:
+        # corrupt levels: the stream must open a slot before extending one
+        # (the Dremel fallback raises the precise error)
+        raise _ShapeMismatch(where)
     starts = np.flatnonzero(is_boundary)
-    has_elem = ed >= mid.max_def
+    has_elem = ed >= elem_def
     if bool(has_elem.all()):
         elems = ev
     else:
+        # fromiter keeps nested list/dict elements as objects (a 2-D
+        # broadcast would mangle equal-length list elements)
         arr = np.fromiter(ev, dtype=object, count=len(ev))
         elems = arr[has_elem].tolist()
     row_of = np.cumsum(is_boundary) - 1
@@ -718,8 +698,10 @@ def _slots_to_lists(node: Column, mid: Column, ev, ed, er):
     offsets = np.zeros(len(starts) + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     first_def = ed[starts]
-    all_present = node.max_def == 0 or bool((first_def >= node.max_def).all())
-    mask = None if all_present else (first_def < node.max_def).astype(np.uint8)
+    mask = None
+    if null_def is not None and null_def > 0:
+        if not bool((first_def >= null_def).all()):
+            mask = (first_def < null_def).astype(np.uint8)
     if _ext is not None:
         values = _ext.rows_from_slices(elems, offsets, mask)
     else:
@@ -732,6 +714,24 @@ def _slots_to_lists(node: Column, mid: Column, ev, ed, er):
                 for m, a, b in zip(mask.tolist(), off[:-1], off[1:])
             ]
     return values, first_def, er[starts]
+
+
+def _aggregated_child(parent: Column, c: Column, chunks, raw: bool):
+    """A REPEATED child aggregated to the parent's slot granularity: each
+    parent slot gets the list of child elements (empty when the levels show
+    no element — reference data_store.go:294-308 loop-until-rep-drops)."""
+    cv, cd, cr = _node_values(c, chunks, raw)
+    return _aggregate_entries(
+        parent.max_rep, c.max_def, None, cv, cd, cr, c.path_str
+    )
+
+
+def _slots_to_lists(node: Column, mid: Column, ev, ed, er):
+    """Shared tail of the LIST/MAP unwrap: aggregate element slots into
+    per-slot lists at `node`'s granularity with null-wrapper detection."""
+    return _aggregate_entries(
+        node.max_rep, mid.max_def, node.max_def, ev, ed, er, node.path_str
+    )
 
 
 def _subtree_covered(node: Column, chunks) -> bool:

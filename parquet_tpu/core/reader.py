@@ -21,6 +21,7 @@ import threading
 from contextlib import contextmanager
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
+from typing import NamedTuple
 
 from ..meta.file_meta import ParquetFileError, read_file_metadata
 from ..meta.parquet_types import FileMetaData, RowGroup
@@ -89,6 +90,43 @@ def _timed_rows(assembler):
             except StopIteration:
                 return
         yield row
+
+
+class MaskedColumn(NamedTuple):
+    """A nullable column in device-batch form: `values` are row-aligned with
+    null rows zero-filled on device; `mask` is True where the row is
+    non-null — the TPU-native validity representation (NamedTuple = a jax
+    pytree node, so a jitted step takes the pair directly and computes e.g.
+    `jnp.where(col.mask, col.values, fill)` with no host trip)."""
+
+    values: object  # jax.Array[n] of the column dtype
+    mask: object    # jax.Array[n] bool
+
+
+_expand_nullable_jit = None
+
+
+def _expand_nullable_device(values, mask) -> MaskedColumn:
+    """Scatter the dense non-null values into row positions ON DEVICE (nulls
+    zero-filled): prefix-sum the validity mask into a gather index — the same
+    levels-to-rows math as host null expansion, but no host round-trip. The
+    jitted kernel is module-cached so repeated groups hit the compile cache."""
+    global _expand_nullable_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _expand_nullable_jit is None:
+
+        @jax.jit
+        def expand(v, m):
+            idx = jnp.cumsum(m) - 1
+            idx = jnp.clip(idx, 0, jnp.maximum(v.shape[0] - 1, 0))
+            dense = v[idx] if v.shape[0] else jnp.zeros(m.shape, v.dtype)
+            zero = jnp.zeros((), v.dtype)
+            return jnp.where(m, dense, zero)
+
+        _expand_nullable_jit = expand
+    return MaskedColumn(values=_expand_nullable_jit(values, mask), mask=mask)
 
 
 # Rows materialize in windows this size: cyclic GC cost scales with LIVE
@@ -279,6 +317,7 @@ class FileReader:
         columns=None,
         drop_remainder: bool = True,
         sharding=None,
+        nullable: str = "error",
     ):
         """Stream the file as fixed-size device-resident batches.
 
@@ -287,9 +326,16 @@ class FileReader:
         a jitted train step compiles once), values already decoded in HBM.
         Dictionary-encoded byte-array columns yield their int32 indices
         (embedding-lookup style). Unsupported shapes raise: raw byte-array
-        columns (no device form), nullable columns (non-null cells would
-        shift rows between columns), repeated/LIST columns (leaf slots are
-        not rows) — project them out with `columns=` or transform upstream.
+        columns (no device form), repeated/LIST columns (leaf slots are not
+        rows) — project them out with `columns=` or transform upstream.
+
+        `nullable` picks the policy for columns with nulls:
+          "error" (default)  raise — non-null cells would silently shift rows
+          "mask"             yield MaskedColumn(values, mask): values are
+                             row-aligned with nulls zero-filled ON DEVICE and
+                             mask is a bool row validity array — the
+                             TPU-native null representation (a jit step takes
+                             the pair as a pytree: jnp.where(m, v, ...)).
 
         While the consumer runs on group i's batches, group i+1 is already
         preparing and dispatching (one-group lookahead); memory stays
@@ -304,10 +350,15 @@ class FileReader:
         """
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
-        return self._iter_device_batches(batch_size, columns, drop_remainder, sharding)
+        if nullable not in ("error", "mask"):
+            raise ValueError('nullable must be "error" or "mask"')
+        return self._iter_device_batches(
+            batch_size, columns, drop_remainder, sharding, nullable
+        )
 
     def _iter_device_batches(
-        self, batch_size: int, columns, drop_remainder: bool, sharding=None
+        self, batch_size: int, columns, drop_remainder: bool, sharding=None,
+        nullable: str = "error",
     ):
         import jax
         import jax.numpy as jnp
@@ -325,11 +376,24 @@ class FileReader:
                     "slots are not rows, so it cannot batch (project it out)"
                 )
             if arr.shape[0] != dc.num_values:
+                if nullable == "mask" and dc.def_levels is not None:
+                    max_def = self.schema.column(path).max_def
+                    mask_np = dc.def_levels == max_def
+                    return _expand_nullable_device(arr, jnp.asarray(mask_np))
                 raise ParquetFileError(
                     f"parquet: column {'.'.join(path)} contains nulls; "
                     "device batches need null-free columns (filter or fill "
-                    "upstream, or project the column out)"
+                    'upstream, project the column out, or pass nullable="mask")'
                 )
+            if nullable == "mask" and dc.def_levels is not None:
+                # no nulls in THIS group, but the column is declared optional:
+                # keep the pytree structure stable across groups/batches
+                max_def = self.schema.column(path).max_def
+                if max_def > 0:
+                    return MaskedColumn(
+                        values=arr,
+                        mask=jnp.asarray(dc.def_levels == max_def),
+                    )
             return arr
 
         groups = list(range(self.num_row_groups))
@@ -356,7 +420,7 @@ class FileReader:
             arrs = {path: _array_of(path, dc) for path, dc in group.items()}
             if not arrs:
                 continue
-            lengths = {a.shape[0] for a in arrs.values()}
+            lengths = {a.shape[0] for a in jax.tree_util.tree_leaves(arrs)}
             if len(lengths) != 1:
                 raise ParquetFileError(
                     f"parquet: columns disagree on row count in group {i}: "
@@ -364,7 +428,9 @@ class FileReader:
                 )
             n = lengths.pop()
             if carry_n:
-                cat = {p: jnp.concatenate([carry[p], a]) for p, a in arrs.items()}
+                cat = jax.tree_util.tree_map(
+                    lambda c, a: jnp.concatenate([c, a]), carry, arrs
+                )
             else:
                 cat = arrs
             total = carry_n + n
@@ -372,21 +438,22 @@ class FileReader:
             # is sliced once per row group, not once per batch
             off = 0
             while total - off >= batch_size:
-                batch = {p: a[off : off + batch_size] for p, a in cat.items()}
+                lo = off
+                batch = jax.tree_util.tree_map(
+                    lambda a, lo=lo: a[lo : lo + batch_size], cat
+                )
                 if sharding is not None:
-                    batch = {
-                        p: jax.device_put(a, sharding) for p, a in batch.items()
-                    }
+                    batch = jax.device_put(batch, sharding)
                 yield batch
                 off += batch_size
             carry_n = total - off
-            carry = {p: a[off:] for p, a in cat.items()} if carry_n else {}
+            carry = (
+                jax.tree_util.tree_map(lambda a: a[off:], cat) if carry_n else {}
+            )
         if carry_n and not drop_remainder:
             if sharding is not None:
                 try:
-                    carry = {
-                        p: jax.device_put(a, sharding) for p, a in carry.items()
-                    }
+                    carry = jax.device_put(carry, sharding)
                 except ValueError:
                     # tail not divisible over the mesh axis: deliver it
                     # unsharded rather than dying on the last batch (callers

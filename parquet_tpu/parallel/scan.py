@@ -168,8 +168,12 @@ def mesh_reduce_stats(stats: dict, mesh, replicas_per_participant: int = 1) -> d
     are idempotent. Keys MUST match across participants — build them from
     the shared schema, not from which chunks happened to decode.
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    if len(mesh.axis_names) > 1:
+        # stats all-reduce spans EVERY device; an N-D compute mesh (e.g.
+        # pages x cols) flattens to one axis so pmin/pmax/psum cover it all
+        mesh = Mesh(mesh.devices.reshape(-1), ("_all",))
     axis = mesh.axis_names[0]
     r = replicas_per_participant
     if mesh.devices.size % max(r, 1) != 0:
@@ -231,7 +235,7 @@ def _stats_identity(leaf):
     return {"min": hi, "max": lo, "count": jnp.asarray(0, dtype=jnp.int64)}
 
 
-def distributed_column_stats(reader, columns=None, mesh=None):
+def distributed_column_stats(reader, columns=None, mesh=None, devices=None):
     """Whole-file column stats in a multi-host program.
 
     Each process decodes only its own row groups (process_row_groups) on its
@@ -240,8 +244,10 @@ def distributed_column_stats(reader, columns=None, mesh=None):
     pytree matches. Partials reduce globally over `mesh` (default: every
     device in the program, one participant per process replicated over its
     local devices). Single-process programs with no explicit mesh skip the
-    collective."""
-    devices = jax.local_devices()
+    collective. `devices` overrides the local device set (e.g. a CPU-pinned
+    dryrun passes the mesh's host devices explicitly)."""
+    if devices is None:
+        devices = jax.local_devices()
     indices = process_row_groups(reader.num_row_groups)
     key_nodes = _stats_key_nodes(reader, columns)
     acc = scan_row_groups(

@@ -636,3 +636,61 @@ def test_sharded_indivisible_remainder_delivered_unsharded(tmp_path):
     assert batches[0][("x",)].sharding == sharding
     # 452 % 8 != 0: the tail arrives, just without the mesh layout
     assert int(np.asarray(batches[-1][("x",)])[-1]) == 2_499
+
+
+def test_nullable_batches_masked_mean_over_mesh(tmp_path):
+    """A nullable int64 column streams as MaskedColumn (device-expanded
+    values + validity mask) through a jitted masked-mean step over the
+    8-device mesh — the TPU-native null representation (real training data
+    has nulls; an error is not an answer)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from parquet_tpu import MaskedColumn
+
+    n = 8_192
+    vals = [None if i % 5 == 0 else i for i in range(n)]
+    t = pa.table({"x": pa.array(vals, pa.int64())})
+    path = str(tmp_path / "nullable.parquet")
+    pq.write_table(t, path, row_group_size=4_096, use_dictionary=False)
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    @jax.jit
+    def masked_mean(b):
+        col = b[("x",)]
+        m = col.mask
+        return jnp.where(m, col.values, 0).sum(), m.sum()
+
+    total = cnt = 0
+    with FileReader(path) as r:
+        for b in r.iter_device_batches(2_048, sharding=sharding, nullable="mask"):
+            col = b[("x",)]
+            assert isinstance(col, MaskedColumn)
+            assert col.values.sharding == sharding and col.mask.sharding == sharding
+            s, c = masked_mean(b)
+            total += int(s)
+            cnt += int(c)
+    expect = [v for v in vals if v is not None]
+    assert total == sum(expect) and cnt == len(expect)
+    # values row-aligned: null rows zero-filled, non-null rows in place
+    with FileReader(path) as r:
+        b = next(r.iter_device_batches(4_096, nullable="mask"))
+        col = b[("x",)]
+        got = np.asarray(col.values)
+        mask = np.asarray(col.mask)
+        ref = np.array([0 if v is None else v for v in vals[:4_096]])
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(mask, [v is not None for v in vals[:4_096]])
+
+
+def test_nullable_batches_default_still_errors(tmp_path):
+    t = pa.table({"x": pa.array([1, None, 3], pa.int64())})
+    path = str(tmp_path / "nerr.parquet")
+    pq.write_table(t, path)
+    from parquet_tpu.meta import ParquetFileError
+
+    with FileReader(path) as r:
+        with pytest.raises(ParquetFileError):
+            next(r.iter_device_batches(2, nullable="error"))
