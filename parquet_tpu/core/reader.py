@@ -375,25 +375,23 @@ class FileReader:
                     f"parquet: column {'.'.join(path)} is repeated; its leaf "
                     "slots are not rows, so it cannot batch (project it out)"
                 )
-            if arr.shape[0] != dc.num_values:
-                if nullable == "mask" and dc.def_levels is not None:
-                    max_def = self.schema.column(path).max_def
-                    mask_np = dc.def_levels == max_def
-                    return _expand_nullable_device(arr, jnp.asarray(mask_np))
+            has_nulls = arr.shape[0] != dc.num_values
+            if nullable == "mask" and dc.def_levels is not None:
+                max_def = self.schema.column(path).max_def
+                if max_def > 0:
+                    mask = jnp.asarray(dc.def_levels == max_def)
+                    if has_nulls:
+                        return _expand_nullable_device(arr, mask)
+                    # no nulls in THIS group, but the column is declared
+                    # optional: keep the pytree structure stable across
+                    # groups/batches
+                    return MaskedColumn(values=arr, mask=mask)
+            if has_nulls:
                 raise ParquetFileError(
                     f"parquet: column {'.'.join(path)} contains nulls; "
                     "device batches need null-free columns (filter or fill "
                     'upstream, project the column out, or pass nullable="mask")'
                 )
-            if nullable == "mask" and dc.def_levels is not None:
-                # no nulls in THIS group, but the column is declared optional:
-                # keep the pytree structure stable across groups/batches
-                max_def = self.schema.column(path).max_def
-                if max_def > 0:
-                    return MaskedColumn(
-                        values=arr,
-                        mask=jnp.asarray(dc.def_levels == max_def),
-                    )
             return arr
 
         groups = list(range(self.num_row_groups))

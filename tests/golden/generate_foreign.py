@@ -1,0 +1,146 @@
+"""Generate frozen FOREIGN-WRITER-FORM fixtures (run once, outputs committed).
+
+The main golden corpus is pyarrow-written, so on-disk forms that pyarrow
+never produces — the quirks of OTHER writers the reference validates against
+(apache/parquet-testing + Impala files, reference: parquet_test.go:11-38,
+parquet_compatibility_test.go:77) — were uncovered. This generator builds
+those byte-level forms with our own encoder primitives:
+
+  foreign_legacy_2level_list  legacy parquet-mr 2-level LIST (repeated leaf
+                              directly under the LIST group, no middle group)
+  foreign_athena_bag          Athena/Hive form: repeated group named `bag`
+                              with an optional `array_element` leaf
+  foreign_bool_rle_v2         boolean column RLE-encoded in DataPage V2
+                              (modern parquet-mr writes booleans this way)
+  foreign_int96_impala        INT96 julian-day timestamps (Impala convention)
+
+Each file is then decoded by PYARROW — the independent implementation — and
+its rows frozen as the expectation, so the oracle never saw our reader.
+The binaries must stay frozen once committed:
+    python tests/golden/generate_foreign.py
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pyarrow.parquet as pq
+
+HERE = Path(__file__).resolve().parent
+sys.path.insert(0, str(HERE))
+sys.path.insert(0, str(HERE.parent.parent))
+
+from canon import canon_rows  # noqa: E402
+
+from parquet_tpu.core.writer import FileWriter  # noqa: E402
+from parquet_tpu.schema.dsl import parse_schema  # noqa: E402
+
+DATA = HERE / "data"
+EXPECTED = HERE / "expected"
+
+N = 1200
+rng = np.random.default_rng(20260730)
+
+
+def _legacy_2level_list(path: Path) -> None:
+    schema = parse_schema(
+        "message m { optional group xs (LIST) { repeated int32 array; } }"
+    )
+    rows = []
+    for i in range(N):
+        if i % 9 == 0:
+            rows.append({"xs": None})
+        elif i % 5 == 0:
+            rows.append({"xs": []})
+        else:
+            rows.append({"xs": [int(v) for v in rng.integers(-999, 999, i % 6)]})
+    with FileWriter(path, schema, codec="snappy") as w:
+        w.write_rows(rows)
+
+
+def _athena_bag(path: Path) -> None:
+    schema = parse_schema(
+        "message m { optional group xs (LIST) { repeated group bag "
+        "{ optional int32 array_element; } } }"
+    )
+    rows = []
+    for i in range(N):
+        if i % 9 == 0:
+            rows.append({"xs": None})
+        elif i % 5 == 0:
+            rows.append({"xs": []})
+        else:
+            rows.append(
+                {
+                    "xs": [
+                        None if (i + j) % 7 == 0 else int(j * i % 1000)
+                        for j in range(i % 5)
+                    ]
+                }
+            )
+    with FileWriter(path, schema, codec="snappy") as w:
+        w.write_rows(rows)
+
+
+def _bool_rle_v2(path: Path) -> None:
+    schema = parse_schema("message m { required boolean b; optional boolean ob; }")
+    rows = [
+        {
+            "b": bool(i % 11 < 7),
+            "ob": None if i % 6 == 0 else bool(i % 3 == 0),
+        }
+        for i in range(N)
+    ]
+    with FileWriter(
+        path,
+        schema,
+        codec="snappy",
+        data_page_version=2,
+        column_encodings={"b": "RLE", "ob": "RLE"},
+        enable_dictionary=False,
+    ) as w:
+        w.write_rows(rows)
+
+
+def _int96_impala(path: Path) -> None:
+    schema = parse_schema("message m { required int96 ts; }")
+    base = dt.datetime(1999, 12, 31, 23, 59, 58, 500_000, tzinfo=dt.timezone.utc)
+    rows = [
+        {"ts": base + dt.timedelta(seconds=int(s), microseconds=int(u))}
+        for s, u in zip(
+            rng.integers(0, 10**7, N), rng.integers(0, 1_000_000, N)
+        )
+    ]
+    with FileWriter(path, schema, codec="snappy", enable_dictionary=False) as w:
+        w.write_rows(rows)
+
+
+FOREIGN = {
+    "foreign_legacy_2level_list": _legacy_2level_list,
+    "foreign_athena_bag": _athena_bag,
+    "foreign_bool_rle_v2": _bool_rle_v2,
+    "foreign_int96_impala": _int96_impala,
+}
+
+
+def main() -> None:
+    for name, build in FOREIGN.items():
+        path = DATA / f"{name}.parquet"
+        if path.exists():
+            print(f"{name}: frozen, skipping")
+            continue
+        build(path)
+        # the INDEPENDENT oracle decodes the bytes and freezes the answer
+        rows = pq.read_table(path).to_pylist()
+        (EXPECTED / f"{name}.json").write_text(
+            json.dumps(canon_rows(rows), separators=(",", ":"))
+        )
+        print(f"{name}: {path.stat().st_size} bytes, {len(rows)} rows frozen")
+
+
+if __name__ == "__main__":
+    main()
