@@ -26,6 +26,7 @@ from ..meta.parquet_types import (
     PageType,
 )
 from ..meta.thrift import CompactReader, ThriftError
+from ..ops.packed_levels import PackedLevels
 from ..utils.trace import stage
 from .alloc import decoded_nbytes
 from .arrays import ByteArrayData
@@ -52,13 +53,17 @@ class ChunkError(ValueError):
 
 @dataclass
 class ChunkData:
-    """All values of one column chunk, concatenated across pages."""
+    """All values of one column chunk, concatenated across pages.
+
+    Levels are uint16 ndarrays by default; readers opened with
+    compact_levels=True deliver them as ops.packed_levels.PackedLevels
+    (bit-packed at rest, ndarray-operator compatible, widen-on-demand)."""
 
     column: Column
     num_values: int  # level entries incl. nulls
     values: object  # ndarray | ByteArrayData (non-null cells only)
-    def_levels: np.ndarray | None
-    rep_levels: np.ndarray | None
+    def_levels: "np.ndarray | PackedLevels | None"
+    rep_levels: "np.ndarray | PackedLevels | None"
     dictionary: object | None = None  # decoded dict page values, if any
 
 

@@ -170,6 +170,7 @@ class FileReader:
         max_memory: int | None = None,
         metadata: FileMetaData | None = None,
         backend: str = "host",
+        compact_levels: bool = False,
     ):
         if isinstance(source, (str, Path)):
             self._f = open(source, "rb")
@@ -191,6 +192,12 @@ class FileReader:
                     "or 'tpu_roundtrip'"
                 )
             self.backend = backend
+            # compact_levels: R/D levels of delivered columns are stored
+            # bit-packed (PackedLevels, width = bits(max_level)) instead of
+            # uint16 arrays — the reference's packed_array memory layout
+            # (packed_array.go:13-101), ~16x smaller at rest. Consumers widen
+            # windows on demand; NumPy comparisons work transparently.
+            self.compact_levels = compact_levels
             self._selected = self._resolve_columns(columns)
         except BaseException:
             if self._owns_file:
@@ -247,6 +254,22 @@ class FileReader:
 
     # -- columnar reads --------------------------------------------------------
 
+    def _pack_chunk_levels(self, path, delivered):
+        """Swap a delivered ChunkData/DeviceColumn's level arrays for their
+        bit-packed form (compact_levels contract). Widened arrays existed
+        transiently during decode; this bounds the at-rest footprint."""
+        if not self.compact_levels or delivered is None:
+            return delivered
+        from ..ops.packed_levels import PackedLevels
+
+        col = self.schema.column(path)
+        dl, rl = delivered.def_levels, delivered.rep_levels
+        if dl is not None and not isinstance(dl, PackedLevels):
+            delivered.def_levels = PackedLevels.from_array(dl, col.max_def)
+        if rl is not None and not isinstance(rl, PackedLevels):
+            delivered.rep_levels = PackedLevels.from_array(rl, col.max_rep)
+        return delivered
+
     def read_row_group(self, i: int, columns=None) -> dict[tuple, ChunkData]:
         """Decode one row group into {leaf path: ChunkData}.
 
@@ -262,14 +285,29 @@ class FileReader:
         On the roundtrip backend all selected chunks are *planned* first
         (host prescan + async device dispatch), then finalized — every
         chunk's device work is in flight before the first fetch blocks."""
+        return self._read_row_group(i, columns, pack=True)
+
+    def _read_row_group(self, i: int, columns, pack: bool) -> dict[tuple, ChunkData]:
+        """pack=False is the internal iteration path: rows/batches consume
+        the levels immediately, so bit-packing them (compact_levels) would be
+        a pure pack+widen round trip with no at-rest benefit."""
         if self.backend == "tpu_roundtrip":
             plans = self._plan_row_group(i, columns)
-            return {path: plan.finalize() for path, plan in plans.items()}
-        out: dict[tuple, ChunkData] = {}
-        for path, cc, column in self._selected_chunks(i, columns):
-            out[path] = read_chunk(
-                self._f, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
-            )
+            out = {path: plan.finalize() for path, plan in plans.items()}
+        else:
+            out = {
+                path: read_chunk(
+                    self._f,
+                    cc,
+                    column,
+                    validate_crc=self.validate_crc,
+                    alloc=self.alloc,
+                )
+                for path, cc, column in self._selected_chunks(i, columns)
+            }
+        if pack and self.compact_levels:
+            for path, cd in out.items():
+                self._pack_chunk_levels(path, cd)
         return out
 
     def read_row_group_device(self, i: int, columns=None):
@@ -279,8 +317,17 @@ class FileReader:
         value arrays are jax arrays resident on the accelerator — encoded
         bytes go up, decoded columns never come back down. Works regardless
         of the reader's configured backend."""
+        return self._read_row_group_device(i, columns, pack=True)
+
+    def _read_row_group_device(self, i: int, columns, pack: bool):
+        """pack=False mirrors _read_row_group: the batch iterator consumes
+        levels immediately (mask build), so packing them would be overhead."""
         plans = self._plan_row_group(i, columns)
-        return {path: plan.device_column() for path, plan in plans.items()}
+        out = {path: plan.device_column() for path, plan in plans.items()}
+        if pack and self.compact_levels:
+            for path, dc in out.items():
+                self._pack_chunk_levels(path, dc)
+        return out
 
     def read_row_groups_device(self, row_groups=None, columns=None):
         """Decode row groups into device memory with full pipelining.
@@ -302,7 +349,10 @@ class FileReader:
             return [self.read_row_group_device(i, columns) for i in indices]
         staged = self._plan_row_groups_async(indices, columns)
         return [
-            {path: fut.result().device_column() for path, fut in group}
+            {
+                path: self._pack_chunk_levels(path, fut.result().device_column())
+                for path, fut in group
+            }
             for group in staged
         ]
 
@@ -412,9 +462,11 @@ class FileReader:
                 staged_next = (
                     stage(groups[gi + 1]) if gi + 1 < len(groups) else None
                 )
+                # no level packing here: _array_of consumes the levels (mask
+                # build) within this iteration, so they never rest
                 group = {path: fut.result().device_column() for path, fut in staged}
             else:
-                group = self.read_row_group_device(i, columns=columns)
+                group = self._read_row_group_device(i, columns, pack=False)
             arrs = {path: _array_of(path, dc) for path, dc in group.items()}
             if not arrs:
                 continue
@@ -613,7 +665,7 @@ class FileReader:
         iterate without an extra generator frame per row), a window-batched
         generator for large ones (bounds the live tracked-object count so
         cyclic GC passes stay cheap), or the streaming Dremel fallback."""
-        chunks = self.read_row_group(i)
+        chunks = self._read_row_group(i, None, pack=False)
         with stage("assemble"):
             with _gc_paused():
                 rc = fast_row_columns(self.schema, chunks, raw)

@@ -52,6 +52,7 @@ from ..core.chunk import ChunkData, ChunkError, iter_chunk_pages, _check_crc
 from ..core.compress import decompress_block
 from ..core.page import PageError, decode_dict_page
 from ..core.schema import Column
+from ..ops.packed_levels import PackedLevels
 from ..ops.rle_hybrid import prescan_hybrid
 from ..ops.delta import prescan_delta_packed
 from .device_ops import (
@@ -377,7 +378,8 @@ class DeviceColumn:
     as device `dict_data`/`dict_offsets`.
 
     def/rep levels stay host-side (record assembly is a host concern,
-    SURVEY §7.1)."""
+    SURVEY §7.1); under compact_levels they arrive bit-packed
+    (ops.packed_levels.PackedLevels)."""
 
     num_values: int
     values: jnp.ndarray | None = None
@@ -387,8 +389,8 @@ class DeviceColumn:
     offsets: jnp.ndarray | None = None  # int64 offsets, len = n + 1
     dict_data: jnp.ndarray | None = None  # uint8 dictionary payload
     dict_offsets: jnp.ndarray | None = None
-    def_levels: np.ndarray | None = None
-    rep_levels: np.ndarray | None = None
+    def_levels: "np.ndarray | PackedLevels | None" = None
+    rep_levels: "np.ndarray | PackedLevels | None" = None
 
 
 class _ChunkPlan:

@@ -33,23 +33,28 @@ def bytes_for(num_values: int, width: int) -> int:
     return (num_values * width + 7) // 8
 
 
-def unpack_bits(data, num_values: int, width: int, dtype=np.uint64) -> np.ndarray:
+def unpack_bits(
+    data, num_values: int, width: int, dtype=np.uint64, bit_offset: int = 0
+) -> np.ndarray:
     """Unpack `num_values` little-endian bit-packed values of `width` bits.
 
-    `data` is a bytes-like; only the first bytes_for(num_values, width) bytes are
-    consumed. Returns an array of `dtype`.
+    `data` is a bytes-like; values start `bit_offset` bits into it (windowed
+    consumers like PackedLevels.widen pass unaligned starts) and only the
+    covering bytes are consumed. Returns an array of `dtype`.
     """
     if width == 0:
         return np.zeros(num_values, dtype=dtype)
     if width > 64:
         raise ValueError(f"bitpack: unsupported width {width}")
-    nbytes = bytes_for(num_values, width)
-    raw = np.frombuffer(data, dtype=np.uint8, count=nbytes)
+    byte0 = bit_offset >> 3
+    off = bit_offset - (byte0 << 3)
+    nbytes = (off + num_values * width + 7) >> 3
+    raw = np.frombuffer(data, dtype=np.uint8, offset=byte0, count=nbytes)
     bits = np.unpackbits(raw, bitorder="little")
     needed = num_values * width
-    if bits.size < needed:
+    if bits.size - off < needed:
         raise ValueError("bitpack: input too short")
-    bits = bits[:needed].reshape(num_values, width)
+    bits = bits[off : off + needed].reshape(num_values, width)
     weights = (np.uint64(1) << np.arange(width, dtype=np.uint64))
     out = bits.astype(np.uint64) @ weights
     return out.astype(dtype, copy=False)
