@@ -224,6 +224,190 @@ ssize_t ptq_snappy_compress(const char* src_c, size_t src_len,
 }
 
 // ---------------------------------------------------------------------------
+// LZ4 block format (+ the Hadoop framing parquet's legacy LZ4 codec uses)
+//
+// Implemented from the public LZ4 block format description: sequences of
+// [token: literal-length nibble | match-length nibble][literals]
+// [2-byte LE match offset][length extension bytes], final sequence literals
+// only. Strict bounds validation before every write; -1 on corrupt input.
+// ---------------------------------------------------------------------------
+
+size_t ptq_lz4_max_compressed_length(size_t n) {
+  // worst case: one literal run (1 token + ceil(n/255) extensions + n bytes)
+  return 16 + n + n / 255;
+}
+
+ssize_t ptq_lz4_decompress(const char* src_c, size_t src_len,
+                           char* dst, size_t expect) {
+  const uint8_t* src = reinterpret_cast<const uint8_t*>(src_c);
+  size_t pos = 0;
+  size_t out = 0;
+  if (src_len == 0) return expect == 0 ? 0 : -1;
+  while (pos < src_len) {
+    uint8_t token = src[pos++];
+    // literals
+    uint64_t lit = token >> 4;
+    if (lit == 15) {
+      for (;;) {
+        if (pos >= src_len) return -1;
+        uint8_t b = src[pos++];
+        lit += b;
+        if (b != 255) break;
+        if (lit > (1ull << 40)) return -1;  // length bomb
+      }
+    }
+    if (pos + lit > src_len || out + lit > expect) return -1;
+    std::memcpy(dst + out, src + pos, lit);
+    out += lit;
+    pos += lit;
+    if (pos == src_len) break;  // last sequence carries literals only
+    // match
+    if (pos + 2 > src_len) return -1;
+    uint32_t offset = static_cast<uint32_t>(src[pos]) |
+                      (static_cast<uint32_t>(src[pos + 1]) << 8);
+    pos += 2;
+    if (offset == 0 || offset > out) return -1;
+    uint64_t mlen = token & 15;
+    if (mlen == 15) {
+      for (;;) {
+        if (pos >= src_len) return -1;
+        uint8_t b = src[pos++];
+        mlen += b;
+        if (b != 255) break;
+        if (mlen > (1ull << 40)) return -1;
+      }
+    }
+    mlen += 4;  // minmatch
+    if (out + mlen > expect) return -1;
+    const char* from = dst + out - offset;
+    char* op = dst + out;
+    if (offset >= 8) {
+      // non-overlapping at 8-byte granularity; sub-8 tail byte-wise so no
+      // write lands past `expect` (same contract as the snappy decoder)
+      uint64_t wide = mlen & ~7ull;
+      for (uint64_t i = 0; i < wide; i += 8) std::memcpy(op + i, from + i, 8);
+      for (uint64_t i = wide; i < mlen; i++) op[i] = from[i];
+    } else {
+      for (uint64_t i = 0; i < mlen; i++) op[i] = from[i];  // RLE overlap
+    }
+    out += mlen;
+  }
+  return out == expect ? static_cast<ssize_t>(out) : -1;
+}
+
+static inline uint32_t lz4_hash(uint32_t v) {
+  return (v * 2654435761u) >> 19;  // 13-bit table
+}
+
+// Append a literal/match length in LZ4's nibble + 255-extension form.
+static inline bool lz4_put_len(uint64_t extra, char* dst, size_t dst_cap,
+                               size_t* out) {
+  while (extra >= 255) {
+    if (*out >= dst_cap) return false;
+    dst[(*out)++] = static_cast<char>(255);
+    extra -= 255;
+  }
+  if (*out >= dst_cap) return false;
+  dst[(*out)++] = static_cast<char>(extra);
+  return true;
+}
+
+ssize_t ptq_lz4_compress(const char* src_c, size_t src_len,
+                         char* dst, size_t dst_cap) {
+  if (dst_cap < ptq_lz4_max_compressed_length(src_len)) return -1;
+  const uint8_t* src = reinterpret_cast<const uint8_t*>(src_c);
+  size_t out = 0;
+  size_t lit_start = 0;
+  size_t pos = 0;
+  constexpr size_t kTableSize = 1 << 13;
+  static thread_local uint32_t table[kTableSize];
+  // The format forbids matches in the final 12 bytes (spec end-of-block
+  // rule: last sequence is literals-only and >= 5 bytes, matches must not
+  // start within the last 12) — canonical decoders rely on it.
+  if (src_len > 12) {
+    std::memset(table, 0, sizeof(table));
+    const size_t match_limit = src_len - 12;
+    while (pos <= match_limit) {
+      uint32_t cur;
+      std::memcpy(&cur, src + pos, 4);
+      uint32_t h = lz4_hash(cur);
+      size_t cand = table[h];
+      table[h] = static_cast<uint32_t>(pos);
+      uint32_t cv;
+      if (cand < pos && pos - cand < (1u << 16) &&
+          (std::memcpy(&cv, src + cand, 4), cv == cur)) {
+        // extend, but never into the last 5 bytes (they must stay literal)
+        size_t max_len = src_len - 5 - pos;
+        size_t len = 4;
+        while (len < max_len && src[cand + len] == src[pos + len]) len++;
+        size_t lit = pos - lit_start;
+        uint8_t tok_lit = lit >= 15 ? 15 : static_cast<uint8_t>(lit);
+        uint8_t tok_m = (len - 4) >= 15 ? 15 : static_cast<uint8_t>(len - 4);
+        if (out >= dst_cap) return -1;
+        dst[out++] = static_cast<char>((tok_lit << 4) | tok_m);
+        if (tok_lit == 15 && !lz4_put_len(lit - 15, dst, dst_cap, &out))
+          return -1;
+        if (out + lit > dst_cap) return -1;
+        std::memcpy(dst + out, src + lit_start, lit);
+        out += lit;
+        size_t offset = pos - cand;
+        if (out + 2 > dst_cap) return -1;
+        dst[out++] = static_cast<char>(offset & 0xff);
+        dst[out++] = static_cast<char>(offset >> 8);
+        if (tok_m == 15 && !lz4_put_len(len - 4 - 15, dst, dst_cap, &out))
+          return -1;
+        pos += len;
+        lit_start = pos;
+      } else {
+        pos++;
+      }
+    }
+  }
+  // trailing literals (the whole input when src_len <= 12)
+  {
+    size_t lit = src_len - lit_start;
+    uint8_t tok_lit = lit >= 15 ? 15 : static_cast<uint8_t>(lit);
+    if (out >= dst_cap) return -1;
+    dst[out++] = static_cast<char>(tok_lit << 4);
+    if (tok_lit == 15 && !lz4_put_len(lit - 15, dst, dst_cap, &out)) return -1;
+    if (out + lit > dst_cap) return -1;
+    std::memcpy(dst + out, src + lit_start, lit);
+    out += lit;
+  }
+  return static_cast<ssize_t>(out);
+}
+
+// Parquet's legacy LZ4 codec (id 5) is Hadoop-framed on disk: repeated
+// [4B BE uncompressed size][4B BE compressed size][raw block]; some writers
+// emit bare raw blocks instead. Mirror parquet-cpp: try the framing, fall
+// back to one raw block.
+ssize_t ptq_lz4_hadoop_decompress(const char* src_c, size_t src_len,
+                                  char* dst, size_t expect) {
+  const uint8_t* src = reinterpret_cast<const uint8_t*>(src_c);
+  size_t pos = 0;
+  size_t out = 0;
+  bool framed = true;
+  while (pos < src_len) {
+    if (pos + 8 > src_len) { framed = false; break; }
+    uint64_t usz = (static_cast<uint32_t>(src[pos]) << 24) |
+                   (static_cast<uint32_t>(src[pos + 1]) << 16) |
+                   (static_cast<uint32_t>(src[pos + 2]) << 8) |
+                   static_cast<uint32_t>(src[pos + 3]);
+    uint64_t csz = (static_cast<uint32_t>(src[pos + 4]) << 24) |
+                   (static_cast<uint32_t>(src[pos + 5]) << 16) |
+                   (static_cast<uint32_t>(src[pos + 6]) << 8) |
+                   static_cast<uint32_t>(src[pos + 7]);
+    if (pos + 8 + csz > src_len || out + usz > expect) { framed = false; break; }
+    ssize_t got = ptq_lz4_decompress(src_c + pos + 8, csz, dst + out, usz);
+    if (got < 0 || static_cast<uint64_t>(got) != usz) { framed = false; break; }
+    out += usz;
+    pos += 8 + csz;
+  }
+  if (framed && out == expect) return static_cast<ssize_t>(out);
+  return ptq_lz4_decompress(src_c, src_len, dst, expect);
+}
+
+// ---------------------------------------------------------------------------
 // PLAIN byte_array scan: 4-byte LE length + payload, repeated
 // ---------------------------------------------------------------------------
 
@@ -860,6 +1044,18 @@ int decompress_page(int codec, const uint8_t* src, size_t src_len,
     return 0;
   }
   if (codec == 2) return gzip_inflate(src, src_len, scratch, expect) ? 0 : -1;
+  if (codec == 5)  // legacy LZ4: hadoop framing with raw-block fallback
+    return ptq_lz4_hadoop_decompress(reinterpret_cast<const char*>(src),
+                                     src_len, reinterpret_cast<char*>(scratch),
+                                     expect) == static_cast<ssize_t>(expect)
+               ? 0
+               : -1;
+  if (codec == 7)  // LZ4_RAW: one raw block
+    return ptq_lz4_decompress(reinterpret_cast<const char*>(src), src_len,
+                              reinterpret_cast<char*>(scratch), expect) ==
+                   static_cast<ssize_t>(expect)
+               ? 0
+               : -1;
   return -1;
 }
 

@@ -6,10 +6,12 @@ registered at import or by the user via register_codec (the reference's public
 RegisterBlockCompressor, compress.go:131-136). Decompressed output is validated
 against the expected size before use (reference: compress.go:102-123).
 
-SNAPPY resolution order: the native C++ codec (native/, loaded via ctypes) if
-built, else pyarrow's bundled snappy. ZSTD comes from the zstandard module when
-present; BROTLI/LZO/LZ4 raise a clear 'codec not registered' error unless the
-user registers an implementation.
+SNAPPY and LZ4/LZ4_RAW resolve to the native C++ codecs (native/, loaded via
+ctypes) when built, else pyarrow's bundled implementations. The legacy LZ4
+codec (id 5) reads both Hadoop-framed and bare raw blocks and writes the
+framed form (parquet-cpp's contract). ZSTD comes from the zstandard module,
+BROTLI from pyarrow; LZO raises a clear 'codec not registered' error unless
+the user registers an implementation.
 """
 
 from __future__ import annotations
@@ -76,13 +78,14 @@ class _Gzip(_Codec):
         return out
 
 
-class _PyArrowSnappy(_Codec):
-    name = "SNAPPY"
+class _PyArrowCodec(_Codec):
+    """Stock wrapper over a pyarrow-bundled codec (snappy/lz4_raw/brotli)."""
 
-    def __init__(self):
+    def __init__(self, name: str, arrow_name: str):
         import pyarrow as pa
 
-        self._codec = pa.Codec("snappy")
+        self.name = name
+        self._codec = pa.Codec(arrow_name)
 
     def compress(self, data):
         return self._codec.compress(bytes(data)).to_pybytes()
@@ -127,6 +130,74 @@ class _Zstd(_Codec):
         return self._d.decompress(bytes(data), max_output_size=max(uncompressed_size, 1))
 
 
+class _NativeLz4Raw(_Codec):
+    """LZ4_RAW (codec 7): one raw LZ4 block per page."""
+
+    name = "LZ4_RAW"
+
+    def __init__(self):
+        from ..utils.native import get_native
+
+        self._lib = get_native()
+        if self._lib is None or not self._lib.has_lz4:
+            raise ImportError("native lz4 not built")
+
+    def compress(self, data):
+        return self._lib.lz4_compress(bytes(data))
+
+    def decompress(self, data, uncompressed_size):
+        return self._lib.lz4_decompress(data, uncompressed_size)
+
+
+class _Lz4Hadoop(_Codec):
+    """Legacy LZ4 (codec 5): Hadoop framing on disk — repeated
+    [4B BE uncompressed size][4B BE compressed size][raw block] — with a
+    bare-raw-block fallback on read (parquet-cpp's contract; pyarrow and
+    parquet-mr both write the framed form)."""
+
+    name = "LZ4"
+
+    def __init__(self, raw: _Codec):
+        self._raw = raw
+        from ..utils.native import get_native
+
+        lib = get_native()
+        self._lib = lib if lib is not None and lib.has_lz4 else None
+
+    def compress(self, data):
+        import struct
+
+        block = self._raw.compress(data)
+        return struct.pack(">II", len(data), len(block)) + block
+
+    def decompress(self, data, uncompressed_size):
+        if self._lib is not None:
+            return self._lib.lz4_decompress(data, uncompressed_size, hadoop=True)
+        import struct
+
+        buf = bytes(data)
+        out = bytearray()
+        pos = 0
+        ok = True
+        while pos < len(buf):
+            if pos + 8 > len(buf):
+                ok = False
+                break
+            usz, csz = struct.unpack_from(">II", buf, pos)
+            if pos + 8 + csz > len(buf) or len(out) + usz > uncompressed_size:
+                ok = False
+                break
+            try:
+                out += self._raw.decompress(buf[pos + 8 : pos + 8 + csz], usz)
+            except Exception:
+                ok = False
+                break
+            pos += 8 + csz
+        if ok and len(out) == uncompressed_size:
+            return bytes(out)
+        return self._raw.decompress(buf, uncompressed_size)
+
+
 _REGISTRY: dict[int, _Codec] = {}
 
 
@@ -145,7 +216,10 @@ def is_builtin_codec(codec) -> bool:
     native whole-chunk walk inlines UNCOMPRESSED/SNAPPY/GZIP and must stand
     down when register_codec has overridden one of them."""
     impl = _REGISTRY.get(int(codec))
-    return isinstance(impl, (_Uncompressed, _Gzip, _NativeSnappy, _PyArrowSnappy))
+    return isinstance(
+        impl,
+        (_Uncompressed, _Gzip, _NativeSnappy, _PyArrowCodec, _NativeLz4Raw, _Lz4Hadoop),
+    )
 
 
 def _get(codec) -> _Codec:
@@ -191,11 +265,26 @@ def _init_registry() -> None:
         _REGISTRY[int(CompressionCodec.SNAPPY)] = _NativeSnappy()
     except Exception:
         try:
-            _REGISTRY[int(CompressionCodec.SNAPPY)] = _PyArrowSnappy()
+            _REGISTRY[int(CompressionCodec.SNAPPY)] = _PyArrowCodec("SNAPPY", "snappy")
         except Exception:
             pass
     try:
         _REGISTRY[int(CompressionCodec.ZSTD)] = _Zstd()
+    except Exception:
+        pass
+    raw: _Codec | None
+    try:
+        raw = _NativeLz4Raw()
+    except Exception:
+        try:
+            raw = _PyArrowCodec("LZ4_RAW", "lz4_raw")
+        except Exception:
+            raw = None
+    if raw is not None:
+        _REGISTRY[int(CompressionCodec.LZ4_RAW)] = raw
+        _REGISTRY[int(CompressionCodec.LZ4)] = _Lz4Hadoop(raw)
+    try:
+        _REGISTRY[int(CompressionCodec.BROTLI)] = _PyArrowCodec("BROTLI", "brotli")
     except Exception:
         pass
 

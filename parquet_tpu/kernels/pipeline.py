@@ -775,9 +775,11 @@ def _native_prepare(f, chunk, column, validate_crc, alloc, stats):
     codec = int(md.codec or 0)
     from ..core.compress import is_builtin_codec
 
-    if codec not in (0, 1, 2) or not is_builtin_codec(codec):
+    if codec not in (0, 1, 2, 5, 7) or not is_builtin_codec(codec):
         return None
     if codec == 1 and not lib.has_snappy:
+        return None
+    if codec in (5, 7) and not lib.has_lz4:
         return None
     from ..core.chunk import chunk_byte_range
 

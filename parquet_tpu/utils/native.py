@@ -54,6 +54,22 @@ class NativeLib:
                 ctypes.c_void_p,
                 ctypes.c_size_t,
             ]
+        self.has_lz4 = hasattr(lib, "ptq_lz4_compress")
+        if self.has_lz4:
+            lib.ptq_lz4_max_compressed_length.restype = ctypes.c_size_t
+            lib.ptq_lz4_max_compressed_length.argtypes = [ctypes.c_size_t]
+            for fn in (
+                lib.ptq_lz4_compress,
+                lib.ptq_lz4_decompress,
+                lib.ptq_lz4_hadoop_decompress,
+            ):
+                fn.restype = ctypes.c_ssize_t
+                fn.argtypes = [
+                    ctypes.c_void_p,
+                    ctypes.c_size_t,
+                    ctypes.c_void_p,
+                    ctypes.c_size_t,
+                ]
         self.has_byte_array_scan = hasattr(lib, "ptq_byte_array_gather")
         if self.has_byte_array_scan:
             lib.ptq_byte_array_gather.restype = ctypes.c_ssize_t
@@ -238,6 +254,33 @@ class NativeLib:
         )
         if n < 0:
             raise ValueError("native snappy: corrupt input")
+        return memoryview(out)[:n]
+
+    def lz4_compress(self, data) -> bytes:
+        """One raw LZ4 block (no framing, no size prefix)."""
+        addr, n_in, _keep = _ptr(data)
+        cap = self._lib.ptq_lz4_max_compressed_length(n_in)
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.ptq_lz4_compress(addr, n_in, out, cap)
+        if n < 0:
+            raise ValueError("native lz4: compression failed")
+        return out.raw[:n]
+
+    def lz4_decompress(self, data, uncompressed_size: int, hadoop: bool = False):
+        """Decode one raw LZ4 block; hadoop=True also accepts the Hadoop
+        [BE usize][BE csize] framing parquet's legacy LZ4 codec uses."""
+        import numpy as np
+
+        addr, n_in, _keep = _ptr(data)
+        out = np.empty(max(uncompressed_size, 1), dtype=np.uint8)
+        fn = (
+            self._lib.ptq_lz4_hadoop_decompress
+            if hadoop
+            else self._lib.ptq_lz4_decompress
+        )
+        n = fn(addr, n_in, ctypes.c_void_p(out.ctypes.data), uncompressed_size)
+        if n < 0:
+            raise ValueError("native lz4: corrupt input")
         return memoryview(out)[:n]
 
     def byte_array_gather(self, data, num_values: int):

@@ -34,7 +34,7 @@ from parquet_tpu.schema.builder import (
 N = 3000
 rng = np.random.default_rng(99)
 
-CODECS = ["uncompressed", "snappy", "gzip", "zstd"]
+CODECS = ["uncompressed", "snappy", "gzip", "zstd", "lz4", "brotli"]
 VERSIONS = [1, 2]
 
 
