@@ -386,15 +386,33 @@ def _phase_write() -> None:
             w.write_column("s", strs_l)
             w.write_column("ts", ts)
 
+    def ours_arrow():
+        # same input class as pyarrow gets (arrow arrays, zero-copy ingest)
+        with FileWriter(
+            "/tmp/pqt_bench_write_ours_arrow.parquet",
+            schema,
+            codec="snappy",
+            column_encodings={"ts": "DELTA_BINARY_PACKED"},
+        ) as w:
+            w.write_column("i", table.column("i"))
+            w.write_column("s", table.column("s"))
+            w.write_column("ts", table.column("ts"))
+
     # correctness FIRST: pyarrow must read our output back identically
     ours()
-    got = pq.read_table("/tmp/pqt_bench_write_ours.parquet")
-    assert got.column("i").to_pylist() == ints.tolist()
-    assert got.column("s").to_pylist() == strs_l
-    assert got.column("ts").cast(pa.int64()).to_pylist() == ts.tolist()
+    ours_arrow()
+    for f in (
+        "/tmp/pqt_bench_write_ours.parquet",
+        "/tmp/pqt_bench_write_ours_arrow.parquet",
+    ):
+        got = pq.read_table(f)
+        assert got.column("i").to_pylist() == ints.tolist()
+        assert got.column("s").to_pylist() == strs_l
+        assert got.column("ts").cast(pa.int64()).to_pylist() == ts.tolist()
     log("bench: write output verified by pyarrow readback ✓")
 
     t_ours = timed(ours, REPEATS, "write ours", rows=rows)
+    t_ours_arrow = timed(ours_arrow, REPEATS, "write ours(arrow-in)", rows=rows)
     t_pa = timed(
         lambda: pq.write_table(
             table, "/tmp/pqt_bench_write_pa.parquet", compression="snappy"
@@ -408,8 +426,10 @@ def _phase_write() -> None:
             {
                 "config": "write",
                 "rows_s_ours": round(rows / t_ours, 1),
+                "rows_s_ours_arrow_in": round(rows / t_ours_arrow, 1),
                 "rows_s_pyarrow": round(rows / t_pa, 1),
                 "vs_pyarrow": round(t_pa / t_ours, 3),
+                "vs_pyarrow_arrow_in": round(t_pa / t_ours_arrow, 3),
                 "written_MB": round(
                     Path("/tmp/pqt_bench_write_ours.parquet").stat().st_size / 1e6, 1
                 ),

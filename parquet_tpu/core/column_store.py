@@ -123,8 +123,99 @@ class ColumnChunkBuilder:
             return np.stack(rows)
         raise StoreError(f"store: unsupported type {ptype}")
 
+    def _from_arrow(self, v):
+        """pyarrow Array/ChunkedArray -> our columnar containers, zero-copy
+        where the layouts agree (numeric buffers, string offsets). The
+        bench-visible case: handing write_column a pa.array skips the
+        per-item Python string encode entirely."""
+        import pyarrow as pa
+
+        if isinstance(v, pa.ChunkedArray):
+            v = v.combine_chunks()
+        if not isinstance(v, pa.Array):
+            raise StoreError(
+                f"store: unsupported pyarrow input {type(v).__name__} for "
+                f"{self.column.path_str}"
+            )
+        t = v.type
+        if pa.types.is_dictionary(t):
+            v = v.dictionary_decode()
+            t = v.type
+        # null check AFTER dictionary decode: a dictionary array carrying
+        # nulls in its VALUE buffer reports null_count 0 on the indices
+        if v.null_count:
+            raise StoreError(
+                f"store: pyarrow array for {self.column.path_str} contains "
+                "nulls; write_column takes non-null cells (pass def_levels "
+                "explicitly, or drop/fill nulls upstream)"
+            )
+        if (
+            pa.types.is_string(t)
+            or pa.types.is_binary(t)
+            or pa.types.is_large_string(t)
+            or pa.types.is_large_binary(t)
+        ):
+            wide = pa.types.is_large_string(t) or pa.types.is_large_binary(t)
+            dt = np.int64 if wide else np.int32
+            off = np.frombuffer(
+                v.buffers()[1],
+                dtype=dt,
+                count=len(v) + 1,
+                offset=v.offset * np.dtype(dt).itemsize,
+            )
+            base = int(off[0]) if len(off) else 0
+            end = int(off[-1]) if len(off) else 0
+            # data stays `bytes` (ByteArrayData contract: slices hash)
+            data = bytes(memoryview(v.buffers()[2] or b"")[base:end])
+            offsets = off.astype(np.int64)
+            if base:
+                offsets = offsets - base
+            return ByteArrayData(offsets=offsets, data=data)
+        if pa.types.is_fixed_size_binary(t):
+            width = t.byte_width
+            flat = np.frombuffer(
+                v.buffers()[1],
+                dtype=np.uint8,
+                count=len(v) * width,
+                offset=v.offset * width,
+            )
+            return flat.reshape(len(v), width)
+        if pa.types.is_boolean(t):
+            return np.asarray(v)  # bit-packed in arrow: unpack copy
+        if (
+            pa.types.is_timestamp(t)
+            or pa.types.is_time64(t)
+            or pa.types.is_duration(t)
+            or pa.types.is_time32(t)
+            or pa.types.is_date32(t)
+            or pa.types.is_date64(t)
+        ):
+            # temporal values pass through as their integer representation;
+            # the schema annotation (TIMESTAMP(unit) etc.) defines meaning
+            width = t.bit_width // 8
+            dt = np.int64 if width == 8 else np.int32
+            return np.frombuffer(
+                v.buffers()[1], dtype=dt, count=len(v), offset=v.offset * width
+            )
+        try:
+            return v.to_numpy(zero_copy_only=True)
+        except Exception as e:
+            raise StoreError(
+                f"store: cannot ingest pyarrow {t} array for "
+                f"{self.column.path_str}: {e}"
+            ) from e
+
     def _coerce_array(self, v):
         ptype = self.column.type
+        if type(v).__module__.split(".", 1)[0] == "pyarrow":
+            v = self._from_arrow(v)
+            if isinstance(v, ByteArrayData):
+                if ptype != Type.BYTE_ARRAY:
+                    raise StoreError(
+                        f"store: string/binary arrow array into non-BYTE_ARRAY "
+                        f"column {self.column.path_str}"
+                    )
+                return v
         if ptype in _NUMERIC:
             try:
                 arr = np.asarray(v)

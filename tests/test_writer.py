@@ -648,3 +648,132 @@ class TestNativeExtension:
         # cutoff: exceeding max_uniques returns None
         many = [str(i).encode() for i in range(100)]
         assert ext.dict_indices(many, 50) is None
+
+
+class TestArrowInput:
+    """write_column accepts pyarrow Array/ChunkedArray (zero-copy ingest where
+    layouts agree) — the same input class pq.write_table consumes."""
+
+    def _roundtrip(self, schema_text, name, arr, expect):
+        import io
+
+        import pyarrow as pa  # noqa: F401
+
+        from parquet_tpu.schema.dsl import parse_schema
+
+        out = io.BytesIO()
+        with FileWriter(out, parse_schema(schema_text)) as w:
+            w.write_column(name, arr)
+        out.seek(0)
+        assert pq.read_table(out).column(name).to_pylist() == expect
+
+    def test_numeric_and_temporal(self):
+        import pyarrow as pa
+
+        ints = list(range(1000))
+        self._roundtrip("message m { required int64 a; }", "a", pa.array(ints), ints)
+        self._roundtrip(
+            "message m { required int32 a; }",
+            "a",
+            pa.array(ints, pa.int32()),
+            ints,
+        )
+        fl = [i / 7 for i in range(1000)]
+        self._roundtrip(
+            "message m { required double a; }", "a", pa.array(fl, pa.float64()), fl
+        )
+        raw = [1_600_000_000_000_000 + i for i in range(100)]
+        ts = pa.array(raw, pa.timestamp("us"))
+        import io
+
+        from parquet_tpu.schema.dsl import parse_schema
+
+        out = io.BytesIO()
+        with FileWriter(
+            out,
+            parse_schema("message m { required int64 a (TIMESTAMP_MICROS); }"),
+        ) as w:
+            w.write_column("a", ts)
+        out.seek(0)
+        got = pq.read_table(out).column("a").cast(pa.int64()).to_pylist()
+        assert got == raw  # integer micros preserved exactly
+
+    def test_strings_binary_chunked_sliced(self):
+        import pyarrow as pa
+
+        vals = [f"s{i % 13}" for i in range(2000)]
+        self._roundtrip(
+            "message m { required binary a (UTF8); }", "a", pa.array(vals), vals
+        )
+        self._roundtrip(
+            "message m { required binary a (UTF8); }",
+            "a",
+            pa.array(vals, pa.large_string()),
+            vals,
+        )
+        chunked = pa.chunked_array([vals[:800], vals[800:]])
+        self._roundtrip(
+            "message m { required binary a (UTF8); }", "a", chunked, vals
+        )
+        sliced = pa.array(vals).slice(37, 555)  # nonzero offset path
+        self._roundtrip(
+            "message m { required binary a (UTF8); }", "a", sliced, vals[37:592]
+        )
+        bins = [bytes([i % 256, (i * 3) % 256]) for i in range(500)]
+        self._roundtrip("message m { required binary a; }", "a", pa.array(bins), bins)
+
+    def test_bool_and_fixed(self):
+        import pyarrow as pa
+
+        flags = [i % 3 == 0 for i in range(333)]
+        self._roundtrip("message m { required boolean a; }", "a", pa.array(flags), flags)
+        fxd = [bytes([i % 256] * 4) for i in range(100)]
+        self._roundtrip(
+            "message m { required fixed_len_byte_array(4) a; }",
+            "a",
+            pa.array(fxd, pa.binary(4)),
+            fxd,
+        )
+
+    def test_nulls_rejected_with_clear_error(self):
+        import io
+
+        import pyarrow as pa
+
+        from parquet_tpu.schema.dsl import parse_schema
+
+        with pytest.raises(ValueError, match="null"):
+            with FileWriter(
+                io.BytesIO(), parse_schema("message m { optional int64 a; }")
+            ) as w:
+                w.write_column("a", pa.array([1, None, 3]))
+                w.flush_row_group()
+        # nulls hiding in a dictionary array's VALUE buffer (indices report
+        # null_count 0) must be rejected too, not written as empty strings
+        dict_arr = pa.DictionaryArray.from_arrays(
+            pa.array([0, 1, 0]), pa.array(["a", None])
+        )
+        with pytest.raises(ValueError, match="null"):
+            with FileWriter(
+                io.BytesIO(),
+                parse_schema("message m { required binary a (UTF8); }"),
+            ) as w:
+                w.write_column("a", dict_arr)
+                w.flush_row_group()
+
+    def test_dictionary_array_decodes(self):
+        import io
+
+        import pyarrow as pa
+
+        from parquet_tpu.schema.dsl import parse_schema
+
+        vals = ["x", "y", "x", "z", "y"] * 100
+        dict_arr = pa.array(vals).dictionary_encode()
+        out = io.BytesIO()
+        with FileWriter(
+            out, parse_schema("message m { required binary a (UTF8); }")
+        ) as w:
+            w.write_column("a", dict_arr)
+        out.seek(0)
+        assert pq.read_table(out).column("a").to_pylist() == vals
