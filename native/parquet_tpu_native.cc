@@ -1459,6 +1459,24 @@ ssize_t ptq_chunk_prepare(
       P[PC_VOFF] = static_cast<int64_t>(values_used);
       P[PC_VLEN] = static_cast<int64_t>(need);
       values_used += need;
+    } else if (enc == 9 && type_size > 0) {  // BYTE_STREAM_SPLIT numeric
+      // De-interleave the byte streams back to PLAIN little-endian layout
+      // in one strided pass; the page then rides the PLAIN device route
+      // (the transform is pure layout, so doing it here keeps byte-identity
+      // with the host decoder for free).
+      size_t need = static_cast<size_t>(non_null) * type_size;
+      if (vlen < need) return -1;
+      if (values_used + need > values_cap) return -5;
+      uint8_t* dstv = values_out + values_used;
+      const size_t nn = static_cast<size_t>(non_null);
+      for (int b = 0; b < type_size; b++) {
+        const uint8_t* sp = vsrc + static_cast<size_t>(b) * nn;
+        for (size_t i = 0; i < nn; i++) dstv[i * type_size + b] = sp[i];
+      }
+      P[PC_ROUTE] = 3;
+      P[PC_VOFF] = static_cast<int64_t>(values_used);
+      P[PC_VLEN] = static_cast<int64_t>(need);
+      values_used += need;
     } else {  // anything else: stream bytes for the Python host decoder
       if (values_used + vlen > values_cap) return -5;
       std::memcpy(values_out + values_used, vsrc, vlen);

@@ -259,6 +259,16 @@ class ColumnChunkBuilder:
                 # never pinning a caller-owned array
                 return ByteArrayData(offsets=v.offsets, data=v.data)
             return byte_array_from_items(v, to_bytes=self._to_bytes)
+        if isinstance(v, (list, tuple)) and (not v or isinstance(v[0], bytes)):
+            width = 12 if ptype == Type.INT96 else (self.column.type_length or 0)
+            if width <= 0 or any(
+                not isinstance(x, bytes) or len(x) != width for x in v
+            ):
+                raise StoreError(
+                    f"store: fixed({width}) column {self.column.path_str} "
+                    f"takes {width}-byte values"
+                )
+            return np.frombuffer(b"".join(v), dtype=np.uint8).reshape(len(v), width)
         arr = np.asarray(v, dtype=np.uint8)
         if arr.ndim != 2:
             raise StoreError("store: fixed-width columnar input must be (n, width)")

@@ -123,6 +123,13 @@ def _decode_values(
         # 4-byte length prefix + hybrid at width 1 (reference: type_boolean.go:100-146)
         levels, _ = decode_levels_v1(data, n, 1)
         return levels.astype(bool), None
+    if encoding == int(Encoding.BYTE_STREAM_SPLIT):
+        from ..ops.byte_stream_split import decode_byte_stream_split
+
+        try:
+            return decode_byte_stream_split(data, n, ptype, column.type_length), None
+        except ValueError as e:
+            raise PageError(f"page: {e}") from e
     try:
         name = Encoding(encoding).name
     except ValueError:
@@ -348,6 +355,13 @@ def _encode_values(values, encoding: Encoding, column: Column, dict_size) -> byt
         return ba_ops.encode_delta_byte_array(values)
     if e == int(Encoding.RLE) and ptype == Type.BOOLEAN:
         return encode_levels_v1(np.asarray(values).astype(np.uint16), 1)
+    if e == int(Encoding.BYTE_STREAM_SPLIT):
+        from ..ops.byte_stream_split import encode_byte_stream_split
+
+        try:
+            return encode_byte_stream_split(values, ptype, column.type_length)
+        except ValueError as err:
+            raise PageError(f"page: {err}") from err
     raise PageError(f"page: unsupported write encoding {encoding} for {ptype}")
 
 

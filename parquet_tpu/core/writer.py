@@ -58,17 +58,25 @@ ROW_GROUP_SIZE_DEFAULT = 128 << 20  # bytes, reference file_writer.go default
 # data_store.go:364-461).
 _ALLOWED_ENCODINGS = {
     Type.BOOLEAN: {Encoding.PLAIN, Encoding.RLE},
-    Type.INT32: {Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED},
-    Type.INT64: {Encoding.PLAIN, Encoding.DELTA_BINARY_PACKED},
+    Type.INT32: {
+        Encoding.PLAIN,
+        Encoding.DELTA_BINARY_PACKED,
+        Encoding.BYTE_STREAM_SPLIT,
+    },
+    Type.INT64: {
+        Encoding.PLAIN,
+        Encoding.DELTA_BINARY_PACKED,
+        Encoding.BYTE_STREAM_SPLIT,
+    },
     Type.INT96: {Encoding.PLAIN},
-    Type.FLOAT: {Encoding.PLAIN},
-    Type.DOUBLE: {Encoding.PLAIN},
+    Type.FLOAT: {Encoding.PLAIN, Encoding.BYTE_STREAM_SPLIT},
+    Type.DOUBLE: {Encoding.PLAIN, Encoding.BYTE_STREAM_SPLIT},
     Type.BYTE_ARRAY: {
         Encoding.PLAIN,
         Encoding.DELTA_LENGTH_BYTE_ARRAY,
         Encoding.DELTA_BYTE_ARRAY,
     },
-    Type.FIXED_LEN_BYTE_ARRAY: {Encoding.PLAIN},
+    Type.FIXED_LEN_BYTE_ARRAY: {Encoding.PLAIN, Encoding.BYTE_STREAM_SPLIT},
 }
 
 

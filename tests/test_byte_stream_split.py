@@ -1,0 +1,200 @@
+"""BYTE_STREAM_SPLIT (encoding 9) — beyond-reference coverage.
+
+The reference's encoding matrix stops at DELTA_BYTE_ARRAY (reference:
+chunk_reader.go:41-159); BSS is the one core encoding it lacks. It is a pure
+(W, n) <-> (n, W) layout transform, so decode/encode are single transposes
+(ops/byte_stream_split.py) and the native chunk walk de-interleaves in C so
+BSS pages keep the PLAIN device route. Cross-validated against pyarrow in
+both directions over types x codecs x page versions, with nulls and FLBA.
+"""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import FileReader, FileWriter, parse_schema
+from parquet_tpu.meta.parquet_types import Type
+from parquet_tpu.ops.byte_stream_split import (
+    decode_byte_stream_split,
+    encode_byte_stream_split,
+)
+
+rng = np.random.default_rng(42)
+
+
+class TestOps:
+    @pytest.mark.parametrize(
+        "ptype,arr",
+        [
+            (Type.FLOAT, rng.standard_normal(1001).astype(np.float32)),
+            (Type.DOUBLE, rng.standard_normal(1001)),
+            (Type.INT32, rng.integers(-(2**31), 2**31, 997).astype(np.int32)),
+            (Type.INT64, rng.integers(-(2**62), 2**62, 997)),
+        ],
+    )
+    def test_roundtrip(self, ptype, arr):
+        enc = encode_byte_stream_split(arr, ptype)
+        assert len(enc) == arr.nbytes
+        out = decode_byte_stream_split(enc, len(arr), ptype)
+        np.testing.assert_array_equal(out, arr)
+        # spec layout: first n bytes are the byte-0 stream
+        lane0 = arr.view(np.uint8).reshape(len(arr), -1)[:, 0]
+        np.testing.assert_array_equal(
+            np.frombuffer(enc[: len(arr)], dtype=np.uint8), lane0
+        )
+
+    def test_flba(self):
+        rows = rng.integers(0, 256, (321, 5), dtype=np.uint8)
+        enc = encode_byte_stream_split(rows, Type.FIXED_LEN_BYTE_ARRAY, 5)
+        out = decode_byte_stream_split(enc, 321, Type.FIXED_LEN_BYTE_ARRAY, 5)
+        np.testing.assert_array_equal(out, rows)
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            decode_byte_stream_split(b"\x00" * 8, 4, Type.FLOAT)  # short
+        with pytest.raises(ValueError):
+            decode_byte_stream_split(b"", 1, Type.BYTE_ARRAY)  # bad type
+        assert decode_byte_stream_split(b"", 0, Type.DOUBLE).shape == (0,)
+
+
+ALL_COLS = ("f", "d", "i", "l")
+
+
+def _table(n=20_000):
+    return pa.table(
+        {
+            "f": pa.array(rng.standard_normal(n).astype(np.float32)),
+            "d": pa.array(rng.standard_normal(n)),
+            "i": pa.array(rng.integers(-(2**31), 2**31, n).astype(np.int32)),
+            "l": pa.array(rng.integers(-(2**62), 2**62, n)),
+        }
+    )
+
+
+class TestPyarrowToOurs:
+    @pytest.mark.parametrize("codec", ["none", "snappy", "zstd", "lz4"])
+    @pytest.mark.parametrize("pagever", ["1.0", "2.0"])
+    def test_matrix(self, codec, pagever):
+        t = _table()
+        buf = io.BytesIO()
+        pq.write_table(
+            t,
+            buf,
+            use_dictionary=False,
+            compression=codec,
+            data_page_version=pagever,
+            version="2.6",
+            column_encoding={c: "BYTE_STREAM_SPLIT" for c in ALL_COLS},
+        )
+        for backend in ("host", "tpu_roundtrip"):
+            buf.seek(0)
+            with FileReader(buf, backend=backend) as r:
+                cd = r.read_row_group(0)
+                for c in ALL_COLS:
+                    np.testing.assert_array_equal(
+                        np.asarray(cd[(c,)].values), np.asarray(t.column(c))
+                    )
+
+    def test_nullable_bss(self):
+        vals = [None if i % 7 == 0 else float(i) for i in range(5_000)]
+        t = pa.table({"x": pa.array(vals, pa.float64())})
+        buf = io.BytesIO()
+        pq.write_table(
+            t,
+            buf,
+            use_dictionary=False,
+            compression="snappy",
+            column_encoding={"x": "BYTE_STREAM_SPLIT"},
+        )
+        for backend in ("host", "tpu_roundtrip"):
+            buf.seek(0)
+            with FileReader(buf, backend=backend) as r:
+                assert [row["x"] for row in r.iter_rows()] == vals
+
+    def test_device_batches(self):
+        t = _table(8_192)
+        buf = io.BytesIO()
+        pq.write_table(
+            t,
+            buf,
+            use_dictionary=False,
+            compression="zstd",
+            column_encoding={c: "BYTE_STREAM_SPLIT" for c in ALL_COLS},
+        )
+        buf.seek(0)
+        with FileReader(buf) as r:
+            b = next(r.iter_device_batches(4_096))
+            np.testing.assert_array_equal(
+                np.asarray(b[("l",)]), np.asarray(t.column("l"))[:4_096]
+            )
+
+
+class TestOursToPyarrow:
+    @pytest.mark.parametrize("version", [1, 2])
+    def test_matrix(self, version):
+        t = _table(5_000)
+        schema = parse_schema(
+            "message m { required float f; required double d; "
+            "required int32 i; required int64 l; }"
+        )
+        out = io.BytesIO()
+        with FileWriter(
+            out,
+            schema,
+            codec="snappy",
+            data_page_version=version,
+            column_encodings={c: "BYTE_STREAM_SPLIT" for c in ALL_COLS},
+        ) as w:
+            for c in ALL_COLS:
+                w.write_column(c, t.column(c))
+        out.seek(0)
+        back = pq.read_table(out)
+        for c in ALL_COLS:
+            np.testing.assert_array_equal(
+                np.asarray(back.column(c)), np.asarray(t.column(c))
+            )
+
+    def test_flba_to_pyarrow(self):
+        rows = [bytes([i % 256] * 6) for i in range(2_000)]
+        schema = parse_schema(
+            "message m { required fixed_len_byte_array(6) a; }"
+        )
+        out = io.BytesIO()
+        with FileWriter(
+            out, schema, column_encodings={"a": "BYTE_STREAM_SPLIT"}
+        ) as w:
+            w.write_column("a", rows)
+        out.seek(0)
+        assert pq.read_table(out).column("a").to_pylist() == rows
+
+    def test_own_roundtrip_bss_pages_multipage(self):
+        arr = rng.standard_normal(300_000)  # several 1MiB pages
+        schema = parse_schema("message m { required double x; }")
+        out = io.BytesIO()
+        with FileWriter(
+            out, schema, codec="gzip", column_encodings={"x": "BYTE_STREAM_SPLIT"}
+        ) as w:
+            w.write_column("x", arr)
+        out.seek(0)
+        with FileReader(out) as r:
+            np.testing.assert_array_equal(r.read_row_group(0)[("x",)].values, arr)
+
+    def test_fixed_list_input_validation(self):
+        # review regressions: wrong-sized elements summing to n*width, and
+        # mixed types, must both raise StoreError — never silently re-split
+        schema = parse_schema("message m { required fixed_len_byte_array(4) a; }")
+        for bad in ([b"12", b"123456"], [b"1234", "abcd"]):
+            with pytest.raises(ValueError, match="4"):
+                with FileWriter(io.BytesIO(), schema) as w:
+                    w.write_column("a", bad)
+                    w.flush_row_group()
+
+    def test_rejected_for_byte_array(self):
+        schema = parse_schema("message m { required binary s (UTF8); }")
+        with pytest.raises(ValueError, match="BYTE_STREAM_SPLIT"):
+            FileWriter(
+                io.BytesIO(), schema, column_encodings={"s": "BYTE_STREAM_SPLIT"}
+            )
