@@ -267,6 +267,30 @@ def _decode_stat(leaf, raw: bytes, legacy: bool):
     return bytes(raw)  # byte arrays compare lexicographically (min/max_value)
 
 
+def _bounds_admit(op, vlo, vhi, lo, hi, null_count) -> bool:
+    """Whether a [lo, hi] stat range (with null_count) may contain a match
+    for op against the [vlo, vhi] bracket of the filter value. Shared by
+    row-group pruning (chunk statistics) and page pruning (ColumnIndex).
+
+    [vlo, vhi] brackets the filter value in the stat domain; vlo != vhi
+    means the value falls between representable stored values, so each
+    comparison uses the end that keeps pruning conservative."""
+    if op == "==" and (vlo != vhi or vhi < lo or vlo > hi):
+        return False  # inexact value: NO stored value can equal it
+    if op == "<" and lo >= vhi:
+        return False
+    if op == "<=" and lo > vlo:
+        return False
+    if op == ">" and hi <= vlo:
+        return False
+    if op == ">=" and hi < vhi:
+        return False
+    # "!=" can only be pruned when lo == hi == value and nothing is null
+    if op == "!=" and vlo == vhi and lo == hi == vlo and not null_count:
+        return False
+    return True
+
+
 def row_group_may_match(rg, normalized) -> bool:
     """False only when statistics PROVE no row of the group matches."""
     chunks = {tuple(c.meta_data.path_in_schema or []): c for c in rg.columns or []}
@@ -298,23 +322,107 @@ def row_group_may_match(rg, normalized) -> bool:
         # NaN bounds make float stats unusable for ordering
         if isinstance(lo, float) and (lo != lo or hi != hi):
             continue
-        # [vlo, vhi] brackets the filter value in the stat domain; vlo != vhi
-        # means the value falls between representable stored values, so each
-        # comparison uses the end that keeps pruning conservative.
-        if op == "==" and (vlo != vhi or vhi < lo or vlo > hi):
-            return False  # inexact value: NO stored value can equal it
-        if op == "<" and lo >= vhi:
-            return False
-        if op == "<=" and lo > vlo:
-            return False
-        if op == ">" and hi <= vlo:
-            return False
-        if op == ">=" and hi < vhi:
-            return False
-        # "!=" can only be pruned when lo == hi == value and nothing is null
-        if op == "!=" and vlo == vhi and lo == hi == vlo and not null_count:
+        if not _bounds_admit(op, vlo, vhi, lo, hi, null_count):
             return False
     return True
+
+
+def page_ranges_matching(normalized, indexes, num_rows: int):
+    """Row ranges of one row group that may hold matching rows, proven by
+    the page index ({path: (ColumnIndex, OffsetIndex)}). Returns a sorted
+    disjoint [(start, stop)] list; [(0, num_rows)] when nothing can be
+    pruned. Conservative: a range is dropped only when every filter column's
+    ColumnIndex PROVES its pages empty of matches."""
+    ranges = [(0, num_rows)] if num_rows > 0 else []
+    for path, leaf, op, _row_value, vlo, vhi in normalized:
+        pair = indexes.get(path)
+        if not pair:
+            continue
+        ci, oi = pair
+        if ci is None or oi is None or not oi.page_locations:
+            continue
+        locs = oi.page_locations
+        n_pages = len(locs)
+        # a malformed/foreign index (thrift decodes lists independently, so
+        # lengths can disagree, and first_row_index can be absent) must
+        # degrade to "cannot prune on this column", never crash
+        if (
+            ci.null_pages is None
+            or len(ci.null_pages) != n_pages
+            or ci.min_values is None
+            or len(ci.min_values) != n_pages
+            or ci.max_values is None
+            or len(ci.max_values) != n_pages
+            or (ci.null_counts and len(ci.null_counts) != n_pages)
+            or any(not isinstance(loc.first_row_index, int) for loc in locs)
+        ):
+            continue
+        nulls = ci.null_counts if ci.null_counts else [None] * n_pages
+        keep = []
+        for k, loc in enumerate(locs):
+            start = loc.first_row_index
+            stop = (
+                locs[k + 1].first_row_index if k + 1 < n_pages else num_rows
+            )
+            if stop <= start:
+                continue
+            if _page_admits(
+                leaf, op, vlo, vhi, ci.null_pages[k],
+                ci.min_values[k], ci.max_values[k], nulls[k], stop - start,
+            ):
+                keep.append((start, stop))
+        ranges = _intersect_ranges(ranges, keep)
+        if not ranges:
+            return []
+    return _coalesce_ranges(ranges)
+
+
+def _coalesce_ranges(rs):
+    out: list = []
+    for s, e in rs:
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _page_admits(leaf, op, vlo, vhi, is_null_page, min_raw, max_raw, null_count, rows):
+    if is_null_page:
+        return op == "is_null"
+    if op == "is_null":
+        return null_count is None or null_count > 0
+    if op == "not_null":
+        # rows counts ROWS; null_count counts level slots — only the
+        # all-null proof is safe, and only for non-repeated columns
+        return not (
+            leaf.max_rep == 0 and null_count is not None and null_count >= rows
+        )
+    if vlo is None:
+        return True
+    lo = _decode_stat(leaf, min_raw, legacy=False)
+    hi = _decode_stat(leaf, max_raw, legacy=False)
+    if lo is None or hi is None:
+        return True
+    if isinstance(lo, float) and (lo != lo or hi != hi):
+        return True
+    return _bounds_admit(op, vlo, vhi, lo, hi, null_count)
+
+
+def _intersect_ranges(a, b):
+    """Intersection of two sorted disjoint [(start, stop)] lists."""
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo < hi:
+            out.append((lo, hi))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
 
 
 def row_matches(row: dict, normalized) -> bool:

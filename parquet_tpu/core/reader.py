@@ -624,6 +624,47 @@ class FileReader:
             if row_group_may_match(self.row_group(i), normalized)
         ]
 
+    def read_page_index(self, i: int, columns=None) -> dict:
+        """The Parquet page index of row group i: {leaf path: (ColumnIndex,
+        OffsetIndex)}; columns whose chunk carries no index map to
+        (None, None). Beyond the reference (no page-index support there);
+        parity oracle is pyarrow's write_page_index=True output."""
+        from ..meta.parquet_types import ColumnIndex, OffsetIndex
+        from ..meta.thrift import ThriftError
+
+        out = {}
+        for path, cc, _col in self._selected_chunks(i, columns):
+            ci = oi = None
+            try:
+                if cc.column_index_offset and cc.column_index_length:
+                    ci = ColumnIndex.loads(
+                        self._pread(cc.column_index_offset, cc.column_index_length)
+                    )
+                if cc.offset_index_offset and cc.offset_index_length:
+                    oi = OffsetIndex.loads(
+                        self._pread(cc.offset_index_offset, cc.offset_index_length)
+                    )
+            except ThriftError as e:
+                raise ParquetFileError(
+                    f"parquet: corrupt page index for {'.'.join(path)}: {e}"
+                ) from e
+            out[path] = (ci, oi)
+        return out
+
+    def prune_pages(self, i: int, filters) -> list[tuple[int, int]]:
+        """Row ranges of row group i that may contain rows matching
+        `filters`, proven by the page index — sorted disjoint [(start,
+        stop)); [(0, num_rows)] when the file has no page index or nothing
+        can be pruned, [] when the whole group is provably empty of
+        matches."""
+        from .filter import normalize_filters, page_ranges_matching
+
+        normalized = normalize_filters(self.schema, filters)
+        num_rows = self.row_group(i).num_rows or 0
+        paths = [p for p, *_ in normalized]
+        indexes = self.read_page_index(i, columns=paths) if paths else {}
+        return page_ranges_matching(normalized, indexes, num_rows)
+
     def iter_rows(self, row_groups=None, raw: bool = False, filters=None):
         """Yield rows as dicts. `raw=True` gives reference-style nested maps
         (no LIST/MAP unwrapping, bytes not decoded). `filters` is a

@@ -19,14 +19,18 @@ import numpy as np
 
 from ..meta.file_meta import MAGIC, serialize_footer
 from ..meta.parquet_types import (
+    BoundaryOrder,
     ColumnChunk,
+    ColumnIndex,
     ColumnMetaData,
     ColumnOrder,
     CompressionCodec,
     Encoding,
     FileMetaData,
     KeyValue,
+    OffsetIndex,
     PageEncodingStats,
+    PageLocation,
     PageType,
     RowGroup,
     Type,
@@ -80,6 +84,103 @@ _ALLOWED_ENCODINGS = {
 }
 
 
+class _PageIndexBuilder:
+    """Accumulates one chunk's per-page locations + statistics into
+    (ColumnIndex, OffsetIndex) — the Parquet page index (beyond the
+    reference, which writes no page index)."""
+
+    def __init__(self, column: Column, dictionary):
+        self.column = column
+        self.dictionary = dictionary  # dict VALUES when pages carry indices
+        self.locations: list[PageLocation] = []
+        self.null_pages: list[bool] = []
+        self.mins: list[bytes] = []
+        self.maxs: list[bytes] = []
+        self.null_counts: list[int] = []
+        self.first_row = 0
+        self.ok = True  # a page without computable stats voids the index
+
+    def add_page(self, offset: int, size: int, v_slice, d_slice, r_slice) -> None:
+        if not self.ok:
+            return
+        if r_slice is not None and len(r_slice):
+            rows = int((np.asarray(r_slice) == 0).sum())
+        elif d_slice is not None:
+            rows = len(d_slice)
+        else:
+            rows = len(v_slice)
+        self.locations.append(
+            PageLocation(
+                offset=offset, compressed_page_size=size, first_row_index=self.first_row
+            )
+        )
+        self.first_row += rows
+        nulls = (
+            int((np.asarray(d_slice) != self.column.max_def).sum())
+            if d_slice is not None
+            else 0
+        )
+        self.null_counts.append(nulls)
+        values = v_slice
+        if self.dictionary is not None:
+            idx = np.asarray(v_slice)
+            values = (
+                self.dictionary.take(idx.astype(np.int64))
+                if isinstance(self.dictionary, ByteArrayData)
+                else np.asarray(self.dictionary)[idx]
+            )
+        if len(values) == 0:
+            self.null_pages.append(True)
+            self.mins.append(b"")
+            self.maxs.append(b"")
+            return
+        st = compute_statistics(self.column.type, values, nulls)
+        if st.min_value is None or st.max_value is None:
+            # all-NaN page / oversized binary: a legal index can't represent
+            # it, so write no index for this chunk at all
+            self.ok = False
+            return
+        self.null_pages.append(False)
+        self.mins.append(st.min_value)
+        self.maxs.append(st.max_value)
+
+    def _boundary_order(self) -> int:
+        from .stats import _PACK  # the table that packed these exact bytes
+
+        unpack = _PACK.get(self.column.type)
+        if unpack is None:
+            return int(BoundaryOrder.UNORDERED)  # binary orders: stay safe
+        pairs = [
+            (unpack.unpack(mn)[0], unpack.unpack(mx)[0])
+            for mn, mx, null in zip(self.mins, self.maxs, self.null_pages)
+            if not null
+        ]
+        if len(pairs) < 2:
+            return int(BoundaryOrder.ASCENDING)
+        if all(
+            b[0] >= a[0] and b[1] >= a[1] for a, b in zip(pairs, pairs[1:])
+        ):
+            return int(BoundaryOrder.ASCENDING)
+        if all(
+            b[0] <= a[0] and b[1] <= a[1] for a, b in zip(pairs, pairs[1:])
+        ):
+            return int(BoundaryOrder.DESCENDING)
+        return int(BoundaryOrder.UNORDERED)
+
+    def build(self):
+        if not self.ok:
+            return ()
+        ci = ColumnIndex(
+            null_pages=self.null_pages,
+            min_values=self.mins,
+            max_values=self.maxs,
+            boundary_order=self._boundary_order(),
+            null_counts=self.null_counts,
+        )
+        oi = OffsetIndex(page_locations=self.locations)
+        return (ci, oi)
+
+
 class WriterError(ValueError):
     pass
 
@@ -110,6 +211,7 @@ class FileWriter:
         use_dictionary=None,
         with_crc: bool = False,
         key_value_metadata: dict | None = None,
+        write_page_index: bool = False,
     ):
         """`column_encodings` maps a leaf ("a.b" or tuple) to the fallback
         value encoding used when the column is not dictionary-encoded:
@@ -117,7 +219,11 @@ class FileWriter:
         DELTA_LENGTH_BYTE_ARRAY / DELTA_BYTE_ARRAY (byte arrays).
         `use_dictionary` is True/False for all columns or a list of leaves
         to dictionary-encode (overrides `enable_dictionary` when given) —
-        the per-column useDict of the reference (data_store.go:364-461)."""
+        the per-column useDict of the reference (data_store.go:364-461).
+        `write_page_index=True` emits the Parquet page index (ColumnIndex +
+        OffsetIndex per chunk, written between the last row group and the
+        footer) — per-page min/max/null stats readers use for page-level
+        pruning; beyond the reference, which has no page-index support."""
         if isinstance(sink, (str, Path)):
             self._f = open(sink, "wb")
             self._owns_file = True
@@ -151,6 +257,10 @@ class FileWriter:
         self._builders: dict[tuple, ColumnChunkBuilder] = {}
         self._columnar_rows: int | None = None
         self._row_groups: list[RowGroup] = []
+        self.write_page_index = write_page_index
+        # aligned with _row_groups: per group, per chunk (leaf order):
+        # (ColumnChunk, ColumnIndex, OffsetIndex) awaiting emission at close
+        self._page_indexes: list[list[tuple]] = []
         self._flush_kv: dict[tuple, dict] = {}
         self._pos = 0
         self._closed = False
@@ -371,13 +481,16 @@ class FileWriter:
         else:
             return  # nothing buffered
         chunks = []
+        group_indexes: list[tuple] = []
         total_bytes = 0
         total_compressed = 0
         for leaf in self.schema.leaves:
-            cc = self._write_chunk(self._builders[leaf.path], n_rows)
+            cc = self._write_chunk(self._builders[leaf.path], n_rows, group_indexes)
             chunks.append(cc)
             total_bytes += cc.meta_data.total_uncompressed_size
             total_compressed += cc.meta_data.total_compressed_size
+        if self.write_page_index:
+            self._page_indexes.append(group_indexes)
         self._flush_kv = {}
         first_md = chunks[0].meta_data if chunks else None
         first_page_offset = None
@@ -400,7 +513,9 @@ class FileWriter:
         )
         self._reset_builders()
 
-    def _write_chunk(self, builder: ColumnChunkBuilder, n_rows: int) -> ColumnChunk:
+    def _write_chunk(
+        self, builder: ColumnChunkBuilder, n_rows: int, group_indexes: list | None = None
+    ) -> ColumnChunk:
         column = builder.column
         self._uncompressed_total = 0
         typed = builder.typed_values()
@@ -462,9 +577,15 @@ class FileWriter:
 
         data_offset = self._pos
         n_pages = 0
+        index = (
+            _PageIndexBuilder(column, dict_result[0] if dict_result else None)
+            if self.write_page_index and group_indexes is not None
+            else None
+        )
         for v_slice, d_slice, r_slice in self._split_pages(
             page_values, def_levels, rep_levels, column
         ):
+            page_offset = self._pos
             if self.data_page_version == 1:
                 header, block = encode_data_page_v1(
                     column, v_slice, d_slice, r_slice, value_encoding,
@@ -476,6 +597,10 @@ class FileWriter:
                     int(self.codec), dict_size, self.with_crc,
                 )
             self._write_page(header, block)
+            if index is not None:
+                index.add_page(
+                    page_offset, self._pos - page_offset, v_slice, d_slice, r_slice
+                )
             n_pages += 1
         page_type = (
             int(PageType.DATA_PAGE) if self.data_page_version == 1 else int(PageType.DATA_PAGE_V2)
@@ -505,7 +630,12 @@ class FileWriter:
                 [KeyValue(key=k, value=v) for k, v in kv.items()] if kv else None
             ),
         )
-        return ColumnChunk(file_offset=0, meta_data=md)
+        cc = ColumnChunk(file_offset=0, meta_data=md)
+        if index is not None:
+            built = index.build()
+            if built:
+                group_indexes.append((cc, *built))
+        return cc
 
     def _write_page(self, header, block: bytes) -> None:
         hdr = header.dumps()
@@ -570,6 +700,22 @@ class FileWriter:
     def close(self) -> FileMetaData:
         self._check_open()
         self.flush_row_group()
+        # Page index blobs live between the last row group and the footer
+        # (parquet-format PageIndex layout): all ColumnIndexes, then all
+        # OffsetIndexes, with ColumnChunk fields pointing at them.
+        for group in self._page_indexes:
+            for cc, ci, _oi in group:
+                blob = ci.dumps()
+                cc.column_index_offset = self._pos
+                cc.column_index_length = len(blob)
+                self._write(blob)
+        for group in self._page_indexes:
+            for cc, _ci, oi in group:
+                blob = oi.dumps()
+                cc.offset_index_offset = self._pos
+                cc.offset_index_length = len(blob)
+                self._write(blob)
+        self._page_indexes = []
         meta = FileMetaData(
             version=2,
             schema=self.schema.to_thrift(),

@@ -269,6 +269,48 @@ class Statistics(TStruct):
     }
 
 
+class BoundaryOrder(enum.IntEnum):
+    """Ordering of min/max values across a ColumnIndex (parquet.thrift)."""
+
+    UNORDERED = 0
+    ASCENDING = 1
+    DESCENDING = 2
+
+
+class PageLocation(TStruct):
+    FIELDS = {
+        1: ("offset", T_I64, None),
+        2: ("compressed_page_size", T_I32, None),
+        3: ("first_row_index", T_I64, None),
+    }
+
+
+class OffsetIndex(TStruct):
+    """Per-page physical locations of one column chunk (the page index's
+    row-range half; written after the row groups, referenced from
+    ColumnChunk.offset_index_offset/_length)."""
+
+    FIELDS = {
+        1: ("page_locations", T_LIST, (T_STRUCT, PageLocation)),
+        2: ("unencoded_byte_array_data_bytes", T_LIST, (T_I64, None)),
+    }
+
+
+class ColumnIndex(TStruct):
+    """Per-page min/max/null statistics of one column chunk (the page
+    index's pruning half; ColumnChunk.column_index_offset/_length)."""
+
+    FIELDS = {
+        1: ("null_pages", T_LIST, (T_BOOL, None)),
+        2: ("min_values", T_LIST, (T_BINARY, None)),
+        3: ("max_values", T_LIST, (T_BINARY, None)),
+        4: ("boundary_order", T_I32, None),
+        5: ("null_counts", T_LIST, (T_I64, None)),
+        6: ("repetition_level_histograms", T_LIST, (T_I64, None)),
+        7: ("definition_level_histograms", T_LIST, (T_I64, None)),
+    }
+
+
 class KeyValue(TStruct):
     FIELDS = {
         1: ("key", T_STRING, None),
