@@ -355,6 +355,13 @@ def page_ranges_matching(normalized, indexes, num_rows: int):
             or len(ci.max_values) != n_pages
             or (ci.null_counts and len(ci.null_counts) != n_pages)
             or any(not isinstance(loc.first_row_index, int) for loc in locs)
+            or locs[0].first_row_index < 0
+            # non-monotonic row indexes would break the sorted-disjoint
+            # contract of the range intersection below
+            or any(
+                b.first_row_index <= a.first_row_index
+                for a, b in zip(locs, locs[1:])
+            )
         ):
             continue
         nulls = ci.null_counts if ci.null_counts else [None] * n_pages

@@ -676,6 +676,7 @@ class FileReader:
             from .filter import (
                 FilterError,
                 normalize_filters,
+                page_ranges_matching,
                 row_group_may_match,
                 row_matches,
             )
@@ -697,15 +698,36 @@ class FileReader:
                 continue
             if not row_group_may_match(self.row_group(i), normalized):
                 continue
-            for row in self._iter_group_rows(i, raw):
+            # page index (when written): restrict row materialization to the
+            # ranges whose pages may match — row assembly is the dominant
+            # cost of a filtered scan, so pruned ranges never build rows
+            ranges = None
+            try:
+                paths = [p for p, *_ in normalized]
+                indexes = self.read_page_index(i, columns=paths)
+                if any(ci is not None for ci, _ in indexes.values()):
+                    num_rows = self.row_group(i).num_rows or 0
+                    ranges = page_ranges_matching(normalized, indexes, num_rows)
+                    if ranges == [(0, num_rows)]:
+                        # nothing pruned: keep the unpruned fast paths
+                        # (direct list / plain windows, no extra slicing)
+                        ranges = None
+            except ParquetFileError:
+                ranges = None  # corrupt index: scan everything, stay correct
+            if ranges is not None and not ranges:
+                continue
+            for row in self._iter_group_rows(i, raw, ranges):
                 if row_matches(row, normalized):
                     yield row
 
-    def _iter_group_rows(self, i: int, raw: bool):
+    def _iter_group_rows(self, i: int, raw: bool, ranges=None):
         """One row group's rows: a LIST for small vectorized shapes (callers
         iterate without an extra generator frame per row), a window-batched
         generator for large ones (bounds the live tracked-object count so
-        cyclic GC passes stay cheap), or the streaming Dremel fallback."""
+        cyclic GC passes stay cheap), or the streaming Dremel fallback.
+        `ranges` (sorted disjoint [(start, stop)), from the page index)
+        limits which rows materialize; the Dremel fallback ignores it (the
+        caller's exact predicate check keeps the result correct)."""
         chunks = self._read_row_group(i, None, pack=False)
         with stage("assemble"):
             with _gc_paused():
@@ -720,6 +742,8 @@ class FileReader:
         names, columns, n = rc
         if not names or n == 0:
             return []
+        if ranges is not None:
+            return self._ranged_rows(names, columns, ranges)
         if n <= _ASSEMBLE_WINDOW:
             with stage("assemble"), _gc_paused():
                 return _zip_dict_rows(names, columns)
@@ -734,6 +758,17 @@ class FileReader:
                     names, [slice_column(c, s, e) for c in columns]
                 )
             yield from rows
+
+    @staticmethod
+    def _ranged_rows(names, columns, ranges):
+        for start, stop in ranges:
+            for s in range(start, stop, _ASSEMBLE_WINDOW):
+                e = min(s + _ASSEMBLE_WINDOW, stop)
+                with stage("assemble"), _gc_paused():
+                    rows = _zip_dict_rows(
+                        names, [slice_column(c, s, e) for c in columns]
+                    )
+                yield from rows
 
     def iter_row_groups(self, columns=None):
         for i in range(self.num_row_groups):

@@ -13,10 +13,10 @@ import struct
 
 import numpy as np
 
-from ..meta.parquet_types import Statistics, Type
+from ..meta.parquet_types import ConvertedType, Statistics, Type
 from .arrays import ByteArrayData
 
-__all__ = ["compute_statistics"]
+__all__ = ["compute_statistics", "column_is_unsigned"]
 
 _PACK = {
     Type.INT32: struct.Struct("<i"),
@@ -25,15 +25,52 @@ _PACK = {
     Type.DOUBLE: struct.Struct("<d"),
 }
 
+_PACK_UNSIGNED = {
+    Type.INT32: struct.Struct("<I"),
+    Type.INT64: struct.Struct("<Q"),
+}
+
+_UINT_VIEW = {Type.INT32: np.uint32, Type.INT64: np.uint64}
+
+_UNSIGNED_CTS = (
+    ConvertedType.UINT_8,
+    ConvertedType.UINT_16,
+    ConvertedType.UINT_32,
+    ConvertedType.UINT_64,
+)
+
+
+def column_is_unsigned(column) -> bool:
+    """Whether a leaf's logical/converted type makes its order UNSIGNED —
+    min/max must then be computed over the unsigned interpretation
+    (parquet-format TypeDefinedOrder for UINT_8..UINT_64)."""
+    lt = column.logical_type
+    if lt is not None and lt.INTEGER is not None:
+        return not lt.INTEGER.isSigned
+    ct = column.converted_type
+    return ct is not None and ct in _UNSIGNED_CTS
+
 # Cap stored min/max byte length, as modern writers do for wide binary values.
 _MAX_STAT_BYTES = 64
 
 
-def compute_statistics(ptype: Type, values, null_count: int) -> Statistics:
-    """Build Statistics for one page or chunk. `values` holds non-null cells."""
+def compute_statistics(
+    ptype: Type, values, null_count: int, unsigned: bool = False
+) -> Statistics:
+    """Build Statistics for one page or chunk. `values` holds non-null
+    cells. `unsigned=True` (UINT logical/converted types) compares and
+    packs min/max in the unsigned domain — the column's defined order; the
+    deprecated min/max fields are then left unset (they are specified as
+    signed-compared, so an unsigned pair there would mislead old readers)."""
     st = Statistics(null_count=null_count)
     n = len(values) if values is not None else 0
     if n == 0:
+        return st
+    if unsigned and ptype in _PACK_UNSIGNED:
+        arr = np.asarray(values).view(_UINT_VIEW[ptype])
+        pk = _PACK_UNSIGNED[ptype]
+        st.min_value = pk.pack(int(arr.min()))
+        st.max_value = pk.pack(int(arr.max()))
         return st
     if ptype in _PACK:
         arr = np.asarray(values)

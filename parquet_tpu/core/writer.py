@@ -50,7 +50,7 @@ from .page import (
 )
 from .schema import Column, Schema
 from .shred import Shredder
-from .stats import compute_statistics
+from .stats import column_is_unsigned, compute_statistics
 
 __all__ = ["FileWriter", "WriterError"]
 
@@ -91,6 +91,7 @@ class _PageIndexBuilder:
 
     def __init__(self, column: Column, dictionary):
         self.column = column
+        self.unsigned = column_is_unsigned(column)
         self.dictionary = dictionary  # dict VALUES when pages carry indices
         self.locations: list[PageLocation] = []
         self.null_pages: list[bool] = []
@@ -134,7 +135,7 @@ class _PageIndexBuilder:
             self.mins.append(b"")
             self.maxs.append(b"")
             return
-        st = compute_statistics(self.column.type, values, nulls)
+        st = compute_statistics(self.column.type, values, nulls, self.unsigned)
         if st.min_value is None or st.max_value is None:
             # all-NaN page / oversized binary: a legal index can't represent
             # it, so write no index for this chunk at all
@@ -145,9 +146,14 @@ class _PageIndexBuilder:
         self.maxs.append(st.max_value)
 
     def _boundary_order(self) -> int:
-        from .stats import _PACK  # the table that packed these exact bytes
+        # the tables that packed these exact bytes
+        from .stats import _PACK, _PACK_UNSIGNED
 
-        unpack = _PACK.get(self.column.type)
+        unpack = (
+            _PACK_UNSIGNED.get(self.column.type)
+            if self.unsigned
+            else _PACK.get(self.column.type)
+        )
         if unpack is None:
             return int(BoundaryOrder.UNORDERED)  # binary orders: stay safe
         pairs = [
@@ -612,7 +618,9 @@ class FileWriter:
             )
         )
         total_compressed = self._pos - first_offset
-        stats = compute_statistics(column.type, typed, null_count)
+        stats = compute_statistics(
+            column.type, typed, null_count, column_is_unsigned(column)
+        )
         kv = self._flush_kv.get(column.path)
         md = ColumnMetaData(
             type=int(column.type),

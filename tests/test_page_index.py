@@ -174,3 +174,60 @@ class TestPruneOracle:
                         "<=": vals <= v, ">": vals > v, ">=": vals >= v,
                     }[op]
                     assert not (mask & ~covered).any(), (op, v)
+
+
+class TestFilteredIterRows:
+    def test_filtered_scan_uses_index_and_stays_exact(self, tmp_path):
+        n = 50_000
+        vals = np.arange(n, dtype=np.int64)
+        strs = [f"g{i // 5000}" for i in range(n)]
+        schema = parse_schema(
+            "message m { required int64 a; required binary s (UTF8); }"
+        )
+        for wpi in (False, True):
+            path = str(tmp_path / f"scan_{wpi}.parquet")
+            with FileWriter(
+                path, schema, write_page_index=wpi, max_page_size=16_384,
+                use_dictionary=False,
+            ) as w:
+                w.write_column("a", vals)
+                w.write_column("s", strs)
+            with FileReader(path) as r:
+                got = list(r.iter_rows(filters=[("a", ">=", 47_000)]))
+                assert [row["a"] for row in got] == list(range(47_000, n))
+                got2 = list(
+                    r.iter_rows(filters=[("a", "<", 2_000), ("s", "==", "g0")])
+                )
+                assert [row["a"] for row in got2] == list(range(2_000))
+                assert list(r.iter_rows(filters=[("a", "==", -1)])) == []
+
+
+class TestUnsignedStats:
+    def test_uint32_crossing_sign_bit(self, tmp_path):
+        """min/max for UINT columns must compare unsigned (review regression:
+        signed order inverted around 2^31, silently pruning matching rows)."""
+        schema = parse_schema("message m { required int32 a (UINT_32); }")
+        vals = np.arange(2_147_480_000, 2_147_500_000, dtype=np.uint32)
+        path = str(tmp_path / "uint.parquet")
+        with FileWriter(
+            path, schema, write_page_index=True, max_page_size=8_192,
+            use_dictionary=False,
+        ) as w:
+            w.write_column("a", vals.view(np.int32))
+        target = 2_147_483_700
+        with FileReader(path) as r:
+            got = list(r.iter_rows(filters=[("a", "==", target)]))
+            assert len(got) == 1 and got[0]["a"] == target
+            # chunk statistics also carry unsigned order now
+            st = r.row_group(0).columns[0].meta_data.statistics
+            import struct as _s
+
+            lo = _s.unpack("<I", st.min_value)[0]
+            hi = _s.unpack("<I", st.max_value)[0]
+            assert (lo, hi) == (int(vals.min()), int(vals.max()))
+            # deprecated fields omitted: they are specified signed-compared
+            assert st.min is None and st.max is None
+        # pyarrow agrees on the written stats
+        col = pq.ParquetFile(path).metadata.row_group(0).column(0)
+        assert col.statistics.min == int(vals.min())
+        assert col.statistics.max == int(vals.max())
