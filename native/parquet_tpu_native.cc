@@ -408,6 +408,123 @@ ssize_t ptq_lz4_hadoop_decompress(const char* src_c, size_t src_len,
 }
 
 // ---------------------------------------------------------------------------
+// XXH64 + split-block bloom filter (parquet-format BloomFilter.md)
+//
+// Implemented from the public xxHash specification and the parquet split-
+// block bloom description: 32-byte blocks of 8 uint32 words; a value's
+// block comes from the hash's top 32 bits, its 8 bit positions from the
+// low 32 bits multiplied by 8 fixed odd salts.
+// ---------------------------------------------------------------------------
+
+static const uint64_t XP1 = 0x9E3779B185EBCA87ull;
+static const uint64_t XP2 = 0xC2B2AE3D27D4EB4Full;
+static const uint64_t XP3 = 0x165667B19E3779F9ull;
+static const uint64_t XP4 = 0x85EBCA77C2B2AE63ull;
+static const uint64_t XP5 = 0x27D4EB2F165667C5ull;
+
+static inline uint64_t xrotl(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t xread64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (matches the rest of this file)
+}
+
+static inline uint32_t xread32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t ptq_xxh64(const uint8_t* p, size_t len, uint64_t seed) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + XP1 + XP2, v2 = seed + XP2, v3 = seed, v4 = seed - XP1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xrotl(v1 + xread64(p) * XP2, 31) * XP1;
+      v2 = xrotl(v2 + xread64(p + 8) * XP2, 31) * XP1;
+      v3 = xrotl(v3 + xread64(p + 16) * XP2, 31) * XP1;
+      v4 = xrotl(v4 + xread64(p + 24) * XP2, 31) * XP1;
+      p += 32;
+    } while (p <= limit);
+    h = xrotl(v1, 1) + xrotl(v2, 7) + xrotl(v3, 12) + xrotl(v4, 18);
+    h = (h ^ (xrotl(v1 * XP2, 31) * XP1)) * XP1 + XP4;
+    h = (h ^ (xrotl(v2 * XP2, 31) * XP1)) * XP1 + XP4;
+    h = (h ^ (xrotl(v3 * XP2, 31) * XP1)) * XP1 + XP4;
+    h = (h ^ (xrotl(v4 * XP2, 31) * XP1)) * XP1 + XP4;
+  } else {
+    h = seed + XP5;
+  }
+  h += static_cast<uint64_t>(len);
+  while (p + 8 <= end) {
+    h = xrotl(h ^ (xrotl(xread64(p) * XP2, 31) * XP1), 27) * XP1 + XP4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h = xrotl(h ^ (static_cast<uint64_t>(xread32(p)) * XP1), 23) * XP2 + XP3;
+    p += 4;
+  }
+  while (p < end) {
+    h = xrotl(h ^ (static_cast<uint64_t>(*p) * XP5), 11) * XP1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= XP2;
+  h ^= h >> 29;
+  h *= XP3;
+  h ^= h >> 32;
+  return h;
+}
+
+// Hash n fixed-width elements (stride bytes each, contiguous).
+void ptq_xxh64_fixed(const uint8_t* src, int64_t n, int stride, uint64_t* out) {
+  for (int64_t i = 0; i < n; i++)
+    out[i] = ptq_xxh64(src + static_cast<size_t>(i) * stride, stride, 0);
+}
+
+// Hash n variable-length elements addressed by int64 offsets[n+1].
+void ptq_xxh64_offsets(const uint8_t* data, const int64_t* offsets, int64_t n,
+                       uint64_t* out) {
+  for (int64_t i = 0; i < n; i++)
+    out[i] = ptq_xxh64(data + offsets[i],
+                       static_cast<size_t>(offsets[i + 1] - offsets[i]), 0);
+}
+
+static const uint32_t BLOOM_SALT[8] = {
+    0x47b6137bu, 0x44974d91u, 0x8824ad5bu, 0xa2b7289du,
+    0x705495c7u, 0x2df1424bu, 0x9efc4947u, 0x5c6bfb31u};
+
+void ptq_bloom_insert(uint32_t* blocks, int64_t num_blocks,
+                      const uint64_t* hashes, int64_t n) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = hashes[i];
+    uint64_t bi = ((h >> 32) * static_cast<uint64_t>(num_blocks)) >> 32;
+    uint32_t x = static_cast<uint32_t>(h);
+    uint32_t* b = blocks + bi * 8;
+    for (int j = 0; j < 8; j++) b[j] |= 1u << ((x * BLOOM_SALT[j]) >> 27);
+  }
+}
+
+// out[i] = 1 if hashes[i] might be present.
+void ptq_bloom_check(const uint32_t* blocks, int64_t num_blocks,
+                     const uint64_t* hashes, int64_t n, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h = hashes[i];
+    uint64_t bi = ((h >> 32) * static_cast<uint64_t>(num_blocks)) >> 32;
+    uint32_t x = static_cast<uint32_t>(h);
+    const uint32_t* b = blocks + bi * 8;
+    uint8_t hit = 1;
+    for (int j = 0; j < 8; j++)
+      hit &= static_cast<uint8_t>((b[j] >> ((x * BLOOM_SALT[j]) >> 27)) & 1);
+    out[i] = hit;
+  }
+}
+
+// ---------------------------------------------------------------------------
 // PLAIN byte_array scan: 4-byte LE length + payload, repeated
 // ---------------------------------------------------------------------------
 

@@ -1,0 +1,264 @@
+"""Split-block bloom filters (parquet-format BloomFilter.md).
+
+Beyond the reference (no bloom support there). A chunk's filter is an array
+of 32-byte blocks (8 uint32 words); a value hashes with XXH64 (seed 0) over
+its PLAIN-encoded bytes, the hash's top 32 bits pick the block, and the low
+32 bits x 8 fixed odd salts pick one bit per word. Equality predicates on
+high-cardinality columns — exactly where min/max statistics are useless —
+prune row groups whose filter proves the value absent.
+
+Hashing and block ops run in native C (utils/native.py); a pure-Python XXH64
+keeps the feature correct without the library. pyarrow (bloom_filter_options)
+is the cross-implementation write oracle.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import numpy as np
+
+from ..meta.parquet_types import (
+    BloomFilterAlgorithm,
+    BloomFilterCompression,
+    BloomFilterHash,
+    BloomFilterHeader,
+    BloomFilterUncompressed,
+    SplitBlockAlgorithm,
+    Type,
+    XxHash,
+)
+
+__all__ = ["BloomFilter", "bloom_hash_values", "plain_bytes_for_hash"]
+
+_SALT = np.array(
+    [
+        0x47B6137B, 0x44974D91, 0x8824AD5B, 0xA2B7289D,
+        0x705495C7, 0x2DF1424B, 0x9EFC4947, 0x5C6BFB31,
+    ],
+    dtype=np.uint64,
+)
+
+_M64 = (1 << 64) - 1
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """Pure-Python XXH64 (spec implementation; the native C path is the hot
+    one — this keeps bloom filters correct without the library)."""
+    p, end = 0, len(data)
+    if end >= 32:
+        v1 = (seed + _P1 + _P2) & _M64
+        v2 = (seed + _P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _P1) & _M64
+        while p + 32 <= end:
+            for off, v in ((0, 1), (8, 2), (16, 3), (24, 4)):
+                lane = int.from_bytes(data[p + off : p + off + 8], "little")
+                acc = {1: v1, 2: v2, 3: v3, 4: v4}[v]
+                acc = (_rotl((acc + lane * _P2) & _M64, 31) * _P1) & _M64
+                if v == 1:
+                    v1 = acc
+                elif v == 2:
+                    v2 = acc
+                elif v == 3:
+                    v3 = acc
+                else:
+                    v4 = acc
+            p += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)) & _M64
+        for acc in (v1, v2, v3, v4):
+            h = ((h ^ (_rotl((acc * _P2) & _M64, 31) * _P1) & _M64) * _P1 + _P4) & _M64
+    else:
+        h = (seed + _P5) & _M64
+    h = (h + end) & _M64
+    while p + 8 <= end:
+        k = (_rotl((int.from_bytes(data[p : p + 8], "little") * _P2) & _M64, 31) * _P1) & _M64
+        h = (_rotl(h ^ k, 27) * _P1 + _P4) & _M64
+        p += 8
+    if p + 4 <= end:
+        h = (_rotl(h ^ ((int.from_bytes(data[p : p + 4], "little") * _P1) & _M64), 23) * _P2 + _P3) & _M64
+        p += 4
+    while p < end:
+        h = (_rotl(h ^ ((data[p] * _P5) & _M64), 11) * _P1) & _M64
+        p += 1
+    h ^= h >> 33
+    h = (h * _P2) & _M64
+    h ^= h >> 29
+    h = (h * _P3) & _M64
+    h ^= h >> 32
+    return h
+
+
+_FIXED_WIDTH = {Type.INT32: 4, Type.INT64: 8, Type.FLOAT: 4, Type.DOUBLE: 8}
+
+
+def plain_bytes_for_hash(ptype, value, unsigned: bool = False) -> bytes | None:
+    """PLAIN-encoded bytes of one filter value (the hash input), or None
+    when the value has no exact physical form for this type."""
+    try:
+        if ptype == Type.INT32:
+            return struct.pack("<I" if unsigned else "<i", value)
+        if ptype == Type.INT64:
+            return struct.pack("<Q" if unsigned else "<q", value)
+        if ptype == Type.FLOAT:
+            # +0.0 == -0.0 but their bit patterns differ; both sides of the
+            # bloom (insert and probe) normalize to +0.0 so equality survives
+            return struct.pack("<f", value + 0.0)
+        if ptype == Type.DOUBLE:
+            return struct.pack("<d", value + 0.0)
+        if ptype in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+            if isinstance(value, str):
+                return value.encode("utf-8")
+            if isinstance(value, (bytes, bytearray, memoryview)):
+                return bytes(value)
+    except struct.error:
+        return None
+    return None
+
+
+def bloom_hash_values(ptype, values) -> np.ndarray:
+    """XXH64 of every value's PLAIN bytes -> uint64 hashes (native batch
+    path when built)."""
+    from ..utils.native import get_native
+    from .arrays import ByteArrayData
+
+    lib = get_native()
+    if isinstance(values, ByteArrayData):
+        if lib is not None and lib.has_xxh64:
+            return lib.xxh64_offsets(values.data, values.offsets)
+        return np.array(
+            [xxh64(v) for v in values.to_list()], dtype=np.uint64
+        )
+    arr = np.ascontiguousarray(np.asarray(values))
+    if arr.ndim == 2:  # FLBA rows
+        width = arr.shape[1]
+        if lib is not None and lib.has_xxh64:
+            return lib.xxh64_fixed(arr, len(arr), width)
+        return np.array([xxh64(r.tobytes()) for r in arr], dtype=np.uint64)
+    width = _FIXED_WIDTH.get(ptype)
+    if width is None or arr.itemsize != width:
+        raise ValueError(f"bloom: unsupported type {ptype} for hashing")
+    if ptype in (Type.FLOAT, Type.DOUBLE):
+        # normalize -0.0 -> +0.0 (see plain_bytes_for_hash)
+        arr = np.ascontiguousarray(arr + arr.dtype.type(0.0))
+    if lib is not None and lib.has_xxh64:
+        return lib.xxh64_fixed(arr, len(arr), width)
+    raw = arr.tobytes()
+    return np.array(
+        [xxh64(raw[i * width : (i + 1) * width]) for i in range(len(arr))],
+        dtype=np.uint64,
+    )
+
+
+class BloomFilter:
+    """One column chunk's split-block bloom filter."""
+
+    MIN_BYTES = 32
+    MAX_BYTES = 128 << 20
+
+    def __init__(self, blocks: np.ndarray):
+        if blocks.dtype != np.uint32 or len(blocks) % 8:
+            raise ValueError("bloom: bitset must be uint32 words in 8-word blocks")
+        self.blocks = blocks
+
+    @classmethod
+    def sized_for(cls, ndv: int, fpp: float = 0.05) -> "BloomFilter":
+        """Empty filter sized for `ndv` distinct values at false-positive
+        rate `fpp` (parquet-mr's optimal-bits formula, bytes rounded up to a
+        power of two within [32 B, 128 MB])."""
+        ndv = max(int(ndv), 1)
+        if not 0.0 < fpp < 1.0:
+            raise ValueError("bloom: fpp must be in (0, 1)")
+        bits = -8.0 * ndv / math.log(1.0 - fpp ** (1.0 / 8.0))
+        nbytes = 1 << max(int(bits / 8.0) - 1, 0).bit_length()
+        nbytes = min(max(nbytes, cls.MIN_BYTES), cls.MAX_BYTES)
+        return cls(np.zeros(nbytes // 4, dtype=np.uint32))
+
+    @property
+    def num_bytes(self) -> int:
+        return self.blocks.nbytes
+
+    def insert_hashes(self, hashes: np.ndarray) -> None:
+        from ..utils.native import get_native
+
+        lib = get_native()
+        if lib is not None and lib.has_xxh64:
+            lib.bloom_insert(self.blocks, hashes)
+            return
+        nb = len(self.blocks) // 8
+        for h in hashes.tolist():
+            bi = ((h >> 32) * nb) >> 32
+            x = np.uint64(h & 0xFFFFFFFF)
+            bits = ((x * _SALT) & np.uint64(0xFFFFFFFF)) >> np.uint64(27)
+            self.blocks[bi * 8 : bi * 8 + 8] |= (
+                np.uint32(1) << bits.astype(np.uint32)
+            )
+
+    def might_contain_hash(self, h: int) -> bool:
+        nb = len(self.blocks) // 8
+        bi = ((h >> 32) * nb) >> 32
+        x = np.uint64(h & 0xFFFFFFFF)
+        bits = ((x * _SALT) & np.uint64(0xFFFFFFFF)) >> np.uint64(27)
+        words = self.blocks[bi * 8 : bi * 8 + 8]
+        return bool(
+            np.all((words >> bits.astype(np.uint32)) & np.uint32(1))
+        )
+
+    def might_contain(self, ptype, value, unsigned: bool = False) -> bool:
+        """False only when the value is PROVABLY absent; unsupported value
+        forms answer True (no pruning)."""
+        raw = plain_bytes_for_hash(ptype, value, unsigned)
+        if raw is None:
+            return True
+        from ..utils.native import get_native
+
+        lib = get_native()
+        h = lib.xxh64(raw) if lib is not None and lib.has_xxh64 else xxh64(raw)
+        return self.might_contain_hash(h)
+
+    # -- wire form -------------------------------------------------------------
+
+    def header(self) -> BloomFilterHeader:
+        return BloomFilterHeader(
+            numBytes=self.num_bytes,
+            algorithm=BloomFilterAlgorithm(BLOCK=SplitBlockAlgorithm()),
+            hash=BloomFilterHash(XXHASH=XxHash()),
+            compression=BloomFilterCompression(
+                UNCOMPRESSED=BloomFilterUncompressed()
+            ),
+        )
+
+    def to_bytes(self) -> bytes:
+        return self.header().dumps() + self.blocks.tobytes()
+
+    @classmethod
+    def from_buffer(cls, buf) -> "BloomFilter":
+        """Parse [BloomFilterHeader][bitset] as stored in the file."""
+        from ..meta.thrift import CompactReader
+
+        r = CompactReader(buf)
+        header = BloomFilterHeader.read(r)
+        n = header.numBytes or 0
+        if n <= 0 or n % 32 or r.pos + n > len(buf):
+            raise ValueError(f"bloom: bad bitset size {n}")
+        if header.algorithm is not None and header.algorithm.BLOCK is None:
+            raise ValueError("bloom: unsupported algorithm")
+        if header.hash is not None and header.hash.XXHASH is None:
+            raise ValueError("bloom: unsupported hash")
+        if (
+            header.compression is not None
+            and header.compression.UNCOMPRESSED is None
+        ):
+            raise ValueError("bloom: unsupported compression")
+        bits = np.frombuffer(buf, dtype=np.uint32, count=n // 4, offset=r.pos)
+        return cls(bits.copy())

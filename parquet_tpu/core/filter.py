@@ -24,7 +24,7 @@ import decimal
 import math
 import struct
 
-from ..meta.parquet_types import ConvertedType, Type
+from ..meta.parquet_types import Type
 from .assembly import logical_kind
 from .schema import Schema
 from .stats import _PACK
@@ -41,12 +41,6 @@ _UNSIGNED = {
     Type.INT64: struct.Struct("<Q"),
 }
 
-_UNSIGNED_CT = (
-    ConvertedType.UINT_8,
-    ConvertedType.UINT_16,
-    ConvertedType.UINT_32,
-    ConvertedType.UINT_64,
-)
 
 
 class FilterError(ValueError):
@@ -54,10 +48,11 @@ class FilterError(ValueError):
 
 
 def _is_unsigned(leaf) -> bool:
-    lt = leaf.logical_type
-    if lt is not None and lt.INTEGER is not None:
-        return not lt.INTEGER.isSigned
-    return leaf.converted_type in _UNSIGNED_CT
+    # one shared definition of UNSIGNED order (stats.py writes with it,
+    # this module decodes with it — they must never drift)
+    from .stats import column_is_unsigned
+
+    return column_is_unsigned(leaf)
 
 
 def normalize_filters(schema: Schema, filters) -> list:

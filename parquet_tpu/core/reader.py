@@ -622,6 +622,7 @@ class FileReader:
             i
             for i in range(self.num_row_groups)
             if row_group_may_match(self.row_group(i), normalized)
+            and not self._bloom_excludes(i, normalized)
         ]
 
     def read_page_index(self, i: int, columns=None) -> dict:
@@ -650,6 +651,81 @@ class FileReader:
                 ) from e
             out[path] = (ci, oi)
         return out
+
+    def read_bloom_filter(self, i: int, column):
+        """The split-block bloom filter of one column chunk, or None when
+        the chunk carries none. Beyond the reference; pyarrow's
+        bloom_filter_options output is the cross-implementation oracle."""
+        from .bloom import BloomFilter
+
+        path = tuple(column.split(".")) if isinstance(column, str) else tuple(column)
+        cache = getattr(self, "_bloom_cache", None)
+        if cache is None:
+            cache = self._bloom_cache = {}
+        if (i, path) in cache:
+            return cache[(i, path)]
+        rg = self.row_group(i)
+        for cc in rg.columns or []:
+            md = cc.meta_data
+            if md is None or tuple(md.path_in_schema or []) != path:
+                continue
+            off = md.bloom_filter_offset
+            if not off or off <= 0:
+                cache[(i, path)] = None
+                return None
+            length = md.bloom_filter_length
+            if not length or length <= 0:
+                # header precedes the bitset; peek enough for the header,
+                # parse numBytes, then take exactly header+bitset
+                peek = self._pread(off, 64)
+                from ..meta.parquet_types import BloomFilterHeader
+                from ..meta.thrift import CompactReader, ThriftError
+
+                try:
+                    r = CompactReader(peek)
+                    h = BloomFilterHeader.read(r)
+                except ThriftError as e:
+                    raise ParquetFileError(
+                        f"parquet: corrupt bloom header for {'.'.join(path)}: {e}"
+                    ) from e
+                length = r.pos + (h.numBytes or 0)
+            try:
+                bf = BloomFilter.from_buffer(self._pread(off, length))
+            except ValueError as e:
+                raise ParquetFileError(
+                    f"parquet: corrupt bloom filter for {'.'.join(path)}: {e}"
+                ) from e
+            cache[(i, path)] = bf
+            return bf
+        raise ParquetFileError(f"parquet: column {'.'.join(path)} not in row group")
+
+    def _bloom_excludes(self, i: int, normalized) -> bool:
+        """True when some equality predicate's value is PROVABLY absent from
+        row group i per its bloom filter (false-positive-only structure:
+        never excludes a group that contains the value)."""
+        from .stats import column_is_unsigned
+
+        rg = self.row_group(i)
+        by_path = {tuple(c.meta_data.path_in_schema or []): c for c in rg.columns or []}
+        for path, leaf, op, _rv, vlo, vhi in normalized:
+            if op != "==" or vlo is None or vlo != vhi:
+                continue
+            cc = by_path.get(path)
+            if (
+                cc is None
+                or cc.meta_data is None
+                or not cc.meta_data.bloom_filter_offset
+            ):
+                continue
+            try:
+                bf = self.read_bloom_filter(i, path)
+            except ParquetFileError:
+                continue  # corrupt filter: never exclude on it
+            if bf is not None and not bf.might_contain(
+                leaf.type, vlo, column_is_unsigned(leaf)
+            ):
+                return True
+        return False
 
     def prune_pages(self, i: int, filters) -> list[tuple[int, int]]:
         """Row ranges of row group i that may contain rows matching
@@ -697,6 +773,8 @@ class FileReader:
                 yield from self._iter_group_rows(i, raw)
                 continue
             if not row_group_may_match(self.row_group(i), normalized):
+                continue
+            if self._bloom_excludes(i, normalized):
                 continue
             # page index (when written): restrict row materialization to the
             # ranges whose pages may match — row assembly is the dominant
@@ -747,17 +825,7 @@ class FileReader:
         if n <= _ASSEMBLE_WINDOW:
             with stage("assemble"), _gc_paused():
                 return _zip_dict_rows(names, columns)
-        return self._windowed_rows(names, columns, n)
-
-    @staticmethod
-    def _windowed_rows(names, columns, n):
-        for s in range(0, n, _ASSEMBLE_WINDOW):
-            e = min(s + _ASSEMBLE_WINDOW, n)
-            with stage("assemble"), _gc_paused():
-                rows = _zip_dict_rows(
-                    names, [slice_column(c, s, e) for c in columns]
-                )
-            yield from rows
+        return self._ranged_rows(names, columns, [(0, n)])
 
     @staticmethod
     def _ranged_rows(names, columns, ranges):

@@ -218,6 +218,7 @@ class FileWriter:
         with_crc: bool = False,
         key_value_metadata: dict | None = None,
         write_page_index: bool = False,
+        bloom_filters=None,
     ):
         """`column_encodings` maps a leaf ("a.b" or tuple) to the fallback
         value encoding used when the column is not dictionary-encoded:
@@ -229,7 +230,11 @@ class FileWriter:
         `write_page_index=True` emits the Parquet page index (ColumnIndex +
         OffsetIndex per chunk, written between the last row group and the
         footer) — per-page min/max/null stats readers use for page-level
-        pruning; beyond the reference, which has no page-index support."""
+        pruning; beyond the reference, which has no page-index support.
+        `bloom_filters` emits split-block bloom filters (also beyond the
+        reference): a {leaf: True | {"fpp": float, "ndv": int}} dict, a
+        list of leaves, or True for every eligible leaf; default fpp 0.01,
+        default ndv the chunk's value count (exact for dictionary chunks)."""
         if isinstance(sink, (str, Path)):
             self._f = open(sink, "wb")
             self._owns_file = True
@@ -267,6 +272,8 @@ class FileWriter:
         # aligned with _row_groups: per group, per chunk (leaf order):
         # (ColumnChunk, ColumnIndex, OffsetIndex) awaiting emission at close
         self._page_indexes: list[list[tuple]] = []
+        self._bloom_specs = self._resolve_blooms(schema, bloom_filters)
+        self._blooms: list[tuple] = []  # (ColumnMetaData, BloomFilter)
         self._flush_kv: dict[tuple, dict] = {}
         self._pos = 0
         self._closed = False
@@ -315,6 +322,43 @@ class FileWriter:
         if isinstance(use_dictionary, str):
             use_dictionary = [use_dictionary]  # one column, not its characters
         return {self._leaf(schema, k).path for k in use_dictionary}
+
+    _BLOOM_TYPES = (
+        Type.INT32,
+        Type.INT64,
+        Type.FLOAT,
+        Type.DOUBLE,
+        Type.BYTE_ARRAY,
+        Type.FIXED_LEN_BYTE_ARRAY,
+    )
+
+    def _resolve_blooms(self, schema: Schema, bloom_filters) -> dict:
+        """{leaf path: (ndv or None, fpp)} for leaves that get a bloom filter."""
+        if not bloom_filters:
+            return {}
+        if bloom_filters is True:
+            bloom_filters = {
+                leaf.path: True
+                for leaf in schema.leaves
+                if leaf.type in self._BLOOM_TYPES
+            }
+        elif isinstance(bloom_filters, str):
+            bloom_filters = {bloom_filters: True}  # one column, not its chars
+        elif not isinstance(bloom_filters, dict):
+            bloom_filters = {k: True for k in bloom_filters}
+        out = {}
+        for key, spec in bloom_filters.items():
+            leaf = self._leaf(schema, key)
+            if leaf.type not in self._BLOOM_TYPES:
+                raise WriterError(
+                    f"writer: bloom filter unsupported for {leaf.type.name} "
+                    f"column {leaf.path_str}"
+                )
+            if spec is True:
+                out[leaf.path] = (None, 0.01)
+            else:
+                out[leaf.path] = (spec.get("ndv"), spec.get("fpp", 0.01))
+        return out
 
     def _reset_builders(self) -> None:
         self._builders = {
@@ -638,6 +682,16 @@ class FileWriter:
                 [KeyValue(key=k, value=v) for k, v in kv.items()] if kv else None
             ),
         )
+        spec = self._bloom_specs.get(column.path)
+        if spec is not None:
+            hash_src = dict_result[0] if dict_result is not None else typed
+            if len(hash_src):
+                from .bloom import BloomFilter, bloom_hash_values
+
+                ndv, fpp = spec
+                bf = BloomFilter.sized_for(ndv or len(hash_src), fpp)
+                bf.insert_hashes(bloom_hash_values(column.type, hash_src))
+                self._blooms.append((md, bf))
         cc = ColumnChunk(file_offset=0, meta_data=md)
         if index is not None:
             built = index.build()
@@ -708,7 +762,14 @@ class FileWriter:
     def close(self) -> FileMetaData:
         self._check_open()
         self.flush_row_group()
-        # Page index blobs live between the last row group and the footer
+        # Bloom filters, then page index blobs, live between the last row
+        # group and the footer, with metadata fields pointing at them.
+        for md, bf in self._blooms:
+            blob = bf.to_bytes()
+            md.bloom_filter_offset = self._pos
+            md.bloom_filter_length = len(blob)
+            self._write(blob)
+        self._blooms = []
         # (parquet-format PageIndex layout): all ColumnIndexes, then all
         # OffsetIndexes, with ColumnChunk fields pointing at them.
         for group in self._page_indexes:

@@ -269,6 +269,42 @@ class Statistics(TStruct):
     }
 
 
+class SplitBlockAlgorithm(TStruct):
+    FIELDS = {}
+
+
+class BloomFilterAlgorithm(TStruct):
+    FIELDS = {1: ("BLOCK", T_STRUCT, SplitBlockAlgorithm)}
+
+
+class XxHash(TStruct):
+    FIELDS = {}
+
+
+class BloomFilterHash(TStruct):
+    FIELDS = {1: ("XXHASH", T_STRUCT, XxHash)}
+
+
+class BloomFilterUncompressed(TStruct):  # thrift name: Uncompressed
+    FIELDS = {}
+
+
+class BloomFilterCompression(TStruct):
+    FIELDS = {1: ("UNCOMPRESSED", T_STRUCT, BloomFilterUncompressed)}
+
+
+class BloomFilterHeader(TStruct):
+    """Precedes the split-block bloom bitset at
+    ColumnMetaData.bloom_filter_offset (parquet.thrift)."""
+
+    FIELDS = {
+        1: ("numBytes", T_I32, None),
+        2: ("algorithm", T_STRUCT, BloomFilterAlgorithm),
+        3: ("hash", T_STRUCT, BloomFilterHash),
+        4: ("compression", T_STRUCT, BloomFilterCompression),
+    }
+
+
 class BoundaryOrder(enum.IntEnum):
     """Ordering of min/max values across a ColumnIndex (parquet.thrift)."""
 
@@ -353,6 +389,7 @@ class ColumnMetaData(TStruct):
         12: ("statistics", T_STRUCT, Statistics),
         13: ("encoding_stats", T_LIST, (T_STRUCT, PageEncodingStats)),
         14: ("bloom_filter_offset", T_I64, None),
+        15: ("bloom_filter_length", T_I32, None),
     }
 
 

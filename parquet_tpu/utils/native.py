@@ -70,6 +70,41 @@ class NativeLib:
                     ctypes.c_void_p,
                     ctypes.c_size_t,
                 ]
+        self.has_xxh64 = hasattr(lib, "ptq_xxh64")
+        if self.has_xxh64:
+            lib.ptq_xxh64.restype = ctypes.c_uint64
+            lib.ptq_xxh64.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_uint64,
+            ]
+            lib.ptq_xxh64_fixed.restype = None
+            lib.ptq_xxh64_fixed.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_int,
+                ctypes.c_void_p,
+            ]
+            lib.ptq_xxh64_offsets.restype = None
+            lib.ptq_xxh64_offsets.argtypes = [ctypes.c_void_p] * 2 + [
+                ctypes.c_int64,
+                ctypes.c_void_p,
+            ]
+            lib.ptq_bloom_insert.restype = None
+            lib.ptq_bloom_insert.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+            ]
+            lib.ptq_bloom_check.restype = None
+            lib.ptq_bloom_check.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+            ]
         self.has_byte_array_scan = hasattr(lib, "ptq_byte_array_gather")
         if self.has_byte_array_scan:
             lib.ptq_byte_array_gather.restype = ctypes.c_ssize_t
@@ -282,6 +317,56 @@ class NativeLib:
         if n < 0:
             raise ValueError("native lz4: corrupt input")
         return memoryview(out)[:n]
+
+    def xxh64(self, data, seed: int = 0) -> int:
+        addr, n, _keep = _ptr(data)
+        return int(self._lib.ptq_xxh64(addr, n, seed))
+
+    def xxh64_fixed(self, data, n: int, stride: int):
+        import numpy as np
+
+        addr, _nb, _keep = _ptr(data)
+        out = np.empty(n, dtype=np.uint64)
+        self._lib.ptq_xxh64_fixed(addr, n, stride, ctypes.c_void_p(out.ctypes.data))
+        return out
+
+    def xxh64_offsets(self, data, offsets):
+        import numpy as np
+
+        n = len(offsets) - 1
+        addr, _nb, _keep = _ptr(data)
+        off = np.ascontiguousarray(offsets, dtype=np.int64)
+        out = np.empty(n, dtype=np.uint64)
+        self._lib.ptq_xxh64_offsets(
+            addr,
+            ctypes.c_void_p(off.ctypes.data),
+            n,
+            ctypes.c_void_p(out.ctypes.data),
+        )
+        return out
+
+    def bloom_insert(self, blocks, hashes) -> None:
+        h = hashes if hashes.flags["C_CONTIGUOUS"] else hashes.copy()
+        self._lib.ptq_bloom_insert(
+            ctypes.c_void_p(blocks.ctypes.data),
+            len(blocks) // 8,
+            ctypes.c_void_p(h.ctypes.data),
+            len(h),
+        )
+
+    def bloom_check(self, blocks, hashes):
+        import numpy as np
+
+        h = hashes if hashes.flags["C_CONTIGUOUS"] else hashes.copy()
+        out = np.empty(len(h), dtype=np.uint8)
+        self._lib.ptq_bloom_check(
+            ctypes.c_void_p(blocks.ctypes.data),
+            len(blocks) // 8,
+            ctypes.c_void_p(h.ctypes.data),
+            len(h),
+            ctypes.c_void_p(out.ctypes.data),
+        )
+        return out.astype(bool)
 
     def byte_array_gather(self, data, num_values: int):
         """PLAIN byte_array scan: returns (offsets int64[n+1], flat bytes, consumed)."""

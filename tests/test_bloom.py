@@ -1,0 +1,190 @@
+"""Split-block bloom filters — beyond-reference coverage.
+
+The reference has no bloom support. These tests pin the XXH64 implementation
+to public test vectors (and C/Python parity), the split-block insert/check
+semantics, the wire form, cross-validation against pyarrow's
+bloom_filter_options output (read side) and pyarrow readback of our files
+(write side), and the equality-predicate pruning integration.
+"""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import FileReader, FileWriter, parse_schema
+from parquet_tpu.core.bloom import BloomFilter, bloom_hash_values, xxh64
+from parquet_tpu.meta.parquet_types import Type
+from parquet_tpu.utils.native import get_native
+
+rng = np.random.default_rng(123)
+
+
+class TestXxh64:
+    # public xxHash test vectors, seed 0
+    VECTORS = {
+        b"": 0xEF46DB3751D8E999,
+        b"a": 0xD24EC4F1A98C6E5B,
+        b"abc": 0x44BC2CF5AD770999,
+    }
+
+    def test_vectors(self):
+        for data, want in self.VECTORS.items():
+            assert xxh64(data) == want
+
+    def test_native_parity(self):
+        lib = get_native()
+        if lib is None or not lib.has_xxh64:
+            pytest.skip("native lib not built")
+        for data, want in self.VECTORS.items():
+            assert lib.xxh64(data) == want
+        for n in (3, 4, 7, 8, 9, 31, 32, 33, 63, 64, 65, 1024, 4097):
+            data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            assert lib.xxh64(data) == xxh64(data), n
+        # batch paths agree with scalar
+        vals = rng.integers(0, 1 << 60, 100).astype(np.int64)
+        batch = lib.xxh64_fixed(vals, len(vals), 8)
+        raw = vals.tobytes()
+        assert [xxh64(raw[i * 8 : i * 8 + 8]) for i in range(100)] == batch.tolist()
+
+
+class TestBloomCore:
+    def test_no_false_negatives_and_fpp(self):
+        vals = rng.integers(0, 1 << 50, 20_000).astype(np.int64)
+        bf = BloomFilter.sized_for(len(vals), 0.01)
+        bf.insert_hashes(bloom_hash_values(Type.INT64, vals))
+        assert all(
+            bf.might_contain(Type.INT64, int(v)) for v in vals[:: len(vals) // 500]
+        )
+        probes = rng.integers(1 << 51, 1 << 52, 3000)
+        fp = sum(bf.might_contain(Type.INT64, int(v)) for v in probes)
+        assert fp / len(probes) < 0.05  # target 0.01, wide margin
+
+    def test_wire_roundtrip_and_validation(self):
+        bf = BloomFilter.sized_for(100, 0.01)
+        bf.insert_hashes(bloom_hash_values(Type.INT64, np.arange(100, dtype=np.int64)))
+        back = BloomFilter.from_buffer(bf.to_bytes())
+        assert np.array_equal(back.blocks, bf.blocks)
+        with pytest.raises(ValueError):
+            BloomFilter.from_buffer(bf.to_bytes()[:10])
+        assert bf.num_bytes % 32 == 0 and bf.num_bytes >= 32
+
+    def test_sizing_monotonic(self):
+        small = BloomFilter.sized_for(100, 0.01).num_bytes
+        big = BloomFilter.sized_for(1_000_000, 0.01).num_bytes
+        tight = BloomFilter.sized_for(100, 0.5).num_bytes
+        assert small < big and tight <= small
+
+
+class TestPyarrowInterop:
+    def test_read_pyarrow_blooms(self, tmp_path):
+        n = 40_000
+        ids = rng.integers(0, 1 << 40, n)
+        path = str(tmp_path / "pa_bloom.parquet")
+        pq.write_table(
+            pa.table({"id": pa.array(ids), "s": pa.array([f"u{i}" for i in range(n)])}),
+            path,
+            row_group_size=20_000,
+            use_dictionary=False,
+            bloom_filter_options={"id": {"ndv": 20_000, "fpp": 0.01}, "s": True},
+        )
+        with FileReader(path) as r:
+            for g in range(2):
+                bf = r.read_bloom_filter(g, "id")
+                assert bf is not None
+                seg = ids[g * 20_000 : (g + 1) * 20_000]
+                assert all(
+                    bf.might_contain(Type.INT64, int(v)) for v in seg[::500]
+                ), "false negative against pyarrow-written bloom"
+            bs = r.read_bloom_filter(0, "s")
+            assert bs.might_contain(Type.BYTE_ARRAY, "u17")
+            # group pruning: equality on a value no bloom admits
+            assert r.prune_row_groups([("id", "==", (1 << 45) + 3)]) == []
+            hit = int(ids[25_000])
+            assert 1 in r.prune_row_groups([("id", "==", hit)])
+
+    def test_pyarrow_reads_our_bloom_files(self, tmp_path):
+        schema = parse_schema(
+            "message m { required int64 id; required binary s (UTF8); "
+            "required double x; }"
+        )
+        n = 10_000
+        path = str(tmp_path / "ours_bloom.parquet")
+        with FileWriter(
+            path, schema, codec="zstd", bloom_filters=True, use_dictionary=False
+        ) as w:
+            w.write_column("id", np.arange(n, dtype=np.int64))
+            w.write_column("s", [f"v{i}" for i in range(n)])
+            w.write_column("x", np.linspace(0, 1, n))
+        t = pq.read_table(path)
+        assert t.column("id").to_pylist() == list(range(n))
+        md = pq.ParquetFile(path).metadata
+        assert md.num_rows == n  # bloom blobs don't disturb the layout
+
+
+class TestFilterIntegration:
+    def test_equality_pruning_dictionary_and_plain(self, tmp_path):
+        schema = parse_schema("message m { required binary s (UTF8); }")
+        # dictionary chunk: bloom built over the dictionary values
+        path = str(tmp_path / "dict_bloom.parquet")
+        vals = [f"city_{i % 300}" for i in range(30_000)]
+        with FileWriter(path, schema, bloom_filters=["s"]) as w:
+            w.write_column("s", vals)
+        with FileReader(path) as r:
+            assert len(list(r.iter_rows(filters=[("s", "==", "city_7")]))) == 100
+            assert list(r.iter_rows(filters=[("s", "==", "nocity")])) == []
+            assert r.prune_row_groups([("s", "==", "nocity")]) == []
+
+    def test_multi_group_selective(self, tmp_path):
+        schema = parse_schema("message m { required int64 id; }")
+        path = str(tmp_path / "multi.parquet")
+        with FileWriter(
+            path, schema, row_group_size=80_000, bloom_filters=True,
+            use_dictionary=False,
+        ) as w:
+            for base in range(0, 40_000, 10_000):
+                w.write_column(
+                    "id", np.arange(base, base + 10_000, dtype=np.int64) * 1_000_003
+                )
+                w.flush_row_group()
+        with FileReader(path) as r:
+            assert r.num_row_groups == 4
+            target = 25_123 * 1_000_003
+            kept = r.prune_row_groups([("id", "==", target)])
+            assert kept == [2]  # min/max overlap can't prove it; bloom can't either way here
+            rows = list(r.iter_rows(filters=[("id", "==", target)]))
+            assert [row["id"] for row in rows] == [target]
+            # a value inside every group's [min, max] but present nowhere:
+            # only the bloom can prune it
+            ghost = 17 * 1_000_003 + 1
+            assert r.prune_row_groups([("id", "==", ghost)]) == []
+
+    def test_unsupported_types_rejected(self):
+        schema = parse_schema("message m { required boolean b; }")
+        with pytest.raises(ValueError, match="bloom"):
+            FileWriter(io.BytesIO(), schema, bloom_filters=["b"])
+
+
+class TestSignedZero:
+    def test_negative_zero_not_pruned(self, tmp_path):
+        """-0.0 == 0.0 but their bit patterns differ; both bloom sides
+        normalize so the equality filter keeps the group (review
+        regression: silent data loss)."""
+        schema = parse_schema("message m { required double x; }")
+        path = str(tmp_path / "zero.parquet")
+        with FileWriter(
+            path, schema, bloom_filters=["x"], use_dictionary=False
+        ) as w:
+            w.write_column("x", np.array([-0.0, 1.0, 2.0]))
+        with FileReader(path) as r:
+            rows = list(r.iter_rows(filters=[("x", "==", 0.0)]))
+            assert len(rows) == 1  # the -0.0 row matches 0.0
+            rows2 = list(r.iter_rows(filters=[("x", "==", -0.0)]))
+            assert len(rows2) == 1
+
+    def test_string_option_means_one_column(self):
+        schema = parse_schema("message m { required int64 id; }")
+        w = FileWriter(io.BytesIO(), schema, bloom_filters="id")
+        assert list(w._bloom_specs) == [("id",)]
