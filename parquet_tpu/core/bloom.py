@@ -57,26 +57,21 @@ def xxh64(data: bytes, seed: int = 0) -> int:
     one — this keeps bloom filters correct without the library)."""
     p, end = 0, len(data)
     if end >= 32:
-        v1 = (seed + _P1 + _P2) & _M64
-        v2 = (seed + _P2) & _M64
-        v3 = seed & _M64
-        v4 = (seed - _P1) & _M64
+        vs = [
+            (seed + _P1 + _P2) & _M64,
+            (seed + _P2) & _M64,
+            seed & _M64,
+            (seed - _P1) & _M64,
+        ]
         while p + 32 <= end:
-            for off, v in ((0, 1), (8, 2), (16, 3), (24, 4)):
-                lane = int.from_bytes(data[p + off : p + off + 8], "little")
-                acc = {1: v1, 2: v2, 3: v3, 4: v4}[v]
-                acc = (_rotl((acc + lane * _P2) & _M64, 31) * _P1) & _M64
-                if v == 1:
-                    v1 = acc
-                elif v == 2:
-                    v2 = acc
-                elif v == 3:
-                    v3 = acc
-                else:
-                    v4 = acc
+            for j in range(4):
+                lane = int.from_bytes(data[p + 8 * j : p + 8 * j + 8], "little")
+                vs[j] = (_rotl((vs[j] + lane * _P2) & _M64, 31) * _P1) & _M64
             p += 32
-        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12) + _rotl(v4, 18)) & _M64
-        for acc in (v1, v2, v3, v4):
+        h = (
+            _rotl(vs[0], 1) + _rotl(vs[1], 7) + _rotl(vs[2], 12) + _rotl(vs[3], 18)
+        ) & _M64
+        for acc in vs:
             h = ((h ^ (_rotl((acc * _P2) & _M64, 31) * _P1) & _M64) * _P1 + _P4) & _M64
     else:
         h = (seed + _P5) & _M64
@@ -223,8 +218,19 @@ class BloomFilter:
         from ..utils.native import get_native
 
         lib = get_native()
-        h = lib.xxh64(raw) if lib is not None and lib.has_xxh64 else xxh64(raw)
-        return self.might_contain_hash(h)
+
+        def _hash(b):
+            return lib.xxh64(b) if lib is not None and lib.has_xxh64 else xxh64(b)
+
+        if self.might_contain_hash(_hash(raw)):
+            return True
+        if ptype in (Type.FLOAT, Type.DOUBLE) and value == 0.0:
+            # our writer normalizes -0.0 -> +0.0, but FOREIGN writers may
+            # have inserted the raw -0.0 bit pattern; 0.0 == -0.0, so the
+            # probe must admit either before claiming provable absence
+            neg = struct.pack("<f" if ptype == Type.FLOAT else "<d", -0.0)
+            return self.might_contain_hash(_hash(neg))
+        return False
 
     # -- wire form -------------------------------------------------------------
 

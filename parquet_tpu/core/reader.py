@@ -780,9 +780,11 @@ class FileReader:
             # ranges whose pages may match — row assembly is the dominant
             # cost of a filtered scan, so pruned ranges never build rows
             ranges = None
+            indexes = None
             try:
-                paths = [p for p, *_ in normalized]
-                indexes = self.read_page_index(i, columns=paths)
+                # one parse covers both uses: range computation here and
+                # selective page decode in _read_group_ranges
+                indexes = self.read_page_index(i)
                 if any(ci is not None for ci, _ in indexes.values()):
                     num_rows = self.row_group(i).num_rows or 0
                     ranges = page_ranges_matching(normalized, indexes, num_rows)
@@ -792,21 +794,37 @@ class FileReader:
                         ranges = None
             except ParquetFileError:
                 ranges = None  # corrupt index: scan everything, stay correct
+                indexes = None
             if ranges is not None and not ranges:
                 continue
-            for row in self._iter_group_rows(i, raw, ranges):
+            for row in self._iter_group_rows(i, raw, ranges, indexes):
                 if row_matches(row, normalized):
                     yield row
 
-    def _iter_group_rows(self, i: int, raw: bool, ranges=None):
+    def _iter_group_rows(self, i: int, raw: bool, ranges=None, indexes=None):
         """One row group's rows: a LIST for small vectorized shapes (callers
         iterate without an extra generator frame per row), a window-batched
         generator for large ones (bounds the live tracked-object count so
         cyclic GC passes stay cheap), or the streaming Dremel fallback.
         `ranges` (sorted disjoint [(start, stop)), from the page index)
-        limits which rows materialize; the Dremel fallback ignores it (the
-        caller's exact predicate check keeps the result correct)."""
-        chunks = self._read_row_group(i, None, pack=False)
+        limits which rows materialize; when every selected column is flat
+        and indexed, only the pages covering the ranges are even READ and
+        decoded (selective page decode). The Dremel fallback ignores ranges
+        (the caller's exact predicate check keeps the result correct)."""
+        chunks = None
+        sliced = False
+        if ranges is not None:
+            try:
+                chunks = self._read_group_ranges(i, ranges, indexes)
+            except ValueError:
+                # inconsistent index, or a page shape the range decoder
+                # doesn't cover (ChunkError/PageError/...): full decode
+                # below stays correct and raises the precise error if the
+                # file is genuinely corrupt
+                chunks = None
+            sliced = chunks is not None
+        if chunks is None:
+            chunks = self._read_row_group(i, None, pack=False)
         with stage("assemble"):
             with _gc_paused():
                 rc = fast_row_columns(self.schema, chunks, raw)
@@ -820,12 +838,55 @@ class FileReader:
         names, columns, n = rc
         if not names or n == 0:
             return []
-        if ranges is not None:
+        if ranges is not None and not sliced:
+            # full decode happened: restrict materialization to the ranges
             return self._ranged_rows(names, columns, ranges)
         if n <= _ASSEMBLE_WINDOW:
             with stage("assemble"), _gc_paused():
                 return _zip_dict_rows(names, columns)
         return self._ranged_rows(names, columns, [(0, n)])
+
+    def _read_group_ranges(self, i: int, ranges, indexes=None) -> dict | None:
+        """Selective page decode of row group i restricted to `ranges`, or
+        None when it doesn't apply (no/partial offset index, repeated
+        columns, or ranges covering most rows — whole-chunk decode wins
+        then). All returned chunks hold exactly the ranges' rows, aligned.
+        `indexes` reuses an already-parsed page index for this group."""
+        from .chunk import read_chunk_row_ranges
+
+        rg = self.row_group(i)
+        num_rows = rg.num_rows or 0
+        covered = sum(e - s for s, e in ranges)
+        if num_rows == 0 or covered * 4 > num_rows * 3:
+            return None
+        selected = list(self._selected_chunks(i, None))
+        if any(col.max_rep > 0 for _, _, col in selected):
+            return None
+        if indexes is None:
+            indexes = self.read_page_index(i)
+        out = {}
+        for path, cc, col in selected:
+            oi = indexes.get(path, (None, None))[1]
+            if oi is None or not oi.page_locations:
+                return None
+            firsts = [loc.first_row_index for loc in oi.page_locations]
+            if (
+                any(not isinstance(x, int) for x in firsts)
+                or firsts[0] != 0
+                or any(b <= a for a, b in zip(firsts, firsts[1:]))
+            ):
+                return None  # foreign/corrupt index: full decode
+            out[path] = read_chunk_row_ranges(
+                self._f,
+                cc,
+                col,
+                oi,
+                ranges,
+                num_rows,
+                validate_crc=self.validate_crc,
+                alloc=self.alloc,
+            )
+        return out
 
     @staticmethod
     def _ranged_rows(names, columns, ranges):

@@ -188,3 +188,19 @@ class TestSignedZero:
         schema = parse_schema("message m { required int64 id; }")
         w = FileWriter(io.BytesIO(), schema, bloom_filters="id")
         assert list(w._bloom_specs) == [("id",)]
+
+    def test_foreign_negative_zero_bloom(self, tmp_path):
+        """pyarrow inserts raw -0.0 bit patterns into its blooms; our probe
+        for == 0.0 must admit the group (review regression: one-sided
+        normalization pruned it)."""
+        path = str(tmp_path / "pa_zero.parquet")
+        pq.write_table(
+            pa.table({"x": pa.array([-0.0, 1.0])}),
+            path,
+            use_dictionary=False,
+            bloom_filter_options={"x": True},
+        )
+        with FileReader(path) as r:
+            rows = list(r.iter_rows(filters=[("x", "==", 0.0)]))
+            assert len(rows) == 1
+            assert r.prune_row_groups([("x", "==", 0.0)]) == [0]
