@@ -783,8 +783,13 @@ class FileReader:
             indexes = None
             try:
                 # one parse covers both uses: range computation here and
-                # selective page decode in _read_group_ranges
-                indexes = self.read_page_index(i)
+                # selective page decode in _read_group_ranges. Filter columns
+                # outside the projection still prune, so their index is
+                # fetched alongside the selected columns'.
+                cols = None
+                if self._selected is not None:
+                    cols = list(self._selected | {p for p, *_ in normalized})
+                indexes = self.read_page_index(i, columns=cols)
                 if any(ci is not None for ci, _ in indexes.values()):
                     num_rows = self.row_group(i).num_rows or 0
                     ranges = page_ranges_matching(normalized, indexes, num_rows)
@@ -874,6 +879,10 @@ class FileReader:
                 any(not isinstance(x, int) for x in firsts)
                 or firsts[0] != 0
                 or any(b <= a for a, b in zip(firsts, firsts[1:]))
+                or any(
+                    not isinstance(loc.offset, int) or loc.offset <= 0
+                    for loc in oi.page_locations
+                )
             ):
                 return None  # foreign/corrupt index: full decode
             out[path] = read_chunk_row_ranges(

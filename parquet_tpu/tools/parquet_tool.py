@@ -31,10 +31,39 @@ def _json_default(v):
     return str(v)
 
 
+def _parse_filters(specs):
+    """['col >= 10', 'name == x'] -> [(col, op, value)] triples; values try
+    int, then float, then stay strings."""
+    if not specs:
+        return None
+    out = []
+    for spec in specs:
+        for op in ("==", "!=", "<=", ">=", "<", ">"):
+            if f" {op} " in spec:
+                col, _, raw = spec.partition(f" {op} ")
+                raw = raw.strip()
+                try:
+                    value = int(raw)
+                except ValueError:
+                    try:
+                        value = float(raw)
+                    except ValueError:
+                        value = raw
+                out.append((col.strip(), op, value))
+                break
+        else:
+            raise ValueError(
+                f"bad --filter {spec!r} (expected 'column OP value', "
+                "OP one of == != < <= > >=)"
+            )
+    return out
+
+
 def cmd_cat(args) -> int:
     cols = args.columns.split(",") if args.columns else None
+    filters = _parse_filters(args.filter)
     with FileReader(args.file, columns=cols) as r:
-        for row in r.iter_rows(raw=args.raw):
+        for row in r.iter_rows(raw=args.raw, filters=filters):
             print(json.dumps(row, default=_json_default))
     return 0
 
@@ -42,8 +71,9 @@ def cmd_cat(args) -> int:
 def cmd_head(args) -> int:
     n = args.n
     cols = args.columns.split(",") if args.columns else None
+    filters = _parse_filters(args.filter)
     with FileReader(args.file, columns=cols) as r:
-        for i, row in enumerate(r.iter_rows(raw=args.raw)):
+        for i, row in enumerate(r.iter_rows(raw=args.raw, filters=filters)):
             if i >= n:
                 break
             print(json.dumps(row, default=_json_default))
@@ -89,11 +119,58 @@ def cmd_meta(args) -> int:
                 stats = ""
                 if md.statistics is not None and md.statistics.null_count is not None:
                     stats = f" nulls={md.statistics.null_count}"
+                extras = []
+                if cc.column_index_offset:
+                    extras.append("page-index")
+                if md.bloom_filter_offset:
+                    extras.append("bloom")
+                extra = f" [{','.join(extras)}]" if extras else ""
                 print(
                     f"  {'.'.join(md.path_in_schema)}: {Type(md.type).name} "
                     f"maxR={leaf.max_rep} maxD={leaf.max_def} values={md.num_values} "
-                    f"codec={codec} encodings=[{encs}]{stats}"
+                    f"codec={codec} encodings=[{encs}]{stats}{extra}"
                 )
+    return 0
+
+
+def cmd_pages(args) -> int:
+    """Per-page layout + statistics from the page index (beyond the
+    reference: it has no page-index support)."""
+    with FileReader(args.file) as r:
+        any_index = False
+        for gi in range(r.num_row_groups):
+            num_rows = r.row_group(gi).num_rows or 0
+            for path, (ci, oi) in r.read_page_index(gi).items():
+                if oi is None or not oi.page_locations:
+                    continue
+                any_index = True
+                name = ".".join(path)
+                locs = oi.page_locations
+                for k, loc in enumerate(locs):
+                    stop = (
+                        locs[k + 1].first_row_index if k + 1 < len(locs) else num_rows
+                    )
+                    line = (
+                        f"rg{gi} {name} page {k}: rows [{loc.first_row_index}, "
+                        f"{stop}) offset={loc.offset} "
+                        f"bytes={loc.compressed_page_size}"
+                    )
+                    if (
+                        ci is not None
+                        and ci.min_values is not None
+                        and k < len(ci.min_values)
+                    ):
+                        if ci.null_pages and k < len(ci.null_pages) and ci.null_pages[k]:
+                            line += " ALL-NULL"
+                        else:
+                            mn = _json_default(ci.min_values[k])
+                            mx = _json_default(ci.max_values[k])
+                            line += f" min={mn!r} max={mx!r}"
+                        if ci.null_counts and k < len(ci.null_counts):
+                            line += f" nulls={ci.null_counts[k]}"
+                    print(line)
+        if not any_index:
+            print("(file carries no page index)")
     return 0
 
 
@@ -158,10 +235,15 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet-tool", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
+    filter_help = (
+        "predicate 'column OP value' (repeatable, ANDed; OP: == != < <= > >=); "
+        "row groups and pages excluded by statistics/bloom/page-index never load"
+    )
     pc = sub.add_parser("cat", help="print all rows as JSON lines")
     pc.add_argument("file")
     pc.add_argument("--raw", action="store_true", help="raw nested-map row shape")
     pc.add_argument("--columns", help="comma-separated column projection")
+    pc.add_argument("--filter", action="append", help=filter_help)
     pc.set_defaults(fn=cmd_cat)
 
     ph = sub.add_parser("head", help="print the first N rows")
@@ -169,11 +251,16 @@ def main(argv=None) -> int:
     ph.add_argument("file")
     ph.add_argument("--raw", action="store_true")
     ph.add_argument("--columns", help="comma-separated column projection")
+    ph.add_argument("--filter", action="append", help=filter_help)
     ph.set_defaults(fn=cmd_head)
 
     pm = sub.add_parser("meta", help="print file + column metadata")
     pm.add_argument("file")
     pm.set_defaults(fn=cmd_meta)
+
+    pg = sub.add_parser("pages", help="per-page layout from the page index")
+    pg.add_argument("file")
+    pg.set_defaults(fn=cmd_pages)
 
     ps = sub.add_parser("schema", help="print the schema DSL")
     ps.add_argument("file")

@@ -170,3 +170,44 @@ class TestColumnProjection:
         assert main(["head", "-n", "2", "--columns", "id", sample]) == 0
         lines = capsys.readouterr().out.strip().splitlines()
         assert len(lines) == 2 and set(json.loads(lines[0])) == {"id"}
+
+
+class TestToolPageIndexBloom:
+    @pytest.fixture
+    def indexed(self, tmp_path):
+        path = str(tmp_path / "idx.parquet")
+        schema = message(required("id", Type.INT64), optional("name", string()))
+        with FileWriter(
+            path, schema, codec="snappy", write_page_index=True,
+            bloom_filters=["id"], max_page_size=64,
+        ) as w:
+            w.write_rows(
+                [{"id": i, "name": f"n{i}" if i % 3 else None} for i in range(50)]
+            )
+        return path
+
+    def test_meta_shows_index_and_bloom(self, indexed, capsys):
+        assert tool_main(["meta", indexed]) == 0
+        out = capsys.readouterr().out
+        assert "page-index" in out and "bloom" in out
+
+    def test_pages(self, indexed, capsys):
+        assert tool_main(["pages", indexed]) == 0
+        out = capsys.readouterr().out
+        assert "rg0 id page 0" in out and "min=" in out and "offset=" in out
+
+    def test_pages_no_index(self, sample, capsys):
+        assert tool_main(["pages", sample]) == 0
+        assert "no page index" in capsys.readouterr().out
+
+    def test_cat_filtered(self, indexed, capsys):
+        assert tool_main(["cat", indexed, "--filter", "id >= 48"]) == 0
+        rows = [json.loads(line) for line in capsys.readouterr().out.splitlines()]
+        assert [r["id"] for r in rows] == [48, 49]
+        assert tool_main(["head", "-n", "1", indexed, "--filter", "name == n7"]) == 0
+        rows = [json.loads(line) for line in capsys.readouterr().out.splitlines()]
+        assert rows == [{"id": 7, "name": "n7"}]
+
+    def test_bad_filter_spec(self, indexed, capsys):
+        assert tool_main(["cat", indexed, "--filter", "id>48"]) == 1
+        assert "bad --filter" in capsys.readouterr().err
