@@ -742,11 +742,20 @@ class FileReader:
         return page_ranges_matching(normalized, indexes, num_rows)
 
     def iter_rows(self, row_groups=None, raw: bool = False, filters=None):
-        """Yield rows as dicts. `raw=True` gives reference-style nested maps
-        (no LIST/MAP unwrapping, bytes not decoded). `filters` is a
-        conjunction of (column, op, value) triples: row groups whose
-        statistics exclude the predicate are skipped wholesale and the
-        surviving rows are predicate-checked exactly."""
+        """Yield rows as dicts (returns an iterator). `raw=True` gives
+        reference-style nested maps (no LIST/MAP unwrapping, bytes not
+        decoded). `filters` is a conjunction of (column, op, value) triples:
+        row groups whose statistics/bloom/page-index exclude the predicate
+        are skipped wholesale and the surviving rows are predicate-checked
+        exactly."""
+        if filters is None and row_groups is None and self.num_row_groups == 1:
+            # single-group scan: hand back the group's list/generator with
+            # no extra per-row generator hop (~10% of assembled-rows time)
+            rows = self._iter_group_rows(0, raw)
+            return iter(rows) if isinstance(rows, list) else rows
+        return self._iter_rows_gen(row_groups, raw, filters)
+
+    def _iter_rows_gen(self, row_groups, raw: bool, filters):
         normalized = None
         if filters is not None:
             from .filter import (

@@ -114,12 +114,41 @@ def compute_statistics(
                 items = [bytes(v) for v in values]
             mn = min(items)
             mx = max(items)
-        if len(mn) <= _MAX_STAT_BYTES and len(mx) <= _MAX_STAT_BYTES:
-            st.min_value = mn
-            st.max_value = mx
+        st.min_value, exact_min = _truncate_min(mn)
+        st.max_value, exact_max = _truncate_max(mx)
+        if not (exact_min and exact_max):
+            # truncated bounds are still valid for range pruning; the
+            # exactness flags tell readers not to treat them as values
+            st.is_min_value_exact = exact_min
+            st.is_max_value_exact = exact_max
+            st.min = st.max = None  # legacy fields carry no exactness flag
+            return st
     else:
         return st  # INT96: no meaningful order (reference nilStats analogue)
     # Legacy fields mirror the modern ones (TypeDefinedOrder).
     st.min = st.min_value
     st.max = st.max_value
     return st
+
+
+def _truncate_min(raw: bytes):
+    """(possibly truncated lower bound, is_exact): a prefix of the min is
+    always <= the min, so plain truncation is a valid lower bound."""
+    if len(raw) <= _MAX_STAT_BYTES:
+        return raw, True
+    return raw[:_MAX_STAT_BYTES], False
+
+
+def _truncate_max(raw: bytes):
+    """(possibly truncated-and-incremented upper bound, is_exact): the
+    prefix alone would UNDERSTATE the max, so the last non-0xFF byte of the
+    prefix increments; an all-0xFF prefix cannot be incremented and the
+    bound is dropped (None) rather than made unsound."""
+    if len(raw) <= _MAX_STAT_BYTES:
+        return raw, True
+    prefix = bytearray(raw[:_MAX_STAT_BYTES])
+    for i in range(len(prefix) - 1, -1, -1):
+        if prefix[i] != 0xFF:
+            prefix[i] += 1
+            return bytes(prefix[: i + 1]), False
+    return None, False

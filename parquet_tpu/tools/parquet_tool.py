@@ -42,13 +42,16 @@ def _parse_filters(specs):
             if f" {op} " in spec:
                 col, _, raw = spec.partition(f" {op} ")
                 raw = raw.strip()
-                try:
-                    value = int(raw)
-                except ValueError:
+                if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "'\"":
+                    value = raw[1:-1]  # quoted: force string ('7' stays "7")
+                else:
                     try:
-                        value = float(raw)
+                        value = int(raw)
                     except ValueError:
-                        value = raw
+                        try:
+                            value = float(raw)
+                        except ValueError:
+                            value = raw
                 out.append((col.strip(), op, value))
                 break
         else:
@@ -136,6 +139,8 @@ def cmd_meta(args) -> int:
 def cmd_pages(args) -> int:
     """Per-page layout + statistics from the page index (beyond the
     reference: it has no page-index support)."""
+    from ..core.filter import _decode_stat
+
     with FileReader(args.file) as r:
         any_index = False
         for gi in range(r.num_row_groups):
@@ -145,6 +150,7 @@ def cmd_pages(args) -> int:
                     continue
                 any_index = True
                 name = ".".join(path)
+                leaf = r.schema.column(path)
                 locs = oi.page_locations
                 for k, loc in enumerate(locs):
                     stop = (
@@ -163,8 +169,14 @@ def cmd_pages(args) -> int:
                         if ci.null_pages and k < len(ci.null_pages) and ci.null_pages[k]:
                             line += " ALL-NULL"
                         else:
-                            mn = _json_default(ci.min_values[k])
-                            mx = _json_default(ci.max_values[k])
+                            # decode PLAIN-packed bounds to typed values
+                            # (raw bytes for ints/floats are unreadable)
+                            mn = _decode_stat(leaf, ci.min_values[k], legacy=False)
+                            mx = _decode_stat(leaf, ci.max_values[k], legacy=False)
+                            if isinstance(mn, bytes):
+                                mn = _json_default(mn)
+                            if isinstance(mx, bytes):
+                                mx = _json_default(mx)
                             line += f" min={mn!r} max={mx!r}"
                         if ci.null_counts and k < len(ci.null_counts):
                             line += f" nulls={ci.null_counts[k]}"

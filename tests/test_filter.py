@@ -285,3 +285,45 @@ class TestTypedFilters:
             # filtering stays exact
             got = list(r.iter_rows(filters=[("dec", ">=", decimal.Decimal("0.97"))]))
         assert len(got) == 3
+
+
+class TestTruncatedBinaryStats:
+    def test_long_binary_stats_truncated_not_dropped(self, tmp_path):
+        """Values past 64 bytes used to void min/max entirely; now they
+        truncate (max: increment-last-byte) with exactness flags, so range
+        pruning keeps working on long strings."""
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema("message m { required binary s (UTF8); }")
+        long = ["x" * 100 + f"{i:04d}" for i in range(1000)]
+        path = str(tmp_path / "long.parquet")
+        with FileWriter(
+            path, schema, write_page_index=True, use_dictionary=False
+        ) as w:
+            w.write_column("s", long)
+        with FileReader(path) as r:
+            st = r.row_group(0).columns[0].meta_data.statistics
+            assert st.min_value == b"x" * 64
+            assert st.max_value == b"x" * 63 + b"y"  # truncated + incremented
+            assert st.is_min_value_exact is False
+            assert st.is_max_value_exact is False
+            assert st.min is None and st.max is None  # legacy has no flags
+            # pruning with truncated bounds stays conservative + useful
+            assert list(r.iter_rows(filters=[("s", "==", "zzz")])) == []
+            got = list(r.iter_rows(filters=[("s", "==", long[77])]))
+            assert got == [{"s": long[77]}]
+            # page index survives too (used to be voided)
+            ci, _ = r.read_page_index(0)[("s",)]
+            assert ci is not None
+        import pyarrow.parquet as pq
+
+        assert pq.read_table(path).column("s").to_pylist() == long
+
+    def test_all_ff_prefix_max_dropped(self):
+        from parquet_tpu.core.stats import _truncate_max, _truncate_min
+
+        assert _truncate_max(b"\xff" * 70) == (None, False)
+        assert _truncate_max(b"a" * 70)[0] == b"a" * 63 + b"b"
+        assert _truncate_max(b"a" * 63 + b"\xff" + b"q" * 10)[0] == b"a" * 62 + b"b"
+        assert _truncate_min(b"m" * 70) == (b"m" * 64, False)
+        assert _truncate_min(b"short") == (b"short", True)

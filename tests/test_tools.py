@@ -211,3 +211,17 @@ class TestToolPageIndexBloom:
     def test_bad_filter_spec(self, indexed, capsys):
         assert tool_main(["cat", indexed, "--filter", "id>48"]) == 1
         assert "bad --filter" in capsys.readouterr().err
+
+    def test_quoted_filter_value_stays_string(self, tmp_path, capsys):
+        path = str(tmp_path / "numstr.parquet")
+        schema = message(required("id", Type.INT64), optional("name", string()))
+        with FileWriter(path, schema) as w:
+            w.write_rows([{"id": 7, "name": "7"}, {"id": 8, "name": "eight"}])
+        assert tool_main(["cat", path, "--filter", 'name == "7"']) == 0
+        rows = [json.loads(x) for x in capsys.readouterr().out.splitlines()]
+        assert rows == [{"id": 7, "name": "7"}]
+
+    def test_pages_decodes_numeric_bounds(self, indexed, capsys):
+        assert tool_main(["pages", indexed]) == 0
+        out = capsys.readouterr().out
+        assert "min=0 max=" in out  # int64 bounds decoded, not raw bytes
