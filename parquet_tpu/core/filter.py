@@ -286,12 +286,22 @@ def _bounds_admit(op, vlo, vhi, lo, hi, null_count) -> bool:
     return True
 
 
+def chunks_by_path(rg) -> dict:
+    """{leaf path: ColumnChunk} for one row group, skipping chunks whose
+    metadata is absent (mutated/corrupt footers must degrade, not crash)."""
+    return {
+        tuple(c.meta_data.path_in_schema or []): c
+        for c in rg.columns or []
+        if c.meta_data is not None
+    }
+
+
 def row_group_may_match(rg, normalized) -> bool:
     """False only when statistics PROVE no row of the group matches."""
-    chunks = {tuple(c.meta_data.path_in_schema or []): c for c in rg.columns or []}
+    chunks = chunks_by_path(rg)
     for path, leaf, op, _row_value, vlo, vhi in normalized:
         cc = chunks.get(path)
-        if cc is None or cc.meta_data is None:
+        if cc is None:
             continue
         md = cc.meta_data
         st = md.statistics

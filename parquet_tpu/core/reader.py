@@ -368,6 +368,7 @@ class FileReader:
         drop_remainder: bool = True,
         sharding=None,
         nullable: str = "error",
+        filters=None,
     ):
         """Stream the file as fixed-size device-resident batches.
 
@@ -397,18 +398,31 @@ class FileReader:
         P("data"))) lays every batch out across a device mesh — the
         data-parallel input pipeline: decode once, shard over ICI. The
         batch size must divide evenly over the sharded axis.
+
+        `filters` pushes a (column, op, value) conjunction down to ROW-GROUP
+        granularity: groups whose statistics/bloom filters exclude the
+        predicate are never prepared, uploaded, or decoded. Surviving groups
+        stream whole (batches keep their static shape; rows are NOT
+        individually filtered — filter columns may admit non-matching rows,
+        exact per-row masking is the consumer's jnp.where).
         """
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
         if nullable not in ("error", "mask"):
             raise ValueError('nullable must be "error" or "mask"')
+        if filters is not None:
+            # eager validation, like batch_size/nullable: a bad column or op
+            # should fail HERE, not at the first next() deep in a train loop
+            from .filter import normalize_filters
+
+            normalize_filters(self.schema, filters)
         return self._iter_device_batches(
-            batch_size, columns, drop_remainder, sharding, nullable
+            batch_size, columns, drop_remainder, sharding, nullable, filters
         )
 
     def _iter_device_batches(
         self, batch_size: int, columns, drop_remainder: bool, sharding=None,
-        nullable: str = "error",
+        nullable: str = "error", filters=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -444,7 +458,11 @@ class FileReader:
                 )
             return arr
 
-        groups = list(range(self.num_row_groups))
+        if filters is not None:
+            # group-level pushdown: excluded groups never touch the device
+            groups = self.prune_row_groups(filters)
+        else:
+            groups = list(range(self.num_row_groups))
         # a memory ceiling forbids the lookahead's two-groups residency
         lookahead = self.alloc is None
 
@@ -703,19 +721,15 @@ class FileReader:
         """True when some equality predicate's value is PROVABLY absent from
         row group i per its bloom filter (false-positive-only structure:
         never excludes a group that contains the value)."""
+        from .filter import chunks_by_path
         from .stats import column_is_unsigned
 
-        rg = self.row_group(i)
-        by_path = {tuple(c.meta_data.path_in_schema or []): c for c in rg.columns or []}
+        by_path = chunks_by_path(self.row_group(i))
         for path, leaf, op, _rv, vlo, vhi in normalized:
             if op != "==" or vlo is None or vlo != vhi:
                 continue
             cc = by_path.get(path)
-            if (
-                cc is None
-                or cc.meta_data is None
-                or not cc.meta_data.bloom_filter_offset
-            ):
+            if cc is None or not cc.meta_data.bloom_filter_offset:
                 continue
             try:
                 bf = self.read_bloom_filter(i, path)

@@ -694,3 +694,44 @@ def test_nullable_batches_default_still_errors(tmp_path):
     with FileReader(path) as r:
         with pytest.raises(ParquetFileError):
             next(r.iter_device_batches(2, nullable="error"))
+
+
+def test_device_batches_filter_pushdown(tmp_path):
+    """filters= on iter_device_batches prunes row groups (stats + bloom)
+    before any prepare/upload; surviving groups stream whole."""
+    from parquet_tpu import FileWriter
+    from parquet_tpu.schema.dsl import parse_schema
+
+    schema = parse_schema("message m { required int64 id; }")
+    path = str(tmp_path / "push.parquet")
+    with FileWriter(
+        path, schema, bloom_filters=True, use_dictionary=False
+    ) as w:
+        for base in (0, 100_000, 200_000):
+            # even ids only: odd values inside [min, max] exist for the
+            # bloom (and only the bloom) to exclude
+            w.write_column(
+                "id", np.arange(base, base + 8_192, 2, dtype=np.int64)
+            )
+            w.flush_row_group()
+    with FileReader(path) as r:
+        batches = list(
+            r.iter_device_batches(4_096, filters=[("id", ">=", 200_000)])
+        )
+        assert len(batches) == 1
+        np.testing.assert_array_equal(
+            np.asarray(batches[0][("id",)]),
+            np.arange(200_000, 208_192, 2, dtype=np.int64),
+        )
+        # bloom-only exclusion: an ODD value inside group 1's [min, max] —
+        # statistics admit it, only the bloom can prove it absent
+        assert r.prune_row_groups([("id", "==", 100_001)]) == []
+        assert list(
+            r.iter_device_batches(4_096, filters=[("id", "==", 100_001)])
+        ) == []
+        # and a present value keeps exactly its group
+        assert len(list(
+            r.iter_device_batches(4_096, filters=[("id", "==", 100_002)])
+        )) == 1
+        # no filters: everything streams
+        assert len(list(r.iter_device_batches(4_096))) == 3
