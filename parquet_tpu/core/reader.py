@@ -410,19 +410,20 @@ class FileReader:
             raise ValueError("batch_size must be positive")
         if nullable not in ("error", "mask"):
             raise ValueError('nullable must be "error" or "mask"')
+        normalized = None
         if filters is not None:
             # eager validation, like batch_size/nullable: a bad column or op
             # should fail HERE, not at the first next() deep in a train loop
             from .filter import normalize_filters
 
-            normalize_filters(self.schema, filters)
+            normalized = normalize_filters(self.schema, filters)
         return self._iter_device_batches(
-            batch_size, columns, drop_remainder, sharding, nullable, filters
+            batch_size, columns, drop_remainder, sharding, nullable, normalized
         )
 
     def _iter_device_batches(
         self, batch_size: int, columns, drop_remainder: bool, sharding=None,
-        nullable: str = "error", filters=None,
+        nullable: str = "error", normalized=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -458,9 +459,9 @@ class FileReader:
                 )
             return arr
 
-        if filters is not None:
+        if normalized is not None:
             # group-level pushdown: excluded groups never touch the device
-            groups = self.prune_row_groups(filters)
+            groups = self._prune_groups_normalized(normalized)
         else:
             groups = list(range(self.num_row_groups))
         # a memory ceiling forbids the lookahead's two-groups residency
@@ -633,9 +634,13 @@ class FileReader:
         groups provably excluded by written min/max/null-count never load
         (statistics-driven pruning; the reference writes stats but never
         consumes them, README.md:47)."""
-        from .filter import normalize_filters, row_group_may_match
+        from .filter import normalize_filters
 
-        normalized = normalize_filters(self.schema, filters)
+        return self._prune_groups_normalized(normalize_filters(self.schema, filters))
+
+    def _prune_groups_normalized(self, normalized) -> list[int]:
+        from .filter import row_group_may_match
+
         return [
             i
             for i in range(self.num_row_groups)

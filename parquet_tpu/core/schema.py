@@ -12,6 +12,7 @@ max_def; REPEATED also increments max_rep. The root is not counted.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from functools import cached_property
 
 from ..meta.parquet_types import (
     ConvertedType,
@@ -47,7 +48,15 @@ class Column:
     def is_leaf(self) -> bool:
         return not self.children
 
-    @property
+    # type/repetition/converted_type are cached: enum construction per call
+    # was the hottest line of the row-path shredder (1.3M Enum() calls per
+    # 200k nested rows). Cache-safety invariant: schema elements are only
+    # mutated while a tree is being BUILT — the builder mutates elements on
+    # fresh clones (builder._clone_column) before any property is read, and
+    # message()/group() share already-final Columns — so a cache never goes
+    # stale. A future schema-rewrite pass must clone Columns, not mutate
+    # elements in place.
+    @cached_property
     def type(self) -> Type | None:
         return Type(self.element.type) if self.element.type is not None else None
 
@@ -55,12 +64,12 @@ class Column:
     def type_length(self) -> int | None:
         return self.element.type_length
 
-    @property
+    @cached_property
     def repetition(self) -> FieldRepetitionType:
         rt = self.element.repetition_type
         return FieldRepetitionType(rt if rt is not None else 0)
 
-    @property
+    @cached_property
     def converted_type(self) -> ConvertedType | None:
         ct = self.element.converted_type
         return ConvertedType(ct) if ct is not None else None
