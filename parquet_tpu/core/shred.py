@@ -99,8 +99,9 @@ class Shredder:
                 if not value:
                     nulls(rep, parent_def)
                     return
-                present(value[0], rep)
-                for item in value[1:]:
+                it = iter(value)
+                present(next(it), rep)
+                for item in it:  # no value[1:] copy on the hot path
                     present(item, max_rep)
 
             return shred_repeated

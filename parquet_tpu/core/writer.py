@@ -33,6 +33,7 @@ from ..meta.parquet_types import (
     PageLocation,
     PageType,
     RowGroup,
+    SortingColumn,
     Type,
     TypeDefinedOrder,
 )
@@ -219,6 +220,7 @@ class FileWriter:
         key_value_metadata: dict | None = None,
         write_page_index: bool = False,
         bloom_filters=None,
+        sorting_columns=None,
     ):
         """`column_encodings` maps a leaf ("a.b" or tuple) to the fallback
         value encoding used when the column is not dictionary-encoded:
@@ -234,13 +236,13 @@ class FileWriter:
         `bloom_filters` emits split-block bloom filters (also beyond the
         reference): a {leaf: True | {"fpp": float, "ndv": int}} dict, a
         list of leaves, or True for every eligible leaf; default fpp 0.01,
-        default ndv the chunk's value count (exact for dictionary chunks)."""
-        if isinstance(sink, (str, Path)):
-            self._f = open(sink, "wb")
-            self._owns_file = True
-        else:
-            self._f = sink
-            self._owns_file = False
+        default ndv the chunk's value count (exact for dictionary chunks).
+        `sorting_columns` declares the row ordering in row-group metadata
+        (not enforced): leaf names or (leaf, descending, nulls_first)
+        triples, like pyarrow's sorting_columns."""
+        # Validate EVERY option before the sink opens: open(path, "wb")
+        # truncates an existing file, so a typo'd codec/column name must
+        # fail without destroying anything.
         self.schema = schema
         if isinstance(codec, str):
             try:
@@ -273,11 +275,18 @@ class FileWriter:
         # (ColumnChunk, ColumnIndex, OffsetIndex) awaiting emission at close
         self._page_indexes: list[list[tuple]] = []
         self._bloom_specs = self._resolve_blooms(schema, bloom_filters)
+        self._sorting = self._resolve_sorting(schema, sorting_columns)
         self._blooms: list[tuple] = []  # (ColumnMetaData, BloomFilter)
         self._flush_kv: dict[tuple, dict] = {}
         self._pos = 0
         self._closed = False
         self._reset_builders()
+        if isinstance(sink, (str, Path)):
+            self._f = open(sink, "wb")
+            self._owns_file = True
+        else:
+            self._f = sink
+            self._owns_file = False
         self._write(MAGIC)  # leading magic (reference: file_writer.go:240-244)
 
     @staticmethod
@@ -358,6 +367,36 @@ class FileWriter:
                 out[leaf.path] = (None, 0.01)
             else:
                 out[leaf.path] = (spec.get("ndv"), spec.get("fpp", 0.01))
+        return out
+
+    def _resolve_sorting(self, schema: Schema, sorting_columns):
+        if not sorting_columns:
+            return None
+        if isinstance(sorting_columns, str):
+            sorting_columns = [sorting_columns]
+        out = []
+        for spec in sorting_columns:
+            if isinstance(spec, str):
+                key, descending, nulls_first = spec, False, False
+            elif (
+                isinstance(spec, (tuple, list))
+                and len(spec) == 3
+                and isinstance(spec[1], (bool, int))
+            ):
+                key, descending, nulls_first = spec
+            else:
+                raise WriterError(
+                    "writer: sorting_columns entries are dotted leaf names "
+                    "or (name, descending, nulls_first) triples"
+                )
+            leaf = self._leaf(schema, key)
+            out.append(
+                SortingColumn(
+                    column_idx=leaf.leaf_index,
+                    descending=bool(descending),
+                    nulls_first=bool(nulls_first),
+                )
+            )
         return out
 
     def _reset_builders(self) -> None:
@@ -558,6 +597,7 @@ class FileWriter:
                 total_compressed_size=total_compressed,
                 num_rows=n_rows,
                 file_offset=first_page_offset,
+                sorting_columns=self._sorting,
                 ordinal=len(self._row_groups),
             )
         )
@@ -665,6 +705,9 @@ class FileWriter:
         stats = compute_statistics(
             column.type, typed, null_count, column_is_unsigned(column)
         )
+        if dict_result is not None:
+            # the dictionary IS the distinct set: record the exact count
+            stats.distinct_count = len(dict_result[0])
         kv = self._flush_kv.get(column.path)
         md = ColumnMetaData(
             type=int(column.type),
@@ -692,7 +735,12 @@ class FileWriter:
                 bf = BloomFilter.sized_for(ndv or len(hash_src), fpp)
                 bf.insert_hashes(bloom_hash_values(column.type, hash_src))
                 self._blooms.append((md, bf))
-        cc = ColumnChunk(file_offset=0, meta_data=md)
+        # file_offset: where this chunk's pages begin (parquet-cpp's
+        # convention; some readers sanity-check it against the page offsets)
+        cc = ColumnChunk(
+            file_offset=dict_offset if dict_offset is not None else data_offset,
+            meta_data=md,
+        )
         if index is not None:
             built = index.build()
             if built:

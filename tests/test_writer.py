@@ -777,3 +777,63 @@ class TestArrowInput:
             w.write_column("a", dict_arr)
         out.seek(0)
         assert pq.read_table(out).column("a").to_pylist() == vals
+
+
+class TestMetadataCompleteness:
+    def test_sorting_columns_distinct_count_file_offset(self, tmp_path):
+        import numpy as np
+
+        from parquet_tpu.schema.dsl import parse_schema
+
+        schema = parse_schema(
+            "message m { required int64 id; required binary s (UTF8); }"
+        )
+        path = str(tmp_path / "meta.parquet")
+        with FileWriter(
+            path, schema, codec="snappy",
+            sorting_columns=[("id", False, False)],
+        ) as w:
+            w.write_column("id", np.arange(5_000, dtype=np.int64))
+            w.write_column("s", [f"v{i % 40}" for i in range(5_000)])
+        md = pq.ParquetFile(path).metadata
+        rg = md.row_group(0)
+        assert tuple(rg.sorting_columns) == (
+            pq.SortingColumn(column_index=0, descending=False, nulls_first=False),
+        )
+        # exact distinct count recorded for the dictionary-encoded column
+        assert rg.column(1).statistics.distinct_count == 40
+        # file_offset points at the chunk's first page, not 0
+        assert rg.column(0).file_offset > 0
+        from parquet_tpu.core.reader import FileReader
+
+        with FileReader(path) as r:
+            sc = r.row_group(0).sorting_columns
+            assert sc and sc[0].column_idx == 0 and sc[0].descending is False
+
+    def test_bad_sorting_spec_rejected(self, tmp_path):
+        import io as _io
+
+        from parquet_tpu.schema.dsl import parse_schema
+
+        schema = parse_schema("message m { required int64 id; }")
+        with pytest.raises(WriterError, match="sorting_columns"):
+            FileWriter(_io.BytesIO(), schema, sorting_columns=[(1, 2)])
+
+    def test_bad_option_does_not_truncate_existing_file(self, tmp_path):
+        """Option validation happens BEFORE the sink opens: a typo'd option
+        must never destroy an existing file (review regression)."""
+        from parquet_tpu.schema.dsl import parse_schema
+
+        schema = parse_schema("message m { required int64 id; }")
+        path = tmp_path / "precious.parquet"
+        path.write_bytes(b"IRREPLACEABLE")
+        for bad_kw in (
+            {"codec": "nope"},
+            {"sorting_columns": ["typo"]},
+            {"bloom_filters": ["typo"]},
+            {"column_encodings": {"typo": "PLAIN"}},
+            {"data_page_version": 3},
+        ):
+            with pytest.raises(WriterError):
+                FileWriter(str(path), schema, **bad_kw)
+            assert path.read_bytes() == b"IRREPLACEABLE", bad_kw
