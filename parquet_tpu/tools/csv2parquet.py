@@ -98,6 +98,19 @@ def main(argv=None) -> int:
     p.add_argument("--codec", default="snappy")
     p.add_argument("--row-group-size", type=int, default=1_000_000, help="rows per row group")
     p.add_argument("--delimiter", default=",")
+    p.add_argument(
+        "--page-index", action="store_true",
+        help="write the Parquet page index (per-page min/max for pruning)",
+    )
+    p.add_argument(
+        "--bloom", default="",
+        help="comma-separated columns to build bloom filters for",
+    )
+    p.add_argument(
+        "--sort", default="",
+        help="comma-separated columns recorded as the row ordering "
+        "(metadata only; data is written as-is)",
+    )
     p.add_argument("csv", help="input CSV file with header row")
     args = p.parse_args(argv)
 
@@ -122,8 +135,15 @@ def main(argv=None) -> int:
         fields = [optional(c, _HINTS[col_types[c]]()) for c in header]
         schema = message(*fields, name="csv")
         n = 0
+        wkw = {}
+        if args.page_index:
+            wkw["write_page_index"] = True
+        if args.bloom:
+            wkw["bloom_filters"] = [c.strip() for c in args.bloom.split(",") if c.strip()]
+        if args.sort:
+            wkw["sorting_columns"] = [c.strip() for c in args.sort.split(",") if c.strip()]
         try:
-            with FileWriter(args.output, schema, codec=args.codec) as w:
+            with FileWriter(args.output, schema, codec=args.codec, **wkw) as w:
                 for i, rec in enumerate(reader, start=2):
                     if len(rec) != len(header):
                         print(

@@ -225,3 +225,26 @@ class TestToolPageIndexBloom:
         assert tool_main(["pages", indexed]) == 0
         out = capsys.readouterr().out
         assert "min=0 max=" in out  # int64 bounds decoded, not raw bytes
+
+
+class TestCsvToParquetAnalytics:
+    def test_bloom_index_sort_flags(self, tmp_path, capsys):
+        src = tmp_path / "in.csv"
+        src.write_text(
+            "id,name\n" + "".join(f"{i},n{i % 9}\n" for i in range(500))
+        )
+        out = str(tmp_path / "out.parquet")
+        rc = csv_main([
+            "-o", out, "-typehints", "id=int64", "--page-index",
+            "--bloom", "id", "--sort", "id", str(src),
+        ])
+        assert rc == 0
+        meta = pq.ParquetFile(out).metadata
+        col = meta.row_group(0).column(0)
+        assert col.has_column_index and col.has_offset_index
+        assert tuple(meta.row_group(0).sorting_columns) == (
+            pq.SortingColumn(0, descending=False, nulls_first=False),
+        )
+        with FileReader(out) as r:
+            assert r.read_bloom_filter(0, "id") is not None
+            assert [row["id"] for row in r.iter_rows(filters=[("id", "==", 42)])] == [42]
