@@ -793,6 +793,27 @@ class FileReader:
                 # unmarshal path
                 raise FilterError("filters cannot be combined with raw=True")
             normalized = normalize_filters(self.schema, filters)
+        # Filter columns OUTSIDE the projection still evaluate: decode them
+        # alongside the selection, predicate-check, then strip them from the
+        # yielded rows (silently returning zero rows because the predicate
+        # column was projected out is a correctness trap). Stripping is
+        # LEAF-granular: each missing leaf is deleted at the shallowest
+        # path component no selected leaf shares, so g.c vanishes from a
+        # row that keeps g.b, and a whole unselected root vanishes outright.
+        read_cols = None
+        strips: list = []  # (parent path parts, key to pop)
+        if normalized is not None and self._selected is not None:
+            fpaths = {p for p, *_ in normalized}
+            missing = fpaths - self._selected
+            if missing:
+                read_cols = list(self._selected | fpaths)
+                for path in missing:
+                    cut = 1
+                    while cut < len(path) and any(
+                        sel[:cut] == path[:cut] for sel in self._selected
+                    ):
+                        cut += 1
+                    strips.append((path[: cut - 1], path[cut - 1]))
         indices = range(self.num_row_groups) if row_groups is None else row_groups
         for i in indices:
             if normalized is None:
@@ -814,10 +835,7 @@ class FileReader:
                 # selective page decode in _read_group_ranges. Filter columns
                 # outside the projection still prune, so their index is
                 # fetched alongside the selected columns'.
-                cols = None
-                if self._selected is not None:
-                    cols = list(self._selected | {p for p, *_ in normalized})
-                indexes = self.read_page_index(i, columns=cols)
+                indexes = self.read_page_index(i, columns=read_cols)
                 if any(ci is not None for ci, _ in indexes.values()):
                     num_rows = self.row_group(i).num_rows or 0
                     ranges = page_ranges_matching(normalized, indexes, num_rows)
@@ -830,11 +848,26 @@ class FileReader:
                 indexes = None
             if ranges is not None and not ranges:
                 continue
-            for row in self._iter_group_rows(i, raw, ranges, indexes):
-                if row_matches(row, normalized):
-                    yield row
+            if read_cols is not None:
+                for row in self._iter_group_rows(i, raw, ranges, indexes, read_cols):
+                    if row_matches(row, normalized):
+                        for parents, key in strips:
+                            d = row
+                            for part in parents:
+                                d = d.get(part) if isinstance(d, dict) else None
+                                if d is None:
+                                    break
+                            if isinstance(d, dict):
+                                d.pop(key, None)
+                        yield row
+            else:
+                for row in self._iter_group_rows(i, raw, ranges, indexes):
+                    if row_matches(row, normalized):
+                        yield row
 
-    def _iter_group_rows(self, i: int, raw: bool, ranges=None, indexes=None):
+    def _iter_group_rows(
+        self, i: int, raw: bool, ranges=None, indexes=None, columns=None
+    ):
         """One row group's rows: a LIST for small vectorized shapes (callers
         iterate without an extra generator frame per row), a window-batched
         generator for large ones (bounds the live tracked-object count so
@@ -848,7 +881,7 @@ class FileReader:
         sliced = False
         if ranges is not None:
             try:
-                chunks = self._read_group_ranges(i, ranges, indexes)
+                chunks = self._read_group_ranges(i, ranges, indexes, columns)
             except ValueError:
                 # inconsistent index, or a page shape the range decoder
                 # doesn't cover (ChunkError/PageError/...): full decode
@@ -857,7 +890,7 @@ class FileReader:
                 chunks = None
             sliced = chunks is not None
         if chunks is None:
-            chunks = self._read_row_group(i, None, pack=False)
+            chunks = self._read_row_group(i, columns, pack=False)
         with stage("assemble"):
             with _gc_paused():
                 rc = fast_row_columns(self.schema, chunks, raw)
@@ -879,7 +912,9 @@ class FileReader:
                 return _zip_dict_rows(names, columns)
         return self._ranged_rows(names, columns, [(0, n)])
 
-    def _read_group_ranges(self, i: int, ranges, indexes=None) -> dict | None:
+    def _read_group_ranges(
+        self, i: int, ranges, indexes=None, columns=None
+    ) -> dict | None:
         """Selective page decode of row group i restricted to `ranges`, or
         None when it doesn't apply (no/partial offset index, repeated
         columns, or ranges covering most rows — whole-chunk decode wins
@@ -892,11 +927,11 @@ class FileReader:
         covered = sum(e - s for s, e in ranges)
         if num_rows == 0 or covered * 4 > num_rows * 3:
             return None
-        selected = list(self._selected_chunks(i, None))
+        selected = list(self._selected_chunks(i, columns))
         if any(col.max_rep > 0 for _, _, col in selected):
             return None
         if indexes is None:
-            indexes = self.read_page_index(i)
+            indexes = self.read_page_index(i, columns=columns)
         out = {}
         for path, cc, col in selected:
             oi = indexes.get(path, (None, None))[1]

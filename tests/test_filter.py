@@ -327,3 +327,54 @@ class TestTruncatedBinaryStats:
         assert _truncate_max(b"a" * 63 + b"\xff" + b"q" * 10)[0] == b"a" * 62 + b"b"
         assert _truncate_min(b"m" * 70) == (b"m" * 64, False)
         assert _truncate_min(b"short") == (b"short", True)
+
+
+class TestFilterOutsideProjection:
+    def test_filter_column_projected_out_still_applies(self, tmp_path):
+        """A predicate on a column outside the projection must FILTER (decode
+        it transiently, strip it from output) — not silently return nothing."""
+        import numpy as np
+
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema(
+            "message m { required int64 id; required binary s (UTF8); }"
+        )
+        path = str(tmp_path / "proj.parquet")
+        with FileWriter(path, schema, write_page_index=True) as w:
+            w.write_column("id", np.arange(100, dtype=np.int64))
+            w.write_column("s", [f"v{i % 5}" for i in range(100)])
+        with FileReader(path, columns=["id"]) as r:
+            rows = list(r.iter_rows(filters=[("s", "==", "v3")]))
+            assert [row["id"] for row in rows] == list(range(3, 100, 5))
+            assert all(set(row) == {"id"} for row in rows)  # s stripped
+        # and with the column IN the projection, it stays in the rows
+        with FileReader(path) as r:
+            rows = list(r.iter_rows(filters=[("s", "==", "v3")]))
+            assert all(set(row) == {"id", "s"} for row in rows)
+
+    def test_shared_root_and_mixed_missing_leaves(self, tmp_path):
+        """Leaf-granular stripping: a filter on g.c with g.b projected keeps
+        g.b rows (and strips only c); an extra whole-root filter column
+        vanishes entirely (review regressions)."""
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema(
+            "message m { required group g { required int64 b; required int64 c; } "
+            "required int64 x; }"
+        )
+        path = str(tmp_path / "shared.parquet")
+        with FileWriter(path, schema) as w:
+            w.write_rows(
+                [{"g": {"b": i, "c": i % 3}, "x": i} for i in range(30)]
+            )
+        with FileReader(path, columns=["g.b"]) as r:
+            rows = list(r.iter_rows(filters=[("g.c", "==", 1)]))
+            assert [row["g"]["b"] for row in rows] == list(range(1, 30, 3))
+            assert all(set(row["g"]) == {"b"} for row in rows)  # c stripped
+            rows = list(
+                r.iter_rows(filters=[("g.c", "==", 1), ("x", ">=", 10)])
+            )
+            assert [row["g"]["b"] for row in rows] == list(range(10, 30, 3))
+            assert all(set(row) == {"g"} for row in rows)  # x stripped
+            assert all(set(row["g"]) == {"b"} for row in rows)
