@@ -31,7 +31,7 @@ from .stats import _PACK
 
 __all__ = ["FilterError", "normalize_filters", "row_group_may_match", "row_matches"]
 
-_OPS = ("==", "!=", "<", "<=", ">", ">=", "is_null", "not_null")
+_OPS = ("==", "!=", "<", "<=", ">", ">=", "is_null", "not_null", "in", "not_in")
 
 _EPOCH_DATE = dt.date(1970, 1, 1)
 _EPOCH_UTC = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
@@ -93,6 +93,21 @@ def normalize_filters(schema: Schema, filters) -> list:
             if value is not None:
                 raise FilterError(f"filter: {op} takes no value")
             out.append((path, leaf, op, None, None, None))
+            continue
+        if op in ("in", "not_in"):
+            # row_value = list of row-domain values; vlo = list of
+            # (stat_lo, stat_hi) brackets (None when any element's stats
+            # are un-orderable — pruning then declines); vhi unused
+            if not isinstance(value, (list, tuple, set, frozenset)):
+                raise FilterError(f"filter: {op} takes a list/tuple/set of values")
+            rows, brackets = [], []
+            for v in value:
+                rv, lo, hi = _coerce_value(leaf, v)
+                rows.append(rv)
+                brackets.append((lo, hi))
+            if any(lo is None for lo, _ in brackets):
+                brackets = None
+            out.append((path, leaf, op, rows, brackets, None))
             continue
         row_value, stat_lo, stat_hi = _coerce_value(leaf, value)
         out.append((path, leaf, op, row_value, stat_lo, stat_hi))
@@ -270,6 +285,13 @@ def _bounds_admit(op, vlo, vhi, lo, hi, null_count) -> bool:
     [vlo, vhi] brackets the filter value in the stat domain; vlo != vhi
     means the value falls between representable stored values, so each
     comparison uses the end that keeps pruning conservative."""
+    if op == "in":
+        # admits iff ANY member could be present ([] provably matches nothing)
+        return any(
+            _bounds_admit("==", a, b, lo, hi, null_count) for a, b in vlo
+        )
+    if op == "not_in":
+        return True  # a range can't prove every row is in the set
     if op == "==" and (vlo != vhi or vhi < lo or vlo > hi):
         return False  # inexact value: NO stored value can equal it
     if op == "<" and lo >= vhi:
@@ -437,6 +459,20 @@ def _intersect_ranges(a, b):
     return out
 
 
+def _lift_row_value(v, value):
+    """Adapt a row value to the filter value's comparison domain."""
+    if isinstance(v, str) and isinstance(value, bytes):
+        return v.encode("utf-8")
+    if isinstance(v, dt.time) and not isinstance(value, dt.time):
+        # sub-microsecond TIME filter value on a MILLIS/MICROS column:
+        # lift the row into exact-nanos Time space for the comparison
+        from ..floor.time import Time
+
+        if isinstance(value, Time):
+            return Time.from_time(v, utc=value.utc)
+    return v
+
+
 def row_matches(row: dict, normalized) -> bool:
     for path, leaf, op, value, _vlo, _vhi in normalized:
         v = row.get(path[0]) if len(path) == 1 else _nested_get(row, path)
@@ -450,15 +486,16 @@ def row_matches(row: dict, normalized) -> bool:
             continue
         if v is None:
             return False
-        if isinstance(v, str) and isinstance(value, bytes):
-            v = v.encode("utf-8")
-        elif isinstance(v, dt.time) and not isinstance(value, dt.time):
-            # sub-microsecond TIME filter value on a MILLIS/MICROS column:
-            # lift the row into exact-nanos Time space for the comparison
-            from ..floor.time import Time
-
-            if isinstance(value, Time):
-                v = Time.from_time(v, utc=value.utc)
+        if op in ("in", "not_in"):
+            # members all came through _coerce_value for one leaf, so they
+            # share a domain: lift the row value once against the first
+            # member, not per member per row
+            lifted = _lift_row_value(v, value[0]) if value else v
+            hit = any(lifted == x for x in value)
+            if hit == (op == "not_in"):
+                return False
+            continue
+        v = _lift_row_value(v, value)
         if op == "==" and not v == value:
             return False
         if op == "!=" and not v != value:

@@ -731,7 +731,17 @@ class FileReader:
 
         by_path = chunks_by_path(self.row_group(i))
         for path, leaf, op, _rv, vlo, vhi in normalized:
-            if op != "==" or vlo is None or vlo != vhi:
+            if op == "==":
+                if vlo is None or vlo != vhi:
+                    continue
+                probes = [vlo]
+            elif op == "in":
+                # exclusion needs EVERY member provably absent, so every
+                # bracket must be exact ([] is handled by stats pruning)
+                if not vlo or any(a != b for a, b in vlo):
+                    continue
+                probes = [a for a, _ in vlo]
+            else:
                 continue
             cc = by_path.get(path)
             if cc is None or not cc.meta_data.bloom_filter_offset:
@@ -740,8 +750,9 @@ class FileReader:
                 bf = self.read_bloom_filter(i, path)
             except ParquetFileError:
                 continue  # corrupt filter: never exclude on it
-            if bf is not None and not bf.might_contain(
-                leaf.type, vlo, column_is_unsigned(leaf)
+            if bf is not None and all(
+                not bf.might_contain(leaf.type, p, column_is_unsigned(leaf))
+                for p in probes
             ):
                 return True
         return False

@@ -378,3 +378,45 @@ class TestFilterOutsideProjection:
             assert [row["g"]["b"] for row in rows] == list(range(10, 30, 3))
             assert all(set(row) == {"g"} for row in rows)  # x stripped
             assert all(set(row["g"]) == {"b"} for row in rows)
+
+
+class TestInOperator:
+    def test_in_and_not_in_with_full_pruning_stack(self, tmp_path):
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema(
+            "message m { required int64 id; required binary city (UTF8); }"
+        )
+        path = str(tmp_path / "in.parquet")
+        with FileWriter(
+            path, schema, write_page_index=True, bloom_filters=["id"],
+            row_group_size=1 << 30, use_dictionary=False,
+        ) as w:
+            for base in (0, 100_000):
+                w.write_column(
+                    "id", np.arange(base, base + 5_000, 2, dtype=np.int64)
+                )
+                w.write_column(
+                    "city", [f"c{(base + i) % 7}" for i in range(0, 5_000, 2)]
+                )
+                w.flush_row_group()
+        with FileReader(path) as r:
+            got = [row["id"] for row in r.iter_rows(filters=[("id", "in", [4, 100_002, 99])])]
+            assert got == [4, 100_002]  # 99 is odd: absent
+            # strings, set form
+            rows = list(r.iter_rows(filters=[("city", "in", {"c3"}), ("id", "<", 50)]))
+            assert all(row["city"] == "c3" for row in rows) and rows
+            # not_in is exact
+            n_all = sum(1 for _ in r.iter_rows())
+            n_in = sum(1 for _ in r.iter_rows(filters=[("city", "in", ["c0", "c1"])]))
+            n_out = sum(1 for _ in r.iter_rows(filters=[("city", "not_in", ["c0", "c1"])]))
+            assert n_in + n_out == n_all and n_in and n_out
+            # empty in-list matches nothing
+            assert list(r.iter_rows(filters=[("id", "in", [])])) == []
+            # stats pruning: members all in group 2's range -> group 1 skipped
+            assert r.prune_row_groups([("id", "in", [100_002, 100_004])]) == [1]
+            # bloom pruning: all members odd (absent) but inside [min, max]
+            assert r.prune_row_groups([("id", "in", [101, 103])]) == []
+            # bad value shape rejected
+            with pytest.raises(FilterError):
+                r.prune_row_groups([("id", "in", 5)])
