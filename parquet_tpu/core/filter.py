@@ -29,7 +29,16 @@ from .assembly import logical_kind
 from .schema import Schema
 from .stats import _PACK
 
-__all__ = ["FilterError", "normalize_filters", "row_group_may_match", "row_matches"]
+__all__ = [
+    "FilterError",
+    "normalize_filters",
+    "normalize_dnf",
+    "row_group_may_match",
+    "row_matches",
+    "dnf_group_may_match",
+    "dnf_row_matches",
+    "dnf_page_ranges",
+]
 
 _OPS = ("==", "!=", "<", "<=", ">", ">=", "is_null", "not_null", "in", "not_in")
 
@@ -95,9 +104,10 @@ def normalize_filters(schema: Schema, filters) -> list:
             out.append((path, leaf, op, None, None, None))
             continue
         if op in ("in", "not_in"):
-            # row_value = list of row-domain values; vlo = list of
-            # (stat_lo, stat_hi) brackets (None when any element's stats
-            # are un-orderable — pruning then declines); vhi unused
+            # row_value = members in ONE shared row domain (set when
+            # hashable, for O(1) membership); vlo = list of (stat_lo,
+            # stat_hi) brackets (None when any element's stats are
+            # un-orderable — pruning then declines); vhi unused
             if not isinstance(value, (list, tuple, set, frozenset)):
                 raise FilterError(f"filter: {op} takes a list/tuple/set of values")
             rows, brackets = [], []
@@ -107,11 +117,36 @@ def normalize_filters(schema: Schema, filters) -> list:
                 brackets.append((lo, hi))
             if any(lo is None for lo, _ in brackets):
                 brackets = None
-            out.append((path, leaf, op, rows, brackets, None))
+            rows = _unify_members(rows)
+            try:
+                members = frozenset(rows)
+            except TypeError:
+                members = rows  # unhashable member type: linear scan
+            out.append((path, leaf, op, members, brackets, None))
             continue
         row_value, stat_lo, stat_hi = _coerce_value(leaf, value)
         out.append((path, leaf, op, row_value, stat_lo, stat_hi))
     return out
+
+
+def _unify_members(rows: list) -> list:
+    """Lift in-list members into ONE comparison domain. TIME coercion is the
+    only mixed case: sub-microsecond members become Time, whole-microsecond
+    members dt.time — comparing across those is order-dependent, so every
+    dt.time member lifts to Time when any Time member exists."""
+    from ..floor.time import Time
+
+    if any(isinstance(r, Time) for r in rows) and any(
+        isinstance(r, dt.time) and not isinstance(r, Time) for r in rows
+    ):
+        utc = next(r.utc for r in rows if isinstance(r, Time))
+        return [
+            Time.from_time(r, utc=utc)
+            if isinstance(r, dt.time) and not isinstance(r, Time)
+            else r
+            for r in rows
+        ]
+    return rows
 
 
 def _int_bracket(value):
@@ -411,6 +446,57 @@ def page_ranges_matching(normalized, indexes, num_rows: int):
     return _coalesce_ranges(ranges)
 
 
+def normalize_dnf(schema: Schema, filters) -> list:
+    """Normalize a predicate into disjunctive normal form: a list of
+    normalized conjunctions (OR of ANDs).
+
+    Accepts pyarrow's convention: a flat list of (column, op, value) triples
+    is one conjunction; a list of LISTS of triples is an OR of conjunctions.
+    Disambiguation matches pyarrow: an element whose first item is a string
+    is a TRIPLE (so JSON-style list-triples like ["id", "==", 3] stay a flat
+    conjunction), and only all-list elements with non-string heads form DNF.
+    """
+    filters = list(filters)  # may be a generator: iterate exactly once
+    if filters and all(
+        isinstance(c, list) and c and not isinstance(c[0], str) for c in filters
+    ):
+        return [normalize_filters(schema, c) for c in filters]
+    if filters and all(isinstance(c, list) for c in filters) and any(
+        not c for c in filters
+    ):
+        raise FilterError("filter: empty conjunction in OR-of-ANDs form")
+    return [normalize_filters(schema, filters)]
+
+
+def dnf_group_may_match(rg, dnf, bloom_excludes=None, group_index=None) -> bool:
+    """A group survives when ANY conjunction admits it (and, when a
+    bloom_excludes(i, conjunction) callback is given, isn't bloom-proven
+    empty for that conjunction)."""
+    for conj in dnf:
+        if not row_group_may_match(rg, conj):
+            continue
+        if bloom_excludes is not None and bloom_excludes(group_index, conj):
+            continue
+        return True
+    return False
+
+
+def dnf_row_matches(row: dict, dnf) -> bool:
+    return any(row_matches(row, conj) for conj in dnf)
+
+
+def dnf_page_ranges(dnf, indexes, num_rows: int):
+    """Union of each conjunction's admitted row ranges."""
+    all_ranges: list = []
+    for conj in dnf:
+        rs = page_ranges_matching(conj, indexes, num_rows)
+        if rs == [(0, num_rows)]:
+            return rs  # one conjunction admits everything
+        all_ranges.extend(rs)
+    all_ranges.sort()
+    return _coalesce_ranges(all_ranges)
+
+
 def _coalesce_ranges(rs):
     out: list = []
     for s, e in rs:
@@ -487,11 +573,17 @@ def row_matches(row: dict, normalized) -> bool:
         if v is None:
             return False
         if op in ("in", "not_in"):
-            # members all came through _coerce_value for one leaf, so they
-            # share a domain: lift the row value once against the first
-            # member, not per member per row
-            lifted = _lift_row_value(v, value[0]) if value else v
-            hit = any(lifted == x for x in value)
+            # members were unified into one domain at normalize time, so
+            # the row value lifts once (against any member), not per member
+            if value:
+                lifted = _lift_row_value(v, next(iter(value)))
+                hit = (
+                    lifted in value
+                    if isinstance(value, frozenset)
+                    else any(lifted == x for x in value)
+                )
+            else:
+                hit = False
             if hit == (op == "not_in"):
                 return False
             continue

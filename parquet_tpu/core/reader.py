@@ -399,7 +399,8 @@ class FileReader:
         data-parallel input pipeline: decode once, shard over ICI. The
         batch size must divide evenly over the sharded axis.
 
-        `filters` pushes a (column, op, value) conjunction down to ROW-GROUP
+        `filters` pushes a predicate (a (column, op, value) conjunction, or
+        a list of lists — the OR-of-ANDs DNF convention) down to ROW-GROUP
         granularity: groups whose statistics/bloom filters exclude the
         predicate are never prepared, uploaded, or decoded. Surviving groups
         stream whole (batches keep their static shape; rows are NOT
@@ -414,9 +415,9 @@ class FileReader:
         if filters is not None:
             # eager validation, like batch_size/nullable: a bad column or op
             # should fail HERE, not at the first next() deep in a train loop
-            from .filter import normalize_filters
+            from .filter import normalize_dnf
 
-            normalized = normalize_filters(self.schema, filters)
+            normalized = normalize_dnf(self.schema, filters)
         return self._iter_device_batches(
             batch_size, columns, drop_remainder, sharding, nullable, normalized
         )
@@ -634,18 +635,17 @@ class FileReader:
         groups provably excluded by written min/max/null-count never load
         (statistics-driven pruning; the reference writes stats but never
         consumes them, README.md:47)."""
-        from .filter import normalize_filters
+        from .filter import normalize_dnf
 
-        return self._prune_groups_normalized(normalize_filters(self.schema, filters))
+        return self._prune_groups_normalized(normalize_dnf(self.schema, filters))
 
-    def _prune_groups_normalized(self, normalized) -> list[int]:
-        from .filter import row_group_may_match
+    def _prune_groups_normalized(self, dnf) -> list[int]:
+        from .filter import dnf_group_may_match
 
         return [
             i
             for i in range(self.num_row_groups)
-            if row_group_may_match(self.row_group(i), normalized)
-            and not self._bloom_excludes(i, normalized)
+            if dnf_group_may_match(self.row_group(i), dnf, self._bloom_excludes, i)
         ]
 
     def read_page_index(self, i: int, columns=None) -> dict:
@@ -763,21 +763,22 @@ class FileReader:
         stop)); [(0, num_rows)] when the file has no page index or nothing
         can be pruned, [] when the whole group is provably empty of
         matches."""
-        from .filter import normalize_filters, page_ranges_matching
+        from .filter import dnf_page_ranges, normalize_dnf
 
-        normalized = normalize_filters(self.schema, filters)
+        dnf = normalize_dnf(self.schema, filters)
         num_rows = self.row_group(i).num_rows or 0
-        paths = [p for p, *_ in normalized]
+        paths = [p for conj in dnf for p, *_ in conj]
         indexes = self.read_page_index(i, columns=paths) if paths else {}
-        return page_ranges_matching(normalized, indexes, num_rows)
+        return dnf_page_ranges(dnf, indexes, num_rows)
 
     def iter_rows(self, row_groups=None, raw: bool = False, filters=None):
         """Yield rows as dicts (returns an iterator). `raw=True` gives
         reference-style nested maps (no LIST/MAP unwrapping, bytes not
-        decoded). `filters` is a conjunction of (column, op, value) triples:
-        row groups whose statistics/bloom/page-index exclude the predicate
-        are skipped wholesale and the surviving rows are predicate-checked
-        exactly."""
+        decoded). `filters` is a flat list of (column, op, value) triples (a
+        conjunction) or a list of LISTS of triples (an OR of conjunctions —
+        pyarrow's DNF convention): row groups whose statistics/bloom/
+        page-index exclude the predicate are skipped wholesale and the
+        surviving rows are predicate-checked exactly."""
         if filters is None and row_groups is None and self.num_row_groups == 1:
             # single-group scan: hand back the group's list/generator with
             # no extra per-row generator hop (~10% of assembled-rows time)
@@ -786,14 +787,14 @@ class FileReader:
         return self._iter_rows_gen(row_groups, raw, filters)
 
     def _iter_rows_gen(self, row_groups, raw: bool, filters):
-        normalized = None
+        dnf = None
         if filters is not None:
             from .filter import (
                 FilterError,
-                normalize_filters,
-                page_ranges_matching,
-                row_group_may_match,
-                row_matches,
+                dnf_group_may_match,
+                dnf_page_ranges,
+                dnf_row_matches,
+                normalize_dnf,
             )
 
             if raw:
@@ -803,7 +804,7 @@ class FileReader:
                 # mismatch — mirror floor.Reader, which only prunes for the
                 # unmarshal path
                 raise FilterError("filters cannot be combined with raw=True")
-            normalized = normalize_filters(self.schema, filters)
+            dnf = normalize_dnf(self.schema, filters)
         # Filter columns OUTSIDE the projection still evaluate: decode them
         # alongside the selection, predicate-check, then strip them from the
         # yielded rows (silently returning zero rows because the predicate
@@ -813,8 +814,8 @@ class FileReader:
         # row that keeps g.b, and a whole unselected root vanishes outright.
         read_cols = None
         strips: list = []  # (parent path parts, key to pop)
-        if normalized is not None and self._selected is not None:
-            fpaths = {p for p, *_ in normalized}
+        if dnf is not None and self._selected is not None:
+            fpaths = {p for conj in dnf for p, *_ in conj}
             missing = fpaths - self._selected
             if missing:
                 read_cols = list(self._selected | fpaths)
@@ -827,14 +828,14 @@ class FileReader:
                     strips.append((path[: cut - 1], path[cut - 1]))
         indices = range(self.num_row_groups) if row_groups is None else row_groups
         for i in indices:
-            if normalized is None:
+            if dnf is None:
                 # no predicate: delegate the whole group (C-level yield from
                 # the assembled list — no per-row Python frame)
                 yield from self._iter_group_rows(i, raw)
                 continue
-            if not row_group_may_match(self.row_group(i), normalized):
-                continue
-            if self._bloom_excludes(i, normalized):
+            if not dnf_group_may_match(
+                self.row_group(i), dnf, self._bloom_excludes, i
+            ):
                 continue
             # page index (when written): restrict row materialization to the
             # ranges whose pages may match — row assembly is the dominant
@@ -849,7 +850,7 @@ class FileReader:
                 indexes = self.read_page_index(i, columns=read_cols)
                 if any(ci is not None for ci, _ in indexes.values()):
                     num_rows = self.row_group(i).num_rows or 0
-                    ranges = page_ranges_matching(normalized, indexes, num_rows)
+                    ranges = dnf_page_ranges(dnf, indexes, num_rows)
                     if ranges == [(0, num_rows)]:
                         # nothing pruned: keep the unpruned fast paths
                         # (direct list / plain windows, no extra slicing)
@@ -861,7 +862,7 @@ class FileReader:
                 continue
             if read_cols is not None:
                 for row in self._iter_group_rows(i, raw, ranges, indexes, read_cols):
-                    if row_matches(row, normalized):
+                    if dnf_row_matches(row, dnf):
                         for parents, key in strips:
                             d = row
                             for part in parents:
@@ -873,7 +874,7 @@ class FileReader:
                         yield row
             else:
                 for row in self._iter_group_rows(i, raw, ranges, indexes):
-                    if row_matches(row, normalized):
+                    if dnf_row_matches(row, dnf):
                         yield row
 
     def _iter_group_rows(

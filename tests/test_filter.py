@@ -420,3 +420,117 @@ class TestInOperator:
             # bad value shape rejected
             with pytest.raises(FilterError):
                 r.prune_row_groups([("id", "in", 5)])
+
+
+class TestDnfOrFilters:
+    def test_or_of_ands_full_stack(self, tmp_path):
+        """pyarrow's DNF convention: a list of LISTS of triples is an OR of
+        conjunctions, pruned per-conjunction and unioned."""
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema(
+            "message m { required int64 id; required binary c (UTF8); }"
+        )
+        path = str(tmp_path / "dnf.parquet")
+        with FileWriter(
+            path, schema, write_page_index=True, bloom_filters=["id"],
+            use_dictionary=False, max_page_size=8_192,
+        ) as w:
+            for base in (0, 1_000_000, 2_000_000):
+                w.write_column("id", np.arange(base, base + 10_000, dtype=np.int64))
+                w.write_column("c", [f"c{(base + i) % 4}" for i in range(10_000)])
+                w.flush_row_group()
+        with FileReader(path) as r:
+            # OR across distant groups
+            got = [
+                row["id"]
+                for row in r.iter_rows(
+                    filters=[[("id", "<", 3)], [("id", ">=", 2_009_997)]]
+                )
+            ]
+            assert got == [0, 1, 2, 2_009_997, 2_009_998, 2_009_999]
+            # group pruning is the union of the conjunctions' groups
+            assert r.prune_row_groups(
+                [[("id", "==", 5)], [("id", "==", 1_000_005)]]
+            ) == [0, 1]
+            # page ranges union within one group
+            ranges = r.prune_pages(
+                0, [[("id", "<", 10)], [("id", ">=", 9_990)]]
+            )
+            covered = sum(e - s for s, e in ranges)
+            assert 0 < covered < 10_000 and len(ranges) == 2
+            # AND inside each conjunct still applies
+            got = list(
+                r.iter_rows(
+                    filters=[
+                        [("id", "<", 8), ("c", "==", "c1")],
+                        [("id", "in", [1_000_001])],
+                    ]
+                )
+            )
+            assert [row["id"] for row in got] == [1, 5, 1_000_001]
+            # flat form still means one conjunction
+            assert len(list(r.iter_rows(filters=[("id", "==", 7)]))) == 1
+            # empty conjunction in DNF form rejected
+            with pytest.raises(FilterError):
+                r.prune_row_groups([[("id", "==", 1)], []])
+
+    def test_dnf_device_batches(self, tmp_path):
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema("message m { required int64 id; }")
+        path = str(tmp_path / "dnfdev.parquet")
+        with FileWriter(path, schema, use_dictionary=False) as w:
+            for base in (0, 50_000, 100_000):
+                w.write_column("id", np.arange(base, base + 4_096, dtype=np.int64))
+                w.flush_row_group()
+        with FileReader(path) as r:
+            batches = list(
+                r.iter_device_batches(
+                    4_096,
+                    filters=[[("id", "<", 10)], [("id", ">=", 100_000)]],
+                )
+            )
+            assert len(batches) == 2  # groups 0 and 2, group 1 pruned
+
+    def test_generator_filters_and_json_list_triples(self, tmp_path):
+        """Review regressions: generator filters must not be silently
+        consumed into a match-all, and JSON-style list triples stay a flat
+        conjunction."""
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema("message m { required int64 id; }")
+        path = str(tmp_path / "gen.parquet")
+        with FileWriter(path, schema) as w:
+            w.write_column("id", np.arange(10, dtype=np.int64))
+        with FileReader(path) as r:
+            got = [row["id"] for row in r.iter_rows(
+                filters=(f for f in [("id", "==", 3)])
+            )]
+            assert got == [3]
+            got = [row["id"] for row in r.iter_rows(filters=[["id", "==", 4]])]
+            assert got == [4]  # list-triple == flat conjunction
+            got = [row["id"] for row in r.iter_rows(
+                filters=[[["id", "==", 1]], [["id", "==", 8]]]
+            )]
+            assert got == [1, 8]  # DNF with list-triples
+
+    def test_time_in_list_mixed_domains(self, tmp_path):
+        """TIME in-lists mixing sub-microsecond (Time) and whole-microsecond
+        (dt.time) members must match regardless of member order."""
+        import datetime as dt
+
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+        from parquet_tpu.floor.time import Time
+
+        schema = parse_schema("message m { required int64 t (TIME_MICROS); }")
+        path = str(tmp_path / "time_in.parquet")
+        with FileWriter(path, schema) as w:
+            w.write_rows([{"t": 3_600_000_000}])  # 01:00:00
+        members_a = [Time.from_nanos(500), dt.time(1, 0, 0)]
+        members_b = [dt.time(1, 0, 0), Time.from_nanos(500)]
+        with FileReader(path) as r:
+            for members in (members_a, members_b):
+                got = list(r.iter_rows(filters=[("t", "in", members)]))
+                assert len(got) == 1, members
+                assert list(r.iter_rows(filters=[("t", "not_in", members)])) == []
