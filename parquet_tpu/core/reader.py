@@ -19,7 +19,10 @@ import io
 import os
 import threading
 from contextlib import contextmanager
+from functools import partial
 from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
 from pathlib import Path
 from typing import NamedTuple
 
@@ -90,6 +93,52 @@ def _timed_rows(assembler):
             except StopIteration:
                 return
         yield row
+
+
+class RaggedColumn(NamedTuple):
+    """A LIST column in device-batch form: `values` is row-padded to a
+    static [rows, max_list_len] matrix (unused slots zero-filled on device)
+    and `lengths` is the int32 element count per row — the TPU-native
+    ragged representation (a NamedTuple = a jax pytree node, so a jitted
+    step takes the pair and masks with
+    `jnp.arange(K) < col.lengths[:, None]`). Null and empty lists both have
+    length 0."""
+
+    values: object  # jax.Array[rows, max_list_len]
+    lengths: object  # jax.Array[rows] int32
+
+
+_pad_ragged_jit = None
+
+
+def _pad_ragged_device(values, lengths, max_len: int) -> RaggedColumn:
+    """Scatter a flat element vector into [rows, max_len] ON DEVICE: row
+    offsets come from a cumsum of lengths, each row gathers its slice, and
+    slots past the row's length zero-fill. Static shapes — one compile per
+    (rows, max_len, dtype) bucket."""
+    global _pad_ragged_jit
+    import jax
+    import jax.numpy as jnp
+
+    if _pad_ragged_jit is None:
+
+        @partial(jax.jit, static_argnames=("max_len",))
+        def pad(v, ln, max_len):
+            offs = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), jnp.cumsum(ln, dtype=jnp.int32)]
+            )
+            idx = offs[:-1, None] + jnp.arange(max_len, dtype=jnp.int32)[None, :]
+            nv = v.shape[0]
+            mask = jnp.arange(max_len, dtype=jnp.int32)[None, :] < ln[:, None]
+            safe = jnp.clip(idx, 0, max(nv - 1, 0))
+            vals = v[safe] if nv else jnp.zeros(idx.shape, v.dtype)
+            zero = jnp.zeros((), v.dtype)
+            return jnp.where(mask, vals, zero)
+
+        _pad_ragged_jit = pad
+    return RaggedColumn(
+        values=_pad_ragged_jit(values, lengths, max_len), lengths=lengths
+    )
 
 
 class MaskedColumn(NamedTuple):
@@ -369,6 +418,8 @@ class FileReader:
         sharding=None,
         nullable: str = "error",
         filters=None,
+        lists: str = "error",
+        max_list_len: int | None = None,
     ):
         """Stream the file as fixed-size device-resident batches.
 
@@ -399,6 +450,17 @@ class FileReader:
         data-parallel input pipeline: decode once, shard over ICI. The
         batch size must divide evenly over the sharded axis.
 
+        `lists` picks the policy for single-level LIST columns:
+          "error" (default)  raise — leaf slots are not rows
+          "pad"              yield RaggedColumn(values, lengths): values
+                             row-padded ON DEVICE to a static
+                             [rows, max_list_len] matrix (zero-filled past
+                             each row's length), lengths the per-row element
+                             count — the TPU-native ragged representation
+                             for sequence data. Requires max_list_len; a row
+                             exceeding it raises. Null and empty lists both
+                             have length 0.
+
         `filters` pushes a predicate (a (column, op, value) conjunction, or
         a list of lists — the OR-of-ANDs DNF convention) down to ROW-GROUP
         granularity: groups whose statistics/bloom filters exclude the
@@ -411,6 +473,21 @@ class FileReader:
             raise ValueError("batch_size must be positive")
         if nullable not in ("error", "mask"):
             raise ValueError('nullable must be "error" or "mask"')
+        if lists not in ("error", "pad"):
+            raise ValueError('lists must be "error" or "pad"')
+        if lists == "pad":
+            if max_list_len is None or max_list_len <= 0:
+                raise ValueError('lists="pad" requires a positive max_list_len')
+            # eager, like every other argument: nested lists fail at the
+            # call, not at the first next() deep in a train loop
+            sel = self._resolve_columns(columns) if columns else self._selected
+            for leaf in self.schema.leaves:
+                if (sel is None or leaf.path in sel) and leaf.max_rep > 1:
+                    raise ParquetFileError(
+                        f"parquet: column {leaf.path_str} has {leaf.max_rep} "
+                        "repetition levels; ragged batching covers "
+                        "single-level LIST columns only"
+                    )
         normalized = None
         if filters is not None:
             # eager validation, like batch_size/nullable: a bad column or op
@@ -419,15 +496,66 @@ class FileReader:
 
             normalized = normalize_dnf(self.schema, filters)
         return self._iter_device_batches(
-            batch_size, columns, drop_remainder, sharding, nullable, normalized
+            batch_size, columns, drop_remainder, sharding, nullable,
+            normalized, lists, max_list_len,
         )
 
     def _iter_device_batches(
         self, batch_size: int, columns, drop_remainder: bool, sharding=None,
-        nullable: str = "error", normalized=None,
+        nullable: str = "error", normalized=None, lists: str = "error",
+        max_list_len=None,
     ):
         import jax
         import jax.numpy as jnp
+
+        def _ragged(path, dc, arr):
+            from ..meta.parquet_types import FieldRepetitionType
+
+            leaf = self.schema.column(path)
+            if leaf.max_rep != 1:
+                raise ParquetFileError(
+                    f"parquet: column {'.'.join(path)} has {leaf.max_rep} "
+                    "repetition levels; ragged batching covers single-level "
+                    "LIST columns only"
+                )
+            rl = np.asarray(dc.rep_levels)
+            starts = np.nonzero(rl == 0)[0]
+            if dc.def_levels is not None:
+                dl = np.asarray(dc.def_levels)
+                present = dl == leaf.max_def
+                # a null ELEMENT (optional leaf, def one below max) would
+                # silently left-shift its row's survivors — corruption for
+                # position-sensitive sequences, so refuse
+                if leaf.repetition == FieldRepetitionType.OPTIONAL and bool(
+                    (dl == leaf.max_def - 1).any()
+                ):
+                    raise ParquetFileError(
+                        f"parquet: column {'.'.join(path)} has null elements "
+                        "inside lists; ragged batching would shift positions "
+                        "(fill nulls upstream)"
+                    )
+            else:
+                present = np.ones(len(rl), dtype=bool)
+            # every row owns >= 1 level entry (null/empty lists carry one
+            # below-max entry), so reduceat over row starts counts elements
+            lengths = (
+                np.add.reduceat(present.astype(np.int32), starts)
+                if len(starts)
+                else np.zeros(0, dtype=np.int32)
+            )
+            if arr.shape[0] != int(present.sum()):
+                raise ParquetFileError(
+                    f"parquet: column {'.'.join(path)} level/value mismatch"
+                )
+            if len(lengths) and int(lengths.max()) > max_list_len:
+                raise ParquetFileError(
+                    f"parquet: column {'.'.join(path)} has a row with "
+                    f"{int(lengths.max())} elements > max_list_len="
+                    f"{max_list_len} (raise it, or filter upstream)"
+                )
+            return _pad_ragged_device(
+                arr, jnp.asarray(lengths), int(max_list_len)
+            )
 
         def _array_of(path, dc):
             arr = dc.values if dc.values is not None else dc.indices
@@ -437,9 +565,12 @@ class FileReader:
                     "(raw byte-array columns cannot batch; project them out)"
                 )
             if dc.rep_levels is not None:
+                if lists == "pad":
+                    return _ragged(path, dc, arr)
                 raise ParquetFileError(
                     f"parquet: column {'.'.join(path)} is repeated; its leaf "
-                    "slots are not rows, so it cannot batch (project it out)"
+                    "slots are not rows, so it cannot batch (project it "
+                    'out, or pass lists="pad" with max_list_len)'
                 )
             has_nulls = arr.shape[0] != dc.num_values
             if nullable == "mask" and dc.def_levels is not None:
