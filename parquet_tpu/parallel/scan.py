@@ -128,15 +128,20 @@ def _stats_materialize(folded) -> dict:
     }
 
 
-def column_stats(reader, devices, columns=None):
+def column_stats(reader, devices, columns=None, filters=None):
     """Global per-column {min, max, count} over the whole file.
 
     Numeric columns only (dictionary-encoded byte-array columns have no
     device values array; project them out with `columns=`). Per-shard stats
     are computed on the decoding device; only those scalars reach the fold.
+    `filters` prunes row groups (statistics + bloom) before any decode —
+    note the stats then cover the SURVIVING groups whole, not exact
+    predicate matches (group-granular pushdown, like iter_device_batches).
     """
+    indices = reader.prune_row_groups(filters) if filters is not None else None
     folded = scan_row_groups(
-        reader, devices, _stats_map_fn, _stats_reduce_fn, columns=columns
+        reader, devices, _stats_map_fn, _stats_reduce_fn,
+        columns=columns, indices=indices,
     )
     return {} if folded is None else _stats_materialize(folded)
 

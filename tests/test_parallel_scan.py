@@ -176,3 +176,24 @@ class TestDistributedStats:
         # psum'd count divides back to the true count
         assert out[("x",)]["count"] == 10_000
         assert out[("x",)]["min"] == 0 and out[("x",)]["max"] == 9_999
+
+
+def test_column_stats_with_filter_pushdown(tmp_path):
+    """filters= prunes row groups before the distributed scan decodes them."""
+    from parquet_tpu import FileWriter, parse_schema
+
+    schema = parse_schema("message m { required int64 x; }")
+    path = str(tmp_path / "scanf.parquet")
+    with FileWriter(path, schema, use_dictionary=False) as w:
+        for base in (0, 1_000_000):
+            w.write_column("x", np.arange(base, base + 4_096, dtype=np.int64))
+            w.flush_row_group()
+    devices = jax.devices("cpu")[:4]
+    with FileReader(path) as r:
+        full = column_stats(r, devices)
+        assert full[("x",)]["count"] == 8_192
+        part = column_stats(r, devices, filters=[("x", ">=", 1_000_000)])
+        assert part[("x",)]["count"] == 4_096
+        assert part[("x",)]["min"] == 1_000_000
+        empty = column_stats(r, devices, filters=[("x", "<", -1)])
+        assert empty == {} or all(v["count"] == 0 for v in empty.values())
