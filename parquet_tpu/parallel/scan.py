@@ -240,7 +240,9 @@ def _stats_identity(leaf):
     return {"min": hi, "max": lo, "count": jnp.asarray(0, dtype=jnp.int64)}
 
 
-def distributed_column_stats(reader, columns=None, mesh=None, devices=None):
+def distributed_column_stats(
+    reader, columns=None, mesh=None, devices=None, filters=None
+):
     """Whole-file column stats in a multi-host program.
 
     Each process decodes only its own row groups (process_row_groups) on its
@@ -250,10 +252,16 @@ def distributed_column_stats(reader, columns=None, mesh=None, devices=None):
     device in the program, one participant per process replicated over its
     local devices). Single-process programs with no explicit mesh skip the
     collective. `devices` overrides the local device set (e.g. a CPU-pinned
-    dryrun passes the mesh's host devices explicitly)."""
+    dryrun passes the mesh's host devices explicitly). `filters` prunes row
+    groups (statistics + bloom) before any decode — every process prunes
+    from the same metadata, so ownership stays consistent; surviving groups
+    stream whole (group-granular pushdown, like column_stats)."""
     if devices is None:
         devices = jax.local_devices()
     indices = process_row_groups(reader.num_row_groups)
+    if filters is not None:
+        admitted = set(reader.prune_row_groups(filters))
+        indices = [i for i in indices if i in admitted]
     key_nodes = _stats_key_nodes(reader, columns)
     acc = scan_row_groups(
         reader, devices, _stats_map_fn, _stats_reduce_fn,

@@ -197,3 +197,21 @@ def test_column_stats_with_filter_pushdown(tmp_path):
         assert part[("x",)]["min"] == 1_000_000
         empty = column_stats(r, devices, filters=[("x", "<", -1)])
         assert empty == {} or all(v["count"] == 0 for v in empty.values())
+
+
+def test_distributed_column_stats_with_filters(tmp_path):
+    from parquet_tpu import FileWriter, parse_schema
+    from parquet_tpu.parallel.scan import distributed_column_stats
+
+    schema = parse_schema("message m { required int64 x; }")
+    path = str(tmp_path / "dscanf.parquet")
+    with FileWriter(path, schema, use_dictionary=False) as w:
+        for base in (0, 1_000_000):
+            w.write_column("x", np.arange(base, base + 2_048, dtype=np.int64))
+            w.flush_row_group()
+    with FileReader(path) as r:
+        st = distributed_column_stats(
+            r, devices=jax.devices("cpu")[:4], filters=[("x", "<", 1_000)]
+        )
+        assert st[("x",)]["count"] == 2_048  # group 0 whole, group 1 pruned
+        assert st[("x",)]["max"] == 2_047
