@@ -17,6 +17,7 @@ import pytest
 from parquet_tpu import FileReader, FileWriter
 from parquet_tpu.meta.parquet_types import Type
 from parquet_tpu.schema.builder import (
+    group,
     list_of,
     message,
     optional,
@@ -73,6 +74,24 @@ def _draw_schema_and_rows(rng):
                 0.15,
             )
         )
+    if rng.random() < 0.4:
+        fields.append(
+            group(
+                "meta",
+                required("k", Type.INT64),
+                optional("v", string()),
+            )
+        )
+        gens.append(
+            (
+                "meta",
+                lambda r: {
+                    "k": int(r.integers(0, 1000)),
+                    "v": None if r.random() < 0.3 else f"m{int(r.integers(0, 9))}",
+                },
+                0.2,
+            )
+        )
     schema = message(*fields)
     rows = []
     for _ in range(N_ROWS):
@@ -124,9 +143,18 @@ def test_random_roundtrip(tmp_path, seed):
     opts = _draw_options(rng, schema)
     path = str(tmp_path / f"prop_{seed}.parquet")
     with FileWriter(path, schema, **opts) as w:
-        w.write_rows(rows)
-    # (a) our reader returns the input exactly
-    with FileReader(path, validate_crc=opts["with_crc"]) as r:
+        n_groups = int(rng.choice([1, 3]))
+        per = (len(rows) + n_groups - 1) // n_groups
+        for g in range(n_groups):
+            w.write_rows(rows[g * per : (g + 1) * per])
+            w.flush_row_group()
+    # (a) our reader returns the input exactly (compact_levels randomly on:
+    # bit-packed level storage must be invisible to every consumer)
+    with FileReader(
+        path,
+        validate_crc=opts["with_crc"],
+        compact_levels=bool(rng.random() < 0.5),
+    ) as r:
         ours = list(r.iter_rows())
     assert len(ours) == len(rows), (seed, opts)
     for i, (o, exp) in enumerate(zip(ours, rows)):
