@@ -1114,6 +1114,139 @@ class FileReader:
                     )
                 yield from rows
 
+    def to_arrow(self, row_groups=None, columns=None):
+        """Decoded columns as a pyarrow.Table (flat leaves only — numerics,
+        booleans, strings/binary, FLBA — with validity from the definition
+        levels; byte-array buffers transfer zero-copy into large_binary/
+        large_string layouts). The reverse of write_column's arrow ingest:
+        a pyarrow user can hand columns either way without a rewrite.
+        Nested columns raise — project them out or use iter_rows."""
+        import pyarrow as pa
+
+        from ..meta.parquet_types import Type
+        from .arrays import ByteArrayData
+
+        def _flat_leaf(path):
+            leaf = self.schema.column(path)
+            if leaf.max_rep > 0 or len(path) != 1:
+                raise ParquetFileError(
+                    f"parquet: to_arrow covers flat columns only; "
+                    f"{'.'.join(path)} is nested (project it out or use "
+                    "iter_rows)"
+                )
+            return leaf
+
+        def _arrow_type(leaf):
+            if leaf.type == Type.BYTE_ARRAY:
+                return pa.large_string() if leaf.is_string() else pa.large_binary()
+            if leaf.type in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+                return pa.binary(12 if leaf.type == Type.INT96 else leaf.type_length)
+            return {
+                Type.INT32: pa.int32(),
+                Type.INT64: pa.int64(),
+                Type.FLOAT: pa.float32(),
+                Type.DOUBLE: pa.float64(),
+                Type.BOOLEAN: pa.bool_(),
+            }[leaf.type]
+
+        indices = list(
+            range(self.num_row_groups) if row_groups is None else row_groups
+        )
+        if not indices:
+            # zero groups selected: a zero-ROW table with the selected
+            # schema, so cross-file concatenation never hits a mismatch
+            sel = self._resolve_columns(columns) if columns else self._selected
+            return pa.table(
+                {
+                    leaf.name: pa.array([], type=_arrow_type(_flat_leaf(leaf.path)))
+                    for leaf in self.schema.leaves
+                    if sel is None or leaf.path in sel
+                }
+            )
+        per_group: list[dict] = []
+        names: list[str] | None = None
+        for i in indices:
+            chunks = self._read_row_group(i, columns, pack=False)
+            cols = {}
+            for path, cd in chunks.items():
+                leaf = _flat_leaf(path)
+                mask = None
+                if cd.def_levels is not None and leaf.max_def > 0:
+                    valid = np.asarray(cd.def_levels) == leaf.max_def
+                    if not valid.all():
+                        mask = ~valid
+                values = cd.values
+                if isinstance(values, ByteArrayData):
+                    atype = (
+                        pa.large_string() if leaf.is_string() else pa.large_binary()
+                    )
+                    offsets = np.ascontiguousarray(values.offsets, dtype=np.int64)
+                    data = values.data
+                    if mask is not None:
+                        # expand offsets to row positions: null rows repeat
+                        # the running offset (zero-length slot)
+                        idx = np.clip(np.cumsum(valid) - 1, 0, None)
+                        ends = offsets[1:]
+                        picked = (
+                            ends[idx]
+                            if len(ends)
+                            else np.zeros(len(valid), dtype=np.int64)
+                        )
+                        offsets = np.concatenate(
+                            [np.zeros(1, dtype=np.int64), np.where(valid, picked, 0)]
+                        )
+                        np.maximum.accumulate(offsets, out=offsets)
+                    n = len(offsets) - 1
+                    bufs = [
+                        None
+                        if mask is None
+                        else pa.py_buffer(
+                            np.packbits(valid, bitorder="little").tobytes()
+                        ),
+                        pa.py_buffer(offsets),
+                        pa.py_buffer(data),
+                    ]
+                    arr = pa.Array.from_buffers(
+                        atype, n, bufs,
+                        null_count=int(mask.sum()) if mask is not None else 0,
+                    )
+                else:
+                    np_vals = np.asarray(values)
+                    if np_vals.ndim == 2:  # FLBA / INT96 rows
+                        atype = pa.binary(np_vals.shape[1])
+                        if mask is None:
+                            flat = np.ascontiguousarray(np_vals).reshape(-1)
+                            arr = pa.Array.from_buffers(
+                                atype, len(np_vals), [None, pa.py_buffer(flat)]
+                            )
+                        else:
+                            # values are DENSE (non-null cells only):
+                            # scatter them to their row positions
+                            it = iter(np_vals)
+                            rows = [
+                                bytes(next(it)) if ok else None for ok in valid
+                            ]
+                            arr = pa.array(rows, atype)
+                    elif mask is not None:
+                        # dense non-null cells scatter to row positions
+                        expanded = np.zeros(len(valid), np_vals.dtype)
+                        expanded[valid] = np_vals
+                        arr = pa.array(expanded, mask=mask)
+                    else:
+                        arr = pa.array(np_vals)
+                cols[path[0]] = arr
+            if names is None:
+                names = list(cols)
+            per_group.append(cols)
+        if names is None:
+            names = []
+        if not per_group:
+            return pa.table({})
+        arrays = [
+            pa.chunked_array([g[name] for g in per_group]) for name in names
+        ]
+        return pa.table(dict(zip(names, arrays)))
+
     def iter_row_groups(self, columns=None):
         for i in range(self.num_row_groups):
             yield self.read_row_group(i, columns=columns)

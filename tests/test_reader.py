@@ -495,3 +495,101 @@ class TestVectorAssembly:
             got = [x["ll"] for x in r.iter_rows()]
         assert got == rows
         assert got == pq.read_table(path).column("ll").to_pylist()
+
+
+class TestToArrow:
+    def test_flat_table_with_nulls_matches_pyarrow(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 10_000
+        rng2 = np.random.default_rng(8)
+        t = pa.table({
+            "i": pa.array(
+                [None if k % 7 == 0 else int(v)
+                 for k, v in enumerate(rng2.integers(0, 1 << 40, n))],
+                pa.int64(),
+            ),
+            "f": pa.array(rng2.standard_normal(n)),
+            "s": pa.array([None if k % 11 == 0 else f"u{k % 97}" for k in range(n)]),
+            "b": pa.array([bool(k % 3) for k in range(n)]),
+        })
+        path = str(tmp_path / "ta.parquet")
+        pq.write_table(t, path, row_group_size=3_000, compression="zstd")
+        with FileReader(path) as r:
+            out = r.to_arrow()
+        for c in t.column_names:
+            assert out.column(c).to_pylist() == t.column(c).to_pylist(), c
+        assert out.column("s").type == pa.large_string()
+        assert out.column("i").null_count == t.column("i").null_count
+        # projection + row-group subset
+        with FileReader(path) as r:
+            sub = r.to_arrow(row_groups=[1], columns=["f"])
+        assert sub.column_names == ["f"]
+        assert sub.num_rows == 3_000
+        np.testing.assert_array_equal(
+            np.asarray(sub.column("f")), np.asarray(t.column("f"))[3_000:6_000]
+        )
+
+    def test_fixed_and_binary(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        t = pa.table({
+            "fx": pa.array([bytes([k] * 4) for k in range(200)], pa.binary(4)),
+            "raw": pa.array([bytes([k, k]) for k in range(200)], pa.binary()),
+        })
+        path = str(tmp_path / "fx.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        with FileReader(path) as r:
+            out = r.to_arrow()
+        assert out.column("fx").to_pylist() == t.column("fx").to_pylist()
+        assert out.column("raw").to_pylist() == t.column("raw").to_pylist()
+
+    def test_nested_rejected(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from parquet_tpu.meta import ParquetFileError
+
+        t = pa.table({"l": pa.array([[1]], pa.list_(pa.int32()))})
+        path = str(tmp_path / "nst.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            with pytest.raises(ParquetFileError, match="flat"):
+                r.to_arrow()
+
+    def test_all_null_column(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        t = pa.table({"x": pa.array([None] * 50, pa.float64()),
+                      "s": pa.array([None] * 50, pa.string())})
+        path = str(tmp_path / "an.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            out = r.to_arrow()
+        assert out.column("x").null_count == 50
+        assert out.column("s").to_pylist() == [None] * 50
+
+    def test_nullable_fixed_and_empty_groups(self, tmp_path):
+        """Review regressions: nullable binary(4) scatters dense values to
+        row positions; row_groups=[] keeps the (selected) schema."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        t = pa.table({
+            "fx": pa.array([b"aaaa", None, b"bbbb", None, b"cccc"], pa.binary(4)),
+            "i": pa.array([1, 2, None, 4, 5], pa.int64()),
+        })
+        path = str(tmp_path / "nfx.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        with FileReader(path) as r:
+            out = r.to_arrow()
+            assert out.column("fx").to_pylist() == t.column("fx").to_pylist()
+            empty = r.to_arrow(row_groups=[])
+            assert empty.num_rows == 0
+            assert set(empty.column_names) == {"fx", "i"}
+            assert pa.concat_tables(
+                [out, empty.cast(out.schema)]
+            ).num_rows == 5
