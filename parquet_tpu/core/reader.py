@@ -95,6 +95,20 @@ def _timed_rows(assembler):
         yield row
 
 
+def _scatter_byte_offsets(valid: np.ndarray, offsets) -> np.ndarray:
+    """Dense byte-array offsets (non-null cells only) -> offsets positioned
+    at every slot, int64[len(valid) + 1], null slots zero-length. Shared by
+    the flat and list to_arrow paths."""
+    idx = np.clip(np.cumsum(valid) - 1, 0, None)
+    ends = np.asarray(offsets[1:], dtype=np.int64)
+    picked = ends[idx] if len(ends) else np.zeros(len(valid), dtype=np.int64)
+    out = np.concatenate(
+        [np.zeros(1, dtype=np.int64), np.where(valid, picked, 0)]
+    )
+    np.maximum.accumulate(out, out=out)
+    return out
+
+
 class RaggedColumn(NamedTuple):
     """A LIST column in device-batch form: `values` is row-padded to a
     static [rows, max_list_len] matrix (unused slots zero-filled on device)
@@ -1128,26 +1142,31 @@ class FileReader:
 
         def _flat_leaf(path):
             leaf = self.schema.column(path)
+            if self._is_canonical_list(path, leaf):
+                return leaf  # canonical top-level LIST: handled below
             if leaf.max_rep > 0 or len(path) != 1:
                 raise ParquetFileError(
-                    f"parquet: to_arrow covers flat columns only; "
-                    f"{'.'.join(path)} is nested (project it out or use "
-                    "iter_rows)"
+                    f"parquet: to_arrow covers flat and single-level LIST "
+                    f"columns; {'.'.join(path)} is nested deeper (project "
+                    "it out or use iter_rows)"
                 )
             return leaf
 
         def _arrow_type(leaf):
+            base = None
             if leaf.type == Type.BYTE_ARRAY:
-                return pa.large_string() if leaf.is_string() else pa.large_binary()
-            if leaf.type in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
-                return pa.binary(12 if leaf.type == Type.INT96 else leaf.type_length)
-            return {
-                Type.INT32: pa.int32(),
-                Type.INT64: pa.int64(),
-                Type.FLOAT: pa.float32(),
-                Type.DOUBLE: pa.float64(),
-                Type.BOOLEAN: pa.bool_(),
-            }[leaf.type]
+                base = pa.large_string() if leaf.is_string() else pa.large_binary()
+            elif leaf.type in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+                base = pa.binary(12 if leaf.type == Type.INT96 else leaf.type_length)
+            else:
+                base = {
+                    Type.INT32: pa.int32(),
+                    Type.INT64: pa.int64(),
+                    Type.FLOAT: pa.float32(),
+                    Type.DOUBLE: pa.float64(),
+                    Type.BOOLEAN: pa.bool_(),
+                }[leaf.type]
+            return pa.large_list(base) if leaf.max_rep == 1 else base
 
         indices = list(
             range(self.num_row_groups) if row_groups is None else row_groups
@@ -1158,7 +1177,12 @@ class FileReader:
             sel = self._resolve_columns(columns) if columns else self._selected
             return pa.table(
                 {
-                    leaf.name: pa.array([], type=_arrow_type(_flat_leaf(leaf.path)))
+                    # keyed by the TOP-LEVEL field name: a LIST leaf is
+                    # called "element", and two list columns must not
+                    # collapse into one
+                    leaf.path[0]: pa.array(
+                        [], type=_arrow_type(_flat_leaf(leaf.path))
+                    )
                     for leaf in self.schema.leaves
                     if sel is None or leaf.path in sel
                 }
@@ -1170,6 +1194,9 @@ class FileReader:
             cols = {}
             for path, cd in chunks.items():
                 leaf = _flat_leaf(path)
+                if leaf.max_rep == 1:
+                    cols[path[0]] = self._arrow_list_column(pa, path, leaf, cd)
+                    continue
                 mask = None
                 if cd.def_levels is not None and leaf.max_def > 0:
                     valid = np.asarray(cd.def_levels) == leaf.max_def
@@ -1185,17 +1212,7 @@ class FileReader:
                     if mask is not None:
                         # expand offsets to row positions: null rows repeat
                         # the running offset (zero-length slot)
-                        idx = np.clip(np.cumsum(valid) - 1, 0, None)
-                        ends = offsets[1:]
-                        picked = (
-                            ends[idx]
-                            if len(ends)
-                            else np.zeros(len(valid), dtype=np.int64)
-                        )
-                        offsets = np.concatenate(
-                            [np.zeros(1, dtype=np.int64), np.where(valid, picked, 0)]
-                        )
-                        np.maximum.accumulate(offsets, out=offsets)
+                        offsets = _scatter_byte_offsets(valid, offsets)
                     n = len(offsets) - 1
                     bufs = [
                         None
@@ -1246,6 +1263,103 @@ class FileReader:
             pa.chunked_array([g[name] for g in per_group]) for name in names
         ]
         return pa.table(dict(zip(names, arrays)))
+
+    def _is_canonical_list(self, path, leaf) -> bool:
+        """True for the one list shape _arrow_list_column's level math
+        covers: top group > repeated mid group > element leaf, with no other
+        optional layer (anything else — e.g. an optional group whose child
+        is a bare repeated leaf — has different level semantics and must
+        take the nested-deeper error, not silently corrupt)."""
+        from ..meta.parquet_types import FieldRepetitionType
+
+        if len(path) != 3 or leaf.max_rep != 1:
+            return False
+        top = self.schema.column((path[0],))
+        mid = next((c for c in top.children if c.name == path[1]), None)
+        if mid is None or mid.repetition != FieldRepetitionType.REPEATED:
+            return False
+        t = 1 if top.repetition == FieldRepetitionType.OPTIONAL else 0
+        e = 1 if leaf.repetition == FieldRepetitionType.OPTIONAL else 0
+        return leaf.max_def == t + 1 + e
+
+    def _arrow_list_column(self, pa, path, leaf, cd):
+        """One canonical LIST column chunk -> pyarrow LargeListArray: row
+        lengths and validity from the levels (the same derivation as ragged
+        device batches), element array from the dense non-null cells."""
+        from ..meta.parquet_types import FieldRepetitionType, Type
+        from .arrays import ByteArrayData
+
+        top = self.schema.column((path[0],))
+        t = 1 if top.repetition == FieldRepetitionType.OPTIONAL else 0
+        n = cd.num_values
+        rl = (
+            np.asarray(cd.rep_levels)
+            if cd.rep_levels is not None
+            else np.zeros(n, dtype=np.uint16)
+        )
+        dl = (
+            np.asarray(cd.def_levels)
+            if cd.def_levels is not None
+            else np.full(n, leaf.max_def, dtype=np.uint16)
+        )
+        starts = np.nonzero(rl == 0)[0]
+        slot = dl >= t + 1  # level entries that denote a list ELEMENT
+        elem_valid = (dl == leaf.max_def)[slot]
+        lengths = (
+            np.add.reduceat(slot.astype(np.int64), starts)
+            if len(starts)
+            else np.zeros(0, dtype=np.int64)
+        )
+        row_null = (dl[starts] < t) if t else np.zeros(len(starts), dtype=bool)
+        n_slots = int(slot.sum())
+        values = cd.values
+        if isinstance(values, ByteArrayData):
+            etype = pa.large_string() if leaf.is_string() else pa.large_binary()
+            if elem_valid.all():
+                offs = np.ascontiguousarray(values.offsets, dtype=np.int64)
+                elem = pa.Array.from_buffers(
+                    etype, n_slots,
+                    [None, pa.py_buffer(offs), pa.py_buffer(values.data)],
+                )
+            else:
+                offs = _scatter_byte_offsets(elem_valid, values.offsets)
+                elem = pa.Array.from_buffers(
+                    etype, n_slots,
+                    [
+                        pa.py_buffer(
+                            np.packbits(elem_valid, bitorder="little").tobytes()
+                        ),
+                        pa.py_buffer(offs),
+                        pa.py_buffer(values.data),
+                    ],
+                    null_count=int((~elem_valid).sum()),
+                )
+        else:
+            npv = np.asarray(values)
+            if npv.ndim != 1 or leaf.type in (
+                Type.FIXED_LEN_BYTE_ARRAY, Type.INT96,
+            ):
+                raise ParquetFileError(
+                    f"parquet: to_arrow does not cover fixed-width elements "
+                    f"inside lists ({'.'.join(path)}); use iter_rows"
+                )
+            if elem_valid.all():
+                elem = pa.array(npv)
+            else:
+                expanded = np.zeros(n_slots, dtype=npv.dtype)
+                expanded[elem_valid] = npv
+                elem = pa.array(expanded, mask=~elem_valid)
+        offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=offsets[1:])
+        if row_null.any():
+            # a null offset at i marks list i null; the final offset (the
+            # appended False) must stay valid
+            offsets_pa = pa.array(
+                offsets, pa.int64(), mask=np.append(row_null, False)
+            )
+        else:
+            offsets_pa = pa.array(offsets, pa.int64())
+        return pa.LargeListArray.from_arrays(offsets_pa, elem)
 
     def iter_row_groups(self, columns=None):
         for i in range(self.num_row_groups):

@@ -546,17 +546,25 @@ class TestToArrow:
         assert out.column("fx").to_pylist() == t.column("fx").to_pylist()
         assert out.column("raw").to_pylist() == t.column("raw").to_pylist()
 
-    def test_nested_rejected(self, tmp_path):
+    def test_deep_nesting_rejected(self, tmp_path):
         import pyarrow as pa
         import pyarrow.parquet as pq
 
         from parquet_tpu.meta import ParquetFileError
 
-        t = pa.table({"l": pa.array([[1]], pa.list_(pa.int32()))})
+        # single-level lists are supported; list<list<>> is not
+        t = pa.table({"ll": pa.array([[[1]]], pa.list_(pa.list_(pa.int32())))})
         path = str(tmp_path / "nst.parquet")
         pq.write_table(t, path)
         with FileReader(path) as r:
-            with pytest.raises(ParquetFileError, match="flat"):
+            with pytest.raises(ParquetFileError, match="nested deeper"):
+                r.to_arrow()
+        # struct members are out of scope too
+        t2 = pa.table({"g": pa.array([{"a": 1}], pa.struct([("a", pa.int64())]))})
+        p2 = str(tmp_path / "st.parquet")
+        pq.write_table(t2, p2)
+        with FileReader(p2) as r:
+            with pytest.raises(ParquetFileError, match="nested deeper"):
                 r.to_arrow()
 
     def test_all_null_column(self, tmp_path):
@@ -593,3 +601,81 @@ class TestToArrow:
             assert pa.concat_tables(
                 [out, empty.cast(out.schema)]
             ).num_rows == 5
+
+    def test_list_columns(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        n = 5_000
+        rng2 = np.random.default_rng(6)
+        t = pa.table({
+            "tags": pa.array(
+                [None if i % 13 == 0 else
+                 [None if rng2.random() < 0.1 else int(x)
+                  for x in rng2.integers(0, 99, i % 6)]
+                 for i in range(n)],
+                pa.list_(pa.int32()),
+            ),
+            "names": pa.array(
+                [None if i % 17 == 0 else [f"w{j}" for j in range(i % 4)]
+                 for i in range(n)],
+                pa.list_(pa.string()),
+            ),
+            "id": pa.array(range(n), pa.int64()),
+        })
+        path = str(tmp_path / "lists.parquet")
+        pq.write_table(t, path, row_group_size=1_500, compression="snappy")
+        with FileReader(path) as r:
+            out = r.to_arrow()
+        for c in t.column_names:
+            assert out.column(c).to_pylist() == t.column(c).to_pylist(), c
+        assert out.column("tags").type == pa.large_list(pa.int32())
+        # required-outer lists (our writer) roundtrip too
+        from parquet_tpu import FileWriter, parse_schema
+
+        schema = parse_schema(
+            "message m { required group l (LIST) "
+            "{ repeated group list { required int64 element; } } }"
+        )
+        p2 = str(tmp_path / "req.parquet")
+        with FileWriter(p2, schema) as w:
+            w.write_rows([{"l": [1, 2]}, {"l": []}, {"l": [3]}])
+        with FileReader(p2) as r:
+            got = r.to_arrow().column("l").to_pylist()
+        assert got == [[1, 2], [], [3]]
+
+    def test_noncanonical_repeated_shape_rejected(self, tmp_path):
+        """Review regression: an optional group holding a bare repeated leaf
+        has different level semantics — it must raise, not corrupt."""
+        from parquet_tpu import FileWriter, parse_schema
+        from parquet_tpu.meta import ParquetFileError
+
+        schema = parse_schema(
+            "message m { required group a { optional group b "
+            "{ repeated int32 c; } } }"
+        )
+        path = str(tmp_path / "odd.parquet")
+        with FileWriter(path, schema) as w:
+            w.write_rows([
+                {"a": {"b": {"c": [5, 6]}}},
+                {"a": {"b": {"c": []}}},
+                {"a": {"b": None}},
+            ])
+        with FileReader(path) as r:
+            with pytest.raises(ParquetFileError, match="nested deeper"):
+                r.to_arrow()
+
+    def test_empty_groups_list_schema(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        t = pa.table({
+            "tags": pa.array([[1]], pa.list_(pa.int32())),
+            "names": pa.array([["x"]], pa.list_(pa.string())),
+        })
+        path = str(tmp_path / "els.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            empty = r.to_arrow(row_groups=[])
+            assert empty.column_names == ["tags", "names"]
+            assert empty.column("tags").type == pa.large_list(pa.int32())
