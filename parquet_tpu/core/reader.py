@@ -1129,12 +1129,14 @@ class FileReader:
                 yield from rows
 
     def to_arrow(self, row_groups=None, columns=None):
-        """Decoded columns as a pyarrow.Table (flat leaves only — numerics,
-        booleans, strings/binary, FLBA — with validity from the definition
-        levels; byte-array buffers transfer zero-copy into large_binary/
-        large_string layouts). The reverse of write_column's arrow ingest:
+        """Decoded columns as a pyarrow.Table: flat leaves (numerics,
+        booleans, strings/binary, FLBA) plus single-level LIST columns
+        (-> large_list), with validity from the definition levels;
+        byte-array buffers transfer zero-copy into large_binary/
+        large_string layouts. The reverse of write_column's arrow ingest:
         a pyarrow user can hand columns either way without a rewrite.
-        Nested columns raise — project them out or use iter_rows."""
+        Deeper nesting (structs, list<list>, list-of-struct, fixed-width
+        list elements) raises — project it out or use iter_rows."""
         import pyarrow as pa
 
         from ..meta.parquet_types import Type
@@ -1157,6 +1159,14 @@ class FileReader:
             if leaf.type == Type.BYTE_ARRAY:
                 base = pa.large_string() if leaf.is_string() else pa.large_binary()
             elif leaf.type in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
+                if leaf.max_rep == 1:
+                    # keep the empty-groups schema consistent with the data
+                    # branch, which does not cover fixed-width list elements
+                    raise ParquetFileError(
+                        f"parquet: to_arrow does not cover fixed-width "
+                        f"elements inside lists ({leaf.path_str}); use "
+                        "iter_rows"
+                    )
                 base = pa.binary(12 if leaf.type == Type.INT96 else leaf.type_length)
             else:
                 base = {
@@ -1276,7 +1286,15 @@ class FileReader:
             return False
         top = self.schema.column((path[0],))
         mid = next((c for c in top.children if c.name == path[1]), None)
-        if mid is None or mid.repetition != FieldRepetitionType.REPEATED:
+        if (
+            mid is None
+            or mid.repetition != FieldRepetitionType.REPEATED
+            # exactly ONE element leaf: a legacy list-of-STRUCT repeated
+            # group has several, and collapsing them to one column would
+            # silently drop fields
+            or len(mid.children) != 1
+            or mid.children[0].path != leaf.path
+        ):
             return False
         t = 1 if top.repetition == FieldRepetitionType.OPTIONAL else 0
         e = 1 if leaf.repetition == FieldRepetitionType.OPTIONAL else 0

@@ -679,3 +679,40 @@ class TestToArrow:
             empty = r.to_arrow(row_groups=[])
             assert empty.column_names == ["tags", "names"]
             assert empty.column("tags").type == pa.large_list(pa.int32())
+
+    def test_legacy_list_of_struct_rejected(self, tmp_path):
+        """Review regression: a repeated group with several fields must
+        raise, not collapse its fields into one column."""
+        from parquet_tpu import FileWriter, parse_schema
+        from parquet_tpu.meta import ParquetFileError
+
+        schema = parse_schema(
+            "message m { optional group owner { repeated group contacts "
+            "{ required binary name (UTF8); required int64 phone; } } }"
+        )
+        path = str(tmp_path / "los.parquet")
+        with FileWriter(path, schema) as w:
+            w.write_rows([
+                {"owner": {"contacts": [{"name": "a", "phone": 1},
+                                        {"name": "b", "phone": 2}]}},
+            ])
+        with FileReader(path) as r:
+            with pytest.raises(ParquetFileError, match="nested deeper"):
+                r.to_arrow()
+            with pytest.raises(ParquetFileError, match="nested deeper"):
+                r.to_arrow(row_groups=[])
+
+    def test_fixed_list_elements_rejected_both_branches(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from parquet_tpu.meta import ParquetFileError
+
+        t = pa.table({"fl": pa.array([[b"abcd"]], pa.list_(pa.binary(4)))})
+        path = str(tmp_path / "fl.parquet")
+        pq.write_table(t, path, use_dictionary=False)
+        with FileReader(path) as r:
+            with pytest.raises(ParquetFileError, match="fixed-width"):
+                r.to_arrow()
+            with pytest.raises(ParquetFileError, match="fixed-width"):
+                r.to_arrow(row_groups=[])
