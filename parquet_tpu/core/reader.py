@@ -18,7 +18,7 @@ import gc
 import io
 import os
 import threading
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from functools import partial
 from concurrent.futures import ThreadPoolExecutor
 
@@ -67,6 +67,22 @@ def _host_pool() -> ThreadPoolExecutor | None:
 
 
 _dispatcher: ThreadPoolExecutor | None = None
+
+
+def _with_device(fn, device):
+    """Run `fn` under jax.default_device(device) (plain call when None).
+
+    Device placement must travel WITH the callable onto whatever thread runs
+    it: jax.default_device is thread-local, so a context entered on the
+    caller's thread never reaches the `pqt-dispatch` worker. Every dispatch
+    submission routes through this so an explicit `device=` is honored by
+    every jnp.asarray the plan issues."""
+    if device is None:
+        return fn()
+    import jax
+
+    with jax.default_device(device):
+        return fn()
 
 
 def _dispatch_pool() -> ThreadPoolExecutor:
@@ -234,6 +250,7 @@ class FileReader:
         metadata: FileMetaData | None = None,
         backend: str = "host",
         compact_levels: bool = False,
+        device=None,
     ):
         if isinstance(source, (str, Path)):
             self._f = open(source, "rb")
@@ -261,6 +278,11 @@ class FileReader:
             # (packed_array.go:13-101), ~16x smaller at rest. Consumers widen
             # windows on demand; NumPy comparisons work transparently.
             self.compact_levels = compact_levels
+            # device: an explicit jax.Device every delivered array is pinned
+            # to — including work issued from the internal dispatch thread,
+            # which a caller-side jax.default_device context (thread-local)
+            # can never reach. None = the process default device.
+            self.device = device
             self._selected = self._resolve_columns(columns)
         except BaseException:
             if self._owns_file:
@@ -373,26 +395,45 @@ class FileReader:
                 self._pack_chunk_levels(path, cd)
         return out
 
-    def read_row_group_device(self, i: int, columns=None):
+    def _effective_device(self, device=None):
+        """Precedence rule, in one place: per-call override > reader default
+        > process default (None)."""
+        return device if device is not None else self.device
+
+    def _devctx(self, device=None):
+        """Context manager that pins caller-thread jax work to the effective
+        device."""
+        dev = self._effective_device(device)
+        if dev is None:
+            return nullcontext()
+        import jax
+
+        return jax.default_device(dev)
+
+    def read_row_group_device(self, i: int, columns=None, device=None):
         """Decode one row group straight into device memory (HBM).
 
         The TPU-native delivery point: returns {leaf path: DeviceColumn} whose
         value arrays are jax arrays resident on the accelerator — encoded
         bytes go up, decoded columns never come back down. Works regardless
-        of the reader's configured backend."""
-        return self._read_row_group_device(i, columns, pack=True)
+        of the reader's configured backend. `device` pins this call's arrays
+        to one jax.Device (overriding the reader-level `device=`); unlike a
+        caller-side jax.default_device context it also reaches the internal
+        dispatch thread."""
+        return self._read_row_group_device(i, columns, pack=True, device=device)
 
-    def _read_row_group_device(self, i: int, columns, pack: bool):
+    def _read_row_group_device(self, i: int, columns, pack: bool, device=None):
         """pack=False mirrors _read_row_group: the batch iterator consumes
         levels immediately (mask build), so packing them would be overhead."""
-        plans = self._plan_row_group(i, columns)
-        out = {path: plan.device_column() for path, plan in plans.items()}
+        plans = self._plan_row_group(i, columns, device=device)
+        with self._devctx(device):
+            out = {path: plan.device_column() for path, plan in plans.items()}
         if pack and self.compact_levels:
             for path, dc in out.items():
                 self._pack_chunk_levels(path, dc)
         return out
 
-    def read_row_groups_device(self, row_groups=None, columns=None):
+    def read_row_groups_device(self, row_groups=None, columns=None, device=None):
         """Decode row groups into device memory with full pipelining.
 
         Unlike per-group read_row_group_device calls — which resolve each
@@ -409,20 +450,26 @@ class FileReader:
             # the host path); cross-group pipelining would account all
             # groups' decoded buffers at once and spuriously trip it, so
             # ceiling-capped readers stage one group at a time.
-            return [self.read_row_group_device(i, columns) for i in indices]
-        staged = self._plan_row_groups_async(indices, columns)
-        return [
-            {
-                path: self._pack_chunk_levels(path, fut.result().device_column())
-                for path, fut in group
-            }
-            for group in staged
-        ]
+            return [
+                self.read_row_group_device(i, columns, device=device)
+                for i in indices
+            ]
+        staged = self._plan_row_groups_async(indices, columns, device=device)
+        out = []
+        for group in staged:
+            with self._devctx(device):
+                cols = {
+                    path: fut.result().device_column() for path, fut in group
+                }
+            out.append(
+                {p: self._pack_chunk_levels(p, dc) for p, dc in cols.items()}
+            )
+        return out
 
-    def _plan_row_group_async(self, i: int, columns=None):
+    def _plan_row_group_async(self, i: int, columns=None, device=None):
         """Stage one row group: prepare (pool or inline) + enqueue dispatch.
         Returns [(path, future-of-dispatched-plan)] without resolving."""
-        return self._plan_row_groups_async([i], columns)[0]
+        return self._plan_row_groups_async([i], columns, device=device)[0]
 
     def iter_device_batches(
         self,
@@ -434,6 +481,7 @@ class FileReader:
         filters=None,
         lists: str = "error",
         max_list_len: int | None = None,
+        device=None,
     ):
         """Stream the file as fixed-size device-resident batches.
 
@@ -482,6 +530,12 @@ class FileReader:
         stream whole (batches keep their static shape; rows are NOT
         individually filtered — filter columns may admit non-matching rows,
         exact per-row masking is the consumer's jnp.where).
+
+        `device` pins every batch's arrays to one jax.Device (overriding the
+        reader-level `device=`); unlike a caller-side jax.default_device
+        context it also reaches the internal dispatch thread. Mutually
+        useful with `sharding`: decode lands on `device`, device_put lays
+        each batch out over the mesh.
         """
         if batch_size <= 0:
             raise ValueError("batch_size must be positive")
@@ -511,13 +565,13 @@ class FileReader:
             normalized = normalize_dnf(self.schema, filters)
         return self._iter_device_batches(
             batch_size, columns, drop_remainder, sharding, nullable,
-            normalized, lists, max_list_len,
+            normalized, lists, max_list_len, device,
         )
 
     def _iter_device_batches(
         self, batch_size: int, columns, drop_remainder: bool, sharding=None,
         nullable: str = "error", normalized=None, lists: str = "error",
-        max_list_len=None,
+        max_list_len=None, device=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -615,56 +669,66 @@ class FileReader:
 
         def stage(i):
             if lookahead:
-                return self._plan_row_group_async(i, columns)
+                return self._plan_row_group_async(i, columns, device=device)
             return None
 
         staged_next = stage(groups[0]) if groups and lookahead else None
         carry: dict = {}
         carry_n = 0
         for gi, i in enumerate(groups):
-            if lookahead:
-                staged = staged_next
-                staged_next = (
-                    stage(groups[gi + 1]) if gi + 1 < len(groups) else None
-                )
-                # no level packing here: _array_of consumes the levels (mask
-                # build) within this iteration, so they never rest
-                group = {path: fut.result().device_column() for path, fut in staged}
-            else:
-                group = self._read_row_group_device(i, columns, pack=False)
-            arrs = {path: _array_of(path, dc) for path, dc in group.items()}
-            if not arrs:
-                continue
-            lengths = {a.shape[0] for a in jax.tree_util.tree_leaves(arrs)}
-            if len(lengths) != 1:
-                raise ParquetFileError(
-                    f"parquet: columns disagree on row count in group {i}: "
-                    f"{sorted(lengths)}"
-                )
-            n = lengths.pop()
-            if carry_n:
-                cat = jax.tree_util.tree_map(
-                    lambda c, a: jnp.concatenate([c, a]), carry, arrs
-                )
-            else:
-                cat = arrs
+            # device work scoped so the pin never leaks across a yield into
+            # the consumer's frame (jax.default_device is thread-local and
+            # the consumer runs on this thread between batches)
+            with self._devctx(device):
+                if lookahead:
+                    staged = staged_next
+                    staged_next = (
+                        stage(groups[gi + 1]) if gi + 1 < len(groups) else None
+                    )
+                    # no level packing here: _array_of consumes the levels
+                    # (mask build) within this iteration, so they never rest
+                    group = {
+                        path: fut.result().device_column() for path, fut in staged
+                    }
+                else:
+                    group = self._read_row_group_device(
+                        i, columns, pack=False, device=device
+                    )
+                arrs = {path: _array_of(path, dc) for path, dc in group.items()}
+                if not arrs:
+                    continue
+                lengths = {a.shape[0] for a in jax.tree_util.tree_leaves(arrs)}
+                if len(lengths) != 1:
+                    raise ParquetFileError(
+                        f"parquet: columns disagree on row count in group {i}: "
+                        f"{sorted(lengths)}"
+                    )
+                n = lengths.pop()
+                if carry_n:
+                    cat = jax.tree_util.tree_map(
+                        lambda c, a: jnp.concatenate([c, a]), carry, arrs
+                    )
+                else:
+                    cat = arrs
             total = carry_n + n
             # cursor slicing: each batch is one static-shape slice; the tail
             # is sliced once per row group, not once per batch
             off = 0
             while total - off >= batch_size:
                 lo = off
-                batch = jax.tree_util.tree_map(
-                    lambda a, lo=lo: a[lo : lo + batch_size], cat
-                )
-                if sharding is not None:
-                    batch = jax.device_put(batch, sharding)
+                with self._devctx(device):
+                    batch = jax.tree_util.tree_map(
+                        lambda a, lo=lo: a[lo : lo + batch_size], cat
+                    )
+                    if sharding is not None:
+                        batch = jax.device_put(batch, sharding)
                 yield batch
                 off += batch_size
             carry_n = total - off
-            carry = (
-                jax.tree_util.tree_map(lambda a: a[off:], cat) if carry_n else {}
-            )
+            with self._devctx(device):
+                carry = (
+                    jax.tree_util.tree_map(lambda a: a[off:], cat) if carry_n else {}
+                )
         if carry_n and not drop_remainder:
             if sharding is not None:
                 try:
@@ -676,7 +740,7 @@ class FileReader:
                     pass
             yield carry
 
-    def _plan_row_groups_async(self, indices, columns=None):
+    def _plan_row_groups_async(self, indices, columns=None, device=None):
         """Stage chunks of several row groups at once.
 
         Every chunk's prepare is submitted to the worker pool up front (no
@@ -697,6 +761,7 @@ class FileReader:
                 win, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
             )
 
+        dev = self._effective_device(device)
         dispatcher = _dispatch_pool()
         pool = _host_pool()
         staged = []
@@ -707,7 +772,9 @@ class FileReader:
                 out = []
                 for path, cc, column in chunks:
                     plan = prep(cc, column)
-                    out.append((path, dispatcher.submit(plan.dispatch_device)))
+                    out.append(
+                        (path, dispatcher.submit(_with_device, plan.dispatch_device, dev))
+                    )
                 staged.append(out)
             return staged
         get_native()  # thread-safe lazy init before fan-out
@@ -719,11 +786,13 @@ class FileReader:
             out = []
             for path, fut in group:
                 plan = fut.result()
-                out.append((path, dispatcher.submit(plan.dispatch_device)))
+                out.append(
+                    (path, dispatcher.submit(_with_device, plan.dispatch_device, dev))
+                )
             staged.append(out)
         return staged
 
-    def _plan_row_group(self, i: int, columns=None):
+    def _plan_row_group(self, i: int, columns=None, device=None):
         """Plan every selected chunk of a row group for device decode.
 
         The host-only prepare phase (one pread per chunk, page walk,
@@ -733,7 +802,8 @@ class FileReader:
         overlapped with the next chunk's prepare.
         """
         return {
-            path: fut.result() for path, fut in self._plan_row_group_async(i, columns)
+            path: fut.result()
+            for path, fut in self._plan_row_group_async(i, columns, device=device)
         }
 
     def _pread(self, offset: int, size: int) -> bytes:

@@ -60,8 +60,10 @@ def scan_row_groups(reader, devices, map_fn, reduce_fn, columns=None, indices=No
         # round-robin by LOCAL position: global indices striped across hosts
         # must still spread over every local device
         dev = devices[k % len(devices)]
+        # device= (not a bare jax.default_device context) so the placement
+        # reaches the reader's internal dispatch thread too
+        cols = reader.read_row_group_device(i, columns=columns, device=dev)
         with jax.default_device(dev):
-            cols = reader.read_row_group_device(i, columns=columns)
             shard_results.append(map_fn(cols))
     if not shard_results:
         return None

@@ -1,0 +1,172 @@
+"""Explicit device placement (`device=`) must reach the dispatch thread.
+
+Round-3 regression (MULTICHIP_r03 rc=1): CPU pinning via a caller-side
+`jax.default_device(...)` context is THREAD-LOCAL, so uploads issued from the
+reader's single `pqt-dispatch` worker landed on the process-default platform
+instead. These tests reproduce that exact shape deterministically on the
+virtual CPU mesh: the process default is one device, the reader is pinned to
+a DIFFERENT one, and every delivered array must land on the pinned device —
+which only happens if the placement travels with the work onto the dispatch
+thread (core/reader.py:_with_device).
+"""
+
+import contextlib
+
+import jax
+import numpy as np
+import pytest
+
+from parquet_tpu.core.reader import FileReader, MaskedColumn
+from parquet_tpu.core.writer import FileWriter
+from parquet_tpu.parallel.scan import scan_row_groups
+from parquet_tpu.schema.dsl import parse_schema
+
+
+@contextlib.contextmanager
+def process_default_device(dev):
+    """Set the process-GLOBAL default device (what the dispatch thread sees
+    when no placement travels with the work)."""
+    prev = jax.config.jax_default_device
+    jax.config.update("jax_default_device", dev)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_default_device", prev)
+
+
+@pytest.fixture(scope="module")
+def mixed_file(tmp_path_factory):
+    """One file that exercises every dispatch path: dict-encoded ints,
+    delta-packed ints, plain floats, dict byte arrays, a nullable column."""
+    path = tmp_path_factory.mktemp("devpin") / "mixed.parquet"
+    n = 4_000
+    rng = np.random.default_rng(3)
+    schema = parse_schema(
+        "message m { required int64 a; required int64 ts; required double x;"
+        " required binary s (STRING); optional int64 opt; }"
+    )
+    with FileWriter(
+        str(path),
+        schema,
+        codec="snappy",
+        row_group_size=1_024,  # bytes: forces a flush at every 1000-row check -> 4 groups
+        column_encodings={"ts": "DELTA_BINARY_PACKED"},
+    ) as w:
+        rows = [
+            {
+                "a": int(rng.integers(0, 40)),
+                "ts": 100 + i,
+                "x": float(i) * 0.5,
+                "s": b"v%d" % (i % 25),
+                "opt": None if i % 7 == 0 else i,
+            }
+            for i in range(n)
+        ]
+        for lo in range(0, n, 1_000):  # four explicit row groups
+            w.write_rows(rows[lo : lo + 1_000])
+            w.flush_row_group()
+    return str(path)
+
+
+def _leaf_arrays(dc):
+    for name in ("values", "indices", "data", "offsets", "dict_data", "dict_offsets"):
+        arr = getattr(dc, name, None)
+        if arr is not None and hasattr(arr, "devices"):
+            yield name, arr
+
+
+def test_reader_device_overrides_process_default(mixed_file):
+    cpus = jax.devices("cpu")
+    assert len(cpus) >= 4
+    with process_default_device(cpus[1]):
+        with FileReader(mixed_file, backend="tpu", device=cpus[3]) as r:
+            groups = r.read_row_groups_device()
+    assert groups
+    seen = 0
+    for g in groups:
+        for path, dc in g.items():
+            for name, arr in _leaf_arrays(dc):
+                assert arr.devices() == {cpus[3]}, (path, name, arr.devices())
+                seen += 1
+    assert seen > 0
+
+
+def test_per_call_device_overrides_reader_default(mixed_file):
+    cpus = jax.devices("cpu")
+    with process_default_device(cpus[1]):
+        with FileReader(mixed_file, backend="tpu", device=cpus[2]) as r:
+            g = r.read_row_group_device(0, device=cpus[5])
+            for dc in g.values():
+                for _, arr in _leaf_arrays(dc):
+                    assert arr.devices() == {cpus[5]}
+            # and without the override, the reader default applies
+            g2 = r.read_row_group_device(1)
+            for dc in g2.values():
+                for _, arr in _leaf_arrays(dc):
+                    assert arr.devices() == {cpus[2]}
+
+
+def test_iter_device_batches_honors_device(mixed_file):
+    cpus = jax.devices("cpu")
+    with process_default_device(cpus[1]):
+        with FileReader(mixed_file, backend="tpu", columns=["a", "ts", "opt"]) as r:
+            total = 0
+            for batch in r.iter_device_batches(
+                512, nullable="mask", device=cpus[4], drop_remainder=False
+            ):
+                for col in batch.values():
+                    arrs = (
+                        [col.values, col.mask]
+                        if isinstance(col, MaskedColumn)
+                        else [col]
+                    )
+                    for arr in arrs:
+                        assert arr.devices() == {cpus[4]}
+                total += next(iter(batch.values())).shape[0] if not isinstance(
+                    next(iter(batch.values())), MaskedColumn
+                ) else next(iter(batch.values())).values.shape[0]
+            assert total == 4_000
+        # the device pin must not leak into the consumer's frame after
+        # iteration: thread-local default is unchanged
+        probe = jax.numpy.zeros(1)
+        assert probe.devices() == {cpus[1]}
+
+
+def test_scan_round_robin_places_shards(mixed_file):
+    cpus = jax.devices("cpu")
+    placed = []
+    with process_default_device(cpus[1]):
+        with FileReader(mixed_file, backend="tpu") as r:
+            out = scan_row_groups(
+                r,
+                [cpus[2], cpus[6]],
+                map_fn=lambda cols: (
+                    placed.append(
+                        next(iter(cols[("a",)].values.devices()))
+                    )
+                    or cols[("a",)].values.sum()
+                ),
+                reduce_fn=lambda x, y: x + y,
+            )
+    assert int(out) >= 0
+    # groups alternate devices 2,6,2,6: the per-shard decode landed where
+    # the round-robin said, not on the process default
+    assert placed == [cpus[2], cpus[6], cpus[2], cpus[6]]
+
+
+def test_leak_shape_regression(mixed_file):
+    """The round-3 failure shape: pinning ONLY via a caller-thread context
+    must be insufficient (documents why device= exists) — dispatch-thread
+    uploads follow the process default, not the caller's thread-local."""
+    cpus = jax.devices("cpu")
+    with process_default_device(cpus[1]):
+        with jax.default_device(cpus[3]):  # thread-local only
+            with FileReader(mixed_file, backend="tpu") as r:
+                g = r.read_row_group_device(0)
+    landed = {
+        next(iter(arr.devices()))
+        for dc in g.values()
+        for _, arr in _leaf_arrays(dc)
+    }
+    # at least one dispatch-thread upload escaped the caller's context
+    assert cpus[1] in landed

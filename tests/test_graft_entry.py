@@ -43,7 +43,20 @@ def test_dryrun_multichip_hermetic_subprocess():
         [
             sys.executable,
             "-c",
-            "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)",
+            # hermeticity proof: the whole run must not have INITIALIZED any
+            # non-CPU backend — a broken accelerator plugin (the round-1 and
+            # round-3 driver failures) then cannot poison the run even in
+            # principle, on any thread. Probe the initialized-backend set via
+            # the internal registry when present (exact), falling back to the
+            # public device list on jax versions that moved it.
+            "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)\n"
+            "try:\n"
+            "    import jax._src.xla_bridge as xb\n"
+            "    inited = set(xb._backends)\n"
+            "except Exception:\n"
+            "    import jax\n"
+            "    inited = {d.platform for d in jax.devices()}\n"
+            "assert inited == {'cpu'}, sorted(inited)",
         ],
         cwd=REPO,
         env=env,
