@@ -31,6 +31,64 @@ size_t ptq_snappy_max_compressed_length(size_t n) {
   return 32 + n + n / 6;
 }
 
+// Tag-dispatch table for the fast decode loop: one lookup replaces the
+// per-kind branch ladder. entry = (extra_trailer_bytes << 11) |
+// (offset_high_bits << 8) | base_copy_length. Literal tags (kind 0) are
+// dispatched before the table is consulted.
+static uint16_t g_snappy_tag[256];
+static const bool g_snappy_tag_init = [] {
+  for (int c = 0; c < 256; c++) {
+    uint16_t e = 0;
+    switch (c & 3) {
+      case 1:  // copy, 1-byte offset trailer, 3 offset bits in the tag
+        e = static_cast<uint16_t>((1u << 11) | ((static_cast<uint32_t>(c) >> 5) << 8) |
+                                  (((static_cast<uint32_t>(c) >> 2) & 7) + 4));
+        break;
+      case 2:  // copy, 2-byte little-endian offset
+        e = static_cast<uint16_t>((2u << 11) | ((static_cast<uint32_t>(c) >> 2) + 1));
+        break;
+      case 3:  // copy, 4-byte little-endian offset
+        e = static_cast<uint16_t>((4u << 11) | ((static_cast<uint32_t>(c) >> 2) + 1));
+        break;
+    }
+    g_snappy_tag[c] = e;
+  }
+  return true;
+}();
+static const uint32_t g_snappy_wordmask[5] = {0, 0xffu, 0xffffu, 0xffffffu,
+                                              0xffffffffu};
+
+// Overshooting match copy: writes in 8/16-byte blocks, spilling at most 15
+// bytes past out+length into the caller-guaranteed slack. Correct for every
+// offset >= 1 (short periods are strided by the first period multiple >= 8).
+static inline void snappy_copy_fast(char* op, const char* from, uint32_t length,
+                                    uint32_t offset) {
+  if (offset >= 8 && length <= 8) {
+    std::memcpy(op, from, 8);
+  } else if (offset >= 8 && length <= 16) {
+    // the dominant op on structured numeric data (e.g. a 7-byte match at
+    // offset 8 per int64): two fixed 8-byte moves, no loop, no call.
+    // Reading from+8 may touch bytes the first move just wrote — for
+    // offset 8..15 those bytes repeat the pattern, which is exactly what
+    // the match semantics require.
+    std::memcpy(op, from, 8);
+    std::memcpy(op + 8, from + 8, 8);
+  } else if (offset >= 16) {
+    for (uint32_t i = 0; i < length; i += 16) std::memcpy(op + i, from + i, 16);
+  } else if (offset >= 8) {
+    for (uint32_t i = 0; i < length; i += 8) std::memcpy(op + i, from + i, 8);
+  } else {
+    // short period: byte-copy one full period multiple >= 8 (<= 14 bytes),
+    // then stride by that multiple — still the same pattern, but each
+    // 8-byte block is non-overlapping
+    uint32_t off2 = offset;
+    while (off2 < 8) off2 += offset;
+    uint32_t head = off2 < length ? off2 : length;
+    for (uint32_t i = 0; i < head; i++) op[i] = from[i];
+    for (uint32_t i = head; i < length; i += 8) std::memcpy(op + i, op + i - off2, 8);
+  }
+}
+
 ssize_t ptq_snappy_decompress(const char* src_c, size_t src_len,
                               char* dst, size_t dst_cap) {
   const uint8_t* src = reinterpret_cast<const uint8_t*>(src_c);
@@ -46,6 +104,14 @@ ssize_t ptq_snappy_decompress(const char* src_c, size_t src_len,
     shift += 7;
   }
   if (expect > dst_cap) return -1;
+  // Fast mode: a destination with >= 64 bytes of physical slack past `expect`
+  // (chunk_prepare's scratch/values buffers are allocated that way) lets
+  // copies run in overshooting 8/16-byte blocks and lets the tag trailer be
+  // read as one unconditional 4-byte load — the decode stays LOGICALLY
+  // bounded by `expect`, only the access granularity spills into the slack.
+  // Exactly-sized destinations (the public codec entry point) take the
+  // byte-exact careful loop below.
+  const bool fast = dst_cap >= expect + 64;
   size_t out = 0;
   while (pos < src_len) {
     uint8_t tag = src[pos++];
@@ -61,7 +127,11 @@ ssize_t ptq_snappy_decompress(const char* src_c, size_t src_len,
       }
       uint64_t n = static_cast<uint64_t>(len) + 1;
       if (pos + n > src_len || out + n > expect) return -1;
-      std::memcpy(dst + out, src + pos, n);
+      if (fast && n <= 8 && pos + 8 <= src_len) {
+        std::memcpy(dst + out, src + pos, 8);
+      } else {
+        std::memcpy(dst + out, src + pos, n);
+      }
       out += n;
       pos += n;
     } else {
@@ -86,7 +156,9 @@ ssize_t ptq_snappy_decompress(const char* src_c, size_t src_len,
       if (offset == 0 || offset > out || out + length > expect) return -1;
       const char* from = dst + out - offset;
       char* op = dst + out;
-      if (offset >= 8) {
+      if (fast) {
+        snappy_copy_fast(op, from, length, offset);
+      } else if (offset >= 8) {
         // Non-overlapping at 8-byte granularity for the body (~2x on
         // match-heavy pages vs the byte loop); the sub-8 tail is copied
         // byte-wise so no write ever lands past `expect` — an exactly-sized
@@ -1782,29 +1854,41 @@ ssize_t ptq_delta_encode(const void* vals, int64_t n, int nbits,
   if (n <= 1) return static_cast<ssize_t>(pos);
 
   const int64_t n_deltas = n - 1;
+  // per-block delta cache: one subtraction per element instead of re-reading
+  // both neighbors in every one of the three scans below (min, width, pack)
+  uint64_t dstack[4096];
+  uint64_t* dheap = nullptr;
+  uint64_t* dbuf = dstack;
+  if (block_size > 4096) {
+    dheap = static_cast<uint64_t*>(malloc(static_cast<size_t>(block_size) * 8));
+    if (!dheap) return -2;
+    dbuf = dheap;
+  }
   for (int64_t bs = 0; bs < n_deltas; bs += block_size) {
     int64_t blen = n_deltas - bs < block_size ? n_deltas - bs : block_size;
-    // signed min of the wrapping deltas
-    int64_t min_s;
+    // one pass: deltas into the cache + signed min of the wrapping deltas
+    int64_t min_s = 0;
     uint64_t dmin_u = 0;
     {
       bool have = false;
-      min_s = 0;
+      uint64_t prev = get(bs);
       for (int64_t k = 0; k < blen; k++) {
-        uint64_t d = (get(bs + k + 1) - get(bs + k)) & mask;
+        uint64_t cur = get(bs + k + 1);
+        uint64_t d = (cur - prev) & mask;
+        prev = cur;
+        dbuf[k] = d;
         int64_t s = static_cast<int64_t>(d);
         if (nbits < 64 && d >= (1ull << (nbits - 1)))
           s = static_cast<int64_t>(d) - (1ll << nbits);
         if (!have || s < min_s) { have = true; min_s = s; dmin_u = d; }
       }
     }
-    if (!put_zigzag(out, out_cap, &pos, min_s)) return -2;
+    if (!put_zigzag(out, out_cap, &pos, min_s)) { free(dheap); return -2; }
     // per-miniblock widths, then payloads
     uint8_t widths[512];
     size_t wpos = pos;
-    if (pos + static_cast<size_t>(mini_count) > out_cap) return -2;
+    if (pos + static_cast<size_t>(mini_count) > out_cap) { free(dheap); return -2; }
     pos += static_cast<size_t>(mini_count);
-    size_t payload_start = pos;
     for (int64_t m = 0; m < mini_count; m++) {
       int64_t mstart = m * mini_len;
       int64_t mlen = blen - mstart;
@@ -1812,8 +1896,7 @@ ssize_t ptq_delta_encode(const void* vals, int64_t n, int nbits,
       if (mlen > mini_len) mlen = mini_len;
       uint64_t mx = 0;
       for (int64_t k = 0; k < mlen; k++) {
-        uint64_t adj = ((get(bs + mstart + k + 1) - get(bs + mstart + k)) -
-                        dmin_u) & mask;
+        uint64_t adj = (dbuf[mstart + k] - dmin_u) & mask;
         if (adj > mx) mx = adj;
       }
       int w = 0;
@@ -1824,17 +1907,15 @@ ssize_t ptq_delta_encode(const void* vals, int64_t n, int nbits,
       bw_init(&bw, out, out_cap, pos);
       for (int64_t k = 0; k < mini_len; k++) {
         uint64_t adj = 0;
-        if (k < mlen)
-          adj = ((get(bs + mstart + k + 1) - get(bs + mstart + k)) - dmin_u) &
-                mask;
-        if (!bw_push(&bw, adj, w)) return -2;
+        if (k < mlen) adj = (dbuf[mstart + k] - dmin_u) & mask;
+        if (!bw_push(&bw, adj, w)) { free(dheap); return -2; }
       }
-      if (!bw_flush(&bw)) return -2;
+      if (!bw_flush(&bw)) { free(dheap); return -2; }
       pos = bw.pos;
     }
-    (void)payload_start;
     for (int64_t m = 0; m < mini_count; m++) out[wpos + m] = widths[m];
   }
+  free(dheap);
   return static_cast<ssize_t>(pos);
 }
 

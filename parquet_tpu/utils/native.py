@@ -35,7 +35,10 @@ def _ptr(data):
 
 class NativeLib:
     def __init__(self, lib: ctypes.CDLL):
+        import threading
+
         self._lib = lib
+        self._chunk_tl = threading.local()  # per-thread chunk_prepare scratch
         self.has_snappy = hasattr(lib, "ptq_snappy_compress")
         if self.has_snappy:
             lib.ptq_snappy_max_compressed_length.restype = ctypes.c_size_t
@@ -283,11 +286,15 @@ class NativeLib:
         import numpy as np
 
         addr, n_in, _keep = _ptr(data)
-        out = np.empty(max(uncompressed_size, 1), dtype=np.uint8)
+        # 64 bytes of slack past the logical size switches the decoder into
+        # its overshooting-wide-copy fast mode; the view below hides it
+        out = np.empty(max(uncompressed_size, 1) + 64, dtype=np.uint8)
         n = self._lib.ptq_snappy_decompress(
-            addr, n_in, ctypes.c_void_p(out.ctypes.data), uncompressed_size
+            addr, n_in, ctypes.c_void_p(out.ctypes.data), uncompressed_size + 64
         )
-        if n < 0:
+        # n > uncompressed_size: the stream's own length claim exceeded the
+        # page header's — corrupt (the pre-slack cap check used to catch it)
+        if n < 0 or n > uncompressed_size:
             raise ValueError("native snappy: corrupt input")
         return memoryview(out)[:n]
 
@@ -530,8 +537,22 @@ class NativeLib:
         rep_out = np.empty(lv, dtype=np.uint16) if max_rep > 0 else np.empty(0, np.uint16)
         values_out = np.empty(cap, dtype=np.uint8)
         packed_out = np.empty(cap, dtype=np.uint8)
-        delta_out = np.empty(cap, dtype=np.uint8) if delta_nbits else np.empty(0, np.uint8)
-        scratch = np.empty(cap, dtype=np.uint8)
+        # delta_out slack covers the worst-case PLAIN->delta repack (a page
+        # that sampled compressible but encodes at full width: raw size +
+        # ~0.5% framing) so the C walk never has to back out mid-chunk
+        delta_out = (
+            np.empty(cap + cap // 64 + 4096, dtype=np.uint8)
+            if delta_nbits
+            else np.empty(0, np.uint8)
+        )
+        # The decompress scratch never escapes the C call, so it is the one
+        # big buffer that can be POOLED per thread: a fresh np.empty faults
+        # in every written page on first touch (~1 ms per decompressed MB on
+        # this class of host), which a reused buffer pays only once.
+        tl = self._chunk_tl
+        scratch = getattr(tl, "scratch", None)
+        if scratch is None or len(scratch) < cap:
+            scratch = tl.scratch = np.empty(cap, dtype=np.uint8)
         totals = np.zeros(8, dtype=np.int64)
         p = ctypes.c_void_p
         while True:
