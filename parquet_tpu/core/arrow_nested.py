@@ -28,7 +28,7 @@ import numpy as np
 
 from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
 
-__all__ = ["build_top_field", "nested_arrow_type"]
+__all__ = ["build_top_field", "nested_arrow_type", "_leaf_arrow_type"]
 
 
 class _LeafState:
@@ -93,8 +93,12 @@ def _leaf_arrow_type(pa, leaf):
     }[leaf.type]
 
 
-def nested_arrow_type(pa, node):
-    """The Arrow type this builder produces for a schema node."""
+def nested_arrow_type(pa, node, selected=None):
+    """The Arrow type this builder produces for a schema node.
+
+    ``selected`` (a set of leaf paths, or None for all) prunes struct
+    members whose leaves are projected out — mirroring _build_struct's
+    data-side skip, so a projected read and its zero-row schema agree."""
     if node.is_leaf:
         base = _leaf_arrow_type(pa, node)
         if node.repetition == FieldRepetitionType.REPEATED:
@@ -102,33 +106,45 @@ def nested_arrow_type(pa, node):
         return base
     if _is_map_annotated(node):
         kv = node.children[0]
+        if not all(_selects(selected, c) for c in kv.children):
+            # key or value projected out: no Arrow MAP without both —
+            # degrade to the underlying list-of-struct shape (pruned)
+            return pa.large_list(_struct_type(pa, kv, selected))
         return pa.map_(
-            nested_arrow_type(pa, kv.children[0]),
-            nested_arrow_type(pa, kv.children[1]),
+            nested_arrow_type(pa, kv.children[0], selected),
+            nested_arrow_type(pa, kv.children[1], selected),
         )
     if _is_list_annotated(node):
         rep = node.children[0]
         if len(rep.children) == 1:
             elem = rep.children[0]
-            return pa.large_list(nested_arrow_type(pa, elem))
+            return pa.large_list(nested_arrow_type(pa, elem, selected))
         # canonical list whose repeated group holds several fields:
         # list of structs
-        return pa.large_list(_struct_type(pa, rep))
+        return pa.large_list(_struct_type(pa, rep, selected))
     if node.repetition == FieldRepetitionType.REPEATED:
         # legacy repeated group: list of structs, elements non-null
-        return pa.large_list(_struct_type(pa, node))
-    return _struct_type(pa, node)
+        return pa.large_list(_struct_type(pa, node, selected))
+    return _struct_type(pa, node, selected)
 
 
-def _struct_type(pa, node):
+def _selects(selected, node) -> bool:
+    if selected is None:
+        return True
+    k = len(node.path)
+    return any(p[:k] == node.path for p in selected)
+
+
+def _struct_type(pa, node, selected=None):
     return pa.struct(
         [
             pa.field(
                 c.name,
-                nested_arrow_type(pa, c),
+                nested_arrow_type(pa, c, selected),
                 nullable=c.repetition != FieldRepetitionType.REQUIRED,
             )
             for c in node.children
+            if _selects(selected, c)
         ]
     )
 
@@ -192,15 +208,27 @@ def _build(pa, node, leaves, state, n_slots, parent_def):
         if node.repetition == FieldRepetitionType.OPTIONAL:
             valid = _first_entry_levels(leaves, state) >= node.max_def
         offsets, elem_state, n_elems = _list_expand(kv, leaves, state, n_slots)
+        have = [
+            c
+            for c in kv.children
+            if any(p[: len(c.path)] == c.path for p in elem_state)
+        ]
+        if len(have) < 2:
+            # key or value projected out: no Arrow MAP without both —
+            # assemble the underlying list-of-struct over what's selected
+            values = _build_struct(
+                pa, kv, leaves, elem_state, n_elems, kv.max_def, force_valid=True
+            )
+            return _list_with_validity(pa, offsets, values, valid)
         key_node, val_node = kv.children
         keys = _build_child(pa, key_node, leaves, elem_state, n_elems, kv.max_def)
         items = _build_child(pa, val_node, leaves, elem_state, n_elems, kv.max_def)
         off32 = offsets.astype(np.int32)
         if valid is not None and not valid.all():
+            # a null offset at i marks map i null; the final offset (the
+            # appended False) must stay valid
             moff = pa.array(
-                np.concatenate([[0], off32[1:]]).astype(np.int32),
-                mask=np.concatenate([[False], ~valid]),
-                type=pa.int32(),
+                off32, mask=np.append(~valid, False), type=pa.int32()
             )
             return pa.MapArray.from_arrays(moff, keys, items)
         return pa.MapArray.from_arrays(pa.array(off32, type=pa.int32()), keys, items)
@@ -302,15 +330,16 @@ def _list_expand(rep_node, leaves, state, n_slots):
 
 def _list_with_validity(pa, offsets, values, valid):
     if valid is not None and not valid.all():
-        arr = pa.LargeListArray.from_arrays(
+        # a null offset at i marks list i null; the final offset (the
+        # appended False) must stay valid
+        return pa.LargeListArray.from_arrays(
             pa.array(
                 offsets.astype(np.int64),
-                mask=np.concatenate([[False], ~valid]),
+                mask=np.append(~valid, False),
                 type=pa.int64(),
             ),
             values,
         )
-        return arr
     return pa.LargeListArray.from_arrays(offsets, values)
 
 

@@ -1199,53 +1199,38 @@ class FileReader:
                 yield from rows
 
     def to_arrow(self, row_groups=None, columns=None):
-        """Decoded columns as a pyarrow.Table: flat leaves (numerics,
-        booleans, strings/binary, FLBA) plus single-level LIST columns
-        (-> large_list), with validity from the definition levels;
-        byte-array buffers transfer zero-copy into large_binary/
-        large_string layouts. The reverse of write_column's arrow ingest:
-        a pyarrow user can hand columns either way without a rewrite.
-        Deeper nesting (structs, list<list>, list-of-struct, fixed-width
-        list elements) raises — project it out or use iter_rows."""
+        """Decoded columns as a pyarrow.Table. Flat leaves (numerics,
+        booleans, strings/binary, FLBA) and canonical single-level LIST
+        columns take zero-copy fast paths; every deeper shape — structs,
+        MAPs, multi-level lists, list-of-struct, struct-of-list, legacy
+        repeated groups/leaves — assembles through the vectorized
+        Dremel-levels builder (core/arrow_nested.py), matching the
+        reference's full nested read surface (reference schema.go:216-312,
+        floor/reader.go:302-409). The reverse of write_column's arrow
+        ingest: a pyarrow user can hand columns either way without a
+        rewrite."""
         import pyarrow as pa
 
         from ..meta.parquet_types import Type
+        from .arrow_nested import _leaf_arrow_type, build_top_field, nested_arrow_type
         from .arrays import ByteArrayData
 
-        def _flat_leaf(path):
+        def _fast_kind(paths):
+            """'flat' | 'list' | 'nested' for one top-level field's leaves."""
+            if len(paths) != 1:
+                return "nested"
+            path = paths[0]
             leaf = self.schema.column(path)
-            if self._is_canonical_list(path, leaf):
-                return leaf  # canonical top-level LIST: handled below
-            if leaf.max_rep > 0 or len(path) != 1:
-                raise ParquetFileError(
-                    f"parquet: to_arrow covers flat and single-level LIST "
-                    f"columns; {'.'.join(path)} is nested deeper (project "
-                    "it out or use iter_rows)"
-                )
-            return leaf
+            if leaf.max_rep == 0 and len(path) == 1:
+                return "flat"
+            if self._is_canonical_list(path, leaf) and leaf.type not in (
+                Type.FIXED_LEN_BYTE_ARRAY, Type.INT96,
+            ):
+                return "list"
+            return "nested"
 
         def _arrow_type(leaf):
-            base = None
-            if leaf.type == Type.BYTE_ARRAY:
-                base = pa.large_string() if leaf.is_string() else pa.large_binary()
-            elif leaf.type in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
-                if leaf.max_rep == 1:
-                    # keep the empty-groups schema consistent with the data
-                    # branch, which does not cover fixed-width list elements
-                    raise ParquetFileError(
-                        f"parquet: to_arrow does not cover fixed-width "
-                        f"elements inside lists ({leaf.path_str}); use "
-                        "iter_rows"
-                    )
-                base = pa.binary(12 if leaf.type == Type.INT96 else leaf.type_length)
-            else:
-                base = {
-                    Type.INT32: pa.int32(),
-                    Type.INT64: pa.int64(),
-                    Type.FLOAT: pa.float32(),
-                    Type.DOUBLE: pa.float64(),
-                    Type.BOOLEAN: pa.bool_(),
-                }[leaf.type]
+            base = _leaf_arrow_type(pa, leaf)
             return pa.large_list(base) if leaf.max_rep == 1 else base
 
         indices = list(
@@ -1255,27 +1240,39 @@ class FileReader:
             # zero groups selected: a zero-ROW table with the selected
             # schema, so cross-file concatenation never hits a mismatch
             sel = self._resolve_columns(columns) if columns else self._selected
-            return pa.table(
-                {
-                    # keyed by the TOP-LEVEL field name: a LIST leaf is
-                    # called "element", and two list columns must not
-                    # collapse into one
-                    leaf.path[0]: pa.array(
-                        [], type=_arrow_type(_flat_leaf(leaf.path))
+            by_top: dict[str, list] = {}
+            for leaf in self.schema.leaves:
+                if sel is None or leaf.path in sel:
+                    by_top.setdefault(leaf.path[0], []).append(leaf.path)
+            cols = {}
+            for top_name, paths in by_top.items():
+                kind = _fast_kind(paths)
+                if kind in ("flat", "list"):
+                    atype = _arrow_type(self.schema.column(paths[0]))
+                else:
+                    atype = nested_arrow_type(
+                        pa, self.schema.column((top_name,)),
+                        None if sel is None else sel,
                     )
-                    for leaf in self.schema.leaves
-                    if sel is None or leaf.path in sel
-                }
-            )
+                cols[top_name] = pa.array([], type=atype)
+            return pa.table(cols)
         per_group: list[dict] = []
         names: list[str] | None = None
         for i in indices:
             chunks = self._read_row_group(i, columns, pack=False)
-            cols = {}
+            by_top: dict[str, dict] = {}
             for path, cd in chunks.items():
-                leaf = _flat_leaf(path)
-                if leaf.max_rep == 1:
-                    cols[path[0]] = self._arrow_list_column(pa, path, leaf, cd)
+                by_top.setdefault(path[0], {})[path] = cd
+            cols = {}
+            for top_name, sub in by_top.items():
+                kind = _fast_kind(list(sub))
+                if kind == "nested":
+                    cols[top_name] = build_top_field(pa, self.schema, top_name, sub)
+                    continue
+                (path, cd), = sub.items()
+                leaf = self.schema.column(path)
+                if kind == "list":
+                    cols[top_name] = self._arrow_list_column(pa, path, leaf, cd)
                     continue
                 mask = None
                 if cd.def_levels is not None and leaf.max_def > 0:

@@ -546,26 +546,26 @@ class TestToArrow:
         assert out.column("fx").to_pylist() == t.column("fx").to_pylist()
         assert out.column("raw").to_pylist() == t.column("raw").to_pylist()
 
-    def test_deep_nesting_rejected(self, tmp_path):
+    def test_deep_nesting_supported(self, tmp_path):
+        """list<list<>> and structs assemble via the nested builder."""
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        from parquet_tpu.meta import ParquetFileError
-
-        # single-level lists are supported; list<list<>> is not
-        t = pa.table({"ll": pa.array([[[1]]], pa.list_(pa.list_(pa.int32())))})
+        t = pa.table({
+            "ll": pa.array(
+                [[[1]], None, [[], [2, None], None]],
+                pa.list_(pa.list_(pa.int32())),
+            ),
+            "g": pa.array(
+                [{"a": 1}, None, {"a": None}], pa.struct([("a", pa.int64())])
+            ),
+        })
         path = str(tmp_path / "nst.parquet")
         pq.write_table(t, path)
         with FileReader(path) as r:
-            with pytest.raises(ParquetFileError, match="nested deeper"):
-                r.to_arrow()
-        # struct members are out of scope too
-        t2 = pa.table({"g": pa.array([{"a": 1}], pa.struct([("a", pa.int64())]))})
-        p2 = str(tmp_path / "st.parquet")
-        pq.write_table(t2, p2)
-        with FileReader(p2) as r:
-            with pytest.raises(ParquetFileError, match="nested deeper"):
-                r.to_arrow()
+            out = r.to_arrow()
+        for c in t.column_names:
+            assert out.column(c).to_pylist() == t.column(c).to_pylist(), c
 
     def test_all_null_column(self, tmp_path):
         import pyarrow as pa
@@ -644,11 +644,12 @@ class TestToArrow:
             got = r.to_arrow().column("l").to_pylist()
         assert got == [[1, 2], [], [3]]
 
-    def test_noncanonical_repeated_shape_rejected(self, tmp_path):
-        """Review regression: an optional group holding a bare repeated leaf
-        has different level semantics — it must raise, not corrupt."""
+    def test_noncanonical_repeated_shape(self, tmp_path):
+        """An optional group holding a bare repeated leaf has non-canonical
+        level semantics; the nested builder assembles it (pyarrow oracle)."""
+        import pyarrow.parquet as pq
+
         from parquet_tpu import FileWriter, parse_schema
-        from parquet_tpu.meta import ParquetFileError
 
         schema = parse_schema(
             "message m { required group a { optional group b "
@@ -661,9 +662,10 @@ class TestToArrow:
                 {"a": {"b": {"c": []}}},
                 {"a": {"b": None}},
             ])
+        want = pq.read_table(path)
         with FileReader(path) as r:
-            with pytest.raises(ParquetFileError, match="nested deeper"):
-                r.to_arrow()
+            out = r.to_arrow()
+        assert out.column("a").to_pylist() == want.column("a").to_pylist()
 
     def test_empty_groups_list_schema(self, tmp_path):
         import pyarrow as pa
@@ -680,11 +682,13 @@ class TestToArrow:
             assert empty.column_names == ["tags", "names"]
             assert empty.column("tags").type == pa.large_list(pa.int32())
 
-    def test_legacy_list_of_struct_rejected(self, tmp_path):
-        """Review regression: a repeated group with several fields must
-        raise, not collapse its fields into one column."""
+    def test_legacy_list_of_struct(self, tmp_path):
+        """A repeated group with several fields assembles as a struct whose
+        repeated member is a list of structs (pyarrow oracle), and the
+        zero-group schema agrees with the data branch."""
+        import pyarrow.parquet as pq
+
         from parquet_tpu import FileWriter, parse_schema
-        from parquet_tpu.meta import ParquetFileError
 
         schema = parse_schema(
             "message m { optional group owner { repeated group contacts "
@@ -695,24 +699,34 @@ class TestToArrow:
             w.write_rows([
                 {"owner": {"contacts": [{"name": "a", "phone": 1},
                                         {"name": "b", "phone": 2}]}},
+                {"owner": {"contacts": []}},
+                {"owner": None},
             ])
+        want = pq.read_table(path)
         with FileReader(path) as r:
-            with pytest.raises(ParquetFileError, match="nested deeper"):
-                r.to_arrow()
-            with pytest.raises(ParquetFileError, match="nested deeper"):
-                r.to_arrow(row_groups=[])
+            out = r.to_arrow()
+            empty = r.to_arrow(row_groups=[])
+        assert out.column("owner").to_pylist() == want.column("owner").to_pylist()
+        assert empty.num_rows == 0
+        assert empty.column("owner").type == out.column("owner").type
 
-    def test_fixed_list_elements_rejected_both_branches(self, tmp_path):
+    def test_fixed_list_elements_both_branches(self, tmp_path):
+        """Fixed-width list elements route through the nested builder (the
+        canonical-list fast path doesn't cover them); zero-group schema
+        matches the data branch's type."""
         import pyarrow as pa
         import pyarrow.parquet as pq
 
-        from parquet_tpu.meta import ParquetFileError
-
-        t = pa.table({"fl": pa.array([[b"abcd"]], pa.list_(pa.binary(4)))})
+        t = pa.table({
+            "fl": pa.array(
+                [[b"abcd", None], None, [b"efgh"]], pa.list_(pa.binary(4))
+            ),
+        })
         path = str(tmp_path / "fl.parquet")
         pq.write_table(t, path, use_dictionary=False)
         with FileReader(path) as r:
-            with pytest.raises(ParquetFileError, match="fixed-width"):
-                r.to_arrow()
-            with pytest.raises(ParquetFileError, match="fixed-width"):
-                r.to_arrow(row_groups=[])
+            out = r.to_arrow()
+            empty = r.to_arrow(row_groups=[])
+        assert out.column("fl").to_pylist() == t.column("fl").to_pylist()
+        assert empty.column("fl").type == out.column("fl").type
+        assert empty.column("fl").type == pa.large_list(pa.binary(4))
