@@ -136,7 +136,18 @@ ssize_t ptq_snappy_decompress(const char* src_c, size_t src_len,
       pos += n;
     } else {
       uint32_t length, offset;
-      if (kind == 1) {
+      if (fast && pos + 4 <= src_len) {
+        // tag-dispatch: one table lookup + one unconditional 4-byte load
+        // replaces the per-kind branch ladder (trailer bytes beyond the
+        // tag's count are masked off, never consumed)
+        const uint16_t e = g_snappy_tag[tag];
+        const uint32_t extra = e >> 11;
+        uint32_t data;
+        std::memcpy(&data, src + pos, 4);
+        offset = (e & 0x700u) + (data & g_snappy_wordmask[extra]);
+        length = e & 0xffu;
+        pos += extra;
+      } else if (kind == 1) {
         if (pos + 1 > src_len) return -1;
         length = ((tag >> 2) & 7) + 4;
         offset = (static_cast<uint32_t>(tag >> 5) << 8) | src[pos];
@@ -1226,8 +1237,12 @@ int decompress_page(int codec, const uint8_t* src, size_t src_len,
                     uint8_t* scratch, size_t scratch_cap, size_t expect) {
   if (expect > scratch_cap) return -5;
   if (codec == 1) {
+    // pass the PHYSICAL capacity: chunk_prepare allocates scratch with
+    // >= 64 bytes of slack past the chunk's uncompressed size, which
+    // switches the decoder into overshooting fast mode; the result is
+    // still validated against the page's claimed size
     if (ptq_snappy_decompress(reinterpret_cast<const char*>(src), src_len,
-                              reinterpret_cast<char*>(scratch), expect) !=
+                              reinterpret_cast<char*>(scratch), scratch_cap) !=
         static_cast<ssize_t>(expect))
       return -1;
     return 0;

@@ -26,9 +26,10 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..meta.file_meta import ParquetFileError
 from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
 
-__all__ = ["build_top_field", "nested_arrow_type", "_leaf_arrow_type"]
+__all__ = ["build_top_field", "nested_arrow_type"]
 
 
 class _LeafState:
@@ -159,7 +160,7 @@ def build_top_field(pa, schema, top_name: str, chunks: dict) -> "pa.Array":
         if path[0] == top_name
     }
     if not leaves:
-        raise ValueError(f"no leaf chunks for field {top_name}")
+        raise ParquetFileError(f"parquet: no leaf chunks for field {top_name}")
     # root slots = records: an entry starts a record iff rep level == 0
     state = {}
     n_slots = None
@@ -172,7 +173,7 @@ def build_top_field(pa, schema, top_name: str, chunks: dict) -> "pa.Array":
         if n_slots is None:
             n_slots = count
         elif n_slots != count:
-            raise ValueError(
+            raise ParquetFileError(
                 f"parquet: leaves of {top_name} disagree on row count "
                 f"({n_slots} vs {count})"
             )
@@ -317,7 +318,7 @@ def _list_expand(rep_node, leaves, state, n_slots):
             offsets = offs
             n_elems = int(offs[-1])
         elif not np.array_equal(offsets, offs):
-            raise ValueError(
+            raise ParquetFileError(
                 f"parquet: leaves under {rep_node.path_str} disagree on "
                 "list structure"
             )
@@ -353,7 +354,7 @@ def _leaf_array(pa, leaf, leaves, state, n_slots):
     ls = leaves[leaf.path]
     sel, slot_of = state[leaf.path]
     if len(sel) != n_slots:
-        raise ValueError(
+        raise ParquetFileError(
             f"parquet: leaf {leaf.path_str} stream does not align with its "
             f"slots ({len(sel)} entries for {n_slots} slots)"
         )

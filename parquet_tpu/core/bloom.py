@@ -175,7 +175,10 @@ class BloomFilter:
         if not 0.0 < fpp < 1.0:
             raise ValueError("bloom: fpp must be in (0, 1)")
         bits = -8.0 * ndv / math.log(1.0 - fpp ** (1.0 / 8.0))
-        nbytes = 1 << max(int(bits / 8.0) - 1, 0).bit_length()
+        # ceil to whole bytes BEFORE the power-of-two round-up: int() here
+        # would undershoot the requested fpp whenever optimal bytes lands
+        # just above a power of two
+        nbytes = 1 << max(math.ceil(bits / 8.0) - 1, 0).bit_length()
         nbytes = min(max(nbytes, cls.MIN_BYTES), cls.MAX_BYTES)
         return cls(np.zeros(nbytes // 4, dtype=np.uint32))
 

@@ -1212,7 +1212,7 @@ class FileReader:
         import pyarrow as pa
 
         from ..meta.parquet_types import Type
-        from .arrow_nested import _leaf_arrow_type, build_top_field, nested_arrow_type
+        from .arrow_nested import build_top_field, nested_arrow_type
         from .arrays import ByteArrayData
 
         def _fast_kind(paths):
@@ -1229,33 +1229,27 @@ class FileReader:
                 return "list"
             return "nested"
 
-        def _arrow_type(leaf):
-            base = _leaf_arrow_type(pa, leaf)
-            return pa.large_list(base) if leaf.max_rep == 1 else base
-
         indices = list(
             range(self.num_row_groups) if row_groups is None else row_groups
         )
         if not indices:
             # zero groups selected: a zero-ROW table with the selected
             # schema, so cross-file concatenation never hits a mismatch
+            # (nested_arrow_type derives the same type every data branch
+            # produces, fast paths included)
             sel = self._resolve_columns(columns) if columns else self._selected
             by_top: dict[str, list] = {}
             for leaf in self.schema.leaves:
                 if sel is None or leaf.path in sel:
                     by_top.setdefault(leaf.path[0], []).append(leaf.path)
-            cols = {}
-            for top_name, paths in by_top.items():
-                kind = _fast_kind(paths)
-                if kind in ("flat", "list"):
-                    atype = _arrow_type(self.schema.column(paths[0]))
-                else:
-                    atype = nested_arrow_type(
-                        pa, self.schema.column((top_name,)),
-                        None if sel is None else sel,
+            return pa.table({
+                top_name: pa.array(
+                    [], type=nested_arrow_type(
+                        pa, self.schema.column((top_name,)), sel
                     )
-                cols[top_name] = pa.array([], type=atype)
-            return pa.table(cols)
+                )
+                for top_name in by_top
+            })
         per_group: list[dict] = []
         names: list[str] | None = None
         for i in indices:

@@ -148,6 +148,7 @@ class _PageIndexBuilder:
 
     def _boundary_order(self) -> int:
         # the tables that packed these exact bytes
+        from ..meta.parquet_types import ConvertedType, Type
         from .stats import _PACK, _PACK_UNSIGNED
 
         unpack = (
@@ -156,12 +157,34 @@ class _PageIndexBuilder:
             else _PACK.get(self.column.type)
         )
         if unpack is None:
-            return int(BoundaryOrder.UNORDERED)  # binary orders: stay safe
-        pairs = [
-            (unpack.unpack(mn)[0], unpack.unpack(mx)[0])
-            for mn, mx, null in zip(self.mins, self.maxs, self.null_pages)
-            if not null
-        ]
+            if self.column.type in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+                ct = self.column.converted_type
+                lt = self.column.logical_type
+                if ct in (ConvertedType.DECIMAL, ConvertedType.INTERVAL) or (
+                    lt is not None
+                    and (lt.DECIMAL is not None or lt.FLOAT16 is not None)
+                ):
+                    # signed / no defined order: lexicographic bytes would
+                    # mislead a reader's binary search
+                    return int(BoundaryOrder.UNORDERED)
+                # unsigned lexicographic IS the defined order for binary
+                # columns, and it's how these bounds were computed — sorted
+                # string columns keep readers' binary search
+                unpack = None
+            else:
+                return int(BoundaryOrder.UNORDERED)  # INT96 etc.: stay safe
+        if unpack is None:
+            pairs = [
+                (mn, mx)
+                for mn, mx, null in zip(self.mins, self.maxs, self.null_pages)
+                if not null
+            ]
+        else:
+            pairs = [
+                (unpack.unpack(mn)[0], unpack.unpack(mx)[0])
+                for mn, mx, null in zip(self.mins, self.maxs, self.null_pages)
+                if not null
+            ]
         if len(pairs) < 2:
             return int(BoundaryOrder.ASCENDING)
         if all(

@@ -1123,6 +1123,8 @@ def _repack_plain_as_delta(plan: _ChunkPlan, whole: np.ndarray, nbits: int) -> b
     per-page) keeps the device kernel's shape buckets stable. Mirrors the
     byte-minimizing intent of the reference's encoded column chunks
     (chunk_writer.go) but applied to the transfer link, not the file."""
+    from ..utils.trace import bump
+
     n = len(whole)
     raw_bytes = n * whole.dtype.itemsize
     if n < 1 << 16 or raw_bytes < 1 << 19:
@@ -1145,20 +1147,26 @@ def _repack_plain_as_delta(plan: _ChunkPlan, whole: np.ndarray, nbits: int) -> b
             zz = int(np.abs(d).max()) << 1
             est_bits = max(est_bits, zz.bit_length())
     if est_bits * n >= 4 * raw_bytes:  # est packed size >= raw/2: not worth it
+        bump("repack_declined", raw_bytes)
         return False
     try:
         stream = lib.delta_encode(whole, nbits, 1024, 4)
     except (ValueError, OverflowError):
+        bump("repack_declined", raw_bytes)
         return False
     if len(stream) * 8 > _BATCH_BITS_CAP or len(stream) * 2 > raw_bytes:
-        return False  # sampled estimate missed: ship raw rather than inflate
+        # sampled estimate missed: ship raw rather than inflate
+        bump("repack_declined", raw_bytes)
+        return False
     try:
         widths, byte_starts, out_starts, mins, first, total, consumed = (
             lib.prescan_delta_packed(stream, nbits, n)
         )
     except (ValueError, OverflowError):
+        bump("repack_declined", raw_bytes)
         return False
     if int(total) != n:
+        bump("repack_declined", raw_bytes)
         return False
     first_u = int(first) & ((1 << 64) - 1)
     first_i64 = first_u - (1 << 64) if first_u >= 1 << 63 else first_u
@@ -1178,6 +1186,8 @@ def _repack_plain_as_delta(plan: _ChunkPlan, whole: np.ndarray, nbits: int) -> b
         "delta_stream": np.frombuffer(stream, dtype=np.uint8),
     }
     plan.frozen_delta = _freeze_delta_from_tables([P2], res2, nbits)
+    if plan.frozen_delta:
+        bump("repack_engaged", len(stream))
     return bool(plan.frozen_delta)
 
 

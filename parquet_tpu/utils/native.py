@@ -550,9 +550,13 @@ class NativeLib:
         # in every written page on first touch (~1 ms per decompressed MB on
         # this class of host), which a reused buffer pays only once.
         tl = self._chunk_tl
+        # +64 bytes of physical slack past the largest page's uncompressed
+        # size: decompress_page passes the physical capacity through, which
+        # switches snappy into its overshooting fast mode even when a chunk
+        # is one exactly-sized page
         scratch = getattr(tl, "scratch", None)
-        if scratch is None or len(scratch) < cap:
-            scratch = tl.scratch = np.empty(cap, dtype=np.uint8)
+        if scratch is None or len(scratch) < cap + 64:
+            scratch = tl.scratch = np.empty(cap + 64, dtype=np.uint8)
         totals = np.zeros(8, dtype=np.int64)
         p = ctypes.c_void_p
         while True:
@@ -573,7 +577,7 @@ class NativeLib:
                 values_out.ctypes.data_as(p), cap,
                 packed_out.ctypes.data_as(p), cap,
                 delta_out.ctypes.data_as(p), len(delta_out),
-                scratch.ctypes.data_as(p), cap,
+                scratch.ctypes.data_as(p), len(scratch),
                 h_is_rle.ctypes.data_as(p), h_counts.ctypes.data_as(p),
                 h_values.ctypes.data_as(p), h_byteoff.ctypes.data_as(p), max_runs,
                 d_widths.ctypes.data_as(p), d_bytestart.ctypes.data_as(p),

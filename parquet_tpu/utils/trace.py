@@ -20,7 +20,7 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-__all__ = ["decode_trace", "stage", "add_bytes", "jax_profile", "DecodeTrace"]
+__all__ = ["decode_trace", "stage", "add_bytes", "bump", "jax_profile", "DecodeTrace"]
 
 _active: "DecodeTrace | None" = None
 
@@ -86,6 +86,15 @@ def stage(name: str, nbytes: int = 0):
 def add_bytes(name: str, nbytes: int) -> None:
     if _active is not None:
         _active._stat(name).bytes += nbytes
+
+
+def bump(name: str, nbytes: int = 0) -> None:
+    """Count an event (with optional byte volume) under an active trace —
+    how tests pin down that an opportunistic path actually engaged."""
+    if _active is not None:
+        s = _active._stat(name)
+        s.calls += 1
+        s.bytes += nbytes
 
 
 @contextmanager

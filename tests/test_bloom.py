@@ -77,6 +77,21 @@ class TestBloomCore:
         tight = BloomFilter.sized_for(100, 0.5).num_bytes
         assert small < big and tight <= small
 
+    def test_sizing_never_undershoots_optimal_bits(self):
+        """The power-of-two round-up must start from ceil(optimal bytes):
+        truncating first can yield a filter SMALLER than the formula asks
+        for (e.g. optimal = 2^k + 0.4 bytes), quietly worsening the fpp."""
+        import math
+
+        for ndv in range(1, 4_000, 7):
+            for fpp in (0.5, 0.1, 0.05, 0.01):
+                bits = -8.0 * ndv / math.log(1.0 - fpp ** (1.0 / 8.0))
+                nbytes = BloomFilter.sized_for(ndv, fpp).num_bytes
+                assert nbytes >= min(
+                    max(math.ceil(bits / 8.0), BloomFilter.MIN_BYTES),
+                    BloomFilter.MAX_BYTES,
+                ), (ndv, fpp, bits / 8.0, nbytes)
+
 
 class TestPyarrowInterop:
     def test_read_pyarrow_blooms(self, tmp_path):

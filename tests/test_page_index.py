@@ -100,6 +100,50 @@ class TestWriteSide:
             ci, _ = r.read_page_index(0)[("a",)]
             assert ci.boundary_order == int(BoundaryOrder.UNORDERED)
 
+    def test_string_boundary_order(self, tmp_path):
+        """Sorted BYTE_ARRAY pages report ASCENDING (lexicographic IS the
+        defined order for binary columns — readers keep their binary
+        search); unsorted strings and DECIMAL-over-FLBA stay UNORDERED."""
+        schema = parse_schema("message m { required binary s (UTF8); }")
+        path = str(tmp_path / "sstr.parquet")
+        sorted_vals = [f"k{i:06d}" for i in range(20_000)]
+        with FileWriter(
+            path, schema, write_page_index=True, max_page_size=8_192,
+            use_dictionary=False,
+        ) as w:
+            w.write_column("s", sorted_vals)
+        with FileReader(path) as r:
+            ci, oi = r.read_page_index(0)[("s",)]
+            assert len(oi.page_locations) > 1  # multiple pages, real ordering
+            assert ci.boundary_order == int(BoundaryOrder.ASCENDING)
+
+        path2 = str(tmp_path / "ustr.parquet")
+        rng = np.random.default_rng(3)
+        with FileWriter(
+            path2, schema, write_page_index=True, max_page_size=8_192,
+            use_dictionary=False,
+        ) as w:
+            w.write_column("s", [f"k{i}" for i in rng.permutation(20_000)])
+        with FileReader(path2) as r:
+            ci, _ = r.read_page_index(0)[("s",)]
+            assert ci.boundary_order == int(BoundaryOrder.UNORDERED)
+
+        # DECIMAL over FLBA: signed order, lexicographic bytes would lie
+        dschema = parse_schema(
+            "message m { required fixed_len_byte_array(4) d (DECIMAL(9,2)); }"
+        )
+        path3 = str(tmp_path / "dec.parquet")
+        with FileWriter(
+            path3, dschema, write_page_index=True, max_page_size=2_048,
+            use_dictionary=False,
+        ) as w:
+            w.write_column(
+                "d", [int(i).to_bytes(4, "big", signed=True) for i in range(3_000)]
+            )
+        with FileReader(path3) as r:
+            ci, _ = r.read_page_index(0)[("d",)]
+            assert ci.boundary_order == int(BoundaryOrder.UNORDERED)
+
     def test_default_off(self, tmp_path):
         schema = parse_schema("message m { required int64 a; }")
         path = str(tmp_path / "noidx.parquet")

@@ -1,0 +1,266 @@
+"""Native snappy decoder: the overshooting fast mode pinned by the suite.
+
+The decoder switches on an out-of-band contract — >= 64 bytes of physical
+destination slack past the stream's claimed uncompressed size buys
+overshooting 8/16-byte copies and a one-load tag dispatch. These tests
+drive ptq_snappy_decompress directly through ctypes at the slack boundary
+(cap == expect+63 stays careful, +64 goes fast), over handcrafted streams
+(short-period overlapping copies, 4-byte-offset tags, truncated tails) and
+a fuzz sweep, asserting fast and careful modes agree byte-for-byte and
+that no write ever lands beyond the permitted slack.
+"""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from parquet_tpu.utils.native import get_native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = get_native()
+    if lib is None or not lib.has_snappy:
+        pytest.skip("native snappy not built")
+    return lib
+
+
+GUARD = 0xAB  # canary byte pattern past the permitted region
+
+
+def decompress(lib, comp: bytes, cap: int):
+    """(rc, payload, canary_ok): decode `comp` into a buffer of physical
+    size cap + 64 guard bytes; canary_ok = nothing wrote past cap + 15
+    (the documented worst-case overshoot is 15 bytes past a copy's end,
+    which itself is bounded by expect <= cap - 64 in fast mode; writes
+    into [cap, cap+64) would mean the slack contract is violated)."""
+    src = np.frombuffer(comp, dtype=np.uint8)
+    out = np.full(cap + 64, GUARD, dtype=np.uint8)
+    rc = lib._lib.ptq_snappy_decompress(
+        ctypes.c_void_p(src.ctypes.data), len(src),
+        ctypes.c_void_p(out.ctypes.data), cap,
+    )
+    canary_ok = bool((out[cap:] == GUARD).all())
+    return rc, bytes(out[: max(rc, 0)]), canary_ok
+
+
+def varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def lit(data: bytes) -> bytes:
+    n = len(data) - 1
+    if n < 60:
+        return bytes([n << 2]) + data
+    enc = n.to_bytes(4, "little").rstrip(b"\x00") or b"\x00"
+    return bytes([(59 + len(enc)) << 2]) + enc + data
+
+
+def copy1(offset: int, length: int) -> bytes:
+    assert 4 <= length <= 11 and offset < 2048
+    return bytes([((offset >> 8) << 5) | ((length - 4) << 2) | 1, offset & 0xFF])
+
+
+def copy2(offset: int, length: int) -> bytes:
+    assert 1 <= length <= 64 and offset < 65536
+    return bytes([((length - 1) << 2) | 2]) + offset.to_bytes(2, "little")
+
+
+def copy4(offset: int, length: int) -> bytes:
+    assert 1 <= length <= 64
+    return bytes([((length - 1) << 2) | 3]) + offset.to_bytes(4, "little")
+
+
+def ref_decode(stream: bytes):
+    """Tiny reference decoder (spec semantics, byte-at-a-time)."""
+    pos, expect, shift = 0, 0, 0
+    while True:
+        b = stream[pos]
+        pos += 1
+        expect |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while pos < len(stream):
+        tag = stream[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:
+            n = tag >> 2
+            if n >= 60:
+                extra = n - 59
+                n = int.from_bytes(stream[pos : pos + extra], "little")
+                pos += extra
+            n += 1
+            out += stream[pos : pos + n]
+            pos += n
+        else:
+            if kind == 1:
+                length = ((tag >> 2) & 7) + 4
+                offset = ((tag >> 5) << 8) | stream[pos]
+                pos += 1
+            elif kind == 2:
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(stream[pos : pos + 2], "little")
+                pos += 2
+            else:
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(stream[pos : pos + 4], "little")
+                pos += 4
+            for _ in range(length):
+                out.append(out[-offset])
+    assert len(out) == expect
+    return bytes(out)
+
+
+def check_both_modes(lib, stream: bytes, want: bytes):
+    """Decode `stream` at every interesting capacity; all must agree."""
+    expect = len(want)
+    for cap in (expect, expect + 1, expect + 63, expect + 64, expect + 200):
+        rc, got, canary_ok = decompress(lib, stream, cap)
+        assert rc == expect, (cap, rc)
+        assert got == want, f"cap={cap} (fast={cap >= expect + 64})"
+        assert canary_ok, f"cap={cap}: write escaped the slack window"
+
+
+class TestHandcraftedStreams:
+    def test_short_period_overlapping_copies(self, lib):
+        """RLE-style matches with offsets 1..7 and lengths crossing the
+        period multiple several times — the fast path's strided copy must
+        reproduce the byte-loop semantics exactly."""
+        for offset in range(1, 8):
+            seed = bytes(range(1, offset + 1))
+            for length in (offset, offset + 1, 7, 8, 9, 15, 16, 17,
+                           3 * offset + 5, 64, 61):
+                if length > 64:
+                    continue
+                stream = (
+                    varint(offset + length)
+                    + lit(seed)
+                    + copy2(offset, length)
+                )
+                check_both_modes(lib, stream, ref_decode(stream))
+
+    def test_one_byte_offset_tags(self, lib):
+        for offset in (1, 2, 7, 8, 9, 255, 1023, 2047):
+            seed = bytes((i * 37 + 11) & 0xFF for i in range(offset))
+            for length in (4, 7, 8, 11):
+                stream = varint(offset + length) + lit(seed) + copy1(offset, length)
+                check_both_modes(lib, stream, ref_decode(stream))
+
+    def test_four_byte_offset_tags(self, lib):
+        """kind-3 tags (rare in encoder output, legal in the format)."""
+        seed = bytes((i * 13 + 5) & 0xFF for i in range(300))
+        stream = varint(300 + 40 + 64) + lit(seed) + copy4(250, 40) + copy4(300, 64)
+        check_both_modes(lib, stream, ref_decode(stream))
+
+    def test_copy_trailer_at_stream_end(self, lib):
+        """A 1-byte-trailer copy as the LAST bytes of the stream: the fast
+        path's unconditional 4-byte trailer load must not be used there
+        (pos+4 > src_len falls back to the ladder) and must still decode."""
+        seed = b"abcdefgh"
+        stream = varint(8 + 4) + lit(seed) + copy1(8, 4)
+        assert stream[-2] & 3 == 1  # really ends on a kind-1 tag + trailer
+        check_both_modes(lib, stream, ref_decode(stream))
+
+    def test_literal_chain_and_mixed_ops(self, lib):
+        rng = np.random.default_rng(9)
+        body = bytes(rng.integers(0, 256, 70).astype(np.uint8))
+        stream = (
+            varint(70 + 64 + 10 + 30)
+            + lit(body)
+            + copy2(70, 64)
+            + lit(b"0123456789")
+            + copy2(3, 30)
+        )
+        check_both_modes(lib, stream, ref_decode(stream))
+
+
+class TestCorruptStreams:
+    @pytest.mark.parametrize("slack", [0, 63, 64, 200])
+    def test_truncated_literal_tail(self, lib, slack):
+        stream = varint(20) + lit(b"abc")[:2]  # literal claims 3, carries 1
+        rc, _, canary_ok = decompress(lib, stream, 20 + slack)
+        assert rc == -1 and canary_ok
+
+    @pytest.mark.parametrize("slack", [0, 63, 64, 200])
+    def test_zero_offset_copy(self, lib, slack):
+        stream = varint(10) + lit(b"abcd") + copy2(0, 6)
+        rc, _, canary_ok = decompress(lib, stream, 10 + slack)
+        assert rc == -1 and canary_ok
+
+    @pytest.mark.parametrize("slack", [0, 63, 64, 200])
+    def test_offset_beyond_output(self, lib, slack):
+        stream = varint(10) + lit(b"abcd") + copy2(5, 6)
+        rc, _, canary_ok = decompress(lib, stream, 10 + slack)
+        assert rc == -1 and canary_ok
+
+    @pytest.mark.parametrize("slack", [0, 63, 64, 200])
+    def test_output_overrun_claim(self, lib, slack):
+        # stream writes more than its preamble claims
+        stream = varint(4) + lit(b"abcdefgh")
+        rc, _, canary_ok = decompress(lib, stream, 4 + slack)
+        assert rc == -1 and canary_ok
+
+    @pytest.mark.parametrize("slack", [0, 63, 64, 200])
+    def test_truncated_copy_trailer(self, lib, slack):
+        stream = varint(12) + lit(b"abcdefgh") + copy2(4, 4)[:2]
+        rc, _, canary_ok = decompress(lib, stream, 12 + slack)
+        assert rc == -1 and canary_ok
+
+    def test_undersized_destination(self, lib):
+        stream = varint(100) + lit(b"x" * 100)
+        rc, _, canary_ok = decompress(lib, stream, 50)
+        assert rc == -1 and canary_ok
+
+
+class TestFuzzSweep:
+    def test_fast_vs_careful_on_encoder_output(self, lib):
+        """Round-trip sweep over data mixes through BOTH our encoder and
+        pyarrow's (different emit patterns), decoded at careful and fast
+        capacities — byte equality everywhere."""
+        import pyarrow as pa
+
+        codec = pa.Codec("snappy")
+        rng = np.random.default_rng(17)
+        cases = []
+        for n in (1, 7, 64, 1000, 65_536, 262_144):
+            cases.append(bytes(rng.integers(0, 256, n).astype(np.uint8)))  # random
+            cases.append(bytes(n))  # zeros: long RLE matches, offset 1
+            cases.append((b"abcdefgh" * (n // 8 + 1))[:n])  # period 8
+            cases.append((b"abc" * (n // 3 + 1))[:n])  # period 3
+            arr = (np.arange(n // 8 + 1, dtype=np.int64) * 977 + 13).tobytes()[:n]
+            cases.append(arr)  # struct-like int64 payload
+        for data in cases:
+            for comp in (lib.snappy_compress(data), codec.compress(data)):
+                comp = bytes(comp)
+                for cap in (len(data), len(data) + 63, len(data) + 64,
+                            len(data) + 256):
+                    rc, got, canary_ok = decompress(lib, comp, cap)
+                    assert rc == len(data)
+                    assert got == data
+                    assert canary_ok
+
+    def test_mutation_sweep_never_escapes_slack(self, lib):
+        """Random single-byte mutations of valid streams: any outcome is
+        allowed except corruption of the canary or a claimed success with
+        wrong length."""
+        rng = np.random.default_rng(23)
+        base = (b"abcdefgh" * 512) + bytes(rng.integers(0, 256, 1024).astype(np.uint8))
+        comp = bytearray(lib.snappy_compress(base))
+        for _ in range(400):
+            mut = bytearray(comp)
+            i = int(rng.integers(0, len(mut)))
+            mut[i] ^= int(rng.integers(1, 256))
+            for cap in (len(base), len(base) + 64):
+                rc, got, canary_ok = decompress(lib, bytes(mut), cap)
+                assert canary_ok, f"mutation at {i} escaped slack (cap={cap})"
+                assert rc <= len(base)

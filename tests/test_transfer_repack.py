@@ -1,0 +1,192 @@
+"""PLAIN->delta transfer repack (kernels/pipeline._repack_plain_as_delta)
+pinned by the suite, not just bench.py.
+
+The repack re-encodes large PLAIN int chunks host-side as delta-bitpacked
+streams so the host->device wire carries the column's entropy; the device
+delta kernel must reconstruct them BIT-exactly. These tests drive chunks
+past the engage thresholds (>=64Ki values, >=512KiB) through the
+tpu_roundtrip backend, assert byte equality against the host decode, and —
+via the decode-trace counters — assert the repack really engaged (or
+really declined for pathological columns that would inflate the wire).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.reader import FileReader
+from parquet_tpu.utils.trace import decode_trace
+
+N64 = 80_000  # int64: 640 KB raw, past both engage thresholds
+N32 = 150_000  # int32: 600 KB raw
+
+
+def _needs_native():
+    from parquet_tpu.utils.native import get_native
+
+    lib = get_native()
+    if lib is None or not (lib.has_delta_encode and lib.has_prescan_delta):
+        pytest.skip("native delta encode/prescan not built")
+    return lib
+
+
+def _write(tmp_path, arr, compression="none"):
+    t = pa.table({"x": pa.array(arr)})
+    p = str(tmp_path / "c.parquet")
+    pq.write_table(
+        t, p, use_dictionary=False, compression=compression,
+        row_group_size=len(arr), data_page_size=1 << 30,
+    )
+    return p
+
+
+def _roundtrip(path):
+    """(host chunk values, device chunk values, trace) for column x."""
+    with FileReader(path, backend="host") as r:
+        host = np.asarray(r.read_row_group(0)[("x",)].values)
+    with decode_trace() as t:
+        with FileReader(path, backend="tpu_roundtrip") as r:
+            dev = np.asarray(r.read_row_group(0)[("x",)].values)
+    return host, dev, t
+
+
+def _calls(t, name):
+    s = t.stages.get(name)
+    return 0 if s is None else s.calls
+
+
+class TestRepackEngages:
+    def test_structured_int64_bit_exact(self, tmp_path):
+        _needs_native()
+        rng = np.random.default_rng(1)
+        vals = np.cumsum(rng.integers(-3, 50, N64)).astype(np.int64) + 10**15
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        assert _calls(t, "repack_engaged") >= 1, t.stages
+        np.testing.assert_array_equal(host, vals)
+        np.testing.assert_array_equal(dev, vals)
+
+    def test_structured_int32_bit_exact(self, tmp_path):
+        _needs_native()
+        rng = np.random.default_rng(2)
+        vals = (np.arange(N32) * 7 + rng.integers(0, 13, N32)).astype(np.int32)
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        assert _calls(t, "repack_engaged") >= 1, t.stages
+        np.testing.assert_array_equal(dev, vals)
+
+    def test_snappy_compressed_chunk_still_repacks(self, tmp_path):
+        """Repack operates on the DECODED chunk — file compression upstream
+        must not disable it."""
+        _needs_native()
+        vals = (np.arange(N64, dtype=np.int64) * 1_000) + 42
+        host, dev, t = _roundtrip(_write(tmp_path, vals, compression="snappy"))
+        assert _calls(t, "repack_engaged") >= 1, t.stages
+        np.testing.assert_array_equal(dev, vals)
+
+    def test_negative_and_near_int64_min(self, tmp_path):
+        """Monotonic walk down to near INT64_MIN: large-magnitude values,
+        small deltas — must engage and reconstruct exactly."""
+        _needs_native()
+        lo = np.iinfo(np.int64).min
+        vals = (lo + 5 + np.arange(N64, dtype=np.int64) * 3)
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        assert _calls(t, "repack_engaged") >= 1, t.stages
+        np.testing.assert_array_equal(dev, vals)
+
+
+class TestRepackDeclines:
+    def test_incompressible_ships_raw(self, tmp_path):
+        """Full-width random data: the width estimate must decline (wire
+        would not shrink) and the raw upload must still be bit-exact."""
+        _needs_native()
+        rng = np.random.default_rng(3)
+        vals = rng.integers(-(2**62), 2**62, N64).astype(np.int64)
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        assert _calls(t, "repack_engaged") == 0, t.stages
+        assert _calls(t, "repack_declined") >= 1, t.stages
+        np.testing.assert_array_equal(dev, vals)
+
+    def test_adversarial_sample_windows_bails_to_raw(self, tmp_path):
+        """Wild deltas OUTSIDE the 4 sampled windows: the estimate says
+        compressible, the encoder proves otherwise — the bail-out must ship
+        raw bytes, never a bloated stream, and stay bit-exact."""
+        _needs_native()
+        rng = np.random.default_rng(4)
+        n = N64
+        vals = np.arange(n, dtype=np.int64)  # windows look ~1-bit
+        wild = rng.integers(-(2**62), 2**62, n).astype(np.int64)
+        keep = np.zeros(n, dtype=bool)
+        for lo in (0, n // 3, (2 * n) // 3, n - 1024):  # the sampled windows
+            keep[max(lo - 2048, 0) : lo + 1024 + 2048] = True
+        vals[~keep] = wild[~keep]
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        assert _calls(t, "repack_engaged") == 0, t.stages
+        assert _calls(t, "repack_declined") >= 1, t.stages
+        np.testing.assert_array_equal(dev, vals)
+
+    def test_small_chunk_not_considered(self, tmp_path):
+        """Below the 64Ki/512KiB thresholds the repack must not even be
+        evaluated (latency-bound regime)."""
+        _needs_native()
+        vals = np.arange(50_000, dtype=np.int64)
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        assert _calls(t, "repack_engaged") == 0
+        assert _calls(t, "repack_declined") == 0
+        np.testing.assert_array_equal(dev, vals)
+
+
+class TestRepackEdgeCases:
+    def test_uint64_wraparound_deltas(self, tmp_path):
+        """Values crossing the int64 sign boundary (uint64-monotonic,
+        int64-wrapping): whether the encoder engages (mod-2^64 zigzag) or
+        declines, the delivered bytes must equal the host decode."""
+        _needs_native()
+        base = np.arange(N64, dtype=np.uint64) + np.uint64(2**63 - N64 // 2)
+        vals = base.view(np.int64).copy()
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        np.testing.assert_array_equal(host, vals)
+        np.testing.assert_array_equal(dev, vals)
+
+    def test_extreme_alternating_deltas(self, tmp_path):
+        """INT64_MIN <-> INT64_MAX alternation between flat sample windows:
+        delta magnitudes overflow int64; engage or decline, never corrupt."""
+        _needs_native()
+        n = N64
+        vals = np.zeros(n, dtype=np.int64)
+        info = np.iinfo(np.int64)
+        alt = np.where(np.arange(n) % 2 == 0, info.min, info.max)
+        keep = np.zeros(n, dtype=bool)
+        for lo in (0, n // 3, (2 * n) // 3, n - 1024):
+            keep[max(lo - 2048, 0) : lo + 1024 + 2048] = True
+        vals[~keep] = alt[~keep]
+        host, dev, t = _roundtrip(_write(tmp_path, vals))
+        np.testing.assert_array_equal(host, vals)
+        np.testing.assert_array_equal(dev, vals)
+
+    def test_nullable_column_repack(self, tmp_path):
+        """Definition levels present: the repack covers the DENSE values;
+        levels and values must both survive."""
+        _needs_native()
+        rng = np.random.default_rng(5)
+        n = N64 + 20_000
+        pyvals = [
+            None if i % 97 == 0 else int(i * 11 + (i % 7)) for i in range(n)
+        ]
+        t = pa.table({"x": pa.array(pyvals, pa.int64())})
+        p = str(tmp_path / "nul.parquet")
+        pq.write_table(
+            t, p, use_dictionary=False, compression="none",
+            row_group_size=n, data_page_size=1 << 30,
+        )
+        with FileReader(p, backend="host") as r:
+            host_cd = r.read_row_group(0)[("x",)]
+        with decode_trace() as tr:
+            with FileReader(p, backend="tpu_roundtrip") as r:
+                dev_cd = r.read_row_group(0)[("x",)]
+        assert _calls(tr, "repack_engaged") >= 1, tr.stages
+        np.testing.assert_array_equal(
+            np.asarray(host_cd.values), np.asarray(dev_cd.values)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(host_cd.def_levels), np.asarray(dev_cd.def_levels)
+        )
