@@ -312,6 +312,7 @@ def _phase_matrix(cfg: int) -> None:
     )
     t_dev, t_base, t_pa = s_dev["t"], s_base["t"], s_pa["t"]
     t_rows = None
+    t_arrow = None
     if cfg == 5:
         # the floor-equivalent read: nested LIST assembly on host over the
         # decoded leaf (BASELINE.md config 5's mixed host/TPU shape)
@@ -320,6 +321,16 @@ def _phase_matrix(cfg: int) -> None:
                 return sum(1 for _ in r.iter_rows())
 
         t_rows = timed(assembled, REPEATS, f"cfg{cfg} assembled-rows", rows=rows)
+
+        # the columnar nested lane (vectorized Dremel-levels -> Arrow): the
+        # product path for bulk nested reads; dict-row materialization above
+        # is bounded by CPython object allocation (~200ns/row just for the
+        # row dicts), this one is not
+        def columnar():
+            with FileReader(path) as r:
+                return r.to_arrow().num_rows
+
+        t_arrow = timed(columnar, REPEATS, f"cfg{cfg} to-arrow", rows=rows)
 
     # verification LAST (fetches poison the transfer path)
     with FileReader(path, backend="host") as r:
@@ -353,6 +364,8 @@ def _phase_matrix(cfg: int) -> None:
     }
     if t_rows is not None:
         out["rows_s_assembled"] = round(rows / t_rows, 1)
+    if t_arrow is not None:
+        out["rows_s_to_arrow"] = round(rows / t_arrow, 1)
     print(json.dumps(out))
 
 

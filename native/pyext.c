@@ -210,20 +210,52 @@ done:
  *
  * The final zip of column value lists into row dicts (flat rows, structs,
  * list<struct> elements): one PyDict_SetItem per cell at C speed. Each
- * column is a value list, OR a ("slices", elems_list, offsets_buf,
- * mask_or_None) spec that slices a LIST column's element values straight
- * into the row dict (no intermediate per-row list-of-lists pass).
+ * column is a value list, OR a ("slices", elems, offsets_buf, mask_or_None)
+ * spec that slices a LIST column's element values straight into the row
+ * dict (no intermediate per-row list-of-lists pass). `elems` is a Python
+ * list, or a contiguous numeric ndarray — then each row's element list is
+ * built DIRECTLY from the buffer (PyLong/PyFloat per cell), skipping the
+ * whole-column tolist() pass entirely.
  */
 #define COLK_LIST 0
 #define COLK_SLICES 1
+#define COLK_SLICES_ARR 2
 typedef struct {
   int kind;
   PyObject *list;      /* COLK_LIST: values; COLK_SLICES: elems */
-  const int64_t *off;  /* COLK_SLICES */
-  const uint8_t *mask; /* COLK_SLICES, may be NULL */
-  Py_buffer ob, mb;    /* held buffers to release */
-  int has_mb;
+  const int64_t *off;  /* COLK_SLICES* */
+  const uint8_t *mask; /* COLK_SLICES*, may be NULL */
+  const char *data;    /* COLK_SLICES_ARR: element buffer */
+  char fmt;            /* COLK_SLICES_ARR: buffer format char */
+  Py_ssize_t itemsize; /* COLK_SLICES_ARR */
+  Py_buffer ob, mb, eb; /* held buffers to release */
+  int has_mb, has_eb;
 } colspec;
+
+/* one element of a COLK_SLICES_ARR buffer as a Python object */
+static inline PyObject *arr_cell(const colspec *s, int64_t idx) {
+  const char *p = s->data + idx * s->itemsize;
+  switch (s->fmt) {
+    case 'b': return PyLong_FromLong(*(const int8_t *)p);
+    case 'B': return PyLong_FromLong(*(const uint8_t *)p);
+    case 'h': return PyLong_FromLong(*(const int16_t *)p);
+    case 'H': return PyLong_FromLong(*(const uint16_t *)p);
+    case 'i': return PyLong_FromLong(*(const int32_t *)p);
+    case 'I': return PyLong_FromUnsignedLong(*(const uint32_t *)p);
+    case 'l': case 'q': return PyLong_FromLongLong(*(const int64_t *)p);
+    case 'L': case 'Q':
+      return PyLong_FromUnsignedLongLong(*(const uint64_t *)p);
+    case 'f': return PyFloat_FromDouble(*(const float *)p);
+    case 'd': return PyFloat_FromDouble(*(const double *)p);
+    case '?': {
+      PyObject *v = *(const uint8_t *)p ? Py_True : Py_False;
+      Py_INCREF(v);
+      return v;
+    }
+  }
+  PyErr_SetString(PyExc_TypeError, "dict_rows: unsupported element format");
+  return NULL;
+}
 
 static PyObject *dict_rows(PyObject *self, PyObject *args) {
   PyObject *names, *cols;
@@ -247,20 +279,54 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
     PyObject *c = PyTuple_GET_ITEM(cols, j);
     colspec *s = &cs[j];
     s->has_mb = 0;
+    s->has_eb = 0;
     Py_ssize_t cn;
     if (PyList_Check(c)) {
       s->kind = COLK_LIST;
       s->list = c;
       cn = PyList_GET_SIZE(c);
     } else if (PyTuple_Check(c) && PyTuple_GET_SIZE(c) == 4) {
-      s->kind = COLK_SLICES;
-      s->list = PyTuple_GET_ITEM(c, 1);
-      if (!PyList_Check(s->list)) {
-        PyErr_SetString(PyExc_TypeError, "dict_rows: slices elems must be a list");
+      Py_ssize_t ne;
+      PyObject *elems = PyTuple_GET_ITEM(c, 1);
+      if (PyList_Check(elems)) {
+        s->kind = COLK_SLICES;
+        s->list = elems;
+        ne = PyList_GET_SIZE(elems);
+      } else {
+        if (PyObject_GetBuffer(elems, &s->eb,
+                               PyBUF_CONTIG_RO | PyBUF_FORMAT) < 0) {
+          PyErr_SetString(PyExc_TypeError,
+                          "dict_rows: slices elems must be a list or buffer");
+          goto fail;
+        }
+        s->kind = COLK_SLICES_ARR;
+        s->has_eb = 1;
+        s->data = (const char *)s->eb.buf;
+        s->itemsize = s->eb.itemsize;
+        /* accept native-order markers ('@'/'='): itemsize always comes from
+         * the view, so standard-size vs native-size is moot here */
+        const char *f = s->eb.format ? s->eb.format : "";
+        if (*f == '@' || *f == '=') f++;
+        s->fmt = (f[0] != '\0' && f[1] == '\0') ? f[0] : '\0';
+        Py_ssize_t want_size = 0;
+        switch (s->fmt) {
+          case 'b': case 'B': case '?': want_size = 1; break;
+          case 'h': case 'H': want_size = 2; break;
+          case 'i': case 'I': case 'f': want_size = 4; break;
+          case 'l': case 'L': case 'q': case 'Q': case 'd': want_size = 8; break;
+        }
+        if (want_size == 0 || s->itemsize != want_size) {
+          PyErr_SetString(PyExc_TypeError,
+                          "dict_rows: unsupported elems buffer format");
+          PyBuffer_Release(&s->eb);
+          goto fail;
+        }
+        ne = s->eb.len / s->itemsize;
+      }
+      if (PyObject_GetBuffer(PyTuple_GET_ITEM(c, 2), &s->ob, PyBUF_CONTIG_RO) < 0) {
+        if (s->has_eb) PyBuffer_Release(&s->eb);
         goto fail;
       }
-      if (PyObject_GetBuffer(PyTuple_GET_ITEM(c, 2), &s->ob, PyBUF_CONTIG_RO) < 0)
-        goto fail;
       s->off = (const int64_t *)s->ob.buf;
       cn = (Py_ssize_t)(s->ob.len / 8) - 1;
       PyObject *m = PyTuple_GET_ITEM(c, 3);
@@ -268,6 +334,7 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
       if (m != Py_None) {
         if (PyObject_GetBuffer(m, &s->mb, PyBUF_CONTIG_RO) < 0) {
           PyBuffer_Release(&s->ob);
+          if (s->has_eb) PyBuffer_Release(&s->eb);
           goto fail;
         }
         s->has_mb = 1;
@@ -279,7 +346,6 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
         s->mask = (const uint8_t *)s->mb.buf;
       }
       /* validate offsets once: monotone within elems bounds */
-      Py_ssize_t ne = PyList_GET_SIZE(s->list);
       for (Py_ssize_t i = 0; i <= cn; i++) {
         if (s->off[i] < 0 || s->off[i] > (int64_t)ne ||
             (i && s->off[i] < s->off[i - 1])) {
@@ -320,6 +386,22 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
         if (s->mask && s->mask[i]) {
           v = Py_None;
           Py_INCREF(v);
+        } else if (s->kind == COLK_SLICES_ARR) {
+          int64_t a = s->off[i], b = s->off[i + 1];
+          v = PyList_New((Py_ssize_t)(b - a));
+          if (v == NULL) {
+            Py_DECREF(d);
+            goto fail_out;
+          }
+          for (int64_t e = a; e < b; e++) {
+            PyObject *cell = arr_cell(s, e);
+            if (cell == NULL) {
+              Py_DECREF(v);
+              Py_DECREF(d);
+              goto fail_out;
+            }
+            PyList_SET_ITEM(v, (Py_ssize_t)(e - a), cell);
+          }
         } else {
           v = PyList_GetSlice(s->list, (Py_ssize_t)s->off[i],
                               (Py_ssize_t)s->off[i + 1]);
@@ -339,9 +421,10 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
     PyList_SET_ITEM(out, i, d);
   }
   for (Py_ssize_t j = 0; j < parsed; j++)
-    if (cs[j].kind == COLK_SLICES) {
+    if (cs[j].kind != COLK_LIST) {
       PyBuffer_Release(&cs[j].ob);
       if (cs[j].has_mb) PyBuffer_Release(&cs[j].mb);
+      if (cs[j].has_eb) PyBuffer_Release(&cs[j].eb);
     }
   return out;
 fail_out:
@@ -349,9 +432,10 @@ fail_out:
   out = NULL;
 fail:
   for (Py_ssize_t j = 0; j < parsed; j++)
-    if (cs[j].kind == COLK_SLICES) {
+    if (cs[j].kind != COLK_LIST) {
       PyBuffer_Release(&cs[j].ob);
       if (cs[j].has_mb) PyBuffer_Release(&cs[j].mb);
+      if (cs[j].has_eb) PyBuffer_Release(&cs[j].eb);
     }
   return out;
 }

@@ -29,6 +29,15 @@ class AssemblyError(ValueError):
     pass
 
 
+# dtype chars the C dict_rows array-elems path accepts, with the itemsize it
+# assumes for each (mirrors pyext.c's format check so ineligible arrays fall
+# back to the tolist path instead of raising)
+_ARR_ELEM_SIZES = {
+    "b": 1, "B": 1, "?": 1, "h": 2, "H": 2, "i": 4, "I": 4, "f": 4,
+    "l": 8, "L": 8, "q": 8, "Q": 8, "d": 8,
+}
+
+
 class _LeafCursor:
     __slots__ = ("chunk", "pos", "vpos", "max_def", "max_rep", "n")
 
@@ -176,7 +185,24 @@ def _list_column_values(top: Column, mid: Column, leaf: Column,
     n_rows = len(row_start)
     if n_rows == 0:
         return []
-    vals = _leaf_python_values(leaf, chunk, raw)
+    # plain numeric leaf with no logical conversion: keep the ndarray — the
+    # C dict_rows builds each row's element list straight from the buffer,
+    # skipping the whole-column tolist() (the assembly hot path's largest
+    # single cost on LIST<numeric> columns)
+    arr = None
+    if (
+        _ext is not None
+        and not isinstance(chunk.values, ByteArrayData)
+        and (raw or logical_kind(leaf) is None)
+    ):
+        a = np.asarray(chunk.values)
+        if (
+            a.ndim == 1
+            and a.dtype.isnative
+            and _ARR_ELEM_SIZES.get(a.dtype.char) == a.dtype.itemsize
+        ):
+            arr = np.ascontiguousarray(a)
+    vals = arr if arr is not None else _leaf_python_values(leaf, chunk, raw)
     has_elem = dfl >= mid.max_def  # entry carries an element (maybe null)
     n_elem = int(has_elem.sum())
     if mid is leaf:
@@ -198,7 +224,9 @@ def _list_column_values(top: Column, mid: Column, leaf: Column,
             elems = vals  # no null elements: the value list IS the entry list
         else:
             full = np.empty(n_elem, dtype=object)  # initialized to None
-            full[is_val_within] = vals
+            full[is_val_within] = (
+                arr.tolist() if arr is not None else vals
+            )
             elems = full.tolist()
     # per-row element counts WITHOUT a full cumsum/bincount pass: a
     # no-element marker (null/empty list) appears only as a row's single
@@ -326,6 +354,13 @@ def _zip_dict_rows(names: list, columns: list) -> list:
 def _rows_from_entries_spec(spec) -> list:
     """Materialize a deferred ("slices", elems, offsets, mask) column."""
     _tag, elems, offsets, mask = spec
+    if isinstance(elems, np.ndarray):  # array-backed spec (C path skipped)
+        # convert only this window's element range (a window-sliced spec
+        # keeps the FULL elems array with absolute offsets — a whole-column
+        # tolist here would repeat per window)
+        base = int(offsets[0]) if len(offsets) else 0
+        elems = elems[base : int(offsets[-1]) if len(offsets) else 0].tolist()
+        offsets = offsets - base
     off = offsets.tolist()
     if mask is None:
         return [elems[a:b] for a, b in zip(off[:-1], off[1:])]

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import gc
 import io
+import itertools
 import os
 import threading
 from contextlib import contextmanager, nullcontext
@@ -38,7 +39,7 @@ from .assembly import (
 )
 from .chunk import ChunkData, read_chunk
 from .schema import Schema
-from ..utils.trace import stage
+from ..utils.trace import bump, stage
 
 __all__ = ["FileReader"]
 
@@ -1121,12 +1122,17 @@ class FileReader:
         with stage("assemble"):
             with _gc_paused():
                 rc = fast_row_columns(self.schema, chunks, raw)
-                if rc is None:
+                if rc is not None:
+                    bump("assemble_canonical")
+                else:
                     # arbitrary nesting: the general level-vectorized walk
                     rc = vector_row_columns(self.schema, chunks, raw)
+                    if rc is not None:
+                        bump("assemble_vectorized")
         if rc is None:
             # per-row Dremel fallback: streams one row at a time (constant
             # memory) and raises precise errors on inconsistent level data
+            bump("assemble_cursor")
             return _timed_rows(RecordAssembler(self.schema, chunks, raw=raw))
         names, columns, n = rc
         if not names or n == 0:
@@ -1189,14 +1195,20 @@ class FileReader:
 
     @staticmethod
     def _ranged_rows(names, columns, ranges):
-        for start, stop in ranges:
-            for s in range(start, stop, _ASSEMBLE_WINDOW):
-                e = min(s + _ASSEMBLE_WINDOW, stop)
-                with stage("assemble"), _gc_paused():
-                    rows = _zip_dict_rows(
-                        names, [slice_column(c, s, e) for c in columns]
-                    )
-                yield from rows
+        # chain.from_iterable over window LISTS: the per-row next() is pure
+        # C (no Python generator frame resumes per row — those cost more
+        # than the dict build itself at multi-M rows/s); the Python frame
+        # below only wakes once per 64Ki-row window
+        def windows():
+            for start, stop in ranges:
+                for s in range(start, stop, _ASSEMBLE_WINDOW):
+                    e = min(s + _ASSEMBLE_WINDOW, stop)
+                    with stage("assemble"), _gc_paused():
+                        yield _zip_dict_rows(
+                            names, [slice_column(c, s, e) for c in columns]
+                        )
+
+        return itertools.chain.from_iterable(windows())
 
     def to_arrow(self, row_groups=None, columns=None):
         """Decoded columns as a pyarrow.Table. Flat leaves (numerics,
