@@ -1203,10 +1203,15 @@ class FileReader:
             for start, stop in ranges:
                 for s in range(start, stop, _ASSEMBLE_WINDOW):
                     e = min(s + _ASSEMBLE_WINDOW, stop)
+                    # build INSIDE the contexts, yield OUTSIDE them: the
+                    # consumer must run with GC enabled and off the stage
+                    # timer (a yield inside `with` would hold both open
+                    # across arbitrary consumer code)
                     with stage("assemble"), _gc_paused():
-                        yield _zip_dict_rows(
+                        rows = _zip_dict_rows(
                             names, [slice_column(c, s, e) for c in columns]
                         )
+                    yield rows
 
         return itertools.chain.from_iterable(windows())
 

@@ -13,6 +13,19 @@ those byte-level forms with our own encoder primitives:
   foreign_bool_rle_v2         boolean column RLE-encoded in DataPage V2
                               (modern parquet-mr writes booleans this way)
   foreign_int96_impala        INT96 julian-day timestamps (Impala convention)
+  foreign_mixed_page_versions one chunk holding a V1 page then a V2 page
+                              (writers migrating page versions mid-file)
+  foreign_v2_uncompressed_pages SNAPPY chunk, V2 pages is_compressed=false
+                              (parquet-cpp ships incompressible pages raw)
+  foreign_empty_pages         zero-value data page mid-chunk
+  foreign_zero_row            footer-only file, zero rows, nested schema
+  foreign_big_dict_page       ~3.5MB dictionary page + RLE_DICTIONARY pages
+  foreign_int96_dict          INT96 through a dictionary (Impala layout)
+  foreign_bool_rle_shapes     non-canonical hybrid runs: single-value and
+                              adjacent same-value RLE runs, 1-group
+                              bit-packed runs, padded final group
+  foreign_nonnullable_impala  REQUIRED-everywhere struct+list+map nesting
+                              (nonnullable.impala.parquet's shape)
 
 Each file is then decoded by PYARROW — the independent implementation — and
 its rows frozen as the expectation, so the oracle never saw our reader.
@@ -119,11 +132,338 @@ def _int96_impala(path: Path) -> None:
         w.write_rows(rows)
 
 
+# -- handcrafted byte-level forms ---------------------------------------------
+#
+# These build files page-by-page (headers, blocks, footer) to freeze on-disk
+# shapes our FileWriter never produces but other writers do — the layouts the
+# reference proves itself against via apache/parquet-testing and Impala files
+# (reference: parquet_test.go:11-38). pyarrow remains the oracle.
+
+
+def _handcraft(path: Path, schema, columns_pages, num_rows: int, codec: int):
+    """Write a single-row-group file from per-column page lists.
+
+    columns_pages: [(leaf Column, [(PageHeader, block_bytes), ...],
+                     num_level_entries, encoding ints)]"""
+    from parquet_tpu.meta.file_meta import MAGIC, serialize_footer
+    from parquet_tpu.meta.parquet_types import (
+        ColumnChunk,
+        ColumnMetaData,
+        ColumnOrder,
+        FileMetaData,
+        RowGroup,
+        TypeDefinedOrder,
+    )
+
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        pos = len(MAGIC)
+        chunks = []
+        total_bytes = 0
+        for col, pages, n_entries, encodings in columns_pages:
+            first = pos
+            dict_off = None
+            data_off = None
+            uncompressed = 0
+            for header, block in pages:
+                if header.type == 2 and dict_off is None:
+                    dict_off = pos
+                elif header.type in (0, 3) and data_off is None:
+                    data_off = pos
+                hb = header.dumps()
+                f.write(hb)
+                f.write(block)
+                pos += len(hb) + len(block)
+                uncompressed += len(hb) + (header.uncompressed_page_size or 0)
+            md = ColumnMetaData(
+                type=int(col.type),
+                encodings=sorted(set(encodings)),
+                path_in_schema=list(col.path),
+                codec=codec,
+                num_values=n_entries,
+                total_uncompressed_size=uncompressed,
+                total_compressed_size=pos - first,
+                data_page_offset=data_off,
+                dictionary_page_offset=dict_off,
+            )
+            chunks.append(ColumnChunk(file_offset=first, meta_data=md))
+            total_bytes += pos - first
+        rg = RowGroup(
+            columns=chunks, total_byte_size=total_bytes, num_rows=num_rows
+        )
+        meta = FileMetaData(
+            version=2,
+            schema=schema.to_thrift(),
+            num_rows=num_rows,
+            row_groups=[rg],
+            created_by="foreign-writer-form 1.0",
+            column_orders=[
+                ColumnOrder(TYPE_ORDER=TypeDefinedOrder())
+                for _ in schema.leaves
+            ],
+        )
+        f.write(serialize_footer(meta))
+
+
+def _mixed_page_versions(path: Path) -> None:
+    """One chunk holding a DataPage V1 FOLLOWED BY a DataPage V2 (writers
+    migrating versions mid-file produced these; the two page forms frame
+    their levels differently: V1 length-prefixed inside the compressed
+    block, V2 raw RLE outside it)."""
+    from parquet_tpu.core.page import encode_data_page_v1, encode_data_page_v2
+    from parquet_tpu.meta.parquet_types import Encoding
+
+    schema = parse_schema("message m { optional int64 v; }")
+    col = schema.leaves[0]
+    codec = 1  # snappy
+    rows_a = [None if i % 7 == 0 else i * 3 for i in range(400)]
+    rows_b = [None if i % 5 == 0 else -i for i in range(300)]
+
+    def parts(rows):
+        dense = np.array([r for r in rows if r is not None], dtype=np.int64)
+        dl = np.array([0 if r is None else 1 for r in rows], dtype=np.uint16)
+        return dense, dl
+
+    da, la = parts(rows_a)
+    db, lb = parts(rows_b)
+    p1 = encode_data_page_v1(col, da, la, None, Encoding.PLAIN, codec)
+    p2 = encode_data_page_v2(col, db, lb, None, Encoding.PLAIN, codec)
+    _handcraft(
+        path, schema,
+        [(col, [p1, p2], len(rows_a) + len(rows_b),
+          [int(Encoding.RLE), int(Encoding.PLAIN)])],
+        len(rows_a) + len(rows_b), codec,
+    )
+
+
+def _v2_uncompressed_pages(path: Path) -> None:
+    """SNAPPY chunk whose V2 pages set is_compressed=false (parquet-cpp
+    ships incompressible pages raw while the chunk codec stays set)."""
+    from parquet_tpu.core.page import encode_data_page_v2
+    from parquet_tpu.meta.parquet_types import Encoding
+
+    schema = parse_schema("message m { required double x; }")
+    col = schema.leaves[0]
+    vals_a = rng.standard_normal(500)  # incompressible: shipped raw
+    vals_b = np.zeros(300)  # compressible: shipped compressed
+    pa_hdr, pa_blk = encode_data_page_v2(col, vals_a, None, None, Encoding.PLAIN, 0)
+    pa_hdr.data_page_header_v2.is_compressed = False
+    pb = encode_data_page_v2(col, vals_b, None, None, Encoding.PLAIN, 1)
+    _handcraft(
+        path, schema,
+        [(col, [(pa_hdr, pa_blk), pb], 800, [int(Encoding.PLAIN)])],
+        800, 1,
+    )
+
+
+def _empty_pages(path: Path) -> None:
+    """A zero-value data page sandwiched between real pages (flush-happy
+    foreign writers emit these): the reader must step over the empty page
+    without desyncing the chunk walk or the value/level alignment."""
+    from parquet_tpu.core.page import encode_data_page_v1
+    from parquet_tpu.meta.parquet_types import Encoding
+
+    schema = parse_schema("message m { optional int32 v; }")
+    col = schema.leaves[0]
+    codec = 1
+
+    def page(rows):
+        dense = np.array([r for r in rows if r is not None], dtype=np.int32)
+        dl = np.array([0 if r is None else 1 for r in rows], dtype=np.uint16)
+        return encode_data_page_v1(col, dense, dl, None, Encoding.PLAIN, codec)
+
+    p1 = page([1, None, 3, 4])
+    p_empty = page([])
+    p2 = page([None, 6])
+    _handcraft(
+        path, schema,
+        [(col, [p1, p_empty, p2], 6, [int(Encoding.RLE), int(Encoding.PLAIN)])],
+        6, codec,
+    )
+
+
+def _zero_row(path: Path) -> None:
+    """Zero rows, nested schema: footer-only file with an empty row-group
+    list (foreign producers write these for empty partitions)."""
+    schema = parse_schema(
+        "message m { optional int64 id; optional group xs (LIST) "
+        "{ repeated group list { optional binary element (UTF8); } } }"
+    )
+    with FileWriter(path, schema, codec="snappy") as w:
+        w.write_rows([])
+
+
+def _big_dict_page(path: Path) -> None:
+    """A ~3.5MB dictionary page (larger than any single decompress window /
+    scratch sizing heuristic) feeding RLE_DICTIONARY data pages."""
+    from parquet_tpu.core.page import encode_data_page_v1, encode_dict_page
+    from parquet_tpu.meta.parquet_types import Encoding
+
+    schema = parse_schema("message m { required binary s (UTF8); }")
+    col = schema.leaves[0]
+    codec = 1
+    n_dict = 30_000
+    uniques = [(f"value_{i:06d}_" + "x" * (80 + i % 40)).encode() for i in range(n_dict)]
+    dict_page = encode_dict_page(col, uniques, codec)
+    n = 50_000
+    indices = rng.integers(0, n_dict, n).astype(np.int64)
+    pages = [dict_page]
+    for lo in range(0, n, 20_000):
+        idx = indices[lo : lo + 20_000]
+        pages.append(
+            encode_data_page_v1(
+                col, idx, None, None, Encoding.RLE_DICTIONARY, codec, n_dict
+            )
+        )
+    _handcraft(
+        path, schema,
+        [(col, pages, n,
+          [int(Encoding.RLE), int(Encoding.PLAIN), int(Encoding.RLE_DICTIONARY)])],
+        n, codec,
+    )
+
+
+def _int96_dict(path: Path) -> None:
+    """INT96 timestamps THROUGH A DICTIONARY (Impala's layout for repeated
+    timestamps: dict page of 12-byte values + RLE_DICTIONARY indices)."""
+    from parquet_tpu.core.page import encode_data_page_v1, encode_dict_page
+    from parquet_tpu.meta.parquet_types import Encoding
+    from parquet_tpu.utils.int96 import datetime_to_int96
+
+    schema = parse_schema("message m { required int96 ts; }")
+    col = schema.leaves[0]
+    codec = 1
+    base = dt.datetime(2001, 2, 3, 4, 5, 6, tzinfo=dt.timezone.utc)
+    uniq = np.stack([
+        np.frombuffer(
+            datetime_to_int96(base + dt.timedelta(hours=int(h))), dtype=np.uint8
+        )
+        for h in range(300)
+    ])
+    dict_page = encode_dict_page(col, uniq, codec)
+    n = 4_000
+    indices = rng.integers(0, len(uniq), n).astype(np.int64)
+    data_page = encode_data_page_v1(
+        col, indices, None, None, Encoding.RLE_DICTIONARY, codec, len(uniq)
+    )
+    _handcraft(
+        path, schema,
+        [(col, [dict_page, data_page], n,
+          [int(Encoding.RLE), int(Encoding.PLAIN), int(Encoding.RLE_DICTIONARY)])],
+        n, codec,
+    )
+
+
+def _bool_rle_shapes(path: Path) -> None:
+    """BOOLEAN column whose RLE hybrid stream uses NON-CANONICAL run shapes:
+    single-value RLE runs, adjacent same-value runs, one-group bit-packed
+    runs, and a final bit-packed group padded past num_values — all legal,
+    none produced by tidy encoders."""
+    import struct as st
+
+    from parquet_tpu.core.page import PageHeader
+    from parquet_tpu.meta.parquet_types import (
+        DataPageHeader,
+        Encoding,
+    )
+    from parquet_tpu.ops.varint import emit_uvarint
+
+    schema = parse_schema("message m { required boolean b; }")
+    col = schema.leaves[0]
+
+    stream = bytearray()
+    expect = []
+
+    def rle(count, value):
+        emit_uvarint(stream, count << 1)
+        stream.append(1 if value else 0)
+        expect.extend([bool(value)] * count)
+
+    def bitpacked(bits):  # len(bits) multiple of 8
+        groups = len(bits) // 8
+        emit_uvarint(stream, (groups << 1) | 1)
+        stream.extend(np.packbits(np.array(bits, np.uint8), bitorder="little").tobytes())
+        expect.extend(bool(b) for b in bits)
+
+    rle(1, True)            # single-value run
+    rle(1, True)            # adjacent run, same value (un-merged)
+    bitpacked([1, 0, 1, 0, 1, 0, 1, 0])
+    rle(3, False)
+    rle(2, False)           # adjacent same-value again
+    bitpacked([0, 0, 1, 1, 0, 0, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0])
+    rle(7, True)
+    # final group padded: only 4 of its 8 values are real
+    pad = [1, 0, 0, 1, 0, 0, 0, 0]
+    groups = 1
+    emit_uvarint(stream, (groups << 1) | 1)
+    stream.extend(np.packbits(np.array(pad, np.uint8), bitorder="little").tobytes())
+    expect.extend([True, False, False, True])
+    n = len(expect)
+
+    raw = st.pack("<I", len(stream)) + bytes(stream)  # V1 length-prefixed RLE
+    header = PageHeader(
+        type=0,
+        uncompressed_page_size=len(raw),
+        compressed_page_size=len(raw),
+        data_page_header=DataPageHeader(
+            num_values=n,
+            encoding=int(Encoding.RLE),
+            definition_level_encoding=int(Encoding.RLE),
+            repetition_level_encoding=int(Encoding.RLE),
+        ),
+    )
+    _handcraft(
+        path, schema,
+        [(col, [(header, raw)], n, [int(Encoding.RLE)])],
+        n, 0,
+    )
+
+
+def _nonnullable_impala(path: Path) -> None:
+    """REQUIRED-everywhere nesting (struct + list + map), the shape of
+    Impala's notorious nonnullable.impala.parquet: zero definition-level
+    freedom anywhere except inside the repeated groups."""
+    schema = parse_schema("""
+    message m {
+      required group s {
+        required int64 id;
+        required group tags (LIST) {
+          repeated group list { required binary element (UTF8); }
+        }
+        required group attrs (MAP) {
+          repeated group key_value {
+            required binary key (UTF8);
+            required int32 value;
+          }
+        }
+      }
+    }""")
+    rows = []
+    for i in range(600):
+        rows.append({
+            "s": {
+                "id": i,
+                "tags": [f"t{j}" for j in range(i % 4)],
+                "attrs": {f"k{j}": i * j for j in range(i % 3)},
+            }
+        })
+    with FileWriter(path, schema, codec="snappy") as w:
+        w.write_rows(rows)
+
+
 FOREIGN = {
     "foreign_legacy_2level_list": _legacy_2level_list,
     "foreign_athena_bag": _athena_bag,
     "foreign_bool_rle_v2": _bool_rle_v2,
     "foreign_int96_impala": _int96_impala,
+    "foreign_mixed_page_versions": _mixed_page_versions,
+    "foreign_v2_uncompressed_pages": _v2_uncompressed_pages,
+    "foreign_empty_pages": _empty_pages,
+    "foreign_zero_row": _zero_row,
+    "foreign_big_dict_page": _big_dict_page,
+    "foreign_int96_dict": _int96_dict,
+    "foreign_bool_rle_shapes": _bool_rle_shapes,
+    "foreign_nonnullable_impala": _nonnullable_impala,
 }
 
 
