@@ -1472,10 +1472,20 @@ ssize_t ptq_chunk_prepare(
       const uint8_t* block = payload;
       size_t block_len = payload_len;
       if (codec != 0) {
-        int rc = decompress_page(codec, payload, payload_len, scratch,
-                                 scratch_cap, static_cast<size_t>(usize));
+        // level-free PLAIN numeric pages decompress STRAIGHT into their
+        // final values_out slot: no scratch bounce, no second multi-MB
+        // memcpy (the PLAIN route below detects the in-place block)
+        uint8_t* dst = scratch;
+        size_t dcap = scratch_cap;
+        if (enc == 0 && type_size > 0 && max_rep == 0 && max_def == 0 &&
+            values_used + static_cast<uint64_t>(usize) <= values_cap) {
+          dst = values_out + values_used;
+          dcap = values_cap - values_used;
+        }
+        int rc = decompress_page(codec, payload, payload_len, dst, dcap,
+                                 static_cast<size_t>(usize));
         if (rc != 0) return rc;
-        block = scratch;
+        block = dst;
         block_len = static_cast<size_t>(usize);
       }
       size_t cur = 0;
@@ -1536,10 +1546,19 @@ ssize_t ptq_chunk_prepare(
       if (codec != 0 && (is_comp == INT64_MIN || is_comp != 0)) {
         int64_t vexpect = usize - rep_len - def_len;
         if (vexpect < 0) vexpect = 0;
-        int rc = decompress_page(codec, vreg, vreg_len, scratch, scratch_cap,
+        // V2 keeps levels outside the compressed region, so PLAIN numeric
+        // values can always land directly in values_out (see V1 note)
+        uint8_t* dst = scratch;
+        size_t dcap = scratch_cap;
+        if (enc == 0 && type_size > 0 &&
+            values_used + static_cast<uint64_t>(vexpect) <= values_cap) {
+          dst = values_out + values_used;
+          dcap = values_cap - values_used;
+        }
+        int rc = decompress_page(codec, vreg, vreg_len, dst, dcap,
                                  static_cast<size_t>(vexpect));
         if (rc != 0) return rc;
-        vsrc = scratch;
+        vsrc = dst;
         vlen = static_cast<size_t>(vexpect);
       } else {
         vsrc = vreg;
@@ -1658,7 +1677,8 @@ ssize_t ptq_chunk_prepare(
       size_t need = static_cast<size_t>(non_null) * type_size;
       if (vlen < need) return -1;  // "plain payload too short"
       if (values_used + need > values_cap) return -5;
-      std::memcpy(values_out + values_used, vsrc, need);
+      if (vsrc != values_out + values_used)  // direct decompress: in place
+        std::memcpy(values_out + values_used, vsrc, need);
       P[PC_ROUTE] = 3;
       P[PC_VOFF] = static_cast<int64_t>(values_used);
       P[PC_VLEN] = static_cast<int64_t>(need);

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import zlib
 
+from ..meta.file_meta import ParquetFileError
 from ..meta.parquet_types import CompressionCodec
 
 __all__ = [
@@ -29,8 +30,10 @@ __all__ = [
 ]
 
 
-class CompressionError(ValueError):
-    pass
+class CompressionError(ParquetFileError):
+    """Corrupt or unsupported compressed block. A ParquetFileError so the
+    API boundary's documented catch-all covers codec-level corruption the
+    same as every other malformed-file path."""
 
 
 class _Codec:

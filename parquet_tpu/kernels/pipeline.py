@@ -894,6 +894,24 @@ def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
                 plan.page_infos.append((P[_PC_N], dfl, rep, "values", vals))
         if not repacked:
             plan.plain_host = whole
+        # PLAIN routes never touch the packed/delta staging buffers, and a
+        # repacked chunk's upload is a FRESH delta stream — whatever leaked
+        # no view into the plan goes back to the thread pool so the next
+        # chunk skips the first-touch page-fault storm on multi-MB buffers.
+        # A decoded dictionary page (dict-write fallback to PLAIN pages)
+        # can alias values_buf zero-copy, so 'values' is only released when
+        # no dictionary rides the plan.
+        from ..utils.native import get_native
+
+        _lib = get_native()
+        if _lib is not None and "_bases" in res:
+            whole = None
+            names = (
+                ("values", "packed", "delta")
+                if repacked and plan.dictionary is None
+                else ("packed", "delta")
+            )
+            _lib.release_buffers(res, names)
         return plan
 
     if routes == {1} or (

@@ -510,6 +510,55 @@ class NativeLib:
             )
 
 
+    _POOL_MAX_BUFS = 6
+    _POOL_MAX_BYTES = 64 << 20  # don't hold giant one-off chunks
+
+    def _take_buf(self, size: int):
+        """A uint8 staging buffer from the per-thread pool (best fit), or a
+        fresh np.empty. Pooled buffers have their pages already faulted in,
+        which is most of the cost of writing a fresh multi-MB allocation.
+        Entries more than 4x the request are left for larger chunks — a
+        tiny chunk pinning a pooled multi-MB buffer (its plan keeps views)
+        would drain the pool of exactly the buffers worth pooling."""
+        import numpy as np
+
+        pool = getattr(self._chunk_tl, "out_pool", None)
+        if pool:
+            best = -1
+            for k in range(len(pool)):
+                n = len(pool[k])
+                if size <= n <= max(4 * size, 1 << 16) and (
+                    best < 0 or n < len(pool[best])
+                ):
+                    best = k
+            if best >= 0:
+                return pool.pop(best)
+        return np.empty(size, dtype=np.uint8)
+
+    def release_buffers(self, res: dict, names) -> None:
+        """Hand chunk_prepare staging buffers back to this thread's pool.
+
+        ONLY legal when the caller proves no view of the named buffers
+        escapes into the returned plan (e.g. the PLAIN route releases
+        packed/delta always, and values when the transfer repack replaced
+        the upload). Must run on the thread that called chunk_prepare."""
+        bases = res.get("_bases")
+        if not bases:
+            return
+        tl = self._chunk_tl
+        pool = getattr(tl, "out_pool", None)
+        if pool is None:
+            pool = tl.out_pool = []
+        for name in names:
+            buf = bases.pop(name, None)
+            if (
+                buf is not None
+                and len(buf)
+                and len(buf) <= self._POOL_MAX_BYTES
+                and len(pool) < self._POOL_MAX_BUFS
+            ):
+                pool.append(buf)
+
     def chunk_prepare(
         self,
         data,
@@ -532,16 +581,20 @@ class NativeLib:
         cap = max(uncompressed_cap, n_in) + 64
         lv = max(expected_values, 1)
         max_pages, max_runs, max_minis = 1024, 4096, 4096
-        # output buffers sized from metadata; np.empty is virtual until touched
+        # output buffers sized from metadata; np.empty is virtual until
+        # touched — but the first WRITE then faults every page in (~0.5 ms
+        # per MB), so routes that provably leak no view of a buffer hand it
+        # back via release_buffers and the next chunk on this thread skips
+        # the fault storm entirely
         def_out = np.empty(lv, dtype=np.uint16) if max_def > 0 else np.empty(0, np.uint16)
         rep_out = np.empty(lv, dtype=np.uint16) if max_rep > 0 else np.empty(0, np.uint16)
-        values_out = np.empty(cap, dtype=np.uint8)
-        packed_out = np.empty(cap, dtype=np.uint8)
+        values_out = self._take_buf(cap)
+        packed_out = self._take_buf(cap)
         # delta_out slack covers the worst-case PLAIN->delta repack (a page
         # that sampled compressible but encodes at full width: raw size +
         # ~0.5% framing) so the C walk never has to back out mid-chunk
         delta_out = (
-            np.empty(cap + cap // 64 + 4096, dtype=np.uint8)
+            self._take_buf(cap + cap // 64 + 4096)
             if delta_nbits
             else np.empty(0, np.uint8)
         )
@@ -605,6 +658,11 @@ class NativeLib:
                 "values": values_out[: int(totals[1])],
                 "packed": packed_out[: int(totals[2])],
                 "delta_stream": delta_out[: int(totals[3])],
+                "_bases": {
+                    "values": values_out,
+                    "packed": packed_out,
+                    "delta": delta_out if delta_nbits else None,
+                },
                 "h_is_rle": h_is_rle[:R],
                 "h_counts": h_counts[:R],
                 "h_values": h_values[:R],

@@ -135,6 +135,112 @@ class TestRepackDeclines:
         np.testing.assert_array_equal(dev, vals)
 
 
+class TestStagingBufferPool:
+    """chunk_prepare's staging buffers recycle through a per-thread pool;
+    the release contract is that NO view escapes into the plan. Two chunks
+    prepared back-to-back on one thread must not alias."""
+
+    def test_sequential_chunks_do_not_alias(self, tmp_path):
+        _needs_native()
+        rng = np.random.default_rng(11)
+        a = np.cumsum(rng.integers(0, 9, N64)).astype(np.int64)   # repacks
+        b = rng.integers(-(2**62), 2**62, N64).astype(np.int64)   # ships raw
+        t = pa.table({"a": pa.array(a), "b": pa.array(b)})
+        p = str(tmp_path / "two.parquet")
+        pq.write_table(t, p, use_dictionary=False, compression="snappy",
+                       row_group_size=N64, data_page_size=1 << 30)
+        from parquet_tpu.core.chunk import ChunkWindow, chunk_byte_range
+        from parquet_tpu.kernels.pipeline import prepare_chunk_plan
+
+        with FileReader(p) as r:
+            plans = []
+            for path, cc, col in r._selected_chunks(0):
+                off, tot = chunk_byte_range(cc)
+                plans.append(
+                    (path, prepare_chunk_plan(ChunkWindow(r._pread(off, tot), off), cc, col))
+                )
+            # prepare chunk b AFTER a: if a's release leaked a live view,
+            # b's walk would have overwritten it
+            (pa_, plan_a), (pb_, plan_b) = plans
+            assert plan_a.plain_host is None  # a repacked: no raw view kept
+            np.testing.assert_array_equal(np.asarray(plan_b.plain_host), b)
+            # decode through the public API for the value check of column a
+            got = np.asarray(r.read_row_group(0)[("a",)].values)
+        np.testing.assert_array_equal(got, a)
+
+    def test_dictionary_page_blocks_values_release(self, tmp_path):
+        """HANDCRAFTED chunk: a dictionary page followed by PLAIN-only data
+        pages big enough to trigger the transfer repack. The decoded
+        dictionary aliases the values staging buffer zero-copy, so the
+        repack's buffer release must SKIP it — preparing another chunk on
+        the same thread must not overwrite the first chunk's dictionary."""
+        _needs_native()
+        import sys as _sys
+        from pathlib import Path as _P
+
+        _sys.path.insert(0, str(_P(__file__).parent / "golden"))
+        from generate_foreign import _handcraft
+
+        from parquet_tpu import parse_schema
+        from parquet_tpu.core.chunk import ChunkWindow, chunk_byte_range
+        from parquet_tpu.core.page import encode_data_page_v1, encode_dict_page
+        from parquet_tpu.kernels.pipeline import prepare_chunk_plan
+        from parquet_tpu.meta.parquet_types import Encoding
+
+        schema = parse_schema("message m { required int64 a; }")
+        col = schema.leaves[0]
+        dict_vals = np.arange(100, dtype=np.int64) * 7 + 3
+        vals = (np.arange(N64, dtype=np.int64) * 11) + 5  # repack-eligible
+        pages = [
+            encode_dict_page(col, dict_vals, 1),
+            encode_data_page_v1(col, vals, None, None, Encoding.PLAIN, 1),
+        ]
+        p = str(tmp_path / "dictplain.parquet")
+        _handcraft(
+            p, schema,
+            [(col, pages, N64,
+              [int(Encoding.RLE), int(Encoding.PLAIN)])],
+            N64, 1,
+        )
+        with decode_trace() as tr:
+            with FileReader(p) as r:
+                (path, cc, c), = list(r._selected_chunks(0))
+                off, tot = chunk_byte_range(cc)
+                plan = prepare_chunk_plan(ChunkWindow(r._pread(off, tot), off), cc, c)
+                assert plan.dictionary is not None
+                # another large prepare on this same thread: if the first
+                # plan's values base was pooled, this overwrites it
+                other = np.cumsum(np.ones(N64, np.int64))
+                t2 = pa.table({"a": pa.array(other)})
+                p2 = str(tmp_path / "second.parquet")
+                pq.write_table(t2, p2, use_dictionary=False, compression="snappy",
+                               row_group_size=N64, data_page_size=1 << 30)
+                with FileReader(p2) as r2:
+                    (path2, cc2, c2), = list(r2._selected_chunks(0))
+                    off2, tot2 = chunk_byte_range(cc2)
+                    prepare_chunk_plan(
+                        ChunkWindow(r2._pread(off2, tot2), off2), cc2, c2
+                    )
+                np.testing.assert_array_equal(np.asarray(plan.dictionary), dict_vals)
+                host = r.read_row_group(0)
+        assert _calls(tr, "repack_engaged") >= 1, tr.stages
+        np.testing.assert_array_equal(np.asarray(host[("a",)].values), vals)
+
+    def test_pool_best_fit_leaves_big_buffers_for_big_chunks(self):
+        lib = _needs_native()
+        big = np.empty(8 << 20, np.uint8)
+        small = np.empty(1 << 16, np.uint8)
+        pool = []
+        lib._chunk_tl.out_pool = pool
+        pool.extend([big, small])
+        got = lib._take_buf(1000)
+        assert got is small  # not the 8MB buffer
+        got2 = lib._take_buf(1 << 20)
+        assert got2 is not big  # >4x cap: fresh allocation instead
+        assert big in pool
+        del lib._chunk_tl.out_pool
+
+
 class TestAssemblyPathCounters:
     """The decode-trace counters distinguish which assembly engine served a
     read: canonical fast path, general vectorized walk, or per-row cursor.
