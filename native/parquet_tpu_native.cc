@@ -1683,7 +1683,21 @@ ssize_t ptq_chunk_prepare(
       P[PC_VOFF] = static_cast<int64_t>(values_used);
       P[PC_VLEN] = static_cast<int64_t>(need);
       values_used += need;
-    } else if (enc == 9 && type_size > 0) {  // BYTE_STREAM_SPLIT numeric
+    } else if (enc == 9 && type_size == 4) {  // BYTE_STREAM_SPLIT, 4-byte
+      // Ship the page's interleaved streams RAW (route 5): the transpose is
+      // pure layout, and the device does it as a reshape+transpose for free
+      // — the host never strides over the bytes at all. 8-byte BSS stays
+      // host-side below (TPU x64 emulation cannot bitcast u8x8 lanes).
+      size_t need = static_cast<size_t>(non_null) * type_size;
+      if (vlen < need) return -1;
+      if (values_used + need > values_cap) return -5;
+      if (vsrc != values_out + values_used)
+        std::memcpy(values_out + values_used, vsrc, need);
+      P[PC_ROUTE] = 5;
+      P[PC_VOFF] = static_cast<int64_t>(values_used);
+      P[PC_VLEN] = static_cast<int64_t>(need);
+      values_used += need;
+    } else if (enc == 9 && type_size > 0) {  // BYTE_STREAM_SPLIT, 8-byte
       // De-interleave the byte streams back to PLAIN little-endian layout
       // in one strided pass; the page then rides the PLAIN device route
       // (the transform is pure layout, so doing it here keeps byte-identity

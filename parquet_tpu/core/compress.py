@@ -167,11 +167,26 @@ class _Lz4Hadoop(_Codec):
         lib = get_native()
         self._lib = lib if lib is not None and lib.has_lz4 else None
 
+    # Hadoop's BlockCompressorStream splits writes at the codec buffer size
+    # (io.compression.codec.lz4.buffersize, default 256KB): pages past that
+    # emit MULTIPLE [sizes][block] frames, which is what parquet-mr files
+    # actually contain — matching it keeps our large pages byte-compatible
+    # with Hadoop-stack readers
+    _BLOCK = 256 << 10
+
     def compress(self, data):
         import struct
 
-        block = self._raw.compress(data)
-        return struct.pack(">II", len(data), len(block)) + block
+        data = bytes(data)
+        if len(data) <= self._BLOCK:
+            block = self._raw.compress(data)
+            return struct.pack(">II", len(data), len(block)) + block
+        out = bytearray()
+        for lo in range(0, len(data), self._BLOCK):
+            piece = data[lo : lo + self._BLOCK]
+            block = self._raw.compress(piece)
+            out += struct.pack(">II", len(piece), len(block)) + block
+        return bytes(out)
 
     def decompress(self, data, uncompressed_size):
         if self._lib is not None:

@@ -1117,6 +1117,8 @@ class FileReader:
                 # file is genuinely corrupt
                 chunks = None
             sliced = chunks is not None
+            if sliced:
+                bump("selective_page_decode")
         if chunks is None:
             chunks = self._read_row_group(i, columns, pack=False)
         with stage("assemble"):

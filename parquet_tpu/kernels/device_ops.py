@@ -225,6 +225,19 @@ def delta_packed_decode_device(
     return jax.lax.bitcast_convert_type(vals, jnp.int64)
 
 
+@partial(jax.jit, static_argnames=("num_values",))
+def bss_transpose_device(streams: jnp.ndarray, num_values: int) -> jnp.ndarray:
+    """BYTE_STREAM_SPLIT de-interleave ON DEVICE for 4-byte types: the
+    page's 4 byte streams arrive as a (4, n_pad) uint8 array (each row one
+    stream, bucket-padded so page shapes reuse compilations); a transpose
+    + one bitcast yields uint32 bit patterns (parquet-format Encodings.md
+    BYTE_STREAM_SPLIT; host analogue: ops/byte_stream_split.decode). The
+    transform compiles to a layout change — the host never strides over
+    the bytes."""
+    m = streams.transpose()  # (n_pad, 4) uint8, one value per row
+    return jax.lax.bitcast_convert_type(m, jnp.uint32)[:num_values]
+
+
 @jax.jit
 def dict_gather_device(dictionary: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
     """Dictionary expansion: one gather (reference: type_dict.go lookup loop)."""

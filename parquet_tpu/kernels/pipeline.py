@@ -418,6 +418,11 @@ class _ChunkPlan:
         self.frozen_delta: list[tuple] = []
         self.plain_host = None
         self.dev_plain: jnp.ndarray | None = None
+        # BYTE_STREAM_SPLIT pages shipped raw: [( (4, n_pad) u8 host staging,
+        # num_values )] -> device transpose (kernels/device_ops
+        # bss_transpose_device); page order matches the "bss" page_infos
+        self.bss_host: list[tuple] = []
+        self.dev_bss: list[tuple] = []  # [(device streams, num_values)]
         self._dispatched = False
 
     # -- device dispatch (async; nothing synchronizes here) --------------------
@@ -446,6 +451,12 @@ class _ChunkPlan:
         if self.plain_host is not None:
             self.dev_plain = _upload_typed(self.plain_host)
             self.plain_host = None
+        for streams, nv in self.bss_host:
+            self.dev_bss.append((jnp.asarray(streams), nv))
+            if self.stats is not None:
+                self.stats.device_values += nv
+                self.stats.device_batches += 1
+        self.bss_host = []
         stats = self.stats
         for frozen in self.frozen_hybrid:
             self.dev_hybrid.append(_HybridBatch.dispatch_frozen(frozen))
@@ -473,6 +484,24 @@ class _ChunkPlan:
         if self.dev_delta:
             fetched = [np.asarray(d) for d in self.dev_delta]
             delta_flat = fetched[0] if len(fetched) == 1 else np.concatenate(fetched)
+        bss_pages = None
+        if self.dev_bss or self.bss_host:
+            # fetch the device transposes (dispatched), or transpose the
+            # staged streams host-side (plan finalized without dispatch)
+            from .device_ops import bss_transpose_device
+
+            np_dt = _NUMERIC_DTYPE.get(self.column.type)
+            if self.dev_bss:
+                bss_pages = [
+                    np.asarray(bss_transpose_device(d, nv)).view(np_dt)
+                    for d, nv in self.dev_bss
+                ]
+            else:
+                bss_pages = [
+                    np.ascontiguousarray(s[:, :nv].T).view(np_dt).reshape(nv)
+                    for s, nv in self.bss_host
+                ]
+            bss_pages = list(reversed(bss_pages))  # pop from the front
         pages_values = []
         all_def: list[np.ndarray] = []
         all_rep: list[np.ndarray] = []
@@ -499,6 +528,8 @@ class _ChunkPlan:
                     vals = delta_flat[dpos : dpos + payload]
                     dpos += payload
                     pages_values.append(vals)
+            elif kind == "bss":
+                pages_values.append(bss_pages.pop())
             elif kind == "values":
                 pages_values.append(payload)
             elif kind == "empty":
@@ -563,6 +594,16 @@ class _ChunkPlan:
                 if len(self.dev_delta) == 1
                 else jnp.concatenate(self.dev_delta)
             )
+            return out
+
+        if kinds <= {"bss", "empty"} and self.dev_bss:
+            from .device_ops import bss_transpose_device
+
+            parts = [bss_transpose_device(d, nv) for d, nv in self.dev_bss]
+            u = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            if column.type == Type.INT32:
+                u = jax.lax.bitcast_convert_type(u, jnp.int32)
+            out.values = _device_bitcast(u, column)
             return out
 
         if "values" in kinds and kinds <= {"values", "empty"} and column.type in _NUMERIC_DTYPE:
@@ -914,6 +955,38 @@ def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
             _lib.release_buffers(res, names)
         return plan
 
+    if routes == {5} and np_dt is not None:
+        # BYTE_STREAM_SPLIT 4-byte pages shipped RAW: each page's streams
+        # stage into a (4, bucket) array (4 contiguous memcpys — the host
+        # never strides byte-by-byte) and the DEVICE does the transpose
+        # (kernels/device_ops.bss_transpose_device)
+        for P in data_pages:
+            dfl, rep = _levels(P)
+            if P[_PC_ROUTE] == 4:
+                plan.page_infos.append((P[_PC_N], dfl, rep, "empty", None))
+                continue
+            nv = P[_PC_NONNULL]
+            raw = np.frombuffer(
+                values_buf, dtype=np.uint8, count=P[_PC_VLEN], offset=P[_PC_VOFF]
+            )
+            staged = np.zeros((4, _bucket(max(nv, 1))), dtype=np.uint8)
+            staged[:, :nv] = raw.reshape(4, nv)
+            plan.bss_host.append((staged, nv))
+            plan.page_infos.append((P[_PC_N], dfl, rep, "bss", nv))
+        # staging copied out of values_buf: the bases can recycle (same
+        # dictionary-aliasing caveat as the PLAIN branch)
+        from ..utils.native import get_native
+
+        _lib = get_native()
+        if _lib is not None and "_bases" in res:
+            names = (
+                ("values", "packed", "delta")
+                if plan.dictionary is None
+                else ("packed", "delta")
+            )
+            _lib.release_buffers(res, names)
+        return plan
+
     if routes == {1} or (
         routes == {1, 3} and np_dt is not None and column.type != Type.DOUBLE
         # DOUBLE mixed chunks can't merge on device (no f64<->u64 bitcast in
@@ -1056,6 +1129,18 @@ def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
         elif route == 3:
             vals = np.frombuffer(
                 values_buf, dtype=np_dt, count=P[_PC_NONNULL], offset=P[_PC_VOFF]
+            )
+            plan.page_infos.append((P[_PC_N], dfl, rep, "values", vals))
+        elif route == 5:
+            # raw BSS page in a mixed chunk: de-interleave host-side
+            nv = P[_PC_NONNULL]
+            raw = np.frombuffer(
+                values_buf, dtype=np.uint8, count=P[_PC_VLEN], offset=P[_PC_VOFF]
+            )
+            vals = (
+                np.ascontiguousarray(raw.reshape(4, nv).T)
+                .view(np_dt)
+                .reshape(nv)
             )
             plan.page_infos.append((P[_PC_N], dfl, rep, "values", vals))
         else:  # route 0: host decoder on the raw stream

@@ -31,9 +31,51 @@ def _json_default(v):
     return str(v)
 
 
+def _coerce(raw: str):
+    raw = raw.strip()
+    if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "'\"":
+        return raw[1:-1]  # quoted: force string ('7' stays "7")
+    try:
+        return int(raw)
+    except ValueError:
+        try:
+            return float(raw)
+        except ValueError:
+            return raw
+
+
+def _split_members(inner: str):
+    """Split a set-literal body on commas OUTSIDE quotes, so quoted members
+    may themselves contain commas ('a,b' stays one member)."""
+    parts = []
+    cur = []
+    quote = None
+    for ch in inner:
+        if quote:
+            cur.append(ch)
+            if ch == quote:
+                quote = None
+        elif ch in "'\"":
+            quote = ch
+            cur.append(ch)
+        elif ch == ",":
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if quote:
+        raise ValueError(f"unterminated quote in set literal {inner!r}")
+    if cur or parts:
+        parts.append("".join(cur))
+    return parts
+
+
 def _parse_filters(specs):
-    """['col >= 10', 'name == x'] -> [(col, op, value)] triples; values try
-    int, then float, then stay strings."""
+    """['col >= 10', 'name == x', 'id in (1,2,3)'] -> [(col, op, value)]
+    triples; values try int, then float, then stay strings. Set membership
+    ('in'/'not_in' with a parenthesized list) rides the full pruning stack,
+    including bloom-filter consultation for 'in'. Comparison ops parse
+    FIRST so a quoted value containing the word 'in' stays a value."""
     if not specs:
         return None
     out = []
@@ -41,24 +83,27 @@ def _parse_filters(specs):
         for op in ("==", "!=", "<=", ">=", "<", ">"):
             if f" {op} " in spec:
                 col, _, raw = spec.partition(f" {op} ")
-                raw = raw.strip()
-                if len(raw) >= 2 and raw[0] == raw[-1] and raw[0] in "'\"":
-                    value = raw[1:-1]  # quoted: force string ('7' stays "7")
-                else:
-                    try:
-                        value = int(raw)
-                    except ValueError:
-                        try:
-                            value = float(raw)
-                        except ValueError:
-                            value = raw
-                out.append((col.strip(), op, value))
+                out.append((col.strip(), op, _coerce(raw)))
                 break
         else:
-            raise ValueError(
-                f"bad --filter {spec!r} (expected 'column OP value', "
-                "OP one of == != < <= > >=)"
-            )
+            for op in ("not_in", "in"):
+                head, sep, tail = spec.partition(f" {op} ")
+                if sep and head.strip() and "(" not in head:
+                    raw = tail.strip()
+                    if not (raw.startswith("(") and raw.endswith(")")):
+                        raise ValueError(
+                            f"bad --filter {spec!r} ({op} needs a "
+                            "parenthesized list: 'col in (1,2,3)')"
+                        )
+                    inner = raw[1:-1].strip()
+                    values = [_coerce(x) for x in _split_members(inner)]
+                    out.append((head.strip(), op, values))
+                    break
+            else:
+                raise ValueError(
+                    f"bad --filter {spec!r} (expected 'column OP value', "
+                    "OP one of == != < <= > >= in not_in)"
+                )
     return out
 
 
@@ -248,8 +293,9 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
 
     filter_help = (
-        "predicate 'column OP value' (repeatable, ANDed; OP: == != < <= > >=); "
-        "row groups and pages excluded by statistics/bloom/page-index never load"
+        "predicate 'column OP value' (repeatable, ANDed; OP: == != < <= > >= "
+        "in not_in — set ops take a list: 'id in (1,2,3)'); row groups and "
+        "pages excluded by statistics/bloom/page-index never load"
     )
     pc = sub.add_parser("cat", help="print all rows as JSON lines")
     pc.add_argument("file")

@@ -198,3 +198,40 @@ class TestOursToPyarrow:
             FileWriter(
                 io.BytesIO(), schema, column_encodings={"s": "BYTE_STREAM_SPLIT"}
             )
+
+
+class TestDeviceTranspose:
+    """4-byte BSS pages ship their streams RAW and transpose ON DEVICE
+    (kernels/device_ops.bss_transpose_device); 8-byte types keep the host
+    de-interleave (no u8x8 bitcast in the TPU x64 emulation)."""
+
+    def test_four_byte_pages_take_the_bss_route(self, tmp_path):
+        from parquet_tpu.core.chunk import ChunkWindow, chunk_byte_range
+        from parquet_tpu.kernels.pipeline import prepare_chunk_plan
+
+        t = _table(50_000)
+        path = str(tmp_path / "bss_route.parquet")
+        pq.write_table(
+            t, path, use_dictionary=False, compression="snappy",
+            version="2.6",
+            column_encoding={c: "BYTE_STREAM_SPLIT" for c in ALL_COLS},
+        )
+        kinds = {}
+        with FileReader(path) as r:
+            for p, cc, col in r._selected_chunks(0):
+                off, tot = chunk_byte_range(cc)
+                plan = prepare_chunk_plan(
+                    ChunkWindow(r._pread(off, tot), off), cc, col
+                )
+                kinds[p[0]] = {
+                    k for _, _, _, k, _ in plan.page_infos if k != "empty"
+                }
+                # deliver through the device path and check values
+                dc = plan.dispatch_device().device_column()
+                np.testing.assert_array_equal(
+                    np.asarray(dc.values), np.asarray(t.column(p[0]))
+                )
+        assert kinds["f"] == {"bss"}, kinds  # float32: device transpose
+        assert kinds["i"] == {"bss"}, kinds  # int32: device transpose
+        assert kinds["d"] == {"values"}, kinds  # float64: host de-interleave
+        assert kinds["l"] == {"values"}, kinds  # int64: host de-interleave

@@ -326,6 +326,35 @@ class TestSelectivePageDecode:
             assert [row["a"] for row in got] == list(range(20_000, 25_000))
             assert all(row["c"] == "cat_1" for row in got)
 
+    def test_dnf_or_engages_selective_decode(self, tmp_path):
+        """An OR of conjunctions pushes its UNION of admitted ranges into
+        selective page decode (trace counter proves engagement), across
+        different conjunction columns, with exact results."""
+        from parquet_tpu.utils.trace import decode_trace
+
+        n = 120_000
+        a = np.arange(n, dtype=np.int64)
+        b = (np.arange(n)[::-1]).astype(np.int64)
+        schema = parse_schema("message m { required int64 a; required int64 b; }")
+        path = str(tmp_path / "dnf_sel.parquet")
+        with FileWriter(
+            path, schema, codec="snappy", write_page_index=True,
+            max_page_size=8_192, use_dictionary=False,
+        ) as w:
+            w.write_column("a", a)
+            w.write_column("b", b)
+        dnf = [
+            [("a", "<", 300)],                       # head band via column a
+            [("b", "<", 200), ("a", ">=", 100)],     # tail band via column b
+        ]
+        with decode_trace() as t:
+            with FileReader(path) as r:
+                got = [row["a"] for row in r.iter_rows(filters=dnf)]
+        sel = t.stages.get("selective_page_decode")
+        assert sel is not None and sel.calls >= 1, t.stages
+        want = [i for i in range(n) if i < 300 or (b[i] < 200 and i >= 100)]
+        assert got == want
+
     def test_matches_full_decode(self, tmp_path):
         rng2 = np.random.default_rng(3)
         n = 50_000

@@ -212,6 +212,41 @@ class TestToolPageIndexBloom:
         assert tool_main(["cat", indexed, "--filter", "id>48"]) == 1
         assert "bad --filter" in capsys.readouterr().err
 
+    def test_cat_filter_in(self, indexed, capsys):
+        """Set membership through the CLI rides the same pruning stack
+        (stats + page index + the reader's bloom consultation for 'in')."""
+        assert tool_main(["cat", indexed, "--filter", "id in (3, 41, 7)"]) == 0
+        rows = [json.loads(x) for x in capsys.readouterr().out.splitlines()]
+        assert sorted(r["id"] for r in rows) == [3, 7, 41]
+        assert tool_main(
+            ["cat", indexed, "--filter", "id not_in (0,1)", "--filter", "id <= 3"]
+        ) == 0
+        rows = [json.loads(x) for x in capsys.readouterr().out.splitlines()]
+        assert sorted(r["id"] for r in rows) == [2, 3]
+        # quoted members keep their string type; empty 'in' list = no rows
+        assert tool_main(["cat", indexed, "--filter", 'name in ("n5")']) == 0
+        rows = [json.loads(x) for x in capsys.readouterr().out.splitlines()]
+        assert [r["id"] for r in rows] == [5]
+        assert tool_main(["cat", indexed, "--filter", "id in ()"]) == 0
+        assert capsys.readouterr().out == ""
+
+    def test_bad_in_filter_spec(self, indexed, capsys):
+        assert tool_main(["cat", indexed, "--filter", "id in 1,2"]) == 1
+        assert "parenthesized" in capsys.readouterr().err
+
+    def test_in_parse_edge_cases(self):
+        """Review regressions: quoted members may hold commas; a quoted
+        comparison VALUE containing the word ' in ' is not a set op."""
+        from parquet_tpu.tools.parquet_tool import _parse_filters
+
+        assert _parse_filters(["name in ('a,b', 'c')"]) == [
+            ("name", "in", ["a,b", "c"])
+        ]
+        assert _parse_filters(["msg == 'logged in now'"]) == [
+            ("msg", "==", "logged in now")
+        ]
+        assert _parse_filters(["a not_in (1, 2)"]) == [("a", "not_in", [1, 2])]
+
     def test_quoted_filter_value_stays_string(self, tmp_path, capsys):
         path = str(tmp_path / "numstr.parquet")
         schema = message(required("id", Type.INT64), optional("name", string()))
