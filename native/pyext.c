@@ -206,6 +206,62 @@ done:
   return out;
 }
 
+/* take_bytes(data, offsets_i64, indices_i64) -> (new_offsets_bytes, out_bytes)
+ *
+ * Byte-array gather (dictionary expansion) in one pass with ONE output
+ * allocation: PyBytes_FromStringAndSize(NULL, ...) skips both the memset a
+ * ctypes string buffer pays and the extra copy string_at() makes. Offsets
+ * come back as raw int64 little-endian bytes (np.frombuffer views them).
+ */
+static PyObject *take_bytes(PyObject *self, PyObject *args) {
+  Py_buffer db, ob, ib;
+  if (!PyArg_ParseTuple(args, "y*y*y*", &db, &ob, &ib)) return NULL;
+  const char *src = (const char *)db.buf;
+  const int64_t *off = (const int64_t *)ob.buf;
+  const int64_t *idx = (const int64_t *)ib.buf;
+  Py_ssize_t n_src = ob.len / 8 - 1;
+  Py_ssize_t n = ib.len / 8;
+  PyObject *off_out = NULL, *data_out = NULL, *result = NULL;
+  if (n_src < 0) {
+    PyErr_SetString(PyExc_ValueError, "take_bytes: empty offsets");
+    goto done;
+  }
+  off_out = PyBytes_FromStringAndSize(NULL, (n + 1) * 8);
+  if (off_out == NULL) goto done;
+  int64_t *no = (int64_t *)PyBytes_AS_STRING(off_out);
+  int64_t total = 0;
+  no[0] = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int64_t k = idx[i];
+    if (k < 0 || k >= (int64_t)n_src) {
+      PyErr_SetString(PyExc_IndexError, "take_bytes: index out of range");
+      goto done;
+    }
+    int64_t len = off[k + 1] - off[k];
+    if (len < 0 || off[k] < 0 || off[k + 1] > (int64_t)db.len) {
+      PyErr_SetString(PyExc_ValueError, "take_bytes: corrupt offsets");
+      goto done;
+    }
+    total += len;
+    no[i + 1] = total;
+  }
+  data_out = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)total);
+  if (data_out == NULL) goto done;
+  char *dst = PyBytes_AS_STRING(data_out);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int64_t k = idx[i];
+    memcpy(dst + no[i], src + off[k], (size_t)(no[i + 1] - no[i]));
+  }
+  result = PyTuple_Pack(2, off_out, data_out);
+done:
+  Py_XDECREF(off_out);
+  Py_XDECREF(data_out);
+  PyBuffer_Release(&db);
+  PyBuffer_Release(&ob);
+  PyBuffer_Release(&ib);
+  return result;
+}
+
 /* dict_rows(names_tuple, columns_tuple) -> [ {name: col[i] ...}, ... ]
  *
  * The final zip of column value lists into row dicts (flat rows, structs,
@@ -449,6 +505,8 @@ static PyMethodDef methods[] = {
      "rows_from_slices(elems, offsets_i64, null_mask|None) -> list of slices"},
     {"dict_rows", dict_rows, METH_VARARGS,
      "dict_rows(names_tuple, columns_tuple) -> list of dicts"},
+    {"take_bytes", take_bytes, METH_VARARGS,
+     "take_bytes(data, offsets_i64, indices_i64) -> (new_offsets_bytes, data_bytes)"},
     {NULL, NULL, 0, NULL}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native_ext",

@@ -71,6 +71,18 @@ class ByteArrayData:
         arange(total) - repeat(out_starts) + repeat(src_starts).
         """
         indices = np.asarray(indices, dtype=np.int64)
+        if _ext is not None and len(indices):
+            # one C pass, ONE uninitialized output allocation (offsets,
+            # lengths, bounds checks and the gather all inside); ~2x the
+            # ctypes route, which pays a memset + an extra result copy
+            off_b, data = _ext.take_bytes(
+                self.data,
+                np.ascontiguousarray(self.offsets, dtype=np.int64),
+                np.ascontiguousarray(indices),
+            )
+            return ByteArrayData(
+                offsets=np.frombuffer(off_b, dtype=np.int64), data=data
+            )
         if len(indices) and (
             int(indices.min()) < 0 or int(indices.max()) >= len(self)
         ):

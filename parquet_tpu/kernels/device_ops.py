@@ -225,17 +225,21 @@ def delta_packed_decode_device(
     return jax.lax.bitcast_convert_type(vals, jnp.int64)
 
 
-@partial(jax.jit, static_argnames=("num_values",))
+@jax.jit
+def _bss_transpose_padded(streams: jnp.ndarray) -> jnp.ndarray:
+    m = streams.transpose()  # (n_pad, 4) uint8, one value per row
+    return jax.lax.bitcast_convert_type(m, jnp.uint32)
+
+
 def bss_transpose_device(streams: jnp.ndarray, num_values: int) -> jnp.ndarray:
     """BYTE_STREAM_SPLIT de-interleave ON DEVICE for 4-byte types: the
     page's 4 byte streams arrive as a (4, n_pad) uint8 array (each row one
-    stream, bucket-padded so page shapes reuse compilations); a transpose
-    + one bitcast yields uint32 bit patterns (parquet-format Encodings.md
-    BYTE_STREAM_SPLIT; host analogue: ops/byte_stream_split.decode). The
-    transform compiles to a layout change — the host never strides over
-    the bytes."""
-    m = streams.transpose()  # (n_pad, 4) uint8, one value per row
-    return jax.lax.bitcast_convert_type(m, jnp.uint32)[:num_values]
+    stream, bucket-padded); a transpose + one bitcast yields uint32 bit
+    patterns (parquet-format Encodings.md BYTE_STREAM_SPLIT; host
+    analogue: ops/byte_stream_split.decode). The jitted part sees ONLY the
+    padded shape — pages with different non-null counts in the same bucket
+    share one compilation; the slice below is a device-side view."""
+    return _bss_transpose_padded(streams)[:num_values]
 
 
 @jax.jit

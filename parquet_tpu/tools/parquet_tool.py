@@ -78,11 +78,29 @@ def _parse_filters(specs):
     FIRST so a quoted value containing the word 'in' stays a value."""
     if not specs:
         return None
+
+    def find_outside_quotes(spec: str, token: str) -> int:
+        quote = None
+        i = 0
+        while i < len(spec):
+            ch = spec[i]
+            if quote:
+                if ch == quote:
+                    quote = None
+            elif ch in "'\"":
+                quote = ch
+            elif spec.startswith(token, i):
+                return i
+            i += 1
+        return -1
+
     out = []
     for spec in specs:
         for op in ("==", "!=", "<=", ">=", "<", ">"):
-            if f" {op} " in spec:
-                col, _, raw = spec.partition(f" {op} ")
+            k = find_outside_quotes(spec, f" {op} ")
+            if k >= 0:
+                col = spec[:k]
+                raw = spec[k + len(op) + 2 :]
                 out.append((col.strip(), op, _coerce(raw)))
                 break
         else:

@@ -246,6 +246,10 @@ class TestToolPageIndexBloom:
             ("msg", "==", "logged in now")
         ]
         assert _parse_filters(["a not_in (1, 2)"]) == [("a", "not_in", [1, 2])]
+        # a quoted set MEMBER containing a comparison token stays a member
+        assert _parse_filters(["tag in ('a == b', 'x')"]) == [
+            ("tag", "in", ["a == b", "x"])
+        ]
 
     def test_quoted_filter_value_stays_string(self, tmp_path, capsys):
         path = str(tmp_path / "numstr.parquet")
