@@ -29,7 +29,7 @@ import numpy as np
 from ..meta.file_meta import ParquetFileError
 from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
 
-__all__ = ["build_top_field", "nested_arrow_type"]
+__all__ = ["build_top_field", "nested_arrow_type", "retype_leaf"]
 
 
 class _LeafState:
@@ -80,7 +80,9 @@ def _is_map_annotated(node) -> bool:
     )
 
 
-def _leaf_arrow_type(pa, leaf):
+def _leaf_storage_type(pa, leaf):
+    """The Arrow type of the STORAGE array the builders produce (physical
+    parquet layout, before logical-type conversion)."""
     if leaf.type == Type.BYTE_ARRAY:
         return pa.large_string() if leaf.is_string() else pa.large_binary()
     if leaf.type in (Type.FIXED_LEN_BYTE_ARRAY, Type.INT96):
@@ -92,6 +94,153 @@ def _leaf_arrow_type(pa, leaf):
         Type.DOUBLE: pa.float64(),
         Type.BOOLEAN: pa.bool_(),
     }[leaf.type]
+
+
+def _logical_target(pa, leaf):
+    """The FINAL Arrow type the leaf's logical/converted annotation maps to
+    (pyarrow.parquet.read_table's convention), or None when the storage
+    type IS the final type (strings, plain numerics, unannotated binary)."""
+    t = leaf.type
+    if t == Type.INT96:
+        return pa.timestamp("ns")  # Impala/Hive timestamps; pyarrow: ns
+    lt = leaf.logical_type
+    ct = leaf.converted_type
+    if lt is not None:
+        if lt.TIMESTAMP is not None and t == Type.INT64:
+            u = lt.TIMESTAMP.unit
+            unit = (
+                "ms" if u and u.MILLIS is not None
+                else "ns" if u and u.NANOS is not None
+                else "us"
+            )
+            tz = "UTC" if lt.TIMESTAMP.isAdjustedToUTC else None
+            return pa.timestamp(unit, tz=tz)
+        if lt.TIME is not None:
+            u = lt.TIME.unit
+            if u is not None and u.MILLIS is not None and t == Type.INT32:
+                return pa.time32("ms")
+            if t == Type.INT64:
+                return pa.time64(
+                    "ns" if u is not None and u.NANOS is not None else "us"
+                )
+            return None
+        if lt.DATE is not None and t == Type.INT32:
+            return pa.date32()
+        if lt.DECIMAL is not None:
+            return _decimal_type(pa, leaf, lt.DECIMAL.precision, lt.DECIMAL.scale)
+        if lt.INTEGER is not None:
+            return _int_arrow_type(pa, lt.INTEGER.bitWidth, bool(lt.INTEGER.isSigned))
+        if lt.FLOAT16 is not None and t == Type.FIXED_LEN_BYTE_ARRAY:
+            return pa.float16()
+        return None
+    if ct is None:
+        return None
+    if ct == ConvertedType.DATE and t == Type.INT32:
+        return pa.date32()
+    if ct == ConvertedType.TIME_MILLIS and t == Type.INT32:
+        return pa.time32("ms")
+    if ct == ConvertedType.TIME_MICROS and t == Type.INT64:
+        return pa.time64("us")
+    if ct == ConvertedType.TIMESTAMP_MILLIS and t == Type.INT64:
+        return pa.timestamp("ms")
+    if ct == ConvertedType.TIMESTAMP_MICROS and t == Type.INT64:
+        return pa.timestamp("us")
+    if ct == ConvertedType.DECIMAL:
+        el = leaf.element
+        return _decimal_type(pa, leaf, el.precision, el.scale)
+    ints = {
+        # INT_32/INT_64 omitted: identity with the storage type
+        ConvertedType.UINT_8: (8, False), ConvertedType.UINT_16: (16, False),
+        ConvertedType.UINT_32: (32, False), ConvertedType.UINT_64: (64, False),
+        ConvertedType.INT_8: (8, True), ConvertedType.INT_16: (16, True),
+    }
+    if ct in ints:
+        return _int_arrow_type(pa, *ints[ct])
+    return None
+
+
+def _int_arrow_type(pa, bit_width, signed: bool):
+    m = {
+        (8, True): pa.int8, (16, True): pa.int16,
+        (32, True): pa.int32, (64, True): pa.int64,
+        (8, False): pa.uint8, (16, False): pa.uint16,
+        (32, False): pa.uint32, (64, False): pa.uint64,
+    }
+    f = m.get((bit_width, signed))
+    return f() if f is not None else None
+
+
+def _decimal_type(pa, leaf, precision, scale):
+    if precision is None or not 1 <= precision <= 38:
+        return None  # decimal256 territory / malformed: keep storage
+    if leaf.type in (Type.INT32, Type.INT64):
+        return pa.decimal128(precision, scale or 0)
+    if leaf.type == Type.FIXED_LEN_BYTE_ARRAY and (leaf.type_length or 0) <= 16:
+        return pa.decimal128(precision, scale or 0)
+    return None  # BYTE_ARRAY-backed decimals: keep raw bytes
+
+
+def _leaf_arrow_type(pa, leaf):
+    """The FINAL Arrow type for a leaf (logical conversion applied)."""
+    return _logical_target(pa, leaf) or _leaf_storage_type(pa, leaf)
+
+
+def retype_leaf(pa, leaf, arr):
+    """Convert a STORAGE array to the leaf's final Arrow type: zero-copy
+    view() where widths agree (timestamps, date32, time, uint32/64,
+    float16), compute cast for narrowing ints, and buffer rebuilds for
+    decimal128 and INT96->timestamp[ns]. Mirrors pyarrow.read_table's
+    logical-type handling so a pyarrow user sees the same schema."""
+    ft = _logical_target(pa, leaf)
+    if ft is None or arr.type == ft:
+        return arr
+    if arr.offset != 0:  # rebase so raw-buffer math below is position 0
+        arr = pa.concat_arrays([arr])
+    if pa.types.is_decimal(ft):
+        return _to_decimal128(pa, leaf, arr, ft)
+    if leaf.type == Type.INT96:
+        return _int96_to_timestamp(pa, arr, ft)
+    bw = {pa.int8(): 8, pa.int16(): 16, pa.uint8(): 8, pa.uint16(): 16}
+    if ft in bw:
+        return arr.cast(ft)  # narrowing: values fit by construction
+    return arr.view(ft)  # same-width reinterpretation, zero-copy
+
+
+def _validity(arr):
+    bufs = arr.buffers()
+    return bufs[0] if bufs else None
+
+
+def _to_decimal128(pa, leaf, arr, ft):
+    n = len(arr)
+    out = np.zeros((n, 16), dtype=np.uint8)
+    if leaf.type in (Type.INT32, Type.INT64):
+        npdt = np.int32 if leaf.type == Type.INT32 else np.int64
+        v = np.frombuffer(arr.buffers()[1], dtype=npdt, count=n).astype(np.int64)
+        lohi = out.view(np.int64).reshape(n, 2)
+        lohi[:, 0] = v
+        lohi[:, 1] = v >> 63  # sign extension
+    else:  # FLBA big-endian two's complement, width <= 16
+        w = leaf.type_length or 0
+        m = np.frombuffer(arr.buffers()[1], dtype=np.uint8, count=n * w).reshape(n, w)
+        out[:, :w] = m[:, ::-1]  # BE -> LE
+        out[m[:, 0] >= 0x80, w:] = 0xFF
+    return pa.Array.from_buffers(
+        ft, n, [_validity(arr), pa.py_buffer(out)], null_count=arr.null_count
+    )
+
+
+def _int96_to_timestamp(pa, arr, ft):
+    n = len(arr)
+    m = np.frombuffer(arr.buffers()[1], dtype=np.uint8, count=n * 12).reshape(n, 12)
+    nanos = np.ascontiguousarray(m[:, :8]).view("<u8").reshape(n)
+    days = np.ascontiguousarray(m[:, 8:12]).view("<u4").reshape(n)
+    ns = (days.astype(np.int64) - 2440588) * 86_400_000_000_000 + nanos.astype(
+        np.int64
+    )
+    return pa.Array.from_buffers(
+        ft, n, [_validity(arr), pa.py_buffer(ns)], null_count=arr.null_count
+    )
 
 
 def nested_arrow_type(pa, node, selected=None):
@@ -385,7 +534,7 @@ def _leaf_array(pa, leaf, leaves, state, n_slots):
         ]
         return pa.Array.from_buffers(
             atype, n_slots, bufs, null_count=int(mask.sum()) if mask is not None else 0
-        )
+        )  # byte-array leaves have no logical retype (BYTE_ARRAY decimals stay raw)
 
     np_vals = np.asarray(values)
     if np_vals.ndim == 2:  # FLBA / INT96 byte rows
@@ -393,14 +542,16 @@ def _leaf_array(pa, leaf, leaves, state, n_slots):
         dense = np_vals[k0 : k0 + nv]
         if mask is None:
             flat = np.ascontiguousarray(dense).reshape(-1)
-            return pa.Array.from_buffers(atype, n_slots, [None, pa.py_buffer(flat)])
-        it = iter(dense)
-        rows = [bytes(next(it)) if ok else None for ok in valid]
-        return pa.array(rows, atype)
+            built = pa.Array.from_buffers(atype, n_slots, [None, pa.py_buffer(flat)])
+        else:
+            it = iter(dense)
+            rows = [bytes(next(it)) if ok else None for ok in valid]
+            built = pa.array(rows, atype)
+        return retype_leaf(pa, leaf, built)
 
     dense = np_vals[k0 : k0 + nv]
     if mask is None:
-        return pa.array(dense)
+        return retype_leaf(pa, leaf, pa.array(dense))
     out = np.zeros(n_slots, dtype=np_vals.dtype)
     out[valid] = dense
-    return pa.array(out, mask=mask)
+    return retype_leaf(pa, leaf, pa.array(out, mask=mask))

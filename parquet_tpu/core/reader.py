@@ -1231,7 +1231,7 @@ class FileReader:
         import pyarrow as pa
 
         from ..meta.parquet_types import Type
-        from .arrow_nested import build_top_field, nested_arrow_type
+        from .arrow_nested import build_top_field, nested_arrow_type, retype_leaf
         from .arrays import ByteArrayData
 
         def _fast_kind(paths):
@@ -1341,7 +1341,7 @@ class FileReader:
                         arr = pa.array(expanded, mask=mask)
                     else:
                         arr = pa.array(np_vals)
-                cols[path[0]] = arr
+                cols[path[0]] = retype_leaf(pa, leaf, arr)
             if names is None:
                 names = list(cols)
             per_group.append(cols)
@@ -1447,6 +1447,9 @@ class FileReader:
                 expanded = np.zeros(n_slots, dtype=npv.dtype)
                 expanded[elem_valid] = npv
                 elem = pa.array(expanded, mask=~elem_valid)
+        from .arrow_nested import retype_leaf
+
+        elem = retype_leaf(pa, leaf, elem)
         offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
         np.cumsum(lengths, out=offsets[1:])
         if row_null.any():

@@ -1,0 +1,188 @@
+"""to_arrow logical-type fidelity: the Arrow types pyarrow.read_table gives
+its users (timestamp/date/time/decimal128/uint*/float16, INT96->ns) must
+come out of our to_arrow too — flat, in lists, and inside structs — with
+equal values. Reference analogue: the reference converts logical types in
+its row model (reference: helpers.go time conversions, schema.go); the
+columnar lane must not lose them."""
+
+import datetime as dt
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import FileReader, FileWriter, parse_schema
+
+BACKENDS = ["host", "tpu_roundtrip"]
+
+
+def _norm(t):
+    """Collapse our large_* container convention for type comparison; the
+    LEAF types (the logical conversions under test) stay exact."""
+    if pa.types.is_large_list(t) or pa.types.is_list(t):
+        return pa.list_(_norm(t.value_type))
+    if pa.types.is_large_string(t):
+        return pa.string()
+    if pa.types.is_large_binary(t):
+        return pa.binary()
+    if pa.types.is_struct(t):
+        return pa.struct([pa.field(f.name, _norm(f.type), f.nullable) for f in t])
+    if pa.types.is_map(t):
+        return pa.map_(_norm(t.key_type), _norm(t.item_type))
+    return t
+
+
+def _cmp(path, backend, cols=None):
+    want = pq.read_table(path)
+    with FileReader(path, backend=backend) as r:
+        out = r.to_arrow(columns=cols)
+    for name in want.column_names if cols is None else cols:
+        w = want.column(name)
+        g = out.column(name)
+        assert _norm(g.type) == _norm(w.type), (name, g.type, w.type)
+        assert g.to_pylist() == w.to_pylist(), name
+    return out
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestFlatLogicalTypes:
+    def test_temporal_and_ints(self, tmp_path, backend):
+        n = 3_000
+        rng = np.random.default_rng(1)
+        base = dt.datetime(2020, 1, 2, 3, 4, 5, 123456)
+        t = pa.table({
+            "ts_us": pa.array(
+                [None if i % 11 == 0 else base + dt.timedelta(seconds=int(s))
+                 for i, s in enumerate(rng.integers(0, 10**6, n))],
+                pa.timestamp("us"),
+            ),
+            "ts_tz": pa.array(
+                [base.replace(tzinfo=dt.timezone.utc)] * n, pa.timestamp("us", tz="UTC")
+            ),
+            "ts_ms": pa.array([base] * n, pa.timestamp("ms")),
+            "ts_ns": pa.array([base] * n, pa.timestamp("ns")),
+            "d": pa.array(
+                [None if i % 7 == 0 else dt.date(2021, 1, 1) + dt.timedelta(int(x))
+                 for i, x in enumerate(rng.integers(0, 3000, n))],
+                pa.date32(),
+            ),
+            "t32": pa.array([dt.time(1, 2, 3, 5000)] * n, pa.time32("ms")),
+            "t64": pa.array([dt.time(23, 59, 59, 999999)] * n, pa.time64("us")),
+            "u8": pa.array(rng.integers(0, 256, n), pa.uint8()),
+            "u16": pa.array(rng.integers(0, 1 << 16, n), pa.uint16()),
+            "u32": pa.array(rng.integers(0, 1 << 32, n, dtype=np.uint64), pa.uint32()),
+            "u64": pa.array(
+                rng.integers(0, 1 << 63, n, dtype=np.uint64) * 2 + 1, pa.uint64()
+            ),
+            "i8": pa.array(rng.integers(-128, 128, n), pa.int8()),
+            "i16": pa.array(rng.integers(-(1 << 15), 1 << 15, n), pa.int16()),
+        })
+        p = str(tmp_path / "tl.parquet")
+        pq.write_table(t, p)
+        _cmp(p, backend)
+
+    def test_decimals(self, tmp_path, backend):
+        vals = [
+            decimal.Decimal("123.45"), None, decimal.Decimal("-0.01"),
+            decimal.Decimal("99999.99"), decimal.Decimal("-99999.99"),
+        ] * 50
+        t = pa.table({
+            "d32": pa.array(vals, pa.decimal128(7, 2)),     # int32-backed
+            "d64": pa.array(vals, pa.decimal128(15, 2)),    # int64-backed
+            "dbig": pa.array(
+                [None if v is None else v * 10**15 for v in vals],
+                pa.decimal128(35, 2),                       # FLBA-backed
+            ),
+        })
+        p = str(tmp_path / "dec.parquet")
+        pq.write_table(t, p)
+        _cmp(p, backend)
+
+    def test_float16(self, tmp_path, backend):
+        arr = np.array([0.5, -2.0, 65504.0, 0.0], np.float16)
+        t = pa.table({"h": pa.array(arr, pa.float16())})
+        p = str(tmp_path / "f16.parquet")
+        pq.write_table(t, p)
+        _cmp(p, backend)
+
+    def test_int96_timestamps(self, tmp_path, backend):
+        """INT96 (Impala convention) -> timestamp[ns], matching pyarrow."""
+        schema = parse_schema("message m { required int96 ts; }")
+        base = dt.datetime(2001, 2, 3, 4, 5, 6, 789123, tzinfo=dt.timezone.utc)
+        rows = [
+            {"ts": base + dt.timedelta(seconds=int(s))}
+            for s in np.random.default_rng(2).integers(0, 10**7, 500)
+        ]
+        p = str(tmp_path / "i96.parquet")
+        with FileWriter(p, schema, codec="snappy") as w:
+            w.write_rows(rows)
+        out = _cmp(p, backend)
+        assert out.column("ts").type == pa.timestamp("ns")
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestNestedLogicalTypes:
+    def test_lists_of_logical(self, tmp_path, backend):
+        t = pa.table({
+            "ld": pa.array(
+                [[dt.date(2020, 1, 1), None], None, [dt.date(1999, 12, 31)]],
+                pa.list_(pa.date32()),
+            ),
+            "lu": pa.array(
+                [[1, 2], [], [2**63 + 5]], pa.list_(pa.uint64())
+            ),
+            "lts": pa.array(
+                [[dt.datetime(2020, 5, 6, 7, 8, 9)], None, []],
+                pa.list_(pa.timestamp("us")),
+            ),
+        })
+        p = str(tmp_path / "ll.parquet")
+        pq.write_table(t, p)
+        _cmp(p, backend)
+
+    def test_struct_with_logical_members(self, tmp_path, backend):
+        st = pa.struct([
+            ("when", pa.timestamp("ms")),
+            ("amount", pa.decimal128(10, 2)),
+            ("day", pa.date32()),
+        ])
+        t = pa.table({
+            "s": pa.array(
+                [
+                    {"when": dt.datetime(2022, 3, 4, 5, 6), "amount": decimal.Decimal("12.34"), "day": dt.date(2022, 3, 4)},
+                    None,
+                    {"when": None, "amount": None, "day": None},
+                ],
+                st,
+            ),
+        })
+        p = str(tmp_path / "slog.parquet")
+        pq.write_table(t, p)
+        _cmp(p, backend)
+
+    def test_map_with_logical_values(self, tmp_path, backend):
+        t = pa.table({
+            "m": pa.array(
+                [[("a", dt.date(2020, 2, 2))], None, []],
+                pa.map_(pa.string(), pa.date32()),
+            ),
+        })
+        p = str(tmp_path / "mlog.parquet")
+        pq.write_table(t, p)
+        _cmp(p, backend)
+
+    def test_zero_group_schema_matches(self, tmp_path, backend):
+        t = pa.table({
+            "ts": pa.array([dt.datetime(2020, 1, 1)], pa.timestamp("us")),
+            "ld": pa.array([[dt.date(2020, 1, 1)]], pa.list_(pa.date32())),
+            "dec": pa.array([decimal.Decimal("1.5")], pa.decimal128(6, 1)),
+        })
+        p = str(tmp_path / "zg.parquet")
+        pq.write_table(t, p)
+        with FileReader(p, backend=backend) as r:
+            full = r.to_arrow()
+            empty = r.to_arrow(row_groups=[])
+        for name in t.column_names:
+            assert empty.column(name).type == full.column(name).type, name
