@@ -172,10 +172,12 @@ def _int_arrow_type(pa, bit_width, signed: bool):
 
 def _decimal_type(pa, leaf, precision, scale):
     if precision is None or not 1 <= precision <= 38:
-        return None  # decimal256 territory / malformed: keep storage
+        return None  # >38 needs decimal256; malformed: keep storage
     if leaf.type in (Type.INT32, Type.INT64):
         return pa.decimal128(precision, scale or 0)
-    if leaf.type == Type.FIXED_LEN_BYTE_ARRAY and (leaf.type_length or 0) <= 16:
+    if leaf.type == Type.FIXED_LEN_BYTE_ARRAY and 1 <= (leaf.type_length or 0) <= 16:
+        # pyarrow's own bound: FromBigEndian accepts 1..16 bytes; wider
+        # FLBA decimals error in pyarrow, so they stay raw binary here
         return pa.decimal128(precision, scale or 0)
     return None  # BYTE_ARRAY-backed decimals: keep raw bytes
 
@@ -220,7 +222,7 @@ def _to_decimal128(pa, leaf, arr, ft):
         lohi = out.view(np.int64).reshape(n, 2)
         lohi[:, 0] = v
         lohi[:, 1] = v >> 63  # sign extension
-    else:  # FLBA big-endian two's complement, width <= 16
+    else:  # FLBA big-endian two's complement, width 1..16 (_decimal_type)
         w = leaf.type_length or 0
         m = np.frombuffer(arr.buffers()[1], dtype=np.uint8, count=n * w).reshape(n, w)
         out[:, :w] = m[:, ::-1]  # BE -> LE

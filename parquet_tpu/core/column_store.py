@@ -197,6 +197,71 @@ class ColumnChunkBuilder:
             return np.frombuffer(
                 v.buffers()[1], dtype=dt, count=len(v), offset=v.offset * width
             )
+        if pa.types.is_decimal(t) and t.bit_width == 128:
+            # unscaled 128-bit LE two's complement -> the column's physical
+            # storage (the reverse of to_arrow's decimal128 widening). The
+            # array's scale must MATCH the column's declared scale (raw
+            # unscaled ints would silently rescale every value otherwise),
+            # and every value must FIT the narrower storage — same exact
+            # round-trip discipline as the numeric path below.
+            decl_scale = self.column.element.scale
+            lt = self.column.logical_type
+            if lt is not None and lt.DECIMAL is not None:
+                decl_scale = lt.DECIMAL.scale
+            if decl_scale is not None and t.scale != decl_scale:
+                raise StoreError(
+                    f"store: decimal scale mismatch for "
+                    f"{self.column.path_str}: array has scale {t.scale}, "
+                    f"column declares {decl_scale}"
+                )
+            n = len(v)
+            m = np.frombuffer(
+                v.buffers()[1], dtype=np.uint8, count=n * 16, offset=v.offset * 16
+            ).reshape(n, 16)
+            ptype = self.column.type
+            if ptype in (Type.INT32, Type.INT64):
+                lohi = np.ascontiguousarray(m).view("<i8").reshape(n, 2)
+                lo = lohi[:, 0]
+                if not bool((lohi[:, 1] == (lo >> 63)).all()):
+                    raise StoreError(
+                        f"store: decimal value does not fit 64-bit storage "
+                        f"of {self.column.path_str}"
+                    )
+                if ptype == Type.INT64:
+                    return lo.copy()
+                lo32 = lo.astype(np.int32)
+                if not bool((lo32 == lo).all()):
+                    raise StoreError(
+                        f"store: decimal value does not fit INT32 storage "
+                        f"of {self.column.path_str}"
+                    )
+                return lo32
+            if ptype == Type.FIXED_LEN_BYTE_ARRAY:
+                w = self.column.type_length or 0
+                if 1 <= w <= 16:
+                    if w < 16:
+                        # dropped high bytes must be pure sign extension
+                        sign = np.where(m[:, w - 1] >= 0x80, 0xFF, 0).astype(
+                            np.uint8
+                        )
+                        if not bool(
+                            (m[:, w:] == sign[:, None]).all()
+                        ):
+                            raise StoreError(
+                                f"store: decimal value does not fit "
+                                f"{w}-byte storage of {self.column.path_str}"
+                            )
+                    return np.ascontiguousarray(m[:, :w][:, ::-1])  # LE -> BE
+                if w > 16:
+                    out = np.zeros((n, w), dtype=np.uint8)
+                    out[:, w - 16 :] = m[:, ::-1]
+                    out[m[:, 15] >= 0x80, : w - 16] = 0xFF  # sign fill
+                    return out
+        if t == pa.float16():
+            n = len(v)
+            return np.frombuffer(
+                v.buffers()[1], dtype=np.uint8, count=n * 2, offset=v.offset * 2
+            ).reshape(n, 2)
         try:
             return v.to_numpy(zero_copy_only=True)
         except Exception as e:

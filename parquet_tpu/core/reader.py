@@ -1385,6 +1385,7 @@ class FileReader:
         lengths and validity from the levels (the same derivation as ragged
         device batches), element array from the dense non-null cells."""
         from ..meta.parquet_types import FieldRepetitionType, Type
+        from .arrow_nested import retype_leaf
         from .arrays import ByteArrayData
 
         top = self.schema.column((path[0],))
@@ -1447,8 +1448,6 @@ class FileReader:
                 expanded = np.zeros(n_slots, dtype=npv.dtype)
                 expanded[elem_valid] = npv
                 elem = pa.array(expanded, mask=~elem_valid)
-        from .arrow_nested import retype_leaf
-
         elem = retype_leaf(pa, leaf, elem)
         offsets = np.zeros(len(lengths) + 1, dtype=np.int64)
         np.cumsum(lengths, out=offsets[1:])

@@ -45,6 +45,7 @@ from ..meta.parquet_types import (
     BsonType,
     DateType,
     EnumType,
+    Float16Type,
     JsonType,
     UUIDType,
 )
@@ -92,6 +93,7 @@ _SIMPLE_ANNOTATIONS = {
     "BSON": (ConvertedType.BSON, lambda: LogicalType(BSON=BsonType())),
     "DATE": (ConvertedType.DATE, lambda: LogicalType(DATE=DateType())),
     "UUID": (None, lambda: LogicalType(UUID=UUIDType())),
+    "FLOAT16": (None, lambda: LogicalType(FLOAT16=Float16Type())),
     "MAP": (ConvertedType.MAP, lambda: LogicalType(MAP=MapType())),
     "LIST": (ConvertedType.LIST, lambda: LogicalType(LIST=ListType())),
     "MAP_KEY_VALUE": (ConvertedType.MAP_KEY_VALUE, lambda: None),
