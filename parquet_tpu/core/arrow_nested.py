@@ -130,7 +130,11 @@ def _logical_target(pa, leaf):
             return _decimal_type(pa, leaf, lt.DECIMAL.precision, lt.DECIMAL.scale)
         if lt.INTEGER is not None:
             return _int_arrow_type(pa, lt.INTEGER.bitWidth, bool(lt.INTEGER.isSigned))
-        if lt.FLOAT16 is not None and t == Type.FIXED_LEN_BYTE_ARRAY:
+        if (
+            lt.FLOAT16 is not None
+            and t == Type.FIXED_LEN_BYTE_ARRAY
+            and leaf.type_length == 2  # spec-invalid widths stay raw binary
+        ):
             return pa.float16()
         return None
     if ct is None:

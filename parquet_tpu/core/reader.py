@@ -1217,7 +1217,7 @@ class FileReader:
 
         return itertools.chain.from_iterable(windows())
 
-    def to_arrow(self, row_groups=None, columns=None):
+    def to_arrow(self, row_groups=None, columns=None, filters=None):
         """Decoded columns as a pyarrow.Table. Flat leaves (numerics,
         booleans, strings/binary, FLBA) and canonical single-level LIST
         columns take zero-copy fast paths; every deeper shape — structs,
@@ -1227,7 +1227,15 @@ class FileReader:
         reference's full nested read surface (reference schema.go:216-312,
         floor/reader.go:302-409). The reverse of write_column's arrow
         ingest: a pyarrow user can hand columns either way without a
-        rewrite."""
+        rewrite.
+
+        `filters` mirrors pyarrow.parquet.read_table's: a flat list of
+        (column, op, value) triples (a conjunction) or a list of lists
+        (an OR of conjunctions). Row groups that statistics/bloom exclude
+        are never decoded; surviving rows are filtered EXACTLY. Filter
+        columns outside the projection still apply, then drop."""
+        if filters is not None:
+            return self._to_arrow_filtered(row_groups, columns, filters)
         import pyarrow as pa
 
         from ..meta.parquet_types import Type
@@ -1353,6 +1361,71 @@ class FileReader:
             pa.chunked_array([g[name] for g in per_group]) for name in names
         ]
         return pa.table(dict(zip(names, arrays)))
+
+    def _to_arrow_filtered(self, row_groups, columns, filters):
+        """Pruned + exactly-filtered columnar read (to_arrow's filters=).
+
+        The row mask evaluates over a SEPARATE read of just the filter
+        leaves, so a predicate on a projected-out column — even a nested
+        sibling leaf — filters without leaking into the output schema
+        (leaf-granular, like iter_rows' strips)."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        from .filter import FilterError, dnf_group_may_match, normalize_dnf
+
+        dnf = normalize_dnf(self.schema, filters)
+        indices = [
+            i
+            for i in (
+                range(self.num_row_groups) if row_groups is None else row_groups
+            )
+            if dnf_group_may_match(self.row_group(i), dnf, self._bloom_excludes, i)
+        ]
+        table = self.to_arrow(row_groups=indices, columns=columns)
+        if not dnf or any(not conj for conj in dnf) or table.num_rows == 0:
+            return table  # an empty conjunction is vacuously true
+        fpaths = sorted({p for conj in dnf for p, *_ in conj})
+        ftab = self.to_arrow(row_groups=indices, columns=fpaths)
+
+        def leaf_col(path):
+            arr = ftab.column(path[0]).combine_chunks()
+            if len(path) > 1:
+                arr = pc.struct_field(arr, list(path[1:]))
+            return arr
+
+        try:
+            mask = None
+            for conj in dnf:
+                m = None
+                for path, _leaf, op, rv, _lo, _hi in conj:
+                    arr = leaf_col(path)
+                    if op == "is_null":
+                        p = pc.is_null(arr)
+                    elif op == "not_null":
+                        p = pc.is_valid(arr)
+                    elif op == "in":
+                        p = pc.is_in(arr, value_set=pa.array(list(rv)))
+                    elif op == "not_in":
+                        p = pc.invert(
+                            pc.is_in(arr, value_set=pa.array(list(rv)))
+                        )
+                    else:
+                        p = {
+                            "==": pc.equal, "!=": pc.not_equal,
+                            "<": pc.less, "<=": pc.less_equal,
+                            ">": pc.greater, ">=": pc.greater_equal,
+                        }[op](arr, rv)
+                    m = p if m is None else pc.and_kleene(m, p)
+                mask = m if mask is None else pc.or_kleene(mask, m)
+        except (pa.lib.ArrowInvalid, pa.lib.ArrowNotImplementedError,
+                TypeError) as err:  # literal pyarrow cannot compare
+            raise FilterError(
+                f"filter: cannot evaluate over arrow columns: {err}"
+            ) from err
+        # null mask entries mean "predicate unknown" -> row drops (pyarrow's
+        # expression-filter convention)
+        return table.filter(mask)
 
     def _is_canonical_list(self, path, leaf) -> bool:
         """True for the one list shape _arrow_list_column's level math

@@ -460,6 +460,11 @@ def _validate_leaf(col: Column) -> None:
     if lt is not None and lt.UUID is not None:
         if t != Type.FIXED_LEN_BYTE_ARRAY or col.type_length != 16:
             raise SchemaError(f"schema: {col.name}: UUID requires fixed_len_byte_array(16)")
+    if lt is not None and lt.FLOAT16 is not None:
+        if t != Type.FIXED_LEN_BYTE_ARRAY or col.type_length != 2:
+            raise SchemaError(
+                f"schema: {col.name}: FLOAT16 requires fixed_len_byte_array(2)"
+            )
     if lt is not None and lt.INTEGER is not None:
         bits = lt.INTEGER.bitWidth or 0
         want = Type.INT64 if bits == 64 else Type.INT32

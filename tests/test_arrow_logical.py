@@ -294,3 +294,91 @@ class TestWriteSideLogicalIngest:
         with FileReader(buf) as r:
             rows = list(r.iter_rows())
         assert [x["d"] for x in rows] == vals.to_pylist()
+
+
+class TestToArrowFilters:
+    """to_arrow(filters=...) mirrors pyarrow.parquet.read_table's filters:
+    pruned by statistics/bloom, then EXACT — equal rows to pyarrow on the
+    same predicate, including DNF (OR of ANDs), logical-typed literals,
+    set membership, and predicates on projected-out columns."""
+
+    def _file(self, tmp_path):
+        n = 50_000
+        rng = np.random.default_rng(9)
+        t = pa.table({
+            "id": pa.array(np.arange(n, dtype=np.int64)),
+            "cat": pa.array([f"c{i % 23}" for i in range(n)]),
+            "when": pa.array(
+                [dt.datetime(2024, 1, 1) + dt.timedelta(minutes=int(i)) for i in range(n)],
+                pa.timestamp("us"),
+            ),
+            "x": pa.array(rng.standard_normal(n)),
+        })
+        p = str(tmp_path / "f.parquet")
+        pq.write_table(t, p, row_group_size=8_000, compression="snappy")
+        return p
+
+    @pytest.mark.parametrize("flt", [
+        [("id", ">=", 49_000)],
+        [("cat", "==", "c7"), ("id", "<", 10_000)],
+        [[("id", "<", 100)], [("id", ">=", 49_900)]],           # OR of ANDs
+        [("cat", "in", ["c1", "c2"]), ("id", ">", 40_000)],
+        [("when", ">=", dt.datetime(2024, 1, 30))],             # logical literal
+        [("id", "<", 0)],                                       # empty result
+    ])
+    def test_matches_pyarrow(self, tmp_path, flt):
+        p = self._file(tmp_path)
+        want = pq.read_table(p, filters=flt)
+        with FileReader(p) as r:
+            got = r.to_arrow(filters=flt)
+        assert got.num_rows == want.num_rows, flt
+        for c in want.column_names:
+            assert got.column(c).to_pylist() == want.column(c).to_pylist(), (flt, c)
+
+    def test_filter_on_projected_out_column(self, tmp_path):
+        p = self._file(tmp_path)
+        want = pq.read_table(p, columns=["id"], filters=[("cat", "==", "c3")])
+        with FileReader(p) as r:
+            got = r.to_arrow(columns=["id"], filters=[("cat", "==", "c3")])
+        assert got.column_names == ["id"]
+        assert got.column("id").to_pylist() == want.column("id").to_pylist()
+
+    def test_bad_filter_column_raises(self, tmp_path):
+        from parquet_tpu.core.filter import FilterError
+
+        p = self._file(tmp_path)
+        with FileReader(p) as r:
+            with pytest.raises(FilterError):
+                r.to_arrow(filters=[("nope", "==", 1)])
+
+    def test_nested_filter_column_does_not_leak(self, tmp_path):
+        """Review regression: a predicate on a projected-out NESTED sibling
+        leaf filters without appearing in the output struct."""
+        t = pa.table({
+            "g": pa.array(
+                [{"b": 1, "c": 10}, {"b": 2, "c": 20}, {"b": 3, "c": 30}],
+                pa.struct([("b", pa.int64()), ("c", pa.int64())]),
+            ),
+        })
+        p = str(tmp_path / "nf.parquet")
+        pq.write_table(t, p)
+        with FileReader(p) as r:
+            got = r.to_arrow(columns=["g.b"], filters=[("g.c", "==", 20)])
+        assert got.column("g").to_pylist() == [{"b": 2}]
+
+    def test_empty_filters_vacuously_true(self, tmp_path):
+        p = self._file(tmp_path)
+        with FileReader(p) as r:
+            got = r.to_arrow(filters=[])
+            assert got.num_rows == 50_000
+
+    def test_null_aware_filtering(self, tmp_path):
+        t = pa.table({"x": pa.array([1, None, 3, None, 5], pa.int64())})
+        p = str(tmp_path / "nulls.parquet")
+        pq.write_table(t, p)
+        want = pq.read_table(p, filters=[("x", ">", 1)])
+        with FileReader(p) as r:
+            got = r.to_arrow(filters=[("x", ">", 1)])
+            nulls = r.to_arrow(filters=[("x", "is_null")])
+        assert got.column("x").to_pylist() == want.column("x").to_pylist()
+        assert nulls.column("x").to_pylist() == [None, None]
