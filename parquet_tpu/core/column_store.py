@@ -38,6 +38,11 @@ _NUMERIC = {
     Type.DOUBLE: np.float64,
 }
 
+_TYPE_WIDTHS = {
+    Type.INT32: 4, Type.INT64: 8, Type.FLOAT: 4, Type.DOUBLE: 8,
+    Type.BOOLEAN: 1, Type.INT96: 12,
+}
+
 
 class ColumnChunkBuilder:
     """Buffers one column's values + levels for the current row group."""
@@ -57,6 +62,33 @@ class ColumnChunkBuilder:
         if self._columnar_values is not None:
             return len(self._columnar_values)
         return len(self.values)
+
+    def data_size(self) -> int:
+        """Rough UNCOMPRESSED byte size of the buffered values + levels
+        (reference: data_store.go DataSize via file_writer.go:355
+        CurrentRowGroupSize) — the signal callers use for size-based
+        row-group flushing; encoding/compression usually shrink it."""
+        n = len(self)
+        size = n * 2 * (
+            (self.column.max_def > 0) + (self.column.max_rep > 0)
+        )
+        v = self._columnar_values
+        if v is not None:
+            if isinstance(v, ByteArrayData):
+                return size + len(v.data) + 4 * len(v)
+            nb = getattr(v, "nbytes", None)
+            if nb is not None:
+                return size + int(nb)
+            v = list(v)
+        else:
+            v = self.values
+        if not v:
+            return size
+        first = v[0]
+        if isinstance(first, (bytes, str)):
+            return size + sum(len(x) for x in v) + 4 * len(v)
+        width = self.column.type_length or _TYPE_WIDTHS.get(self.column.type, 8)
+        return size + len(v) * width
 
     # -- ingestion -------------------------------------------------------------
 

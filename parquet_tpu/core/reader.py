@@ -1385,11 +1385,23 @@ class FileReader:
         table = self.to_arrow(row_groups=indices, columns=columns)
         if not dnf or any(not conj for conj in dnf) or table.num_rows == 0:
             return table  # an empty conjunction is vacuously true
+        # flat top-level filter columns already in the projection evaluate
+        # straight off `table`; only projected-out or nested paths pay a
+        # second (filter-leaves-only) read
+        sel = self._resolve_columns(columns) if columns else self._selected
         fpaths = sorted({p for conj in dnf for p, *_ in conj})
-        ftab = self.to_arrow(row_groups=indices, columns=fpaths)
+        extra = [
+            p
+            for p in fpaths
+            if len(p) > 1 or (sel is not None and p not in sel)
+        ]
+        ftab = (
+            self.to_arrow(row_groups=indices, columns=extra) if extra else None
+        )
 
         def leaf_col(path):
-            arr = ftab.column(path[0]).combine_chunks()
+            src = ftab if path in extra or len(path) > 1 else table
+            arr = src.column(path[0]).combine_chunks()
             if len(path) > 1:
                 arr = pc.struct_field(arr, list(path[1:]))
             return arr
@@ -1423,8 +1435,11 @@ class FileReader:
             raise FilterError(
                 f"filter: cannot evaluate over arrow columns: {err}"
             ) from err
-        # null mask entries mean "predicate unknown" -> row drops (pyarrow's
-        # expression-filter convention)
+        # Null handling mirrors pyarrow.parquet.read_table exactly: a null
+        # comparison yields a null mask entry (dropped), EXCEPT not_in —
+        # pc.is_in maps null to false, so invert KEEPS null rows (pyarrow's
+        # convention). iter_rows' row predicate instead fails every op on
+        # null (SQL-ish); the difference is pinned by tests.
         return table.filter(mask)
 
     def _is_canonical_list(self, path, leaf) -> bool:

@@ -888,6 +888,17 @@ class FileWriter:
     def current_row_group_rows(self) -> int:
         return self._shredder.num_rows or (self._columnar_rows or 0)
 
+    @property
+    def current_row_group_size(self) -> int:
+        """Rough UNCOMPRESSED size of the buffered (unflushed) row group —
+        the size-based flush signal (reference: file_writer.go:355
+        CurrentRowGroupSize); the flushed bytes will usually be smaller
+        once encoded and compressed. Covers both ingestion paths: shredded
+        rows still in the Shredder plus columnar data in the builders."""
+        return self._estimated_size() + sum(
+            b.data_size() for b in self._builders.values()
+        )
+
     def _check_open(self) -> None:
         if self._closed:
             raise WriterError("writer: already closed")

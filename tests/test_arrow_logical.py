@@ -382,3 +382,32 @@ class TestToArrowFilters:
             nulls = r.to_arrow(filters=[("x", "is_null")])
         assert got.column("x").to_pylist() == want.column("x").to_pylist()
         assert nulls.column("x").to_pylist() == [None, None]
+
+    def test_not_in_null_semantics_match_pyarrow(self, tmp_path):
+        """to_arrow(filters=) promises pyarrow parity: not_in KEEPS null
+        rows (pc.is_in maps null->false, inverted to true) while iter_rows'
+        SQL-ish row predicate drops them — both pinned intentionally."""
+        t = pa.table({"x": pa.array([1, None, 3], pa.int64())})
+        p = str(tmp_path / "ni.parquet")
+        pq.write_table(t, p)
+        want = pq.read_table(p, filters=[("x", "not in", [1])])
+        with FileReader(p) as r:
+            got = r.to_arrow(filters=[("x", "not_in", [1])])
+            rows = list(r.iter_rows(filters=[("x", "not_in", [1])]))
+        assert got.column("x").to_pylist() == want.column("x").to_pylist() == [None, 3]
+        assert [x["x"] for x in rows] == [3]
+
+    def test_projected_filter_column_not_decoded_twice(self, tmp_path):
+        """Flat filter columns already in the projection evaluate off the
+        main table (no second read of their chunks)."""
+        from parquet_tpu.utils.trace import decode_trace
+
+        p = self._file(tmp_path)
+        with decode_trace() as tr:
+            with FileReader(p) as r:
+                r.to_arrow(filters=[("id", ">=", 0)])  # admits every group
+        one_pass = tr.stages["decode"].bytes
+        with decode_trace() as tr2:
+            with FileReader(p) as r:
+                r.to_arrow()
+        assert one_pass <= tr2.stages["decode"].bytes * 1.05  # no double decode

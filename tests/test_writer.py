@@ -837,3 +837,49 @@ class TestMetadataCompleteness:
             with pytest.raises(WriterError):
                 FileWriter(str(path), schema, **bad_kw)
             assert path.read_bytes() == b"IRREPLACEABLE", bad_kw
+
+
+class TestSizeIntrospection:
+    """Size-based flushing signals (reference: file_writer.go:352-363
+    CurrentRowGroupSize/CurrentFileSize)."""
+
+    def test_current_row_group_size_tracks_buffered_data(self, tmp_path):
+        import numpy as np
+
+        from parquet_tpu import FileWriter, parse_schema
+
+        schema = parse_schema(
+            "message m { required int64 a; optional binary s (UTF8); }"
+        )
+        path = str(tmp_path / "sz.parquet")
+        with FileWriter(path, schema) as w:
+            assert w.current_row_group_size == 0
+            w.write_rows([{"a": i, "s": "x" * 10} for i in range(1000)])
+            est = w.current_row_group_size
+            # 8B ints + 10B strings (+len prefixes, levels): sane bracket
+            assert 18_000 <= est <= 40_000, est
+            before_flush = w.current_file_size
+            w.flush_row_group()
+            assert w.current_row_group_size == 0
+            assert w.current_file_size > before_flush
+            # columnar input tracks too
+            w.write_column("a", np.arange(500, dtype=np.int64))
+            w.write_column("s", ["yy"] * 500)
+            est2 = w.current_row_group_size
+            assert 4_000 <= est2 <= 12_000, est2
+
+    def test_size_based_flush_loop(self, tmp_path):
+        """The reference's canonical use: flush whenever the buffered group
+        passes a target size."""
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+
+        schema = parse_schema("message m { required int64 a; }")
+        path = str(tmp_path / "szloop.parquet")
+        with FileWriter(path, schema) as w:
+            for i in range(20_000):
+                w.write_row({"a": i})
+                if w.current_row_group_size >= 32_000:
+                    w.flush_row_group()
+        with FileReader(path) as r:
+            assert r.num_row_groups > 2
+            assert [x["a"] for x in r.iter_rows()] == list(range(20_000))
