@@ -940,6 +940,28 @@ ssize_t ptq_bytearray_take(const char* data, size_t data_len,
   return 0;
 }
 
+// PLAIN BYTE_ARRAY encode: [4B LE length][bytes] per value, straight from
+// an (offsets, data) column — the write path's hot loop for string chunks.
+// out must hold data_len + 4*n bytes.
+ssize_t ptq_plain_encode_bytearray(const char* data, size_t data_len,
+                                   const int64_t* offsets, int64_t n,
+                                   char* out, size_t out_cap) {
+  size_t pos = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int64_t start = offsets[i];
+    int64_t len = offsets[i + 1] - start;
+    if (start < 0 || len < 0 || static_cast<size_t>(start + len) > data_len)
+      return -1;
+    if (len > static_cast<int64_t>(UINT32_MAX)) return -1;  // 4B prefix cap
+    if (pos + 4 + static_cast<size_t>(len) > out_cap) return -1;
+    uint32_t l32 = static_cast<uint32_t>(len);
+    std::memcpy(out + pos, &l32, 4);
+    std::memcpy(out + pos + 4, data + start, static_cast<size_t>(len));
+    pos += 4 + static_cast<size_t>(len);
+  }
+  return static_cast<ssize_t>(pos);
+}
+
 // ---------------------------------------------------------------------------
 // DELTA_BINARY_PACKED header-only prescan (device-decode planning hot path)
 // ---------------------------------------------------------------------------

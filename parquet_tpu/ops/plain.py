@@ -126,6 +126,14 @@ def encode_plain(values, ptype: Type, type_length: int | None = None) -> bytes:
         return v.tobytes()
     if ptype == Type.BYTE_ARRAY:
         if isinstance(values, ByteArrayData):
+            from ..utils.native import get_native
+
+            lib = get_native()
+            if lib is not None and lib.has_plain_encode_ba:
+                # one C pass over (offsets, data) — the write path's hot
+                # loop for string chunks; the Python loop below is the
+                # no-native oracle
+                return lib.plain_encode_bytearray(values.data, values.offsets)
             items = values.to_list(cache=True)
         else:
             items = [bytes(x) for x in values]

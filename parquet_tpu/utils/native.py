@@ -161,6 +161,17 @@ class NativeLib:
                 ctypes.c_void_p,
                 ctypes.c_size_t,
             ]
+        self.has_plain_encode_ba = hasattr(lib, "ptq_plain_encode_bytearray")
+        if self.has_plain_encode_ba:
+            lib.ptq_plain_encode_bytearray.restype = ctypes.c_ssize_t
+            lib.ptq_plain_encode_bytearray.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
         self.has_prescan_delta = hasattr(lib, "ptq_prescan_delta_packed")
         if self.has_prescan_delta:
             lib.ptq_prescan_delta_packed.restype = ctypes.c_ssize_t
@@ -445,6 +456,25 @@ class NativeLib:
         if consumed < 0:
             raise ValueError("native: corrupt delta stream")
         return out, int(consumed)
+
+    def plain_encode_bytearray(self, data, offsets) -> bytes:
+        """(offsets, data) column -> PLAIN stream ([4B LE len][bytes] per
+        value) in one C pass; ~memcpy speed vs the per-item Python loop."""
+        import numpy as np
+
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        n = len(offsets) - 1
+        addr, n_in, _keep = _ptr(data)
+        cap = n_in + 4 * max(n, 0)
+        out = np.empty(max(cap, 1), dtype=np.uint8)
+        rc = self._lib.ptq_plain_encode_bytearray(
+            addr, n_in,
+            offsets.ctypes.data_as(ctypes.c_void_p), n,
+            ctypes.c_void_p(out.ctypes.data), cap,
+        )
+        if rc < 0:
+            raise ValueError("native: corrupt byte-array offsets")
+        return out[: int(rc)].tobytes()
 
     def bytearray_take(self, data: bytes, offsets, indices, new_offsets, total: int) -> bytes:
         """Gather rows of an (offsets, data) byte-array column by index."""
