@@ -373,13 +373,21 @@ class FileReader:
         chunk's device work is in flight before the first fetch blocks."""
         return self._read_row_group(i, columns, pack=True)
 
-    def _read_row_group(self, i: int, columns, pack: bool) -> dict[tuple, ChunkData]:
+    def _read_row_group(
+        self, i: int, columns, pack: bool, dict_paths=frozenset()
+    ) -> dict[tuple, ChunkData]:
         """pack=False is the internal iteration path: rows/batches consume
         the levels immediately, so bit-packing them (compact_levels) would be
-        a pure pack+widen round trip with no at-rest benefit."""
+        a pure pack+widen round trip with no at-rest benefit. `dict_paths`
+        keeps those columns' dictionary indices unmaterialized when their
+        chunk allows it (to_arrow read_dictionary=; both backends — the
+        roundtrip path passes its decoded indices through finalize)."""
         if self.backend == "tpu_roundtrip":
             plans = self._plan_row_group(i, columns)
-            out = {path: plan.finalize() for path, plan in plans.items()}
+            out = {
+                path: plan.finalize(keep_dict_indices=path in dict_paths)
+                for path, plan in plans.items()
+            }
         else:
             out = {
                 path: read_chunk(
@@ -388,6 +396,7 @@ class FileReader:
                     column,
                     validate_crc=self.validate_crc,
                     alloc=self.alloc,
+                    keep_dict_indices=path in dict_paths,
                 )
                 for path, cc, column in self._selected_chunks(i, columns)
             }
@@ -1217,7 +1226,9 @@ class FileReader:
 
         return itertools.chain.from_iterable(windows())
 
-    def to_arrow(self, row_groups=None, columns=None, filters=None):
+    def to_arrow(
+        self, row_groups=None, columns=None, filters=None, read_dictionary=None
+    ):
         """Decoded columns as a pyarrow.Table. Flat leaves (numerics,
         booleans, strings/binary, FLBA) and canonical single-level LIST
         columns take zero-copy fast paths; every deeper shape — structs,
@@ -1233,9 +1244,18 @@ class FileReader:
         (column, op, value) triples (a conjunction) or a list of lists
         (an OR of conjunctions). Row groups that statistics/bloom exclude
         are never decoded; surviving rows are filtered EXACTLY. Filter
-        columns outside the projection still apply, then drop."""
+        columns outside the projection still apply, then drop.
+
+        `read_dictionary` (list of flat string/binary column names, like
+        pyarrow's) returns those columns DICTIONARY-ENCODED
+        (dictionary<int32, large_string>) — indices and the (small)
+        dictionary pass through without materializing the strings. Chunks
+        with PLAIN fallback pages decode plain; a column mixing both
+        normalizes to plain across groups so the type stays uniform."""
         if filters is not None:
-            return self._to_arrow_filtered(row_groups, columns, filters)
+            return self._to_arrow_filtered(
+                row_groups, columns, filters, read_dictionary
+            )
         import pyarrow as pa
 
         from ..meta.parquet_types import Type
@@ -1256,6 +1276,28 @@ class FileReader:
                 return "list"
             return "nested"
 
+        # dictionary-preserving columns: flat BYTE_ARRAY tops only
+        dict_paths = frozenset()
+        if read_dictionary:
+            wanted = set()
+            for name in read_dictionary:
+                path = (
+                    tuple(name.split(".")) if isinstance(name, str) else tuple(name)
+                )
+                try:
+                    leaf = self.schema.column(path)
+                except Exception as e:
+                    raise ParquetFileError(
+                        f"parquet: read_dictionary column {name!r} not in schema"
+                    ) from e
+                if (
+                    len(path) == 1
+                    and leaf.is_leaf
+                    and leaf.max_rep == 0
+                    and leaf.type == Type.BYTE_ARRAY
+                ):
+                    wanted.add(path)
+            dict_paths = frozenset(wanted)
         indices = list(
             range(self.num_row_groups) if row_groups is None else row_groups
         )
@@ -1269,18 +1311,21 @@ class FileReader:
             for leaf in self.schema.leaves:
                 if sel is None or leaf.path in sel:
                     by_top.setdefault(leaf.path[0], []).append(leaf.path)
+            def _empty_type(top_name):
+                t = nested_arrow_type(pa, self.schema.column((top_name,)), sel)
+                if (top_name,) in dict_paths:
+                    return pa.dictionary(pa.int32(), t)
+                return t
             return pa.table({
-                top_name: pa.array(
-                    [], type=nested_arrow_type(
-                        pa, self.schema.column((top_name,)), sel
-                    )
-                )
+                top_name: pa.array([], type=_empty_type(top_name))
                 for top_name in by_top
             })
         per_group: list[dict] = []
         names: list[str] | None = None
         for i in indices:
-            chunks = self._read_row_group(i, columns, pack=False)
+            chunks = self._read_row_group(
+                i, columns, pack=False, dict_paths=dict_paths
+            )
             by_top: dict[str, dict] = {}
             for path, cd in chunks.items():
                 by_top.setdefault(path[0], {})[path] = cd
@@ -1294,6 +1339,11 @@ class FileReader:
                 leaf = self.schema.column(path)
                 if kind == "list":
                     cols[top_name] = self._arrow_list_column(pa, path, leaf, cd)
+                    continue
+                if cd.indices is not None and isinstance(
+                    cd.dictionary, ByteArrayData
+                ):
+                    cols[top_name] = self._arrow_dictionary_column(pa, leaf, cd)
                     continue
                 mask = None
                 if cd.def_levels is not None and leaf.max_def > 0:
@@ -1357,12 +1407,48 @@ class FileReader:
             names = []
         if not per_group:
             return pa.table({})
-        arrays = [
-            pa.chunked_array([g[name] for g in per_group]) for name in names
-        ]
+        arrays = []
+        for name in names:
+            parts = [g[name] for g in per_group]
+            is_dict = [pa.types.is_dictionary(a.type) for a in parts]
+            if any(is_dict) and not all(is_dict):
+                # a group with PLAIN fallback pages decoded plain: the
+                # column normalizes to plain so the chunked type is uniform
+                parts = [
+                    a.dictionary_decode() if pa.types.is_dictionary(a.type) else a
+                    for a in parts
+                ]
+            arrays.append(pa.chunked_array(parts))
         return pa.table(dict(zip(names, arrays)))
 
-    def _to_arrow_filtered(self, row_groups, columns, filters):
+    def _arrow_dictionary_column(self, pa, leaf, cd):
+        """A dictionary-preserved chunk -> pyarrow DictionaryArray: the
+        (small) dictionary transfers zero-copy into large_string/
+        large_binary, indices scatter to row positions with validity from
+        the definition levels (read_dictionary= lane)."""
+        d = cd.dictionary
+        offs = np.ascontiguousarray(d.offsets, dtype=np.int64)
+        dict_arr = pa.Array.from_buffers(
+            pa.large_string() if leaf.is_string() else pa.large_binary(),
+            len(d),
+            [None, pa.py_buffer(offs), pa.py_buffer(d.data)],
+        )
+        n = cd.num_values
+        idx = np.asarray(cd.indices, dtype=np.int32)
+        valid = None
+        if cd.def_levels is not None and leaf.max_def > 0:
+            v = np.asarray(cd.def_levels) == leaf.max_def
+            if not v.all():
+                valid = v
+        if valid is None:
+            ind = pa.array(idx)
+        else:
+            expanded = np.zeros(n, dtype=np.int32)
+            expanded[valid] = idx
+            ind = pa.array(expanded, mask=~valid)
+        return pa.DictionaryArray.from_arrays(ind, dict_arr)
+
+    def _to_arrow_filtered(self, row_groups, columns, filters, read_dictionary=None):
         """Pruned + exactly-filtered columnar read (to_arrow's filters=).
 
         The row mask evaluates over a SEPARATE read of just the filter
@@ -1382,7 +1468,9 @@ class FileReader:
             )
             if dnf_group_may_match(self.row_group(i), dnf, self._bloom_excludes, i)
         ]
-        table = self.to_arrow(row_groups=indices, columns=columns)
+        table = self.to_arrow(
+            row_groups=indices, columns=columns, read_dictionary=read_dictionary
+        )
         if not dnf or any(not conj for conj in dnf) or table.num_rows == 0:
             return table  # an empty conjunction is vacuously true
         # flat top-level filter columns already in the projection evaluate

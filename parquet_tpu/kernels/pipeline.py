@@ -474,12 +474,56 @@ class _ChunkPlan:
 
     # -- fetch + host reassembly (byte-identical to core.chunk.read_chunk) ----
 
-    def finalize(self) -> ChunkData:
+    def finalize(self, keep_dict_indices: bool = False) -> ChunkData:
         column = self.column
         hybrid_flat = None
         if self.dev_hybrid:
             fetched = [np.asarray(d) for d in self.dev_hybrid]
             hybrid_flat = fetched[0] if len(fetched) == 1 else np.concatenate(fetched)
+        if keep_dict_indices and self.dictionary is not None:
+            kinds = {k for _, _, _, k, _ in self.page_infos if k != "empty"}
+            if kinds and kinds <= {"dict", "indices"}:
+                # dictionary-preserving delivery: the (device- or host-)
+                # decoded indices pass through unmaterialized
+                parts = []
+                hpos = 0
+                all_def, all_rep = [], []
+                total = 0
+                for n, dfl, rep, kind, payload in self.page_infos:
+                    total += n
+                    if dfl is not None:
+                        all_def.append(dfl)
+                    if rep is not None:
+                        all_rep.append(rep)
+                    if kind == "dict":
+                        parts.append(hybrid_flat[hpos : hpos + payload])
+                        hpos += payload
+                    elif kind == "indices":
+                        parts.append(np.asarray(payload))
+                if total != self.expected:
+                    raise ChunkError(
+                        f"chunk: pages hold {total} values, "
+                        f"metadata says {self.expected}"
+                    )
+                idx = (
+                    np.concatenate(parts)
+                    if len(parts) != 1
+                    else parts[0]
+                ) if parts else np.empty(0, np.int32)
+                if self.native_def is not None or self.native_rep is not None:
+                    dl, rl = self.native_def, self.native_rep
+                else:
+                    dl = np.concatenate(all_def) if all_def else None
+                    rl = np.concatenate(all_rep) if all_rep else None
+                return ChunkData(
+                    column=column,
+                    num_values=total,
+                    values=None,
+                    def_levels=dl,
+                    rep_levels=rl,
+                    dictionary=self.dictionary,
+                    indices=idx.astype(np.int32, copy=False),
+                )
         delta_flat = None
         if self.dev_delta:
             fetched = [np.asarray(d) for d in self.dev_delta]

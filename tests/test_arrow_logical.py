@@ -411,3 +411,87 @@ class TestToArrowFilters:
             with FileReader(p) as r:
                 r.to_arrow()
         assert one_pass <= tr2.stages["decode"].bytes * 1.05  # no double decode
+
+
+class TestReadDictionary:
+    """to_arrow(read_dictionary=) — pyarrow's dictionary-preserving read:
+    the column arrives as dictionary<int32, large_string> with indices and
+    the (small) dictionary passing through unmaterialized."""
+
+    def test_matches_pyarrow(self, tmp_path):
+        n = 60_000
+        t = pa.table({
+            "s": pa.array(
+                [None if i % 13 == 0 else f"key_{i % 500:04d}" for i in range(n)]
+            ),
+            "i": pa.array(np.arange(n, dtype=np.int64)),
+        })
+        p = str(tmp_path / "rd.parquet")
+        pq.write_table(t, p, use_dictionary=["s"], compression="snappy",
+                       row_group_size=20_000)
+        want = pq.read_table(p, read_dictionary=["s"])
+        with FileReader(p) as r:
+            out = r.to_arrow(read_dictionary=["s"])
+            empty = r.to_arrow(read_dictionary=["s"], row_groups=[])
+            plain = r.to_arrow()
+        assert pa.types.is_dictionary(out.column("s").type)
+        assert out.column("s").to_pylist() == want.column("s").to_pylist()
+        assert out.column("i").type == pa.int64()  # others untouched
+        assert pa.types.is_dictionary(empty.column("s").type)
+        assert not pa.types.is_dictionary(plain.column("s").type)
+
+    def test_dict_overflow_normalizes_to_plain(self, tmp_path):
+        """A chunk whose dictionary overflowed into PLAIN fallback pages
+        cannot stay dictionary-typed; the whole column normalizes so the
+        chunked type is uniform — values still exact."""
+        n = 120_000
+        rng = np.random.default_rng(3)
+        # high-cardinality strings blow pyarrow's default 1MB dict ceiling
+        t = pa.table({
+            "s": pa.array([f"u{int(x):08d}" + "p" * 40 for x in rng.integers(0, n, n)]),
+        })
+        p = str(tmp_path / "ov.parquet")
+        pq.write_table(t, p, use_dictionary=["s"], compression="snappy",
+                       row_group_size=n)
+        with FileReader(p) as r:
+            out = r.to_arrow(read_dictionary=["s"])
+        assert out.column("s").to_pylist() == t.column("s").to_pylist()
+
+    def test_non_dictable_columns_ignored_and_unknown_raises(self, tmp_path):
+        from parquet_tpu.meta import ParquetFileError
+
+        t = pa.table({"i": pa.array([1, 2, 3], pa.int64())})
+        p = str(tmp_path / "nd.parquet")
+        pq.write_table(t, p)
+        with FileReader(p) as r:
+            out = r.to_arrow(read_dictionary=["i"])  # not BYTE_ARRAY: ignored
+            assert out.column("i").type == pa.int64()
+            with pytest.raises(ParquetFileError, match="read_dictionary"):
+                r.to_arrow(read_dictionary=["nope"])
+
+    def test_both_backends_and_memory_ceiling(self, tmp_path):
+        """Review regressions: tpu_roundtrip honors read_dictionary (the
+        device-decoded indices pass through), and a memory-bounded reader
+        does NOT charge the never-performed gather — a dict-preserving read
+        fits where a materializing one would trip the ceiling."""
+        n = 200_000
+        uniq = [f"v{i:03d}" + "x" * 1000 for i in range(20)]
+        t = pa.table({"s": pa.array([uniq[i % 20] for i in range(n)])})
+        p = str(tmp_path / "big.parquet")
+        pq.write_table(t, p, use_dictionary=["s"], compression="snappy",
+                       row_group_size=n)
+        for backend in BACKENDS:
+            with FileReader(p, backend=backend) as r:
+                out = r.to_arrow(read_dictionary=["s"])
+            assert pa.types.is_dictionary(out.column("s").type), backend
+            assert out.column("s").to_pylist() == t.column("s").to_pylist(), backend
+        # ~200MB materialized vs ~1MB as indices+dict: the ceiling only
+        # blocks the materializing read
+        with FileReader(p, max_memory=40_000_000) as r:
+            out = r.to_arrow(read_dictionary=["s"])
+            assert out.column("s").num_chunks >= 1
+        from parquet_tpu.core.alloc import AllocError
+
+        with FileReader(p, max_memory=40_000_000) as r:
+            with pytest.raises(AllocError):
+                r.to_arrow()
