@@ -484,3 +484,56 @@ class TestMutationSweepIndexed:
             blob = indexed_file[:cut]
             _try_read(blob)
             self._try_filtered(blob)
+
+
+class TestToArrowMutationSweep:
+    """The round-5 columnar lanes (to_arrow incl. nested assembly, logical
+    retype, dictionary-preserving reads, filters) must fail CLEANLY on
+    corrupt bytes — never leak numpy/pyarrow internals past the boundary."""
+
+    @pytest.fixture(scope="class")
+    def rich_file(self) -> bytes:
+        import datetime as dt
+        import decimal
+
+        t = pa.table({
+            "i": pa.array(range(400), pa.int64()),
+            "cat": pa.array([f"c{i % 7}" for i in range(400)]),
+            "ts": pa.array(
+                [dt.datetime(2024, 1, 1) + dt.timedelta(hours=i) for i in range(400)],
+                pa.timestamp("us"),
+            ),
+            "dec": pa.array(
+                [decimal.Decimal(i) / 100 for i in range(400)], pa.decimal128(10, 2)
+            ),
+            "g": pa.array(
+                [{"a": i, "b": [i, i + 1]} if i % 5 else None for i in range(400)],
+                pa.struct([("a", pa.int64()), ("b", pa.list_(pa.int32()))]),
+            ),
+        })
+        buf = io.BytesIO()
+        pq.write_table(t, buf, compression="snappy", use_dictionary=["cat"])
+        return buf.getvalue()
+
+    def _try(self, data: bytes) -> None:
+        try:
+            with FileReader(io.BytesIO(data)) as r:
+                r.to_arrow(read_dictionary=["cat"], filters=[("i", ">=", 100)])
+        except CLEAN_ERRORS:
+            pass  # module convention: recovered-panic model (line 22)
+        except (KeyError, TypeError) as e:
+            raise AssertionError(f"unclean error escaped to_arrow: {e!r}") from e
+
+    def test_single_byte_flips(self, rich_file):
+        rng2 = np.random.default_rng(77)
+        data = bytearray(rich_file)
+        for _ in range(250):
+            pos = int(rng2.integers(0, len(data)))
+            old = data[pos]
+            data[pos] ^= int(rng2.integers(1, 256))
+            self._try(bytes(data))
+            data[pos] = old
+
+    def test_truncations(self, rich_file):
+        for cut in range(1, len(rich_file), max(len(rich_file) // 60, 1)):
+            self._try(rich_file[:cut])
