@@ -26,6 +26,8 @@ those byte-level forms with our own encoder primitives:
                               bit-packed runs, padded final group
   foreign_nonnullable_impala  REQUIRED-everywhere struct+list+map nesting
                               (nonnullable.impala.parquet's shape)
+  foreign_plain_dictionary_legacy  old parquet-mr 1.x PLAIN_DICTIONARY
+                              encoding ids on dict + data pages
 
 Each file is then decoded by PYARROW — the independent implementation — and
 its rows frozen as the expectation, so the oracle never saw our reader.
@@ -451,6 +453,39 @@ def _nonnullable_impala(path: Path) -> None:
         w.write_rows(rows)
 
 
+
+def _plain_dictionary_legacy(path: Path) -> None:
+    """Old parquet-mr 1.x form: data pages tagged PLAIN_DICTIONARY (id 2)
+    instead of the modern RLE_DICTIONARY (id 8), dict page also tagged
+    PLAIN_DICTIONARY — both mean the same bytes on the wire."""
+    from parquet_tpu.core.page import encode_data_page_v1, encode_dict_page
+    from parquet_tpu.meta.parquet_types import Encoding
+
+    schema = parse_schema("message m { required binary s (UTF8); required int64 v; }")
+    col_s, col_v = schema.leaves
+    codec = 1
+    uniques = [f"word_{i:03d}".encode() for i in range(120)]
+    n = 3_000
+    idx = rng.integers(0, len(uniques), n).astype(np.int64)
+    dict_page = encode_dict_page(col_s, uniques, codec)
+    dict_page[0].dictionary_page_header.encoding = int(Encoding.PLAIN_DICTIONARY)
+    data_page = encode_data_page_v1(
+        col_s, idx, None, None, Encoding.RLE_DICTIONARY, codec, len(uniques)
+    )
+    data_page[0].data_page_header.encoding = int(Encoding.PLAIN_DICTIONARY)
+    vals = np.cumsum(rng.integers(0, 9, n)).astype(np.int64)
+    v_page = encode_data_page_v1(col_v, vals, None, None, Encoding.PLAIN, codec)
+    _handcraft(
+        path, schema,
+        [
+            (col_s, [dict_page, data_page], n,
+             [int(Encoding.RLE), int(Encoding.PLAIN_DICTIONARY)]),
+            (col_v, [v_page], n, [int(Encoding.RLE), int(Encoding.PLAIN)]),
+        ],
+        n, codec,
+    )
+
+
 FOREIGN = {
     "foreign_legacy_2level_list": _legacy_2level_list,
     "foreign_athena_bag": _athena_bag,
@@ -464,6 +499,7 @@ FOREIGN = {
     "foreign_int96_dict": _int96_dict,
     "foreign_bool_rle_shapes": _bool_rle_shapes,
     "foreign_nonnullable_impala": _nonnullable_impala,
+    "foreign_plain_dictionary_legacy": _plain_dictionary_legacy,
 }
 
 

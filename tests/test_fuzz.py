@@ -519,8 +519,12 @@ class TestToArrowMutationSweep:
         try:
             with FileReader(io.BytesIO(data)) as r:
                 r.to_arrow(read_dictionary=["cat"], filters=[("i", ">=", 100)])
-        except CLEAN_ERRORS:
-            pass  # module convention: recovered-panic model (line 22)
+        except CLEAN_ERRORS as e:
+            # ArrowInvalid subclasses ValueError: without this check a raw
+            # pyarrow internal would count as clean
+            assert not isinstance(e, pa.lib.ArrowException), (
+                f"pyarrow internal escaped to_arrow: {e!r}"
+            )
         except (KeyError, TypeError) as e:
             raise AssertionError(f"unclean error escaped to_arrow: {e!r}") from e
 
