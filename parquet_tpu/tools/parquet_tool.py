@@ -306,6 +306,21 @@ def cmd_split(args) -> int:
     return 0
 
 
+def cmd_merge(args) -> int:
+    """Concatenate files at row-group granularity WITHOUT re-encoding:
+    chunk bytes copy verbatim, only footer offsets rewrite (compaction —
+    the parquet-mr `parquet-tools merge` primitive; beyond the reference).
+    Schemas must match exactly; page indexes/blooms are not carried."""
+    from ..core.merge import merge_files
+
+    meta = merge_files(args.out, args.files)
+    print(
+        f"merged {len(args.files)} files -> {args.out}: "
+        f"{meta.num_rows} rows, {len(meta.row_groups or [])} row groups"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet-tool", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -357,6 +372,13 @@ def main(argv=None) -> int:
     pp.add_argument("file")
     pp.add_argument("out", help="output pattern containing %%d")
     pp.set_defaults(fn=cmd_split)
+
+    pm = sub.add_parser(
+        "merge", help="concatenate files at row-group level (no re-encoding)"
+    )
+    pm.add_argument("out", help="output file")
+    pm.add_argument("files", nargs="+", help="input files (order preserved)")
+    pm.set_defaults(fn=cmd_merge)
 
     args = p.parse_args(argv)
     try:

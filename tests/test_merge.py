@@ -1,0 +1,169 @@
+"""Row-group-level merge (core/merge.py): byte-verbatim compaction.
+
+Chunk bytes copy unmodified — only footer offsets rewrite — so the merged
+file must decode identically to the concatenation of its inputs, through
+pyarrow (the independent oracle), our host path, and the device backend.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import FileReader, FileWriter, merge_files, parse_schema
+from parquet_tpu.meta import ParquetFileError
+from parquet_tpu.tools.parquet_tool import main as tool_main
+
+
+def _make(path, lo, hi, **write_opts):
+    t = pa.table({
+        "i": pa.array(np.arange(lo, hi, dtype=np.int64)),
+        "s": pa.array([f"n{k % 37}" for k in range(lo, hi)]),
+        "l": pa.array(
+            [None if k % 11 == 0 else [k, k + 1] for k in range(lo, hi)],
+            pa.list_(pa.int32()),
+        ),
+    })
+    pq.write_table(t, path, **write_opts)
+    return t
+
+
+class TestMerge:
+    def test_merge_pyarrow_inputs(self, tmp_path):
+        p1, p2, p3 = (str(tmp_path / f"in{k}.parquet") for k in range(3))
+        t1 = _make(p1, 0, 5_000, compression="snappy", row_group_size=2_000)
+        t2 = _make(p2, 5_000, 6_500, compression="snappy", use_dictionary=["s"])
+        t3 = _make(p3, 6_500, 6_700, compression="snappy")
+        out = str(tmp_path / "merged.parquet")
+        meta = merge_files(out, [p1, p2, p3])
+        want = pa.concat_tables([t1, t2, t3])
+        assert meta.num_rows == want.num_rows
+        # pyarrow (independent) decodes the merged bytes
+        got = pq.read_table(out)
+        for c in want.column_names:
+            assert got.column(c).to_pylist() == want.column(c).to_pylist(), c
+        # both our backends agree
+        for backend in ("host", "tpu_roundtrip"):
+            with FileReader(out, backend=backend) as r:
+                rows = [x["i"] for x in r.iter_rows()]
+            assert rows == list(range(6_700)), backend
+
+    def test_chunk_bytes_verbatim(self, tmp_path):
+        """The page bytes in the merged file are IDENTICAL to the source's
+        (no re-encoding): compare the first chunk's byte range."""
+        from parquet_tpu.core.chunk import chunk_byte_range
+
+        p1 = str(tmp_path / "a.parquet")
+        _make(p1, 0, 3_000, compression="zstd")
+        out = str(tmp_path / "m.parquet")
+        merge_files(out, [p1, p1])  # self-merge doubles the file
+        with FileReader(p1) as src, FileReader(out) as dst:
+            assert dst.num_row_groups == 2 * src.num_row_groups
+            s_cc = src.metadata.row_groups[0].columns[0]
+            for g in range(2):
+                d_cc = dst.metadata.row_groups[g * src.num_row_groups].columns[0]
+                so, sn = chunk_byte_range(s_cc)
+                do, dn = chunk_byte_range(d_cc)
+                assert sn == dn
+                with open(p1, "rb") as f:
+                    f.seek(so)
+                    src_bytes = f.read(sn)
+                with open(out, "rb") as f:
+                    f.seek(do)
+                    assert f.read(dn) == src_bytes
+
+    def test_merged_output_remerges_and_stats_survive(self, tmp_path):
+        p1 = str(tmp_path / "a.parquet")
+        _make(p1, 0, 2_000)
+        m1 = str(tmp_path / "m1.parquet")
+        merge_files(m1, [p1])
+        m2 = str(tmp_path / "m2.parquet")
+        merge_files(m2, [m1, p1])
+        with FileReader(m2) as r:
+            # statistics carried verbatim: row-group pruning still works
+            assert r.prune_row_groups([("i", ">", 10**9)]) == []
+            assert len(list(r.iter_rows())) == 4_000
+
+    def test_schema_mismatch_and_empty(self, tmp_path):
+        p1 = str(tmp_path / "a.parquet")
+        _make(p1, 0, 100)
+        p2 = str(tmp_path / "b.parquet")
+        pq.write_table(pa.table({"x": pa.array([1.0])}), p2)
+        with pytest.raises(ParquetFileError, match="schema mismatch"):
+            merge_files(str(tmp_path / "o.parquet"), [p1, p2])
+        with pytest.raises(ParquetFileError, match="at least one"):
+            merge_files(str(tmp_path / "o.parquet"), [])
+
+    def test_our_writer_inputs_with_nested(self, tmp_path):
+        schema = parse_schema(
+            "message m { required int64 id; optional group g "
+            "{ optional binary name (UTF8); } }"
+        )
+        paths = []
+        for k in range(2):
+            p = str(tmp_path / f"w{k}.parquet")
+            with FileWriter(p, schema, codec="snappy") as w:
+                w.write_rows([
+                    {"id": k * 10 + j, "g": None if j % 3 == 0 else {"name": f"x{j}"}}
+                    for j in range(10)
+                ])
+            paths.append(p)
+        out = str(tmp_path / "wm.parquet")
+        merge_files(out, paths)
+        got = pq.read_table(out)
+        assert got.column("id").to_pylist() == [j for k in range(2) for j in range(k * 10, k * 10 + 10)]
+
+    def test_cli(self, tmp_path, capsys):
+        p1 = str(tmp_path / "a.parquet")
+        p2 = str(tmp_path / "b.parquet")
+        _make(p1, 0, 500)
+        _make(p2, 500, 800)
+        out = str(tmp_path / "m.parquet")
+        assert tool_main(["merge", out, p1, p2]) == 0
+        assert "800 rows" in capsys.readouterr().out
+        assert pq.read_table(out).num_rows == 800
+
+    def test_bloom_and_index_sources_merge_clean(self, tmp_path):
+        """Inputs carrying page indexes + blooms (regions outside the chunk
+        ranges) merge cleanly: those offsets drop, values stay exact."""
+        schema = parse_schema("message m { required int64 a; }")
+        p = str(tmp_path / "ib.parquet")
+        with FileWriter(p, schema, write_page_index=True,
+                        bloom_filters=["a"]) as w:
+            w.write_column("a", np.arange(5_000, dtype=np.int64))
+        out = str(tmp_path / "ibm.parquet")
+        merge_files(out, [p, p])
+        assert pq.read_table(out).column("a").to_pylist() == (
+            list(range(5_000)) + list(range(5_000))
+        )
+        with FileReader(out) as r:
+            cc = r.metadata.row_groups[0].columns[0]
+            assert cc.meta_data.bloom_filter_offset is None
+            assert cc.column_index_offset is None
+
+    def test_output_must_not_be_an_input(self, tmp_path):
+        """Review regression: merging a file into itself must refuse BEFORE
+        truncating the source."""
+        p1 = str(tmp_path / "a.parquet")
+        _make(p1, 0, 100)
+        size = __import__("os").path.getsize(p1)
+        with pytest.raises(ParquetFileError, match="also an input"):
+            merge_files(p1, [p1])
+        assert __import__("os").path.getsize(p1) == size  # source intact
+        assert pq.read_table(p1).num_rows == 100
+
+    def test_file_offset_zero_convention_preserved(self, tmp_path):
+        """Review regression: pyarrow writes ColumnChunk.file_offset=0
+        (modern spec); the merged footer must keep 0, not a bogus delta."""
+        p1 = str(tmp_path / "a.parquet")
+        _make(p1, 0, 200)
+        p2 = str(tmp_path / "b.parquet")
+        _make(p2, 200, 400)
+        out = str(tmp_path / "m.parquet")
+        merge_files(out, [p1, p2])
+        with FileReader(p1) as src, FileReader(out) as dst:
+            src_off = src.metadata.row_groups[0].columns[0].file_offset
+            for rg in dst.metadata.row_groups:
+                for cc in rg.columns:
+                    if not src_off:
+                        assert not cc.file_offset
