@@ -47,7 +47,7 @@ from .core.arrays import ByteArrayData  # noqa: F401
 from .core.alloc import AllocError  # noqa: F401
 from .core.filter import FilterError  # noqa: F401
 from .core.compress import register_codec, CompressionError  # noqa: F401
-from .core.merge import merge_files  # noqa: F401
+from .core.merge import merge_files, split_row_groups  # noqa: F401
 from .meta import (  # noqa: F401
     CompressionCodec,
     ConvertedType,

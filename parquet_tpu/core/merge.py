@@ -25,9 +25,123 @@ from ..meta.file_meta import (
 from ..meta.parquet_types import FileMetaData, KeyValue
 from .chunk import chunk_byte_range
 
-__all__ = ["merge_files"]
+__all__ = ["merge_files", "split_row_groups"]
 
 _COPY_BLOCK = 8 << 20
+
+def _copy_group(out, pos: int, f, rg, ordinal: int, src_label: str) -> int:
+    """Copy one row group's chunk bytes verbatim from open input `f` to open
+    output `out` at byte position `pos`, rewriting the group's footer
+    offsets IN PLACE (callers pass a private RowGroup). Returns the new
+    position. Shared by merge_files and split_row_groups so the two lanes
+    can never diverge on offset handling."""
+    first_new = None
+    for cc in rg.columns or []:
+        if cc.file_path:
+            raise ParquetFileError(
+                "parquet: merge/split does not support external column "
+                f"chunks ({src_label!r})"
+            )
+        offset, total = chunk_byte_range(cc)
+        delta = pos - offset
+        f.seek(offset)
+        remaining = total
+        while remaining:
+            block = f.read(min(remaining, _COPY_BLOCK))
+            if not block:
+                raise ParquetFileError(
+                    f"parquet: merge/split input truncated ({src_label!r})"
+                )
+            out.write(block)
+            remaining -= len(block)
+        md = cc.meta_data
+        for attr in (
+            "data_page_offset", "dictionary_page_offset", "index_page_offset"
+        ):
+            v = getattr(md, attr)
+            if v is not None:
+                setattr(md, attr, v + delta)
+        # regions outside the chunk range are not carried
+        md.bloom_filter_offset = None
+        md.bloom_filter_length = None
+        cc.offset_index_offset = None
+        cc.offset_index_length = None
+        cc.column_index_offset = None
+        cc.column_index_length = None
+        if cc.file_offset:  # modern writers set 0: keep it
+            cc.file_offset += delta
+        if first_new is None:
+            first_new = pos
+        pos += total
+    rg.file_offset = first_new
+    rg.ordinal = ordinal
+    return pos
+
+
+
+def split_row_groups(in_path, out_pattern: str, groups_per_part: int = 1,
+                     created_by: str | None = None) -> list:
+    """Shard a file into parts of `groups_per_part` row groups each by
+    copying chunk bytes VERBATIM (the converse of merge_files — no decode,
+    no re-encoding; parquet-tool `split --groups` rides this). Returns the
+    written part paths. `out_pattern` must contain %d."""
+    if "%d" not in out_pattern:
+        raise ParquetFileError("parquet: split pattern must contain %d")
+    if groups_per_part < 1:
+        raise ParquetFileError("parquet: groups_per_part must be >= 1")
+    with open(in_path, "rb") as f:
+        meta = read_file_metadata(f)
+    n_groups = len(meta.row_groups or [])
+    parts = []
+    for part, lo in enumerate(range(0, n_groups, groups_per_part)):
+        out = out_pattern % part
+        _copy_groups(
+            out, in_path, meta,
+            range(lo, min(lo + groups_per_part, n_groups)),
+            created_by or "parquet_tpu split",
+        )
+        parts.append(out)
+    return parts
+
+
+def _copy_groups(out_path, in_path, meta, group_indices, created_by) -> None:
+    """One output file holding verbatim copies of the selected row groups.
+
+    Deep-copies the footer structs it mutates (thrift round-trip) so the
+    caller's metadata — shared across parts — stays untouched."""
+    from ..meta.parquet_types import RowGroup
+
+    import os
+
+    st_in = os.stat(in_path)
+    try:
+        st_out = os.stat(out_path)
+        if (st_out.st_dev, st_out.st_ino) == (st_in.st_dev, st_in.st_ino):
+            raise ParquetFileError(
+                f"parquet: split output {out_path!r} is the input"
+            )
+    except OSError:
+        pass
+    out_groups = []
+    num_rows = 0
+    with open(out_path, "wb") as out, open(in_path, "rb") as f:
+        out.write(MAGIC)
+        pos = len(MAGIC)
+        for gi in group_indices:
+            rg = RowGroup.loads((meta.row_groups[gi]).dumps())  # private copy
+            pos = _copy_group(out, pos, f, rg, len(out_groups), str(in_path))
+            out_groups.append(rg)
+            num_rows += rg.num_rows or 0
+        out_meta = FileMetaData(
+            version=2,
+            schema=meta.schema,
+            num_rows=num_rows,
+            row_groups=out_groups,
+            created_by=created_by,
+            key_value_metadata=meta.key_value_metadata,
+            column_orders=meta.column_orders,
+        )
+        out.write(serialize_footer(out_meta))
 
 
 def merge_files(out_path, in_paths, created_by: str | None = None,
@@ -76,46 +190,7 @@ def merge_files(out_path, in_paths, created_by: str | None = None,
         for path, meta in zip(in_paths, metas):
             with open(path, "rb") as f:
                 for rg in meta.row_groups or []:
-                    first_new = None
-                    for cc in rg.columns or []:
-                        if cc.file_path:
-                            raise ParquetFileError(
-                                "parquet: merge does not support external "
-                                f"column chunks ({path!r})"
-                            )
-                        offset, total = chunk_byte_range(cc)
-                        delta = pos - offset
-                        f.seek(offset)
-                        remaining = total
-                        while remaining:
-                            block = f.read(min(remaining, _COPY_BLOCK))
-                            if not block:
-                                raise ParquetFileError(
-                                    f"parquet: merge input truncated ({path!r})"
-                                )
-                            out.write(block)
-                            remaining -= len(block)
-                        md = cc.meta_data
-                        if md.data_page_offset is not None:
-                            md.data_page_offset += delta
-                        if md.dictionary_page_offset is not None:
-                            md.dictionary_page_offset += delta
-                        if md.index_page_offset is not None:
-                            md.index_page_offset += delta
-                        # regions outside the chunk range are not carried
-                        md.bloom_filter_offset = None
-                        md.bloom_filter_length = None
-                        cc.offset_index_offset = None
-                        cc.offset_index_length = None
-                        cc.column_index_offset = None
-                        cc.column_index_length = None
-                        if cc.file_offset:  # modern writers set 0: keep it
-                            cc.file_offset += delta
-                        if first_new is None:
-                            first_new = pos
-                        pos += total
-                    rg.file_offset = first_new
-                    rg.ordinal = len(out_groups)
+                    pos = _copy_group(out, pos, f, rg, len(out_groups), path)
                     out_groups.append(rg)
                     num_rows += rg.num_rows or 0
         kv = dict(key_value_metadata or {})

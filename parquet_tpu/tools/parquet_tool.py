@@ -270,6 +270,15 @@ def cmd_split(args) -> int:
     if "%d" not in pattern:
         print("split: output pattern must contain %d", file=sys.stderr)
         return 2
+    if getattr(args, "groups", None) is not None:
+        if args.n is not None or args.size is not None:
+            print("split: --groups excludes -n/--size", file=sys.stderr)
+            return 2
+        from ..core.merge import split_row_groups
+
+        parts = split_row_groups(args.file, pattern, args.groups)
+        print(f"wrote {len(parts)} parts (row-group copy, no re-encoding)")
+        return 0
     if (args.n is None) == (args.size is None):
         print("split: pass exactly one of -n or --size", file=sys.stderr)
         return 2
@@ -369,6 +378,12 @@ def main(argv=None) -> int:
         help="target bytes per part (suffixes K/M/G), like the reference",
     )
     pp.add_argument("--codec", default="snappy")
+    pp.add_argument(
+        "--groups",
+        type=int,
+        help="row GROUPS per part: verbatim chunk-byte copy, no re-encoding "
+        "(fast lane; -n/--size re-encode rows)",
+    )
     pp.add_argument("file")
     pp.add_argument("out", help="output pattern containing %%d")
     pp.set_defaults(fn=cmd_split)
