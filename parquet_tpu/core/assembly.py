@@ -1073,6 +1073,103 @@ def _to_micros(v: int, unit: str) -> int:
     return v
 
 
+_KIND_UNSET = object()
+
+
+def convert_to_storage(node: Column, v, kind=_KIND_UNSET):
+    """Ergonomic Python value -> storage value (the INVERSE of
+    convert_logical, same logical_kind dispatch table): datetime ->
+    epoch int at the declared unit (exact integer arithmetic — float
+    total_seconds() drifts microseconds past ~270 years from epoch),
+    date -> days, time -> unit int, Decimal -> unscaled int (or
+    big-endian bytes for FLBA/BYTE_ARRAY storage, exact-scale and
+    width-fit enforced as ValueError). Raw ints/floats/bytes pass
+    through. `kind` takes a precomputed logical_kind(node) so per-chunk
+    callers dispatch once. Closes the write_row side of the iter_rows
+    round-trip."""
+    import datetime as dt
+    import decimal
+
+    if kind is _KIND_UNSET:
+        kind = logical_kind(node)
+    if v is None or isinstance(v, (int, float, np.integer, np.floating)):
+        if (
+            isinstance(v, (int, np.integer))
+            and kind is not None
+            and kind[0] == "uint"
+        ):
+            bits = kind[1]
+            u = int(v) & ((1 << bits) - 1)
+            return u - (1 << bits) if u >= (1 << (bits - 1)) else u
+        return v
+    if kind == "decimal" and isinstance(v, decimal.Decimal):
+        lt = node.logical_type
+        scale = node.element.scale
+        if scale is None and lt is not None and lt.DECIMAL is not None:
+            scale = lt.DECIMAL.scale
+        scale = scale or 0
+        scaled = v.scaleb(scale)
+        unscaled = int(scaled)
+        if scaled != unscaled:
+            raise ValueError(
+                f"decimal {v} does not fit scale {scale} of "
+                f"{node.path_str} exactly"
+            )
+        try:
+            if node.type == Type.FIXED_LEN_BYTE_ARRAY:
+                w = node.type_length or 0
+                if w <= 0:
+                    raise ValueError(
+                        f"fixed column {node.path_str} lacks type_length"
+                    )
+                return unscaled.to_bytes(w, "big", signed=True)
+            if node.type == Type.BYTE_ARRAY:
+                n = max((unscaled.bit_length() + 8) // 8, 1)
+                return unscaled.to_bytes(n, "big", signed=True)
+        except OverflowError as e:
+            raise ValueError(
+                f"decimal {v} does not fit {node.type_length}-byte storage "
+                f"of {node.path_str}"
+            ) from e
+        return unscaled
+    if kind == "date" and isinstance(v, dt.date) and not isinstance(v, dt.datetime):
+        return (v - dt.date(1970, 1, 1)).days
+    if kind is not None and kind[0] == "timestamp":
+        unit = kind[1]
+        if isinstance(v, np.datetime64):
+            ns = int(v.astype("datetime64[ns]").astype(np.int64))
+            return ns // {"NANOS": 1, "MICROS": 1_000, "MILLIS": 1_000_000}[unit]
+        if isinstance(v, dt.datetime):
+            epoch = dt.datetime(
+                1970, 1, 1, tzinfo=dt.timezone.utc if v.tzinfo else None
+            )
+            delta = v - epoch
+            micros = (
+                delta.days * 86_400_000_000
+                + delta.seconds * 1_000_000
+                + delta.microseconds
+            )
+            return {
+                "MILLIS": micros // 1_000,
+                "MICROS": micros,
+                "NANOS": micros * 1_000,
+            }[unit]
+    if kind is not None and kind[0] == "time":
+        nanos = None
+        if isinstance(v, dt.time):
+            nanos = (
+                (v.hour * 3600 + v.minute * 60 + v.second) * 10**9
+                + v.microsecond * 1_000
+            )
+        elif hasattr(v, "nanos"):  # floor.Time
+            nanos = int(v.nanos)
+        if nanos is not None:
+            return nanos // {"NANOS": 1, "MICROS": 1_000, "MILLIS": 1_000_000}[
+                kind[1]
+            ]
+    return v
+
+
 def convert_logical(node: Column, v):
     """Storage value -> ergonomic Python value by logical type, matching
     pyarrow's to_pylist() conventions (DATE -> date, TIMESTAMP -> datetime,

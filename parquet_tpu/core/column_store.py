@@ -127,8 +127,23 @@ class ColumnChunkBuilder:
             return self._coerce_array(self.values)
         if ptype == Type.BOOLEAN:
             return np.asarray(self.values, dtype=bool)
+        vals = self.values
+        if vals and not isinstance(vals[0], (bytes, str)):
+            # row-domain objects (e.g. Decimal into FLBA/BYTE_ARRAY
+            # storage) convert by the leaf's logical annotation; datetimes
+            # for INT96 pass through untouched (handled below)
+            from .assembly import convert_to_storage, logical_kind
+
+            k = logical_kind(self.column)
+            if k is not None and k != "int96":
+                try:
+                    vals = [convert_to_storage(self.column, x, k) for x in vals]
+                except ValueError as e:
+                    raise StoreError(
+                        f"store: {self.column.path_str}: {e}"
+                    ) from e
         if ptype == Type.BYTE_ARRAY:
-            return byte_array_from_items(self.values, to_bytes=self._to_bytes)
+            return byte_array_from_items(vals, to_bytes=self._to_bytes)
         if ptype in (Type.INT96, Type.FIXED_LEN_BYTE_ARRAY):
             width = 12 if ptype == Type.INT96 else (self.column.type_length or 0)
             if width <= 0:
@@ -136,7 +151,7 @@ class ColumnChunkBuilder:
                     f"store: fixed column {self.column.path_str} lacks type_length"
                 )
             rows = []
-            for v in self.values:
+            for v in vals:
                 if ptype == Type.INT96 and isinstance(v, _dt.datetime):
                     # datetime into an INT96 column converts like the
                     # reference's floor writer (writer.go INT96 heuristics)
@@ -304,6 +319,36 @@ class ColumnChunkBuilder:
 
     def _coerce_array(self, v):
         ptype = self.column.type
+        if isinstance(v, list) and v:
+            # row-domain objects (datetime/date/time/Decimal — what
+            # iter_rows RETURNS) convert to storage by the leaf's logical
+            # annotation; raw storage lists skip on the first-element
+            # check. UINT columns also wrap plain ints >= 2^(bits-1) into
+            # their signed storage bit pattern.
+            first = v[0]
+            needs = not isinstance(first, (int, float, str, bytes))
+            if (
+                not needs
+                and isinstance(first, int)
+                and ptype in (Type.INT32, Type.INT64)
+            ):
+                from .assembly import logical_kind
+
+                k = logical_kind(self.column)
+                needs = k is not None and k[0] == "uint"
+            if needs:
+                from .assembly import convert_to_storage, logical_kind
+
+                k = logical_kind(self.column)
+                if k is not None:
+                    try:
+                        v = [
+                            convert_to_storage(self.column, x, k) for x in v
+                        ]
+                    except ValueError as e:
+                        raise StoreError(
+                            f"store: {self.column.path_str}: {e}"
+                        ) from e
         if type(v).__module__.split(".", 1)[0] == "pyarrow":
             v = self._from_arrow(v)
             if isinstance(v, ByteArrayData):

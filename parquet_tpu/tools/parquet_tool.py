@@ -274,6 +274,13 @@ def cmd_split(args) -> int:
         if args.n is not None or args.size is not None:
             print("split: --groups excludes -n/--size", file=sys.stderr)
             return 2
+        if args.codec is not None:
+            print(
+                "split: --groups copies chunk bytes verbatim; --codec has "
+                "no effect there (use -n/--size to re-encode)",
+                file=sys.stderr,
+            )
+            return 2
         from ..core.merge import split_row_groups
 
         parts = split_row_groups(args.file, pattern, args.groups)
@@ -285,7 +292,7 @@ def cmd_split(args) -> int:
     target_size = args.size
     with FileReader(args.file) as r:
         schema = r.schema
-        codec = args.codec
+        codec = args.codec or "snappy"
         part = 0
         rows_in_part = 0
         writer = None
@@ -377,7 +384,7 @@ def main(argv=None) -> int:
         type=_parse_size,
         help="target bytes per part (suffixes K/M/G), like the reference",
     )
-    pp.add_argument("--codec", default="snappy")
+    pp.add_argument("--codec", default=None, help="re-encode codec (default snappy; invalid with --groups)")
     pp.add_argument(
         "--groups",
         type=int,
