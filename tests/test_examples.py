@@ -1,0 +1,42 @@
+"""Every example runs to completion as a subprocess (its own assertions are
+the checks) — the documented entry points must not rot. Each runs in an
+isolated temp cwd; the two low-level READER examples get
+write_low_level's output produced there first."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+EXAMPLES = sorted(p.name for p in (ROOT / "examples").glob("*.py"))
+
+# readers of example.parquet (cwd-relative): produce it first
+NEEDS_WRITE = {"read_low_level.py", "tpu_columnar_scan.py"}
+
+
+def _run(name, cwd):
+    env = {
+        "PATH": "/usr/bin:/bin",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "HOME": str(cwd),
+    }
+    return subprocess.run(
+        [sys.executable, str(ROOT / "examples" / name)],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+        cwd=cwd,
+    )
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name, tmp_path):
+    if name in NEEDS_WRITE:
+        pre = _run("write_low_level.py", tmp_path)
+        assert pre.returncode == 0, pre.stderr[-1500:]
+    r = _run(name, tmp_path)
+    assert r.returncode == 0, f"{name}:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
