@@ -682,6 +682,18 @@ def main() -> None:
                 "value_max": round(ROWS / r_dev["t_min"], 1),
                 "vs_baseline_min": round(r_base["t_min"] / r_dev["t_max"], 3),
                 "vs_baseline_max": round(r_base["t_max"] / r_dev["t_min"], 3),
+                # the EXTERNAL comparator (pyarrow decode + upload at the
+                # same delivery point): stable across rounds, unlike our
+                # own host baseline, which each round's host-lane work
+                # speeds up (see BASELINE.md "Headline trajectory")
+                **(
+                    {
+                        "rows_s_pyarrow": round(ROWS / r_pa["t"], 1),
+                        "vs_pyarrow": round(r_pa["t"] / t_dev, 3),
+                    }
+                    if r_pa
+                    else {}
+                ),
             }
         )
     )

@@ -73,10 +73,8 @@ def main() -> None:
             )
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
-        return (
-            {k: v - 0.5 * g for (k, v), g in zip(params.items(), grads.values())},
-            loss,
-        )
+        new_params = jax.tree_util.tree_map(lambda v, g: v - 0.5 * g, params, grads)
+        return new_params, loss
 
     params = jax.device_put(
         {"w": jnp.zeros(2, jnp.float32), "b": jnp.zeros((), jnp.float32)},
