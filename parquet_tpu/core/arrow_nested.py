@@ -136,6 +136,13 @@ def _logical_target(pa, leaf):
             and leaf.type_length == 2  # spec-invalid widths stay raw binary
         ):
             return pa.float16()
+        # UUID/JSON extension types deliberately NOT mapped: pyarrow's
+        # arrow.uuid/arrow.json extensions cannot ride every lane here
+        # (zero-group empty arrays, nested structs, dictionary-preserved
+        # columns all reject extension types), and JSON would force a
+        # UTF-8-validating cast that crashes on foreign non-UTF-8 payloads
+        # our raw-binary convention reads fine. write_column still accepts
+        # extension ARRAYS (storage unwrap in column_store._from_arrow).
         return None
     if ct is None:
         return None

@@ -185,6 +185,9 @@ class ColumnChunkBuilder:
                 f"{self.column.path_str}"
             )
         t = v.type
+        if isinstance(t, pa.BaseExtensionType):  # arrow.uuid / arrow.json etc.
+            v = v.storage
+            t = v.type
         if pa.types.is_dictionary(t):
             v = v.dictionary_decode()
             t = v.type
