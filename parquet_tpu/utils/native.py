@@ -542,6 +542,7 @@ class NativeLib:
 
     _POOL_MAX_BUFS = 6
     _POOL_MAX_BYTES = 64 << 20  # don't hold giant one-off chunks
+    _POOL_MAX_TOTAL = 192 << 20  # per-thread retention cap (all buffers)
 
     def _take_buf(self, size: int):
         """A uint8 staging buffer from the per-thread pool (best fit), or a
@@ -579,6 +580,7 @@ class NativeLib:
         pool = getattr(tl, "out_pool", None)
         if pool is None:
             pool = tl.out_pool = []
+        held = sum(len(b) for b in pool)
         for name in names:
             buf = bases.pop(name, None)
             if (
@@ -586,8 +588,10 @@ class NativeLib:
                 and len(buf)
                 and len(buf) <= self._POOL_MAX_BYTES
                 and len(pool) < self._POOL_MAX_BUFS
+                and held + len(buf) <= self._POOL_MAX_TOTAL
             ):
                 pool.append(buf)
+                held += len(buf)
 
     def chunk_prepare(
         self,

@@ -368,3 +368,17 @@ class TestRepackEdgeCases:
         np.testing.assert_array_equal(
             np.asarray(host_cd.def_levels), np.asarray(dev_cd.def_levels)
         )
+
+    def test_pool_total_retention_capped(self):
+        lib = _needs_native()
+        pool = []
+        lib._chunk_tl.out_pool = pool
+        # simulate releases up to the retention cap
+        for _ in range(5):
+            fresh = {"_bases": {"values": np.empty(60 << 20, np.uint8),
+                                 "packed": None, "delta": None}}
+            lib.release_buffers(fresh, ("values",))
+        total = sum(len(b) for b in pool)
+        assert total <= lib._POOL_MAX_TOTAL, total
+        assert len(pool) == 3  # 3 x 60MB fits under 192MB, the 4th doesn't
+        del lib._chunk_tl.out_pool
