@@ -18,6 +18,8 @@ from __future__ import annotations
 from ..core.schema import Column, Schema
 from ..meta.parquet_types import (
     ConvertedType,
+    DateType,
+    DecimalType,
     FieldRepetitionType,
     IntType,
     ListType,
@@ -26,6 +28,7 @@ from ..meta.parquet_types import (
     SchemaElement,
     StringType,
     TimestampType,
+    TimeType,
     TimeUnit,
     Type,
 )
@@ -40,6 +43,9 @@ __all__ = [
     "map_of",
     "string",
     "timestamp",
+    "date",
+    "time_of_day",
+    "decimal",
     "int_type",
 ]
 
@@ -78,6 +84,59 @@ def timestamp(unit: str = "micros", utc: bool = True) -> _TypeSpec:
         logical=LogicalType(
             TIMESTAMP=TimestampType(isAdjustedToUTC=utc, unit=units[unit]())
         ),
+    )
+
+
+def date() -> _TypeSpec:
+    return _TypeSpec(
+        Type.INT32,
+        converted=ConvertedType.DATE,
+        logical=LogicalType(DATE=DateType()),
+    )
+
+
+def time_of_day(unit: str = "micros", utc: bool = True) -> _TypeSpec:
+    units = {"millis": TimeUnit.millis, "micros": TimeUnit.micros, "nanos": TimeUnit.nanos}
+    conv = {
+        "millis": ConvertedType.TIME_MILLIS,
+        "micros": ConvertedType.TIME_MICROS,
+        "nanos": None,
+    }[unit]
+    return _TypeSpec(
+        Type.INT32 if unit == "millis" else Type.INT64,
+        converted=conv,
+        logical=LogicalType(TIME=TimeType(isAdjustedToUTC=utc, unit=units[unit]())),
+    )
+
+
+def decimal(precision: int, scale: int = 0, fixed_width: int | None = None) -> _TypeSpec:
+    """DECIMAL over the narrowest standard storage (INT32 to precision 9,
+    INT64 to 18, FLBA beyond — or `fixed_width` to force FLBA)."""
+    if not 1 <= precision or not 0 <= scale <= precision:
+        raise ValueError("decimal: need precision >= 1 and 0 <= scale <= precision")
+    min_width = 1
+    while 10 ** precision > 1 << (8 * min_width - 1):
+        min_width += 1
+    if fixed_width is not None:
+        if fixed_width < min_width:
+            raise ValueError(
+                f"decimal: fixed_width {fixed_width} cannot hold precision "
+                f"{precision} (needs >= {min_width} bytes)"
+            )
+        ptype, tl = Type.FIXED_LEN_BYTE_ARRAY, fixed_width
+    elif precision <= 9:
+        ptype, tl = Type.INT32, None
+    elif precision <= 18:
+        ptype, tl = Type.INT64, None
+    else:
+        ptype, tl = Type.FIXED_LEN_BYTE_ARRAY, min_width
+    return _TypeSpec(
+        ptype,
+        converted=ConvertedType.DECIMAL,
+        logical=LogicalType(DECIMAL=DecimalType(scale=scale, precision=precision)),
+        type_length=tl,
+        scale=scale,
+        precision=precision,
     )
 
 

@@ -17,12 +17,17 @@ import pytest
 from parquet_tpu import FileReader, FileWriter
 from parquet_tpu.meta.parquet_types import Type
 from parquet_tpu.schema.builder import (
+    date,
+    decimal as decimal_spec,
     group,
+    int_type,
     list_of,
     message,
     optional,
     required,
     string,
+    time_of_day,
+    timestamp,
 )
 
 N_SEEDS = 12
@@ -39,6 +44,11 @@ def eq(a, b):
     return a == b
 
 
+import datetime as _rt_dt
+import decimal as _rt_dec
+
+_EPOCH = _rt_dt.datetime(1970, 1, 1, tzinfo=_rt_dt.timezone.utc)
+
 _SCALARS = [
     ("i32", Type.INT32, lambda r: int(r.integers(-(2**31), 2**31))),
     ("i64", Type.INT64, lambda r: int(r.integers(-(2**62), 2**62))),
@@ -46,7 +56,34 @@ _SCALARS = [
     ("f64", Type.DOUBLE, lambda r: float(r.standard_normal())),
     ("flag", Type.BOOLEAN, lambda r: bool(r.random() < 0.5)),
     ("name", "string", lambda r: f"s{int(r.integers(0, 50))}" * int(r.integers(1, 3))),
+    # logical types: generators emit the ROW-DOMAIN values iter_rows
+    # returns, so the roundtrip exercises both conversion directions
+    ("ts", "timestamp",
+     lambda r: _EPOCH + _rt_dt.timedelta(microseconds=int(r.integers(-2**52, 2**52)))),
+    ("day", "date",
+     lambda r: _rt_dt.date(1970, 1, 1) + _rt_dt.timedelta(days=int(r.integers(-200_000, 200_000)))),
+    ("amount", "decimal",
+     lambda r: _rt_dec.Decimal(int(r.integers(-10**8, 10**8))).scaleb(-2)),
+    ("u64", "uint64", lambda r: int(r.integers(0, 2**63)) * 2 + int(r.random() < 0.5)),
+    ("tod", "time", lambda r: _rt_dt.time(
+        int(r.integers(0, 24)), int(r.integers(0, 60)), int(r.integers(0, 60)),
+        int(r.integers(0, 1000)) * 1000,  # whole millis: exact at both units
+    )),
 ]
+
+_LOGICAL_SPECS = {
+    # utc=True always: the generators emit tz-aware datetimes, and the
+    # read side returns naive ones for utc=False specs (spec semantics)
+    "timestamp": lambda r: timestamp("micros", utc=True),
+    "date": lambda r: date(),
+    "decimal": lambda r: decimal_spec(
+        10, 2, fixed_width=9 if r.random() < 0.3 else None
+    ),
+    "uint64": lambda r: int_type(64, signed=False),
+    "time": lambda r: time_of_day(
+        "millis" if r.random() < 0.5 else "micros", utc=True
+    ),
+}
 
 
 def _draw_schema_and_rows(rng):
@@ -58,7 +95,12 @@ def _draw_schema_and_rows(rng):
         base, ptype, gen = _SCALARS[pi]
         colname = f"{base}_{ci}"
         opt = bool(rng.random() < 0.5)
-        spec = string() if ptype == "string" else ptype
+        if ptype == "string":
+            spec = string()
+        elif ptype in _LOGICAL_SPECS:
+            spec = _LOGICAL_SPECS[ptype](rng)
+        else:
+            spec = ptype
         fields.append(optional(colname, spec) if opt else required(colname, spec))
         null_p = 0.2 if opt else 0.0
         gens.append((colname, gen, null_p))
