@@ -191,7 +191,13 @@ def decode_data_page_v2(
     if column.max_def > 0:
         dfl = decode_levels_v2(buf[rep_len : rep_len + def_len], n, column.max_def)
         non_null = int((dfl == column.max_def).sum())
-    if h.num_nulls is not None and dfl is not None:
+    if h.num_nulls is not None and dfl is not None and column.max_rep == 0:
+        # FLAT columns only: for repeated columns parquet-cpp counts
+        # num_nulls as null VALUES (def one below max at the element or a
+        # struct member), excluding empty-list/ancestor placeholders — the
+        # "non_null = num_values - num_nulls" invariant does not hold for
+        # its nested pages (found by differential fuzz vs pyarrow), so the
+        # levels are the only trustworthy source there
         if n - non_null != h.num_nulls:
             raise PageError(
                 f"page: v2 header claims {h.num_nulls} nulls, levels say {n - non_null}"

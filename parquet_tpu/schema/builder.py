@@ -71,8 +71,17 @@ def string() -> _TypeSpec:
     )
 
 
+_TIME_UNITS = {"millis": TimeUnit.millis, "micros": TimeUnit.micros, "nanos": TimeUnit.nanos}
+
+
+def _unit(unit: str):
+    if unit not in _TIME_UNITS:
+        raise ValueError(f"bad time unit {unit!r} (millis/micros/nanos)")
+    return _TIME_UNITS[unit]
+
+
 def timestamp(unit: str = "micros", utc: bool = True) -> _TypeSpec:
-    units = {"millis": TimeUnit.millis, "micros": TimeUnit.micros, "nanos": TimeUnit.nanos}
+    u = _unit(unit)
     conv = {
         "millis": ConvertedType.TIMESTAMP_MILLIS,
         "micros": ConvertedType.TIMESTAMP_MICROS,
@@ -82,7 +91,7 @@ def timestamp(unit: str = "micros", utc: bool = True) -> _TypeSpec:
         Type.INT64,
         converted=conv,
         logical=LogicalType(
-            TIMESTAMP=TimestampType(isAdjustedToUTC=utc, unit=units[unit]())
+            TIMESTAMP=TimestampType(isAdjustedToUTC=utc, unit=u())
         ),
     )
 
@@ -96,7 +105,7 @@ def date() -> _TypeSpec:
 
 
 def time_of_day(unit: str = "micros", utc: bool = True) -> _TypeSpec:
-    units = {"millis": TimeUnit.millis, "micros": TimeUnit.micros, "nanos": TimeUnit.nanos}
+    u = _unit(unit)
     conv = {
         "millis": ConvertedType.TIME_MILLIS,
         "micros": ConvertedType.TIME_MICROS,
@@ -105,7 +114,7 @@ def time_of_day(unit: str = "micros", utc: bool = True) -> _TypeSpec:
     return _TypeSpec(
         Type.INT32 if unit == "millis" else Type.INT64,
         converted=conv,
-        logical=LogicalType(TIME=TimeType(isAdjustedToUTC=utc, unit=units[unit]())),
+        logical=LogicalType(TIME=TimeType(isAdjustedToUTC=utc, unit=u())),
     )
 
 

@@ -326,3 +326,32 @@ class TestLegacyShapes:
             [],
             [None],
         ]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_nested_v2_pages_num_nulls_quirk(tmp_path, backend):
+    """parquet-cpp's V2 pages count num_nulls as null VALUES only (empty
+    lists and null ancestors excluded), so num_values - num_nulls does NOT
+    equal the data section's value count for nested columns — the reader
+    must trust the levels, not the header claim (found by differential
+    fuzz; a strict equality check used to reject valid pyarrow files)."""
+    elem = pa.struct([("a", pa.int64()), ("b", pa.string())])
+    t = pa.table({
+        "c": pa.array(
+            [
+                None,                       # null list
+                [],                         # empty list
+                [None],                     # null element
+                [{"a": None, "b": None}],   # null members
+                [{"a": 1, "b": "x"}, None],
+            ] * 40,
+            pa.list_(elem),
+        ),
+    })
+    p = str(tmp_path / "v2n.parquet")
+    pq.write_table(t, p, data_page_version="2.0", use_dictionary=False,
+                   compression="snappy")
+    _assert_matches_pyarrow(p, backend)
+    with FileReader(p, backend=backend) as r:
+        rows = [x["c"] for x in r.iter_rows()]
+    assert rows[:5] == t.column("c").to_pylist()[:5]

@@ -1,0 +1,138 @@
+"""Differential fuzz of the nested-to-Arrow builder: RANDOM schema shapes.
+
+The targeted suite (test_arrow_nested.py) pins named shapes; this one
+generates arbitrary nestings — structs in lists in maps in structs, to
+depth 4, with independent null probabilities at every level — writes them
+with pyarrow under randomized row-group sizes and encodings, and requires
+to_arrow to equal pyarrow.parquet.read_table on every column of every
+seed. The Dremel level math has exactly the kind of corners (placeholder
+dropping, slot alignment, validity thresholds) that only random shapes
+find.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu import FileReader
+
+N_SEEDS = 20
+N_ROWS = 300
+
+_LEAVES = [
+    pa.int64(),
+    pa.int32(),
+    pa.float64(),
+    pa.string(),
+    pa.bool_(),
+    pa.date32(),
+    pa.timestamp("us"),
+]
+
+
+def _rand_type(rng, depth):
+    if depth >= 4 or rng.random() < 0.45:
+        return _LEAVES[int(rng.integers(0, len(_LEAVES)))]
+    k = rng.random()
+    if k < 0.4:
+        return pa.list_(_rand_type(rng, depth + 1))
+    if k < 0.75:
+        n = int(rng.integers(1, 4))
+        return pa.struct(
+            [(f"f{j}", _rand_type(rng, depth + 1)) for j in range(n)]
+        )
+    return pa.map_(pa.string(), _rand_type(rng, depth + 1))
+
+
+def _rand_value(rng, typ, depth=0):
+    if rng.random() < (0.15 if depth else 0.1):
+        return None
+    if pa.types.is_list(typ):
+        return [
+            _rand_value(rng, typ.value_type, depth + 1)
+            for _ in range(int(rng.integers(0, 4)))
+        ]
+    if pa.types.is_struct(typ):
+        return {
+            f.name: _rand_value(rng, f.type, depth + 1) for f in typ
+        }
+    if pa.types.is_map(typ):
+        return [
+            (f"k{j}", _rand_value(rng, typ.item_type, depth + 1))
+            for j in range(int(rng.integers(0, 3)))
+        ]
+    if typ == pa.int64():
+        return int(rng.integers(-(2**62), 2**62))
+    if typ == pa.int32():
+        return int(rng.integers(-(2**31), 2**31))
+    if typ == pa.float64():
+        return float(rng.standard_normal())
+    if typ == pa.string():
+        return f"s{int(rng.integers(0, 40))}" * int(rng.integers(0, 3))
+    if typ == pa.bool_():
+        return bool(rng.random() < 0.5)
+    if typ == pa.date32():
+        return dt.date(1970, 1, 1) + dt.timedelta(int(rng.integers(-10000, 10000)))
+    if typ == pa.timestamp("us"):
+        return dt.datetime(2000, 1, 1) + dt.timedelta(
+            seconds=int(rng.integers(0, 10**9))
+        )
+    raise AssertionError(typ)
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_random_nested_shapes_match_pyarrow(tmp_path, seed):
+    rng = np.random.default_rng(5_000_000 + seed)
+    n_cols = int(rng.integers(1, 4))
+    cols = {}
+    for ci in range(n_cols):
+        typ = _rand_type(rng, 0)
+        vals = [_rand_value(rng, typ) for _ in range(N_ROWS)]
+        cols[f"c{ci}"] = pa.array(vals, typ)
+    t = pa.table(cols)
+    p = str(tmp_path / f"fz{seed}.parquet")
+    pq.write_table(
+        t,
+        p,
+        row_group_size=int(rng.choice([64, 128, N_ROWS])),
+        compression=str(rng.choice(["snappy", "zstd", "none"])),
+        use_dictionary=bool(rng.random() < 0.5),
+        data_page_version=str(rng.choice(["1.0", "2.0"])),
+    )
+    want = pq.read_table(p)
+    with FileReader(p) as r:
+        out = r.to_arrow()
+    for name in want.column_names:
+        got = out.column(name).to_pylist()
+        exp = want.column(name).to_pylist()
+        assert got == exp, (seed, name, t.schema.field(name).type)
+    # row lane agrees too (three-way: pyarrow / columnar / rows)
+    with FileReader(p) as r:
+        rows = list(r.iter_rows())
+    exp_rows = want.to_pylist()
+    assert len(rows) == len(exp_rows)
+    for i, (g, w) in enumerate(zip(rows, exp_rows)):
+        for name in want.column_names:
+            typ = want.schema.field(name).type
+            gn = _norm_by_type(g[name], typ)
+            wn = _norm_by_type(w[name], typ)
+            assert gn == wn, (seed, i, name, g[name], w[name])
+
+
+def _norm_by_type(v, typ):
+    """Type-DRIVEN normalization: maps compare as dicts (pyarrow's
+    to_pylist yields pair lists, our rows yield dicts — an empty map is
+    ambiguous without the type), lists recurse by value type."""
+    if v is None:
+        return None
+    if pa.types.is_map(typ):
+        pairs = v.items() if isinstance(v, dict) else v
+        return {k: _norm_by_type(x, typ.item_type) for k, x in pairs}
+    if pa.types.is_list(typ) or pa.types.is_large_list(typ):
+        return [_norm_by_type(x, typ.value_type) for x in v]
+    if pa.types.is_struct(typ):
+        return {f.name: _norm_by_type(v.get(f.name), f.type) for f in typ}
+    return v

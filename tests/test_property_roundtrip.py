@@ -8,6 +8,8 @@ exactly through pyarrow (cross-implementation), and (c) decode byte-identical
 on the device roundtrip backend. Failures reproduce from the printed seed.
 """
 
+import datetime as _rt_dt
+import decimal as _rt_dec
 import math
 
 import numpy as np
@@ -43,9 +45,6 @@ def eq(a, b):
         return len(a) == len(b) and all(eq(x, y) for x, y in zip(a, b))
     return a == b
 
-
-import datetime as _rt_dt
-import decimal as _rt_dec
 
 _EPOCH = _rt_dt.datetime(1970, 1, 1, tzinfo=_rt_dt.timezone.utc)
 
