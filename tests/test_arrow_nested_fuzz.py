@@ -83,8 +83,9 @@ def _rand_value(rng, typ, depth=0):
     raise AssertionError(typ)
 
 
+@pytest.mark.parametrize("backend", ["host", "tpu_roundtrip"])
 @pytest.mark.parametrize("seed", range(N_SEEDS))
-def test_random_nested_shapes_match_pyarrow(tmp_path, seed):
+def test_random_nested_shapes_match_pyarrow(tmp_path, seed, backend):
     rng = np.random.default_rng(5_000_000 + seed)
     n_cols = int(rng.integers(1, 4))
     cols = {}
@@ -103,14 +104,14 @@ def test_random_nested_shapes_match_pyarrow(tmp_path, seed):
         data_page_version=str(rng.choice(["1.0", "2.0"])),
     )
     want = pq.read_table(p)
-    with FileReader(p) as r:
+    with FileReader(p, backend=backend) as r:
         out = r.to_arrow()
     for name in want.column_names:
         got = out.column(name).to_pylist()
         exp = want.column(name).to_pylist()
         assert got == exp, (seed, name, t.schema.field(name).type)
     # row lane agrees too (three-way: pyarrow / columnar / rows)
-    with FileReader(p) as r:
+    with FileReader(p, backend=backend) as r:
         rows = list(r.iter_rows())
     exp_rows = want.to_pylist()
     assert len(rows) == len(exp_rows)
