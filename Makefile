@@ -2,7 +2,7 @@
 # .github/workflows/ci.yml (ruff runs there; this image has no linter, so the
 # syntax gate is compileall).
 
-.PHONY: check test native bench dryrun
+.PHONY: check test native bench bench-prepare dryrun
 
 check: native
 	python -m compileall -q parquet_tpu tests bench.py __graft_entry__.py
@@ -16,6 +16,11 @@ native:
 
 bench:
 	python bench.py
+
+# host prepare microbench: serial wall + per-stage breakdown (decompress /
+# levels / prescan / copy) + GIL-free thread scaling; no accelerator needed
+bench-prepare: native
+	python bench.py --phase prepare
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -561,6 +561,89 @@ def _phase_verify(path) -> None:
     print(json.dumps({"ok": True}))
 
 
+def _phase_prepare() -> None:
+    """Host-prepare microbench (`make bench-prepare`): the serial prepare wall
+    named in BASELINE.md, split per stage by the fused native walk's internal
+    clocks (decompress / levels / prescan / copy), plus thread scaling of the
+    GIL-free path. Host-only — runs with or without an accelerator."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch the tunnel here
+    path = build_file()
+    import concurrent.futures as cf
+
+    from parquet_tpu.core.chunk import ChunkWindow, chunk_byte_range
+    from parquet_tpu.core.reader import FileReader
+    from parquet_tpu.kernels.pipeline import prepare_chunk_plan
+    from parquet_tpu.utils.trace import decode_trace
+
+    with FileReader(path) as r:
+        rows = int(r.metadata.num_rows or 0)
+        work = []
+        for i in range(r.num_row_groups):
+            for _p, cc, column in r._selected_chunks(i):
+                off, total = chunk_byte_range(cc)
+                work.append((r._pread(off, total), off, cc, column))
+
+    def prep_one(item):
+        buf, off, cc, column = item
+        return prepare_chunk_plan(ChunkWindow(buf, off), cc, column)
+
+    def prep_all():
+        for it in work:
+            prep_one(it)
+
+    prep_all()  # warm: lazy imports, native load, per-thread buffer pools
+    with decode_trace() as tr:
+        t0 = time.perf_counter()
+        prep_all()
+        serial_probe = time.perf_counter() - t0
+    stages = {
+        name: round(s.seconds * 1e3, 3)
+        for name, s in sorted(tr.stages.items())
+        if name.startswith("prepare.")
+    }
+    engaged = tr.stages.get("prepare_fused_engaged")
+    declined = tr.stages.get("prepare_fused_declined")
+    serial = timed_stats(prep_all, REPEATS, "prepare-serial", rows)["t"]
+
+    # thread scaling: the same chunk list split over N workers; the fused
+    # walk holds no lock and no GIL, so wall should shrink ~linearly until
+    # memory bandwidth saturates
+    scaling = {}
+    ncpu = os.cpu_count() or 1
+    for nthreads in sorted({2, 4, min(8, ncpu), ncpu}):
+        if nthreads < 2 or nthreads > ncpu:
+            continue
+        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            list(pool.map(prep_one, work))  # per-thread warmup (scratch pools)
+            t0 = time.perf_counter()
+            list(pool.map(prep_one, work))
+            wall = time.perf_counter() - t0
+        scaling[str(nthreads)] = {
+            "t": round(wall, 5),
+            "effective_cores": round(serial / wall, 2),
+        }
+    from parquet_tpu.utils.native import get_native
+
+    nlib = get_native()
+    out = {
+        "rows": rows,
+        # which binding ran: the extension (explicit Py_BEGIN_ALLOW_THREADS)
+        # vs the ctypes fallback — scaling numbers are not comparable across
+        # the two, so the artifact must say which produced them
+        "gil_free_binding": bool(nlib is not None and nlib.fused_gil_free),
+        "prepare_serial_s": round(serial, 5),
+        "prepare_serial_probe_s": round(serial_probe, 5),
+        "prepare_ms_per_1m_rows": round(serial / max(rows, 1) * 1e6 * 1e3, 3),
+        "rows_s_prepare": round(rows / serial, 1),
+        "stage_ms": stages,
+        "fused_engaged": engaged.calls if engaged else 0,
+        "fused_declined": declined.calls if declined else 0,
+        "thread_scaling": scaling,
+    }
+    log(f"bench: prepare breakdown {out}")
+    print(json.dumps(out))
+
+
 _PHASE_FNS = {
     "host": decode_all_host,
     "tpu_host": decode_all_tpu_to_host,
@@ -624,6 +707,19 @@ def main() -> None:
     log("bench: parity checks (isolated process; also warms the compile cache)")
     if _run_phase("verify") is None:
         raise SystemExit("bench: verification phase failed")
+
+    # host prepare breakdown (PQT_BENCH_PREPARE=0 to skip): the serial
+    # prepare wall + per-stage split that bounds the device pipeline
+    r_prep = None
+    if os.environ.get("PQT_BENCH_PREPARE", "1") != "0":
+        r_prep = _run_phase("prepare")
+        if r_prep:
+            log(
+                f"bench: prepare: {r_prep['prepare_ms_per_1m_rows']:.1f} ms/1M rows "
+                f"serial, stages {r_prep['stage_ms']}, fused "
+                f"{r_prep['fused_engaged']}/{r_prep['fused_engaged'] + r_prep['fused_declined']} "
+                f"chunks, scaling {r_prep['thread_scaling']}"
+            )
 
     # secondary metric (stderr): classic decode-to-host rows/s
     r_h = _run_phase("host")
@@ -694,6 +790,18 @@ def main() -> None:
                     if r_pa
                     else {}
                 ),
+                # host prepare breakdown (make bench-prepare for the full
+                # standalone report): the serial stage split that bounds
+                # prepare/RPC overlap
+                **(
+                    {
+                        "prepare_ms_per_1m_rows": r_prep["prepare_ms_per_1m_rows"],
+                        "prepare_stage_ms": r_prep["stage_ms"],
+                        "prepare_thread_scaling": r_prep["thread_scaling"],
+                    }
+                    if r_prep
+                    else {}
+                ),
             }
         )
     )
@@ -732,6 +840,8 @@ if __name__ == "__main__":
             _phase_write()
         elif name == "verify":
             _phase_verify(build_file())
+        elif name == "prepare":
+            _phase_prepare()
         else:
             _phase_timed(name, build_file())
     else:

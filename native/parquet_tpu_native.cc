@@ -16,8 +16,11 @@
 #include <cstdlib>
 #include <cstring>
 #include <cstddef>
+#include <ctime>        // per-stage prepare clocks (chunk_prepare stage_ns)
 #include <sys/types.h>  // ssize_t
 #include <zlib.h>       // gzip pages in the whole-chunk prepare walk
+
+#include "parquet_tpu_native.h"  // shared ptq_chunk_prepare prototype (pyext)
 
 extern "C" {
 
@@ -1373,6 +1376,32 @@ ssize_t decode_levels16(const uint8_t* src, size_t src_len, int64_t n,
   return static_cast<ssize_t>(pos);
 }
 
+// Per-stage wall clock for the whole-chunk walk. All accounting is skipped
+// when the caller passes no stage array (ns == nullptr): production calls pay
+// one branch per stage boundary, the bench pays ~25 ns per clock_gettime.
+struct StageClock {
+  int64_t* ns;
+  int64_t t0;
+  static inline int64_t now() {
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<int64_t>(ts.tv_sec) * 1000000000ll + ts.tv_nsec;
+  }
+  inline void start() {
+    if (ns) t0 = now();
+  }
+  inline void stop(int slot) {
+    if (ns) {
+      int64_t t = now();
+      ns[slot] += t - t0;
+      t0 = t;
+    }
+  }
+};
+
+// stage_ns slots (accumulated nanoseconds)
+enum { ST_DECOMPRESS = 0, ST_LEVELS = 1, ST_PRESCAN = 2, ST_COPY = 3 };
+
 }  // namespace
 
 // Page-table column layout (int64[n_pages][18]); absent fields are 0 unless
@@ -1422,8 +1451,11 @@ ssize_t ptq_chunk_prepare(
     int64_t* h_byteoff, size_t max_runs,
     uint32_t* d_widths, int64_t* d_bytestart, int32_t* d_outstart,
     uint64_t* d_mins, size_t max_minis,
-    int64_t* totals /* [8]: lvl_total, values_used, packed_used, delta_used,
-                       runs, minis, has_dict, reserved */) {
+    int64_t* totals, /* [8]: lvl_total, values_used, packed_used, delta_used,
+                        runs, minis, has_dict, reserved */
+    int64_t* stage_ns /* nullable [4]: accumulated ns per stage (decompress,
+                         levels, prescan, copy) for the bench breakdown */) {
+  StageClock clk{stage_ns, 0};
   size_t pos = 0;
   size_t n_pages = 0;
   int64_t lvl_total = 0;
@@ -1457,14 +1489,18 @@ ssize_t ptq_chunk_prepare(
       const uint8_t* block = payload;
       size_t block_len = payload_len;
       if (codec != 0) {
+        clk.start();
         int rc = decompress_page(codec, payload, payload_len, scratch,
                                  scratch_cap, static_cast<size_t>(usize));
+        clk.stop(ST_DECOMPRESS);
         if (rc != 0) return rc;
         block = scratch;
         block_len = static_cast<size_t>(usize);
       }
       if (values_used + block_len > values_cap) return -5;
+      clk.start();
       std::memcpy(values_out + values_used, block, block_len);
+      clk.stop(ST_COPY);
       P[PC_KIND] = 1;
       P[PC_N] = slots[11] == INT64_MIN ? 0 : slots[11];  // dict num_values
       P[PC_ENC] = slots[12] == INT64_MIN ? 0 : slots[12];
@@ -1504,14 +1540,17 @@ ssize_t ptq_chunk_prepare(
           dst = values_out + values_used;
           dcap = values_cap - values_used;
         }
+        clk.start();
         int rc = decompress_page(codec, payload, payload_len, dst, dcap,
                                  static_cast<size_t>(usize));
+        clk.stop(ST_DECOMPRESS);
         if (rc != 0) return rc;
         block = dst;
         block_len = static_cast<size_t>(usize);
       }
       size_t cur = 0;
       if (lvl_total + n > expected_values) return -5;
+      clk.start();
       if (max_rep > 0) {
         if (block_len < cur + 4) return -1;
         uint32_t sz;
@@ -1535,6 +1574,7 @@ ssize_t ptq_chunk_prepare(
         cur += 4 + sz;
         non_null = eq;
       }
+      clk.stop(ST_LEVELS);
       vsrc = block + cur;
       vlen = block_len - cur;
     } else {  // DATA_PAGE_V2: levels raw, values optionally compressed
@@ -1550,6 +1590,7 @@ ssize_t ptq_chunk_prepare(
               payload_len)
         return -1;
       if (lvl_total + n > expected_values) return -5;
+      clk.start();
       if (max_rep > 0) {
         if (decode_levels16(payload, static_cast<size_t>(rep_len), n, max_rep,
                             rep_out + lvl_total, -1, nullptr) < 0)
@@ -1563,6 +1604,7 @@ ssize_t ptq_chunk_prepare(
           return -1;
         non_null = eq;
       }
+      clk.stop(ST_LEVELS);
       const uint8_t* vreg = payload + rep_len + def_len;
       size_t vreg_len = payload_len - static_cast<size_t>(rep_len + def_len);
       if (codec != 0 && (is_comp == INT64_MIN || is_comp != 0)) {
@@ -1577,8 +1619,10 @@ ssize_t ptq_chunk_prepare(
           dst = values_out + values_used;
           dcap = values_cap - values_used;
         }
+        clk.start();
         int rc = decompress_page(codec, vreg, vreg_len, dst, dcap,
                                  static_cast<size_t>(vexpect));
+        clk.stop(ST_DECOMPRESS);
         if (rc != 0) return rc;
         vsrc = dst;
         vlen = static_cast<size_t>(vexpect);
@@ -1616,6 +1660,7 @@ ssize_t ptq_chunk_prepare(
       size_t spos = 0;
       int64_t produced = 0;
       size_t run0 = runs, pack0 = packed_used;
+      clk.start();
       while (produced < non_null) {
         uint64_t header = 0;
         int shift = 0;
@@ -1664,6 +1709,7 @@ ssize_t ptq_chunk_prepare(
         runs++;
         produced += take;
       }
+      clk.stop(ST_PRESCAN);
       P[PC_ROUTE] = 1;
       P[PC_RUNS] = static_cast<int64_t>(run0);
       P[PC_RUNE] = static_cast<int64_t>(runs);
@@ -1675,15 +1721,19 @@ ssize_t ptq_chunk_prepare(
       int64_t total = 0, consumed = 0;
       size_t mini0 = minis;
       // prescan against max_minis - minis remaining slots
+      clk.start();
       ssize_t m = ptq_prescan_delta_packed(
           vsrc, vlen, delta_nbits, non_null, d_widths + minis,
           d_bytestart + minis, d_outstart + minis, d_mins + minis,
           max_minis - minis, &first, &total, &consumed);
+      clk.stop(ST_PRESCAN);
       if (m == -2) return -4;
       if (m < 0) return -1;
       // byte starts are relative to the page's stream: rebase into delta_out
       if (delta_used + static_cast<size_t>(consumed) > delta_cap) return -5;
+      clk.start();
       std::memcpy(delta_out + delta_used, vsrc, static_cast<size_t>(consumed));
+      clk.stop(ST_COPY);
       for (ssize_t i = 0; i < m; i++)
         d_bytestart[mini0 + i] += static_cast<int64_t>(delta_used);
       P[PC_ROUTE] = 2;
@@ -1699,8 +1749,11 @@ ssize_t ptq_chunk_prepare(
       size_t need = static_cast<size_t>(non_null) * type_size;
       if (vlen < need) return -1;  // "plain payload too short"
       if (values_used + need > values_cap) return -5;
-      if (vsrc != values_out + values_used)  // direct decompress: in place
+      if (vsrc != values_out + values_used) {  // direct decompress: in place
+        clk.start();
         std::memcpy(values_out + values_used, vsrc, need);
+        clk.stop(ST_COPY);
+      }
       P[PC_ROUTE] = 3;
       P[PC_VOFF] = static_cast<int64_t>(values_used);
       P[PC_VLEN] = static_cast<int64_t>(need);
@@ -1713,8 +1766,11 @@ ssize_t ptq_chunk_prepare(
       size_t need = static_cast<size_t>(non_null) * type_size;
       if (vlen < need) return -1;
       if (values_used + need > values_cap) return -5;
-      if (vsrc != values_out + values_used)
+      if (vsrc != values_out + values_used) {
+        clk.start();
         std::memcpy(values_out + values_used, vsrc, need);
+        clk.stop(ST_COPY);
+      }
       P[PC_ROUTE] = 5;
       P[PC_VOFF] = static_cast<int64_t>(values_used);
       P[PC_VLEN] = static_cast<int64_t>(need);
@@ -1729,17 +1785,21 @@ ssize_t ptq_chunk_prepare(
       if (values_used + need > values_cap) return -5;
       uint8_t* dstv = values_out + values_used;
       const size_t nn = static_cast<size_t>(non_null);
+      clk.start();
       for (int b = 0; b < type_size; b++) {
         const uint8_t* sp = vsrc + static_cast<size_t>(b) * nn;
         for (size_t i = 0; i < nn; i++) dstv[i * type_size + b] = sp[i];
       }
+      clk.stop(ST_COPY);
       P[PC_ROUTE] = 3;
       P[PC_VOFF] = static_cast<int64_t>(values_used);
       P[PC_VLEN] = static_cast<int64_t>(need);
       values_used += need;
     } else {  // anything else: stream bytes for the Python host decoder
       if (values_used + vlen > values_cap) return -5;
+      clk.start();
       std::memcpy(values_out + values_used, vsrc, vlen);
+      clk.stop(ST_COPY);
       P[PC_ROUTE] = 0;
       P[PC_VOFF] = static_cast<int64_t>(values_used);
       P[PC_VLEN] = static_cast<int64_t>(vlen);

@@ -1,0 +1,35 @@
+/* Single source of truth for the ptq_chunk_prepare C ABI.
+ *
+ * Included (inside extern "C") by BOTH parquet_tpu_native.cc and pyext.c so
+ * the 31-argument prototype cannot drift between translation units — C does
+ * no cross-TU type checking, and a silently-misaligned call here would be
+ * heap corruption, not a compile error. The ctypes binding in
+ * utils/native.py mirrors this signature; change all three together.
+ */
+#ifndef PARQUET_TPU_NATIVE_H
+#define PARQUET_TPU_NATIVE_H
+
+#include <stddef.h>
+#include <stdint.h>
+#include <sys/types.h> /* ssize_t */
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+ssize_t ptq_chunk_prepare(
+    const uint8_t* src, size_t src_len, int codec, int max_def, int max_rep,
+    int type_size, int delta_nbits, int64_t expected_values, int64_t* pages,
+    size_t max_pages, uint16_t* def_out, uint16_t* rep_out, uint8_t* values_out,
+    size_t values_cap, uint8_t* packed_out, size_t packed_cap,
+    uint8_t* delta_out, size_t delta_cap, uint8_t* scratch, size_t scratch_cap,
+    uint8_t* h_is_rle, int64_t* h_counts, uint64_t* h_values,
+    int64_t* h_byteoff, size_t max_runs, uint32_t* d_widths,
+    int64_t* d_bytestart, int32_t* d_outstart, uint64_t* d_mins,
+    size_t max_minis, int64_t* totals, int64_t* stage_ns);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PARQUET_TPU_NATIVE_H */

@@ -1,6 +1,11 @@
 /* CPython extension for write-path hot loops that ctypes cannot reach
  * (they take Python object sequences, so a ctypes boundary would pay the
- * per-item conversion it exists to avoid).
+ * per-item conversion it exists to avoid), plus the fused GIL-free
+ * chunk-prepare entry point: the whole-page-walk C call
+ * (ptq_chunk_prepare, linked in from parquet_tpu_native.cc) runs under
+ * Py_BEGIN_ALLOW_THREADS with every buffer bound through the buffer
+ * protocol — no ctypes argument marshaling under the GIL, so the host
+ * prepare pool scales with cores.
  *
  * Built by native/Makefile into parquet_tpu/_native_ext.so; every caller
  * degrades to the pure-Python implementation when the module is absent.
@@ -11,6 +16,99 @@
 
 #include <stdint.h>
 #include <string.h>
+
+/* The fused whole-chunk walk from parquet_tpu_native.cc (plain C ABI; the
+ * Makefile links the same object file into this extension). The prototype
+ * lives in the shared header so the two translation units cannot drift. */
+#include "parquet_tpu_native.h"
+
+/* chunk_prepare(src, codec, max_def, max_rep, type_size, delta_nbits,
+ *               expected_values, pages, def_out, rep_out, values_out,
+ *               packed_out, delta_out, scratch, h_is_rle, h_counts, h_values,
+ *               h_byteoff, d_widths, d_bytestart, d_outstart, d_mins, totals,
+ *               stage_ns|None) -> rc
+ *
+ * The fused whole-chunk prepare: ONE Python->C transition per column chunk,
+ * with the entire walk (page-header parse, decompress, level decode, value
+ * prescan, repack) under Py_BEGIN_ALLOW_THREADS. Table capacities derive
+ * from the buffer lengths (pages: 18 int64 per row; h_is_rle: one byte per
+ * run slot; d_widths: 4 bytes per miniblock slot), so the caller grows a
+ * table by handing in a bigger buffer — same retry contract as the ctypes
+ * binding. Returns ptq_chunk_prepare's rc (page count or negative code).
+ */
+static PyObject *chunk_prepare(PyObject *self, PyObject *args) {
+  Py_buffer src, pages, def_out, rep_out, values, packed, delta, scratch;
+  Py_buffer h_is_rle, h_counts, h_values, h_byteoff;
+  Py_buffer d_widths, d_bytestart, d_outstart, d_mins, totals;
+  int codec, max_def, max_rep, type_size, delta_nbits;
+  long long expected_values;
+  PyObject *stage_obj;
+  if (!PyArg_ParseTuple(
+          args, "y*iiiiiLw*w*w*w*w*w*w*w*w*w*w*w*w*w*w*w*O", &src, &codec,
+          &max_def, &max_rep, &type_size, &delta_nbits, &expected_values,
+          &pages, &def_out, &rep_out, &values, &packed, &delta, &scratch,
+          &h_is_rle, &h_counts, &h_values, &h_byteoff, &d_widths, &d_bytestart,
+          &d_outstart, &d_mins, &totals, &stage_obj))
+    return NULL;
+  Py_buffer stage;
+  stage.buf = NULL;
+  if (stage_obj != Py_None &&
+      PyObject_GetBuffer(stage_obj, &stage, PyBUF_CONTIG) < 0) {
+    PyBuffer_Release(&src);
+    PyBuffer_Release(&pages);
+    PyBuffer_Release(&def_out);
+    PyBuffer_Release(&rep_out);
+    PyBuffer_Release(&values);
+    PyBuffer_Release(&packed);
+    PyBuffer_Release(&delta);
+    PyBuffer_Release(&scratch);
+    PyBuffer_Release(&h_is_rle);
+    PyBuffer_Release(&h_counts);
+    PyBuffer_Release(&h_values);
+    PyBuffer_Release(&h_byteoff);
+    PyBuffer_Release(&d_widths);
+    PyBuffer_Release(&d_bytestart);
+    PyBuffer_Release(&d_outstart);
+    PyBuffer_Release(&d_mins);
+    PyBuffer_Release(&totals);
+    return NULL;
+  }
+  Py_ssize_t rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = ptq_chunk_prepare(
+      (const uint8_t *)src.buf, (size_t)src.len, codec, max_def, max_rep,
+      type_size, delta_nbits, (int64_t)expected_values, (int64_t *)pages.buf,
+      (size_t)(pages.len / (18 * 8)), (uint16_t *)def_out.buf,
+      (uint16_t *)rep_out.buf, (uint8_t *)values.buf, (size_t)values.len,
+      (uint8_t *)packed.buf, (size_t)packed.len, (uint8_t *)delta.buf,
+      (size_t)delta.len, (uint8_t *)scratch.buf, (size_t)scratch.len,
+      (uint8_t *)h_is_rle.buf, (int64_t *)h_counts.buf,
+      (uint64_t *)h_values.buf, (int64_t *)h_byteoff.buf,
+      (size_t)h_is_rle.len, (uint32_t *)d_widths.buf,
+      (int64_t *)d_bytestart.buf, (int32_t *)d_outstart.buf,
+      (uint64_t *)d_mins.buf, (size_t)(d_widths.len / 4),
+      (int64_t *)totals.buf, stage.buf ? (int64_t *)stage.buf : NULL);
+  Py_END_ALLOW_THREADS
+  PyBuffer_Release(&src);
+  PyBuffer_Release(&pages);
+  PyBuffer_Release(&def_out);
+  PyBuffer_Release(&rep_out);
+  PyBuffer_Release(&values);
+  PyBuffer_Release(&packed);
+  PyBuffer_Release(&delta);
+  PyBuffer_Release(&scratch);
+  PyBuffer_Release(&h_is_rle);
+  PyBuffer_Release(&h_counts);
+  PyBuffer_Release(&h_values);
+  PyBuffer_Release(&h_byteoff);
+  PyBuffer_Release(&d_widths);
+  PyBuffer_Release(&d_bytestart);
+  PyBuffer_Release(&d_outstart);
+  PyBuffer_Release(&d_mins);
+  PyBuffer_Release(&totals);
+  if (stage.buf) PyBuffer_Release(&stage);
+  return PyLong_FromSsize_t(rc);
+}
 
 /* encode_items(seq) -> (flat_bytes, lengths_int64_le_bytes)
  *
@@ -230,28 +328,42 @@ static PyObject *take_bytes(PyObject *self, PyObject *args) {
   if (off_out == NULL) goto done;
   int64_t *no = (int64_t *)PyBytes_AS_STRING(off_out);
   int64_t total = 0;
+  int bad = 0; /* 1 = index out of range, 2 = corrupt offsets */
+  /* both passes are pure C over held buffers: release the GIL so gathers
+   * running on prepare worker threads overlap instead of serializing */
+  Py_BEGIN_ALLOW_THREADS
   no[0] = 0;
   for (Py_ssize_t i = 0; i < n; i++) {
     int64_t k = idx[i];
     if (k < 0 || k >= (int64_t)n_src) {
-      PyErr_SetString(PyExc_IndexError, "take_bytes: index out of range");
-      goto done;
+      bad = 1;
+      break;
     }
     int64_t len = off[k + 1] - off[k];
     if (len < 0 || off[k] < 0 || off[k + 1] > (int64_t)db.len) {
-      PyErr_SetString(PyExc_ValueError, "take_bytes: corrupt offsets");
-      goto done;
+      bad = 2;
+      break;
     }
     total += len;
     no[i + 1] = total;
   }
+  Py_END_ALLOW_THREADS
+  if (bad) {
+    if (bad == 1)
+      PyErr_SetString(PyExc_IndexError, "take_bytes: index out of range");
+    else
+      PyErr_SetString(PyExc_ValueError, "take_bytes: corrupt offsets");
+    goto done;
+  }
   data_out = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)total);
   if (data_out == NULL) goto done;
   char *dst = PyBytes_AS_STRING(data_out);
+  Py_BEGIN_ALLOW_THREADS
   for (Py_ssize_t i = 0; i < n; i++) {
     int64_t k = idx[i];
     memcpy(dst + no[i], src + off[k], (size_t)(no[i + 1] - no[i]));
   }
+  Py_END_ALLOW_THREADS
   result = PyTuple_Pack(2, off_out, data_out);
 done:
   Py_XDECREF(off_out);
@@ -497,6 +609,9 @@ fail:
 }
 
 static PyMethodDef methods[] = {
+    {"chunk_prepare", chunk_prepare, METH_VARARGS,
+     "chunk_prepare(src, ints..., buffers..., stage_ns|None) -> rc; the "
+     "fused GIL-free whole-chunk prepare walk"},
     {"encode_items", encode_items, METH_O,
      "encode_items(seq) -> (flat_bytes, int64le_lengths_bytes)"},
     {"dict_indices", dict_indices, METH_VARARGS,

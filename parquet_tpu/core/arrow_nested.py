@@ -116,13 +116,18 @@ def _logical_target(pa, leaf):
             tz = "UTC" if lt.TIMESTAMP.isAdjustedToUTC else None
             return pa.timestamp(unit, tz=tz)
         if lt.TIME is not None:
+            # Spec-pinned unit/physical pairs only: MILLIS stores INT32,
+            # MICROS/NANOS store INT64. Any other combination (a foreign
+            # writer annotating TIME(MILLIS) on INT64, a missing unit) is
+            # spec-invalid: keep raw storage rather than silently misreading
+            # the values in a wrong unit.
             u = lt.TIME.unit
-            if u is not None and u.MILLIS is not None and t == Type.INT32:
-                return pa.time32("ms")
-            if t == Type.INT64:
-                return pa.time64(
-                    "ns" if u is not None and u.NANOS is not None else "us"
-                )
+            if u is not None and u.MILLIS is not None:
+                return pa.time32("ms") if t == Type.INT32 else None
+            if u is not None and u.MICROS is not None:
+                return pa.time64("us") if t == Type.INT64 else None
+            if u is not None and u.NANOS is not None:
+                return pa.time64("ns") if t == Type.INT64 else None
             return None
         if lt.DATE is not None and t == Type.INT32:
             return pa.date32()
@@ -215,7 +220,16 @@ def retype_leaf(pa, leaf, arr):
         return _int96_to_timestamp(pa, arr, ft)
     bw = {pa.int8(): 8, pa.int16(): 16, pa.uint8(): 8, pa.uint16(): 16}
     if ft in bw:
-        return arr.cast(ft)  # narrowing: values fit by construction
+        try:
+            # narrowing: our own writer's values fit by construction, but a
+            # malformed FOREIGN file can annotate INT_8/UINT_16/... on stored
+            # values outside the annotated range — fail through the
+            # documented error surface, not a raw pyarrow exception
+            return arr.cast(ft)
+        except pa.lib.ArrowInvalid as e:
+            raise ParquetFileError(
+                f"parquet: stored values overflow annotated type {ft}: {e}"
+            ) from e
     return arr.view(ft)  # same-width reinterpretation, zero-copy
 
 

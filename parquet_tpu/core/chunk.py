@@ -27,7 +27,7 @@ from ..meta.parquet_types import (
 )
 from ..meta.thrift import CompactReader, ThriftError
 from ..ops.packed_levels import PackedLevels
-from ..utils.trace import stage
+from ..utils.trace import bump, stage
 from .alloc import decoded_nbytes
 from .arrays import ByteArrayData
 from .compress import decompress_block
@@ -472,6 +472,9 @@ def read_chunk(
     seen_data_values = 0
     deferred_gather = 0
     expected = md.num_values or 0
+    # staged (per-page Python) walk: the counterpart of the fused native
+    # prepare's prepare_fused_engaged — lets traces attribute a read to a path
+    bump("prepare_staged_chunk")
     for raw in iter_chunk_pages(f, chunk):
         header = raw.header
         if alloc is not None:
@@ -484,9 +487,10 @@ def read_chunk(
                 raise ChunkError("chunk: dictionary page after data pages")
             if validate_crc:
                 _check_crc(header, raw.payload)
-            block = decompress_block(
-                raw.payload, codec, header.uncompressed_page_size or 0
-            )
+            with stage("decompress", len(raw.payload)):
+                block = decompress_block(
+                    raw.payload, codec, header.uncompressed_page_size or 0
+                )
             dictionary = decode_dict_page(header, block, column)
             if alloc is not None:
                 alloc.register_buffers(dictionary)

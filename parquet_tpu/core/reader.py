@@ -50,13 +50,17 @@ _pool_lock = threading.Lock()
 def _host_pool() -> ThreadPoolExecutor | None:
     """Shared worker pool for the host-side chunk prepare phase.
 
-    Sized by PQT_HOST_THREADS (default: cpu count, capped at 8). Returns None
-    when threading cannot help (single worker): single-core hosts, or the
-    knob set to 0/1.
+    Sized by PQT_HOST_THREADS (default: cpu count, capped at 16). The cap is
+    real parallelism, not oversubscription insurance: the fused native
+    chunk-prepare walk (decompress + level decode + prescan + repack) runs
+    the whole chunk in one GIL-free C call, so N workers deliver ~N cores of
+    prepare throughput until memory bandwidth saturates. Returns None when
+    threading cannot help (single worker): single-core hosts, or the knob
+    set to 0/1.
     """
     global _pool
     env = os.environ.get("PQT_HOST_THREADS")
-    workers = int(env) if env else min(os.cpu_count() or 1, 8)
+    workers = int(env) if env else min(os.cpu_count() or 1, 16)
     if workers <= 1:
         return None
     with _pool_lock:
@@ -1487,11 +1491,26 @@ class FileReader:
             self.to_arrow(row_groups=indices, columns=extra) if extra else None
         )
 
+        # A column referenced in N DNF conjunctions must combine its chunks
+        # once, not N times (combine_chunks copies the whole column); the
+        # filter_combine_chunks counter pins the memoization in tests.
+        combined: dict = {}
+        leaf_cache: dict = {}
+
         def leaf_col(path):
-            src = ftab if path in extra or len(path) > 1 else table
-            arr = src.column(path[0]).combine_chunks()
+            arr = leaf_cache.get(path)
+            if arr is not None:
+                return arr
+            key = (path in extra or len(path) > 1, path[0])
+            base = combined.get(key)
+            if base is None:
+                src = ftab if key[0] else table
+                base = combined[key] = src.column(path[0]).combine_chunks()
+                bump("filter_combine_chunks")
+            arr = base
             if len(path) > 1:
                 arr = pc.struct_field(arr, list(path[1:]))
+            leaf_cache[path] = arr
             return arr
 
         try:

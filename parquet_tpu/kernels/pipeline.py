@@ -842,13 +842,32 @@ _PC_EXTRA, _PC_DFIRST = 16, 17
 
 
 def _native_prepare(f, chunk, column, validate_crc, alloc, stats):
-    """Whole-chunk native prepare: ONE C call walks every page (header parse,
-    decompress, level decode, value prescan) and returns packed tables; batch
-    assembly is then a handful of vectorized NumPy ops instead of a per-page
-    Python loop (the dominant host cost — reference page walk:
-    chunk_reader.go:182-263). Returns a ready _ChunkPlan or None when the
-    chunk needs the Python walk (CRC validation, memory ceiling, non-builtin
-    codec, corrupt input — the Python path reproduces exact error semantics)."""
+    """Whole-chunk native prepare: ONE GIL-free C call walks every page
+    (header parse, decompress, level decode, value prescan) and returns
+    packed tables; batch assembly is then a handful of vectorized NumPy ops
+    instead of a per-page Python loop (the dominant host cost — reference
+    page walk: chunk_reader.go:182-263). Returns a ready _ChunkPlan or None
+    when the chunk needs the Python walk (CRC validation, memory ceiling,
+    non-builtin codec, corrupt input — the Python path reproduces exact
+    error semantics). PQT_FUSED_PREPARE=0 forces the staged walk (the
+    differential-test control). Under an active decode_trace the outcome is
+    pinned by the prepare_fused_engaged / prepare_fused_declined counters
+    and the walk's internal stage split lands in prepare.* stages."""
+    import os as _os
+
+    from ..utils import trace as _trace
+
+    if _os.environ.get("PQT_FUSED_PREPARE", "1") == "0":
+        return None  # forced staged path: not a decline, no counter
+    plan = _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats)
+    if plan is None:
+        _trace.bump("prepare_fused_declined")
+    else:
+        _trace.bump("prepare_fused_engaged")
+    return plan
+
+
+def _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats):
     if validate_crc or alloc is not None:
         return None
     from ..utils.native import get_native
@@ -883,6 +902,8 @@ def _native_prepare(f, chunk, column, validate_crc, alloc, stats):
     expected = int(md.num_values or 0)
     if expected < 0:
         return None
+    from ..utils import trace as _trace
+
     res = lib.chunk_prepare(
         buf,
         codec,
@@ -892,9 +913,17 @@ def _native_prepare(f, chunk, column, validate_crc, alloc, stats):
         delta_nbits,
         expected,
         int(md.total_uncompressed_size or 0),
+        collect_stages=_trace.active(),
     )
     if res is None:
         return None
+    stage_ns = res.get("stage_ns")
+    if stage_ns is not None:
+        for slot, name in enumerate(
+            ("prepare.decompress", "prepare.levels", "prepare.prescan", "prepare.copy")
+        ):
+            if stage_ns[slot]:
+                _trace.add_seconds(name, int(stage_ns[slot]) / 1e9)
     try:
         return _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits)
     except (PageError, ChunkError):

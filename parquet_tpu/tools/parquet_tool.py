@@ -326,12 +326,37 @@ def cmd_merge(args) -> int:
     """Concatenate files at row-group granularity WITHOUT re-encoding:
     chunk bytes copy verbatim, only footer offsets rewrite (compaction —
     the parquet-mr `parquet-tools merge` primitive; beyond the reference).
-    Schemas must match exactly; page indexes/blooms are not carried."""
+    Schemas must match exactly; page indexes/blooms are not carried.
+
+    Canonical form matches parquet-mr's argument order (inputs first):
+        merge <inputs...> -o <output>
+    The legacy output-first positional form (`merge <output> <inputs...>`)
+    still parses, with a deprecation note on stderr. BOTH forms now refuse
+    to overwrite an existing output unless --force is given — legacy
+    invocations that relied on silent overwrite must add --force."""
+    import os
+
     from ..core.merge import merge_files
 
-    meta = merge_files(args.out, args.files)
+    inputs = list(args.files)
+    out = args.out
+    if out is None:
+        if len(inputs) < 2:
+            raise ValueError("merge: need -o/--out OUTPUT and at least one input")
+        out, inputs = inputs[0], inputs[1:]
+        print(
+            "parquet-tool: merge with a positional output is deprecated; "
+            "use 'merge <inputs...> -o <output>' (note: overwriting an "
+            "existing output now requires --force in both forms)",
+            file=sys.stderr,
+        )
+    if os.path.exists(out) and not args.force:
+        raise ValueError(
+            f"merge: output {out!r} already exists (pass --force to overwrite)"
+        )
+    meta = merge_files(out, inputs)
     print(
-        f"merged {len(args.files)} files -> {args.out}: "
+        f"merged {len(inputs)} files -> {out}: "
         f"{meta.num_rows} rows, {len(meta.row_groups or [])} row groups"
     )
     return 0
@@ -398,8 +423,24 @@ def main(argv=None) -> int:
     pm = sub.add_parser(
         "merge", help="concatenate files at row-group level (no re-encoding)"
     )
-    pm.add_argument("out", help="output file")
-    pm.add_argument("files", nargs="+", help="input files (order preserved)")
+    pm.add_argument(
+        "-o",
+        "--out",
+        default=None,
+        help="output file (canonical, parquet-mr argument order: "
+        "merge <inputs...> -o <output>)",
+    )
+    pm.add_argument(
+        "--force",
+        action="store_true",
+        help="overwrite the output file if it already exists",
+    )
+    pm.add_argument(
+        "files",
+        nargs="+",
+        help="input files, order preserved (without -o the FIRST positional "
+        "is taken as the output — deprecated legacy form)",
+    )
     pm.set_defaults(fn=cmd_merge)
 
     args = p.parse_args(argv)

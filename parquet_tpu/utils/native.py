@@ -280,7 +280,24 @@ class NativeLib:
                 + [ctypes.c_void_p] * 4 + [ctypes.c_size_t]  # hybrid tables
                 + [ctypes.c_void_p] * 4 + [ctypes.c_size_t]  # delta tables
                 + [ctypes.c_void_p]  # totals
+                + [ctypes.c_void_p]  # stage_ns (nullable per-stage clock)
             )
+        # The CPython-extension binding of the same walk: one call, every
+        # buffer through the buffer protocol, the whole walk under
+        # Py_BEGIN_ALLOW_THREADS. Preferred over ctypes when built — ctypes
+        # marshals ~30 arguments under the GIL per call; the extension
+        # binds them in C. Falls back transparently when the extension is
+        # absent (ctypes also drops the GIL during the foreign call, so
+        # multi-thread prepare stays correct either way, just slower).
+        self._ext_chunk_prepare = None
+        if self.has_chunk_prepare:
+            try:
+                from .. import _native_ext as _ext
+
+                self._ext_chunk_prepare = getattr(_ext, "chunk_prepare", None)
+            except ImportError:
+                pass
+        self.fused_gil_free = self._ext_chunk_prepare is not None
 
     def snappy_compress(self, data) -> bytes:
         addr, n_in, _keep = _ptr(data)
@@ -603,12 +620,17 @@ class NativeLib:
         delta_nbits: int,
         expected_values: int,
         uncompressed_cap: int,
+        collect_stages: bool = False,
     ):
         """Whole-chunk prepare walk (ptq_chunk_prepare): one native call does
         header parse + decompress + level decode + value-stream prescan for
-        every page. Returns a dict of packed tables, or None when the chunk
-        needs the Python walk (corrupt / unsupported / capacity-exceeded —
-        the Python path reproduces the exact error semantics)."""
+        every page, GIL-free (the CPython-extension binding releases it
+        explicitly via Py_BEGIN_ALLOW_THREADS; the ctypes fallback drops it
+        at the foreign-call boundary). Returns a dict of packed tables, or
+        None when the chunk needs the Python walk (corrupt / unsupported /
+        capacity-exceeded — the Python path reproduces the exact error
+        semantics). collect_stages=True adds a "stage_ns" int64[4] entry
+        (decompress, levels, prescan, copy accumulated wall ns)."""
         import numpy as np
 
         addr, n_in, _keep = _ptr(data)
@@ -645,8 +667,13 @@ class NativeLib:
         if scratch is None or len(scratch) < cap + 64:
             scratch = tl.scratch = np.empty(cap + 64, dtype=np.uint8)
         totals = np.zeros(8, dtype=np.int64)
+        stage_ns = np.zeros(4, dtype=np.int64) if collect_stages else None
+        ext = self._ext_chunk_prepare
         p = ctypes.c_void_p
         while True:
+            if stage_ns is not None:
+                stage_ns[:] = 0  # a table-growth retry re-walks from scratch:
+                # keep only the final walk's split, not partial+full summed
             pages = np.empty((max_pages, 18), dtype=np.int64)
             h_is_rle = np.empty(max_runs, dtype=np.uint8)
             h_counts = np.empty(max_runs, dtype=np.int64)
@@ -656,21 +683,41 @@ class NativeLib:
             d_bytestart = np.empty(max_minis, dtype=np.int64)
             d_outstart = np.empty(max_minis, dtype=np.int32)
             d_mins = np.empty(max_minis, dtype=np.uint64)
-            rc = self._lib.ptq_chunk_prepare(
-                addr, n_in, codec, max_def, max_rep, type_size, delta_nbits,
-                expected_values,
-                pages.ctypes.data_as(p), max_pages,
-                def_out.ctypes.data_as(p), rep_out.ctypes.data_as(p),
-                values_out.ctypes.data_as(p), cap,
-                packed_out.ctypes.data_as(p), cap,
-                delta_out.ctypes.data_as(p), len(delta_out),
-                scratch.ctypes.data_as(p), len(scratch),
-                h_is_rle.ctypes.data_as(p), h_counts.ctypes.data_as(p),
-                h_values.ctypes.data_as(p), h_byteoff.ctypes.data_as(p), max_runs,
-                d_widths.ctypes.data_as(p), d_bytestart.ctypes.data_as(p),
-                d_outstart.ctypes.data_as(p), d_mins.ctypes.data_as(p), max_minis,
-                totals.ctypes.data_as(p),
-            )
+            if ext is not None:
+                # single GIL-free transition: every buffer binds through the
+                # buffer protocol and capacities derive from the buffer
+                # lengths — values/packed are sliced to exactly `cap` so both
+                # bindings enforce the SAME -5 overflow bound (the pool may
+                # hand back a larger staging buffer than requested)
+                rc = ext(
+                    data if isinstance(data, (bytes, memoryview)) else _keep,
+                    codec, max_def, max_rep, type_size, delta_nbits,
+                    expected_values,
+                    pages, def_out, rep_out,
+                    memoryview(values_out)[:cap],
+                    memoryview(packed_out)[:cap],
+                    delta_out, scratch,
+                    h_is_rle, h_counts, h_values, h_byteoff,
+                    d_widths, d_bytestart, d_outstart, d_mins,
+                    totals, stage_ns,
+                )
+            else:
+                rc = self._lib.ptq_chunk_prepare(
+                    addr, n_in, codec, max_def, max_rep, type_size, delta_nbits,
+                    expected_values,
+                    pages.ctypes.data_as(p), max_pages,
+                    def_out.ctypes.data_as(p), rep_out.ctypes.data_as(p),
+                    values_out.ctypes.data_as(p), cap,
+                    packed_out.ctypes.data_as(p), cap,
+                    delta_out.ctypes.data_as(p), len(delta_out),
+                    scratch.ctypes.data_as(p), len(scratch),
+                    h_is_rle.ctypes.data_as(p), h_counts.ctypes.data_as(p),
+                    h_values.ctypes.data_as(p), h_byteoff.ctypes.data_as(p), max_runs,
+                    d_widths.ctypes.data_as(p), d_bytestart.ctypes.data_as(p),
+                    d_outstart.ctypes.data_as(p), d_mins.ctypes.data_as(p), max_minis,
+                    totals.ctypes.data_as(p),
+                    None if stage_ns is None else stage_ns.ctypes.data_as(p),
+                )
             if rc == -2 and max_pages < (1 << 24):
                 max_pages *= 8
                 continue
@@ -706,6 +753,7 @@ class NativeLib:
                 "d_outstart": d_outstart[:M],
                 "d_mins": d_mins[:M],
                 "has_dict": bool(totals[6]),
+                "stage_ns": stage_ns,
             }
 
     def hybrid_encode(self, values, width: int) -> bytes:

@@ -20,7 +20,16 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-__all__ = ["decode_trace", "stage", "add_bytes", "bump", "jax_profile", "DecodeTrace"]
+__all__ = [
+    "decode_trace",
+    "stage",
+    "add_bytes",
+    "add_seconds",
+    "bump",
+    "active",
+    "jax_profile",
+    "DecodeTrace",
+]
 
 _active: "DecodeTrace | None" = None
 
@@ -83,9 +92,25 @@ def stage(name: str, nbytes: int = 0):
         s.calls += 1
 
 
+def active() -> bool:
+    """True while a decode_trace() is collecting — callers use this to skip
+    instrumentation work (e.g. native per-stage clocks) when nobody listens."""
+    return _active is not None
+
+
 def add_bytes(name: str, nbytes: int) -> None:
     if _active is not None:
         _active._stat(name).bytes += nbytes
+
+
+def add_seconds(name: str, seconds: float, nbytes: int = 0) -> None:
+    """Credit externally-measured wall time to a stage (how the native fused
+    prepare walk reports its internal decompress/levels/prescan/copy split)."""
+    if _active is not None:
+        s = _active._stat(name)
+        s.seconds += seconds
+        s.bytes += nbytes
+        s.calls += 1
 
 
 def bump(name: str, nbytes: int = 0) -> None:

@@ -669,3 +669,58 @@ class TestExtensionTypes:
             out = r.to_arrow()
         assert out.column("j").type == pa.large_binary()
         assert out.column("u").type == pa.binary(16)
+
+
+class TestSpecInvalidAnnotations:
+    """Malformed FOREIGN annotations must fail safe: spec-invalid TIME
+    unit/physical combos keep raw storage (never silently misread a unit),
+    and out-of-range narrowing casts fail through ParquetFileError."""
+
+    def _leaf(self, ptype, lt=None, ct=None):
+        from parquet_tpu.core.schema import Column
+        from parquet_tpu.meta.parquet_types import SchemaElement
+
+        el = SchemaElement(
+            name="c", type=int(ptype), logicalType=lt,
+            converted_type=None if ct is None else int(ct),
+        )
+        return Column(element=el, path=("c",), leaf_index=0)
+
+    def test_time_unit_physical_matrix(self):
+        from parquet_tpu.core.arrow_nested import _logical_target
+        from parquet_tpu.meta.parquet_types import (
+            LogicalType,
+            TimeType,
+            TimeUnit,
+            Type,
+        )
+
+        def time_lt(unit):
+            return LogicalType(TIME=TimeType(isAdjustedToUTC=True, unit=unit))
+
+        cases = [
+            (TimeUnit.millis(), Type.INT32, pa.time32("ms")),
+            (TimeUnit.millis(), Type.INT64, None),  # millis stored as int64: invalid
+            (TimeUnit.micros(), Type.INT64, pa.time64("us")),
+            (TimeUnit.micros(), Type.INT32, None),
+            (TimeUnit.nanos(), Type.INT64, pa.time64("ns")),
+            (TimeUnit.nanos(), Type.INT32, None),
+            (None, Type.INT64, None),  # missing unit: invalid
+            (None, Type.INT32, None),
+        ]
+        for unit, ptype, want in cases:
+            got = _logical_target(pa, self._leaf(ptype, lt=time_lt(unit)))
+            assert got == want, (unit, ptype, got)
+
+    def test_narrowing_overflow_raises_parquet_error(self):
+        from parquet_tpu.core.arrow_nested import retype_leaf
+        from parquet_tpu.meta.file_meta import ParquetFileError
+        from parquet_tpu.meta.parquet_types import IntType, LogicalType, Type
+
+        leaf = self._leaf(
+            Type.INT32, lt=LogicalType(INTEGER=IntType(bitWidth=8, isSigned=True))
+        )
+        ok = retype_leaf(pa, leaf, pa.array([1, -7, 127], pa.int32()))
+        assert ok.type == pa.int8() and ok.to_pylist() == [1, -7, 127]
+        with pytest.raises(ParquetFileError, match="overflow"):
+            retype_leaf(pa, leaf, pa.array([1, 300], pa.int32()))

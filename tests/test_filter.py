@@ -534,3 +534,35 @@ class TestDnfOrFilters:
                 got = list(r.iter_rows(filters=[("t", "in", members)]))
                 assert len(got) == 1, members
                 assert list(r.iter_rows(filters=[("t", "not_in", members)])) == []
+
+
+class TestFilterCombineMemo:
+    def test_column_in_many_conjunctions_combines_once(self, tmp_path):
+        """A column referenced in N DNF conjunctions must pay its
+        combine_chunks exactly once per mask evaluation (pinned by the
+        filter_combine_chunks trace counter)."""
+        from parquet_tpu import FileReader, FileWriter, parse_schema
+        from parquet_tpu.utils.trace import decode_trace
+
+        schema = parse_schema(
+            "message m { required int64 id; required binary c (UTF8); }"
+        )
+        path = str(tmp_path / "memo.parquet")
+        with FileWriter(path, schema, use_dictionary=False) as w:
+            for base in (0, 10_000):
+                w.write_column("id", np.arange(base, base + 10_000, dtype=np.int64))
+                w.write_column("c", [f"c{(base + i) % 5}" for i in range(10_000)])
+                w.flush_row_group()
+        filters = [
+            [("id", "<", 5), ("c", "==", "c1")],
+            [("id", ">=", 19_998)],
+            [("id", "in", [7, 8]), ("c", "!=", "c0")],
+        ]
+        with FileReader(path) as r:
+            with decode_trace() as tr:
+                got = r.to_arrow(filters=filters)
+            want = sorted([1, 7, 8, 19_998, 19_999])
+            assert sorted(got.column("id").to_pylist()) == want
+        combines = tr.stages.get("filter_combine_chunks")
+        # two distinct leaves referenced across five predicates: two combines
+        assert combines is not None and combines.calls == 2

@@ -287,3 +287,49 @@ class TestCsvToParquetAnalytics:
         with FileReader(out) as r:
             assert r.read_bloom_filter(0, "id") is not None
             assert [row["id"] for row in r.iter_rows(filters=[("id", "==", 42)])] == [42]
+
+
+class TestMergeCli:
+    def _mk(self, path, n):
+        import numpy as np
+        import pyarrow as pa
+
+        pq.write_table(
+            pa.table({"a": pa.array(np.arange(n, dtype=np.int64))}), str(path)
+        )
+
+    def test_canonical_inputs_first_form(self, tmp_path, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        a, b = tmp_path / "a.parquet", tmp_path / "b.parquet"
+        self._mk(a, 10)
+        self._mk(b, 5)
+        out = tmp_path / "m.parquet"
+        assert tool_main(["merge", str(a), str(b), "-o", str(out)]) == 0
+        assert "15 rows" in capsys.readouterr().out
+        assert pq.read_table(str(out)).num_rows == 15
+
+    def test_legacy_output_first_form_deprecated(self, tmp_path, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        a, b = tmp_path / "a.parquet", tmp_path / "b.parquet"
+        self._mk(a, 4)
+        self._mk(b, 4)
+        out = tmp_path / "legacy.parquet"
+        assert tool_main(["merge", str(out), str(a), str(b)]) == 0
+        err = capsys.readouterr().err
+        assert "deprecated" in err
+        assert pq.read_table(str(out)).num_rows == 8
+
+    def test_refuses_to_overwrite_without_force(self, tmp_path, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        a = tmp_path / "a.parquet"
+        self._mk(a, 6)
+        out = tmp_path / "exists.parquet"
+        self._mk(out, 1)  # pre-existing output
+        assert tool_main(["merge", str(a), str(a), "-o", str(out)]) == 1
+        assert "already exists" in capsys.readouterr().err
+        assert pq.read_table(str(out)).num_rows == 1  # untouched
+        assert tool_main(["merge", str(a), str(a), "-o", str(out), "--force"]) == 0
+        assert pq.read_table(str(out)).num_rows == 12
