@@ -2,14 +2,15 @@
 # .github/workflows/ci.yml (ruff runs there; this image has no linter, so the
 # syntax gate is compileall).
 
-.PHONY: check test native bench bench-prepare dryrun
+.PHONY: check test native bench bench-prepare dryrun fuzz
 
+# tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
 check: native
 	python -m compileall -q parquet_tpu tests bench.py __graft_entry__.py
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not slow'
 
 test:
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not slow'
 
 native:
 	$(MAKE) -C native
@@ -24,3 +25,10 @@ bench-prepare: native
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+# seeded fault-injection matrix, fast subset AND the extended `slow` sweep —
+# fully deterministic (numpy default_rng from fixed seeds), so a failure here
+# replays exactly; the fast subset also rides the tier-1 `-m 'not slow'` run
+fuzz: native
+	python -m pytest tests/test_faults.py -q
+

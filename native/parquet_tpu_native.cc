@@ -1119,6 +1119,15 @@ bool cp_skip(CpReader* r, int wire, int depth) {
       uint64_t n = b >> 4;
       int etype = b & 0x0F;
       if (n == 15 && !cp_uvarint(r, &n)) return false;
+      // Preflight size guard: every element occupies >= 1 wire byte, EXCEPT
+      // bool (kind 1/2), whose cp_skip consumes nothing — a lying count
+      // there would spin this loop for up to 2^64 iterations (a hang, not
+      // an overread). pos <= len is invariant, so len-pos cannot underflow.
+      if (n > r->len - r->pos) { r->truncated = true; return false; }
+      if (etype == 1 || etype == 2) {   // bool list: 1 byte per element
+        r->pos += n;
+        return true;
+      }
       for (uint64_t i = 0; i < n; i++)
         if (!cp_skip(r, etype, depth + 1)) return false;
       return true;
@@ -1127,9 +1136,26 @@ bool cp_skip(CpReader* r, int wire, int depth) {
       if (!cp_uvarint(r, &u)) return false;
       if (u == 0) return true;
       if (!cp_byte(r, &b)) return false;
+      // Same hang guard as list/set: a bool key/value type would make each
+      // iteration consume zero bytes, so an adversarial count must be
+      // rejected against the remaining window up front.
+      if (u > r->len - r->pos) { r->truncated = true; return false; }
+      int kt = b >> 4, vt = b & 0x0F;
       for (uint64_t i = 0; i < u; i++) {
-        if (!cp_skip(r, b >> 4, depth + 1)) return false;
-        if (!cp_skip(r, b & 0x0F, depth + 1)) return false;
+        // map bool keys/values occupy one byte each on the wire (unlike
+        // bool STRUCT fields, whose value rides the field header)
+        if (kt == 1 || kt == 2) {
+          if (r->pos >= r->len) { r->truncated = true; return false; }
+          r->pos++;
+        } else if (!cp_skip(r, kt, depth + 1)) {
+          return false;
+        }
+        if (vt == 1 || vt == 2) {
+          if (r->pos >= r->len) { r->truncated = true; return false; }
+          r->pos++;
+        } else if (!cp_skip(r, vt, depth + 1)) {
+          return false;
+        }
       }
       return true;
     }
@@ -1400,7 +1426,7 @@ struct StageClock {
 };
 
 // stage_ns slots (accumulated nanoseconds)
-enum { ST_DECOMPRESS = 0, ST_LEVELS = 1, ST_PRESCAN = 2, ST_COPY = 3 };
+enum { ST_DECOMPRESS = 0, ST_LEVELS = 1, ST_PRESCAN = 2, ST_COPY = 3, ST_CRC = 4 };
 
 }  // namespace
 
@@ -1433,10 +1459,15 @@ enum {
 // Returns n_pages >= 0 on success. Negative: -1 corrupt/unsupported (caller
 // falls back to the Python walk for exact errors), -2 page table full,
 // -3 hybrid run table full, -4 delta miniblock table full, -5 level/value
-// capacity exceeded (metadata understated the chunk).
+// capacity exceeded (metadata understated the chunk), -6 stored page CRC
+// mismatch (validate_crc only; definite corruption, not "unsupported").
+// err_info (nullable int64[4]) reports {stage, page index, page byte offset
+// in the chunk, 0} for any negative return — the structured error channel
+// parquet-tool verify and the fallback-ladder counters consume.
 ssize_t ptq_chunk_prepare(
     const uint8_t* src, size_t src_len,
     int codec,               // 0 UNCOMPRESSED, 1 SNAPPY, 2 GZIP
+    int validate_crc,        // nonzero: verify stored page CRCs in the walk
     int max_def, int max_rep,
     int type_size,           // PLAIN itemsize for numeric types, else 0
     int delta_nbits,         // 32/64 when delta-bp is device-eligible, else 0
@@ -1453,8 +1484,9 @@ ssize_t ptq_chunk_prepare(
     uint64_t* d_mins, size_t max_minis,
     int64_t* totals, /* [8]: lvl_total, values_used, packed_used, delta_used,
                         runs, minis, has_dict, reserved */
-    int64_t* stage_ns /* nullable [4]: accumulated ns per stage (decompress,
-                         levels, prescan, copy) for the bench breakdown */) {
+    int64_t* stage_ns, /* nullable [5]: accumulated ns per stage (decompress,
+                          levels, prescan, copy, crc) for the bench breakdown */
+    int64_t* err_info /* nullable [4]: see above */) {
   StageClock clk{stage_ns, 0};
   size_t pos = 0;
   size_t n_pages = 0;
@@ -1463,8 +1495,17 @@ ssize_t ptq_chunk_prepare(
   size_t runs = 0, minis = 0;
   bool has_dict = false;
   int64_t slots[23];
+  // Failure-context tracking: the walk keeps err[] current (stage, page,
+  // page byte offset) so every `return negative` below reports where it
+  // died without threading the detail through dozens of return sites.
+  int64_t err_local[4];
+  int64_t* err = err_info ? err_info : err_local;
+  err[0] = PTQ_STAGE_NONE; err[1] = 0; err[2] = 0; err[3] = 0;
 
   while (pos < src_len) {
+    err[0] = PTQ_STAGE_HEADER;
+    err[1] = static_cast<int64_t>(n_pages);
+    err[2] = static_cast<int64_t>(pos);
     ssize_t hrc = ptq_parse_page_header(src + pos, src_len - pos, slots);
     if (hrc != 0) return -1;  // truncated-within-chunk IS corrupt here
     size_t hlen = static_cast<size_t>(slots[0]);
@@ -1476,6 +1517,26 @@ ssize_t ptq_chunk_prepare(
     size_t payload_len = static_cast<size_t>(psize);
     pos += hlen + payload_len;
     if (n_pages >= max_pages) return -2;
+    if (validate_crc && slots[4] != INT64_MIN) {
+      // CRC over the page payload EXACTLY as stored (V1: the compressed
+      // block; V2: raw rep+def level streams + compressed values) — the
+      // parquet-format contract, byte-for-byte what core/chunk._check_crc
+      // computes on the staged path.
+      err[0] = PTQ_STAGE_CRC;
+      clk.start();
+      uLong crc = crc32(0L, Z_NULL, 0);
+      size_t off = 0;
+      while (off < payload_len) {
+        size_t take = payload_len - off;
+        if (take > (1u << 30)) take = 1u << 30;  // uInt-safe chunks
+        crc = crc32(crc, payload + off, static_cast<uInt>(take));
+        off += take;
+      }
+      clk.stop(ST_CRC);
+      if (static_cast<uint32_t>(crc) !=
+          static_cast<uint32_t>(static_cast<int64_t>(slots[4])))
+        return PTQ_E_CRC;
+    }
     int64_t* P = pages + n_pages * PT_COLS;
     std::memset(P, 0, PT_COLS * sizeof(int64_t));
 
@@ -1489,11 +1550,15 @@ ssize_t ptq_chunk_prepare(
       const uint8_t* block = payload;
       size_t block_len = payload_len;
       if (codec != 0) {
+        err[0] = PTQ_STAGE_DECOMPRESS;
         clk.start();
         int rc = decompress_page(codec, payload, payload_len, scratch,
                                  scratch_cap, static_cast<size_t>(usize));
         clk.stop(ST_DECOMPRESS);
         if (rc != 0) return rc;
+      }
+      err[0] = PTQ_STAGE_VALUES;
+      if (codec != 0) {
         block = scratch;
         block_len = static_cast<size_t>(usize);
       }
@@ -1540,6 +1605,7 @@ ssize_t ptq_chunk_prepare(
           dst = values_out + values_used;
           dcap = values_cap - values_used;
         }
+        err[0] = PTQ_STAGE_DECOMPRESS;
         clk.start();
         int rc = decompress_page(codec, payload, payload_len, dst, dcap,
                                  static_cast<size_t>(usize));
@@ -1549,6 +1615,7 @@ ssize_t ptq_chunk_prepare(
         block_len = static_cast<size_t>(usize);
       }
       size_t cur = 0;
+      err[0] = PTQ_STAGE_LEVELS;
       if (lvl_total + n > expected_values) return -5;
       clk.start();
       if (max_rep > 0) {
@@ -1575,6 +1642,7 @@ ssize_t ptq_chunk_prepare(
         non_null = eq;
       }
       clk.stop(ST_LEVELS);
+      err[0] = PTQ_STAGE_VALUES;
       vsrc = block + cur;
       vlen = block_len - cur;
     } else {  // DATA_PAGE_V2: levels raw, values optionally compressed
@@ -1589,6 +1657,7 @@ ssize_t ptq_chunk_prepare(
           static_cast<uint64_t>(def_len) + static_cast<uint64_t>(rep_len) >
               payload_len)
         return -1;
+      err[0] = PTQ_STAGE_LEVELS;
       if (lvl_total + n > expected_values) return -5;
       clk.start();
       if (max_rep > 0) {
@@ -1604,6 +1673,14 @@ ssize_t ptq_chunk_prepare(
           return -1;
         non_null = eq;
       }
+      // FLAT columns only: the V2 header's num_nulls must agree with the
+      // decoded levels (parity with decode_data_page_v2's cross-check; for
+      // repeated columns foreign writers count nulls differently, so the
+      // levels are the only trustworthy source there). A mismatch means the
+      // header or the level stream is lying — corrupt, not unsupported.
+      if (max_rep == 0 && max_def > 0 && slots[16] != INT64_MIN &&
+          n - non_null != slots[16])
+        return -1;
       clk.stop(ST_LEVELS);
       const uint8_t* vreg = payload + rep_len + def_len;
       size_t vreg_len = payload_len - static_cast<size_t>(rep_len + def_len);
@@ -1619,6 +1696,7 @@ ssize_t ptq_chunk_prepare(
           dst = values_out + values_used;
           dcap = values_cap - values_used;
         }
+        err[0] = PTQ_STAGE_DECOMPRESS;
         clk.start();
         int rc = decompress_page(codec, vreg, vreg_len, dst, dcap,
                                  static_cast<size_t>(vexpect));
@@ -1630,6 +1708,7 @@ ssize_t ptq_chunk_prepare(
         vsrc = vreg;
         vlen = vreg_len;
       }
+      err[0] = PTQ_STAGE_VALUES;
     }
 
     P[PC_KIND] = 0;
@@ -1660,6 +1739,7 @@ ssize_t ptq_chunk_prepare(
       size_t spos = 0;
       int64_t produced = 0;
       size_t run0 = runs, pack0 = packed_used;
+      err[0] = PTQ_STAGE_PRESCAN;
       clk.start();
       while (produced < non_null) {
         uint64_t header = 0;
@@ -1721,6 +1801,7 @@ ssize_t ptq_chunk_prepare(
       int64_t total = 0, consumed = 0;
       size_t mini0 = minis;
       // prescan against max_minis - minis remaining slots
+      err[0] = PTQ_STAGE_PRESCAN;
       clk.start();
       ssize_t m = ptq_prescan_delta_packed(
           vsrc, vlen, delta_nbits, non_null, d_widths + minis,
@@ -1729,6 +1810,7 @@ ssize_t ptq_chunk_prepare(
       clk.stop(ST_PRESCAN);
       if (m == -2) return -4;
       if (m < 0) return -1;
+      err[0] = PTQ_STAGE_VALUES;
       // byte starts are relative to the page's stream: rebase into delta_out
       if (delta_used + static_cast<size_t>(consumed) > delta_cap) return -5;
       clk.start();

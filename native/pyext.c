@@ -22,33 +22,36 @@
  * lives in the shared header so the two translation units cannot drift. */
 #include "parquet_tpu_native.h"
 
-/* chunk_prepare(src, codec, max_def, max_rep, type_size, delta_nbits,
- *               expected_values, pages, def_out, rep_out, values_out,
- *               packed_out, delta_out, scratch, h_is_rle, h_counts, h_values,
- *               h_byteoff, d_widths, d_bytestart, d_outstart, d_mins, totals,
- *               stage_ns|None) -> rc
+/* chunk_prepare(src, codec, validate_crc, max_def, max_rep, type_size,
+ *               delta_nbits, expected_values, pages, def_out, rep_out,
+ *               values_out, packed_out, delta_out, scratch, h_is_rle,
+ *               h_counts, h_values, h_byteoff, d_widths, d_bytestart,
+ *               d_outstart, d_mins, totals, stage_ns|None, err_info) -> rc
  *
  * The fused whole-chunk prepare: ONE Python->C transition per column chunk,
- * with the entire walk (page-header parse, decompress, level decode, value
- * prescan, repack) under Py_BEGIN_ALLOW_THREADS. Table capacities derive
- * from the buffer lengths (pages: 18 int64 per row; h_is_rle: one byte per
- * run slot; d_widths: 4 bytes per miniblock slot), so the caller grows a
- * table by handing in a bigger buffer — same retry contract as the ctypes
- * binding. Returns ptq_chunk_prepare's rc (page count or negative code).
+ * with the entire walk (page-header parse, CRC verify, decompress, level
+ * decode, value prescan, repack) under Py_BEGIN_ALLOW_THREADS. Table
+ * capacities derive from the buffer lengths (pages: 18 int64 per row;
+ * h_is_rle: one byte per run slot; d_widths: 4 bytes per miniblock slot), so
+ * the caller grows a table by handing in a bigger buffer — same retry
+ * contract as the ctypes binding. Returns ptq_chunk_prepare's rc (page count
+ * or negative code); err_info (int64[4] buffer) carries {stage, page, byte
+ * offset, 0} when rc < 0.
  */
 static PyObject *chunk_prepare(PyObject *self, PyObject *args) {
   Py_buffer src, pages, def_out, rep_out, values, packed, delta, scratch;
   Py_buffer h_is_rle, h_counts, h_values, h_byteoff;
-  Py_buffer d_widths, d_bytestart, d_outstart, d_mins, totals;
-  int codec, max_def, max_rep, type_size, delta_nbits;
+  Py_buffer d_widths, d_bytestart, d_outstart, d_mins, totals, err_info;
+  int codec, validate_crc, max_def, max_rep, type_size, delta_nbits;
   long long expected_values;
   PyObject *stage_obj;
   if (!PyArg_ParseTuple(
-          args, "y*iiiiiLw*w*w*w*w*w*w*w*w*w*w*w*w*w*w*w*O", &src, &codec,
-          &max_def, &max_rep, &type_size, &delta_nbits, &expected_values,
-          &pages, &def_out, &rep_out, &values, &packed, &delta, &scratch,
-          &h_is_rle, &h_counts, &h_values, &h_byteoff, &d_widths, &d_bytestart,
-          &d_outstart, &d_mins, &totals, &stage_obj))
+          args, "y*iiiiiiLw*w*w*w*w*w*w*w*w*w*w*w*w*w*w*w*Ow*", &src, &codec,
+          &validate_crc, &max_def, &max_rep, &type_size, &delta_nbits,
+          &expected_values, &pages, &def_out, &rep_out, &values, &packed,
+          &delta, &scratch, &h_is_rle, &h_counts, &h_values, &h_byteoff,
+          &d_widths, &d_bytestart, &d_outstart, &d_mins, &totals, &stage_obj,
+          &err_info))
     return NULL;
   Py_buffer stage;
   stage.buf = NULL;
@@ -71,24 +74,27 @@ static PyObject *chunk_prepare(PyObject *self, PyObject *args) {
     PyBuffer_Release(&d_outstart);
     PyBuffer_Release(&d_mins);
     PyBuffer_Release(&totals);
+    PyBuffer_Release(&err_info);
     return NULL;
   }
   Py_ssize_t rc;
   Py_BEGIN_ALLOW_THREADS
   rc = ptq_chunk_prepare(
-      (const uint8_t *)src.buf, (size_t)src.len, codec, max_def, max_rep,
-      type_size, delta_nbits, (int64_t)expected_values, (int64_t *)pages.buf,
-      (size_t)(pages.len / (18 * 8)), (uint16_t *)def_out.buf,
-      (uint16_t *)rep_out.buf, (uint8_t *)values.buf, (size_t)values.len,
-      (uint8_t *)packed.buf, (size_t)packed.len, (uint8_t *)delta.buf,
-      (size_t)delta.len, (uint8_t *)scratch.buf, (size_t)scratch.len,
-      (uint8_t *)h_is_rle.buf, (int64_t *)h_counts.buf,
+      (const uint8_t *)src.buf, (size_t)src.len, codec, validate_crc, max_def,
+      max_rep, type_size, delta_nbits, (int64_t)expected_values,
+      (int64_t *)pages.buf, (size_t)(pages.len / (18 * 8)),
+      (uint16_t *)def_out.buf, (uint16_t *)rep_out.buf, (uint8_t *)values.buf,
+      (size_t)values.len, (uint8_t *)packed.buf, (size_t)packed.len,
+      (uint8_t *)delta.buf, (size_t)delta.len, (uint8_t *)scratch.buf,
+      (size_t)scratch.len, (uint8_t *)h_is_rle.buf, (int64_t *)h_counts.buf,
       (uint64_t *)h_values.buf, (int64_t *)h_byteoff.buf,
       (size_t)h_is_rle.len, (uint32_t *)d_widths.buf,
       (int64_t *)d_bytestart.buf, (int32_t *)d_outstart.buf,
       (uint64_t *)d_mins.buf, (size_t)(d_widths.len / 4),
-      (int64_t *)totals.buf, stage.buf ? (int64_t *)stage.buf : NULL);
+      (int64_t *)totals.buf, stage.buf ? (int64_t *)stage.buf : NULL,
+      err_info.len >= 32 ? (int64_t *)err_info.buf : NULL);
   Py_END_ALLOW_THREADS
+  PyBuffer_Release(&err_info);
   PyBuffer_Release(&src);
   PyBuffer_Release(&pages);
   PyBuffer_Release(&def_out);

@@ -46,6 +46,7 @@ __all__ = [
     "read_chunk_row_ranges",
     "RawPage",
     "iter_chunk_pages",
+    "iter_page_sites",
 ]
 
 # Page headers are small; peek a bounded window per header read, growing up to
@@ -274,6 +275,39 @@ class ChunkWindow:
         out = self._mv[self._pos : end]
         self._pos = end
         return out
+
+
+def iter_page_sites(f, chunk: ColumnChunk):
+    """Yield (header_offset, header, header_len, payload_len) for every page
+    of a chunk WITHOUT reading payloads — the page-location walk shared by
+    parquet-tool verify and the fault harness's page mapper, so the two can
+    never disagree about page boundaries. Raises ChunkError on a header that
+    cannot be parsed or a page size escaping the chunk's byte range (the
+    caller decides whether that ends triage or the read). Size errors carry
+    `.stage = "layout"` so triage can classify them without matching
+    message text."""
+    offset, total = chunk_byte_range(chunk)
+    pos = offset
+    while pos < offset + total:
+        f.seek(pos)
+        header = _read_page_header(f)
+        hlen = f.tell() - pos
+        plen = header.compressed_page_size
+        if plen is None or plen < 0:
+            # same invariant (and message) as the read path below: an absent
+            # size must NOT silently walk on as a 0-byte payload, or triage
+            # and the actual read would disagree about page boundaries
+            err = ChunkError(f"chunk: invalid compressed page size {plen}")
+            err.stage = "layout"
+            raise err
+        if pos + hlen + plen > offset + total:
+            err = ChunkError(
+                f"chunk: compressed page size {plen} exceeds chunk bounds"
+            )
+            err.stage = "layout"
+            raise err
+        yield pos, header, hlen, plen
+        pos += hlen + plen
 
 
 def iter_chunk_pages(f, chunk: ColumnChunk):
@@ -630,11 +664,15 @@ def _concat_pages(
                 dictionary=dictionary,
                 indices=idx.astype(np.int32, copy=False),
             )
-        values = (
-            dictionary.take(idx)
-            if isinstance(dictionary, ByteArrayData)
-            else np.asarray(dictionary)[idx]
-        )
+        try:
+            values = (
+                dictionary.take(idx)
+                if isinstance(dictionary, ByteArrayData)
+                else np.asarray(dictionary)[idx]
+            )
+        except (IndexError, ValueError) as e:
+            # corrupt index stream, not a programming error: stay typed
+            raise ChunkError(f"chunk: dictionary index out of range: {e}") from e
         return ChunkData(
             column=column,
             num_values=num_values,

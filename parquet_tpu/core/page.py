@@ -16,11 +16,14 @@ batch the gathers (kernels/pipeline.py).
 
 from __future__ import annotations
 
+import struct as _struct
 import zlib
+from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass
 
 import numpy as np
 
+from ..meta.file_meta import ParquetFileError
 from ..meta.parquet_types import (
     DataPageHeader,
     DataPageHeaderV2,
@@ -53,6 +56,50 @@ class PageError(ValueError):
     pass
 
 
+class MissingDictionaryError(PageError):
+    """A data page references a chunk dictionary that is absent (or failed
+    to decode). Distinct type so triage tooling (parquet-tool verify) can
+    tell a DEPENDENT failure — data pages orphaned by one rotten dictionary
+    page — from independent corruption, without matching message text."""
+
+
+@_contextmanager
+def typed_page_errors(what: str):
+    """Context manager converting ANY stray exception from decoding
+    untrusted page bytes into a typed PageError (already-typed Parquet
+    errors pass through). Corrupt input must never surface as a raw
+    struct.error / zlib.error / IndexError / OverflowError — the
+    fault-injection harness (parquet_tpu.testing.faults) enforces this
+    contract over every decode entry point."""
+    try:
+        yield
+    except (PageError, ParquetFileError):
+        raise
+    except ValueError as e:
+        # ChunkError is a ValueError defined downstream (core.chunk imports
+        # this module); keep its exact message when it bubbles through a
+        # page decode
+        if type(e).__name__ == "ChunkError":
+            raise
+        raise PageError(f"page: corrupt {what}: {e}") from e
+    except (
+        KeyError,
+        IndexError,
+        OverflowError,
+        ZeroDivisionError,
+        TypeError,
+        EOFError,
+        _struct.error,
+        zlib.error,
+    ) as e:
+        # MemoryError deliberately NOT converted: genuine memory pressure on
+        # a valid page is not corruption, and under on_error='skip' a typed
+        # rewrap would silently quarantine valid rows (header-driven bomb
+        # allocations are already rejected by the preflight size guards
+        # before any allocation happens)
+        raise PageError(f"page: corrupt {what}: {e!r}") from e
+
+
 @dataclass
 class DecodedPage:
     """One decoded data page.
@@ -71,11 +118,19 @@ class DecodedPage:
     def materialize(self, dictionary):
         if self.values is None and self.indices is not None:
             if dictionary is None:
-                raise PageError("page: dictionary-encoded page but no dictionary page")
-            if isinstance(dictionary, ByteArrayData):
-                self.values = dictionary.take(self.indices)
-            else:
-                self.values = np.asarray(dictionary)[self.indices]
+                raise MissingDictionaryError(
+                    "page: dictionary-encoded page but no dictionary page"
+                )
+            try:
+                if isinstance(dictionary, ByteArrayData):
+                    self.values = dictionary.take(self.indices)
+                else:
+                    self.values = np.asarray(dictionary)[self.indices]
+            except (IndexError, ValueError) as e:
+                # corrupt index stream, not a programming error: stay typed
+                raise PageError(
+                    f"page: dictionary index out of range: {e}"
+                ) from e
         return self
 
 
@@ -90,7 +145,9 @@ def _decode_values(
     ptype = column.type
     if encoding in _DICT_ENCODINGS:
         if dict_size is None:
-            raise PageError("page: dictionary encoding without dictionary")
+            raise MissingDictionaryError(
+                "page: dictionary encoding without dictionary"
+            )
         return None, decode_dict_indices(data, n, dict_size)
     if encoding == int(Encoding.PLAIN):
         values, _ = plain_ops.decode_plain(data, n, ptype, column.type_length)
@@ -149,16 +206,20 @@ def decode_data_page_v1(
     buf = memoryview(block)
     pos = 0
     rep = None
-    if column.max_rep > 0:
-        rep, used = decode_levels_v1(buf, n, column.max_rep)
-        pos += used
-    dfl = None
-    non_null = n
-    if column.max_def > 0:
-        dfl, used = decode_levels_v1(buf[pos:], n, column.max_def)
-        pos += used
-        non_null = int((dfl == column.max_def).sum())
-    values, indices = _decode_values(buf[pos:], non_null, h.encoding, column, dict_size)
+    with typed_page_errors("v1 level stream"):
+        if column.max_rep > 0:
+            rep, used = decode_levels_v1(buf, n, column.max_rep)
+            pos += used
+        dfl = None
+        non_null = n
+        if column.max_def > 0:
+            dfl, used = decode_levels_v1(buf[pos:], n, column.max_def)
+            pos += used
+            non_null = int((dfl == column.max_def).sum())
+    with typed_page_errors("v1 value stream"):
+        values, indices = _decode_values(
+            buf[pos:], non_null, h.encoding, column, dict_size
+        )
     return DecodedPage(
         num_values=n, def_levels=dfl, rep_levels=rep, values=values, indices=indices
     )
@@ -182,15 +243,16 @@ def decode_data_page_v2(
         raise PageError("page: v2 level sizes exceed page")
     buf = memoryview(raw)
     rep = None
-    if column.max_rep > 0:
-        rep = decode_levels_v2(buf[:rep_len], n, column.max_rep)
-    elif rep_len:
-        raise PageError("page: v2 rep levels present for flat column")
-    dfl = None
-    non_null = n
-    if column.max_def > 0:
-        dfl = decode_levels_v2(buf[rep_len : rep_len + def_len], n, column.max_def)
-        non_null = int((dfl == column.max_def).sum())
+    with typed_page_errors("v2 level stream"):
+        if column.max_rep > 0:
+            rep = decode_levels_v2(buf[:rep_len], n, column.max_rep)
+        elif rep_len:
+            raise PageError("page: v2 rep levels present for flat column")
+        dfl = None
+        non_null = n
+        if column.max_def > 0:
+            dfl = decode_levels_v2(buf[rep_len : rep_len + def_len], n, column.max_def)
+            non_null = int((dfl == column.max_def).sum())
     if h.num_nulls is not None and dfl is not None and column.max_rep == 0:
         # FLAT columns only: for repeated columns parquet-cpp counts
         # num_nulls as null VALUES (def one below max at the element or a
@@ -207,7 +269,10 @@ def decode_data_page_v2(
         uncompressed = (header.uncompressed_page_size or 0) - rep_len - def_len
         with stage("decompress", len(values_block)):
             values_block = decompress_block(values_block, codec, max(uncompressed, 0))
-    values, indices = _decode_values(values_block, non_null, h.encoding, column, dict_size)
+    with typed_page_errors("v2 value stream"):
+        values, indices = _decode_values(
+            values_block, non_null, h.encoding, column, dict_size
+        )
     return DecodedPage(
         num_values=n, def_levels=dfl, rep_levels=rep, values=values, indices=indices
     )
@@ -223,7 +288,10 @@ def decode_dict_page(header: PageHeader, block: bytes, column: Column):
     enc = h.encoding
     if enc not in (int(Encoding.PLAIN), int(Encoding.PLAIN_DICTIONARY)):
         raise PageError(f"page: dictionary page encoding {enc} unsupported")
-    values, consumed = plain_ops.decode_plain(block, n, column.type, column.type_length)
+    with typed_page_errors("dictionary page"):
+        values, consumed = plain_ops.decode_plain(
+            block, n, column.type, column.type_length
+        )
     if consumed != len(block):
         # Strict full decode (reference: page_dict.go:35-72): trailing bytes
         # mean the header lied about num_values or the page is corrupt.

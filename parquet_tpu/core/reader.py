@@ -37,11 +37,24 @@ from .assembly import (
     slice_column,
     vector_row_columns,
 )
-from .chunk import ChunkData, read_chunk
+from .chunk import ChunkData, ChunkError, read_chunk
+from .page import PageError
 from .schema import Schema
+from ..meta.thrift import ThriftError
 from ..utils.trace import bump, stage
 
-__all__ = ["FileReader"]
+__all__ = ["FileReader", "PARQUET_ERRORS"]
+
+# The typed malformed-file error family: everything a corrupt or lying file
+# can legally raise out of a read. Anything else escaping a decode is a bug
+# the fault-injection harness (parquet_tpu.testing.faults) hunts for.
+PARQUET_ERRORS = (ParquetFileError, ChunkError, PageError, ThriftError)
+
+
+class _GroupQuarantined(Exception):
+    """Internal control flow for on_error != 'raise': the current row group
+    cannot be delivered (a required column was corrupt, or the policy is
+    'skip'). Never escapes FileReader."""
 
 _pool: ThreadPoolExecutor | None = None
 _pool_lock = threading.Lock()
@@ -128,6 +141,33 @@ def _scatter_byte_offsets(valid: np.ndarray, offsets) -> np.ndarray:
     )
     np.maximum.accumulate(out, out=out)
     return out
+
+
+def _concat_group_tables(pa, parts):
+    """Concatenate per-row-group pyarrow tables of the SAME selection,
+    normalizing dictionary-vs-plain per column exactly like to_arrow's
+    cross-group chunk assembly (a group with PLAIN fallback pages decodes
+    plain while its siblings stay dictionary-typed). None for no parts."""
+    if not parts:
+        return None
+    if len(parts) == 1:
+        return parts[0]
+    names = parts[0].column_names
+    arrays = []
+    for name in names:
+        cols = [p.column(name) for p in parts]
+        is_dict = [pa.types.is_dictionary(c.type) for c in cols]
+        if any(is_dict) and not all(is_dict):
+            cols = [
+                c.cast(c.type.value_type) if pa.types.is_dictionary(c.type) else c
+                for c in cols
+            ]
+        arrays.append(
+            pa.chunked_array(
+                [ch for c in cols for ch in c.chunks], type=cols[0].type
+            )
+        )
+    return pa.table(dict(zip(names, arrays)))
 
 
 class RaggedColumn(NamedTuple):
@@ -256,6 +296,7 @@ class FileReader:
         backend: str = "host",
         compact_levels: bool = False,
         device=None,
+        on_error: str = "raise",
     ):
         if isinstance(source, (str, Path)):
             self._f = open(source, "rb")
@@ -277,6 +318,24 @@ class FileReader:
                     "or 'tpu_roundtrip'"
                 )
             self.backend = backend
+            # on_error: corruption-isolation policy for host-delivery reads
+            # (read_row_group / iter_rows / to_arrow).
+            #   "raise" (default)  the first typed Parquet error aborts the read
+            #   "skip"             a corrupt column chunk quarantines its whole
+            #                      row group (dropped; counters:
+            #                      chunks_quarantined / row_groups_quarantined)
+            #   "null"             the corrupt chunk delivers as all-null when
+            #                      its column is optional; required columns
+            #                      degrade to "skip" for that group
+            # Device-resident delivery (read_row_group_device, device batches)
+            # always raises: a training loop silently missing rows is worse
+            # than a crash.
+            if on_error not in ("raise", "skip", "null"):
+                raise ValueError(
+                    f"unknown on_error {on_error!r}: expected 'raise', "
+                    "'skip', or 'null'"
+                )
+            self.on_error = on_error
             # compact_levels: R/D levels of delivered columns are stored
             # bit-packed (PackedLevels, width = bits(max_level)) instead of
             # uint16 arrays — the reference's packed_array memory layout
@@ -385,29 +444,92 @@ class FileReader:
         a pure pack+widen round trip with no at-rest benefit. `dict_paths`
         keeps those columns' dictionary indices unmaterialized when their
         chunk allows it (to_arrow read_dictionary=; both backends — the
-        roundtrip path passes its decoded indices through finalize)."""
-        if self.backend == "tpu_roundtrip":
-            plans = self._plan_row_group(i, columns)
-            out = {
-                path: plan.finalize(keep_dict_indices=path in dict_paths)
-                for path, plan in plans.items()
-            }
-        else:
-            out = {
-                path: read_chunk(
-                    self._f,
-                    cc,
-                    column,
-                    validate_crc=self.validate_crc,
-                    alloc=self.alloc,
-                    keep_dict_indices=path in dict_paths,
-                )
-                for path, cc, column in self._selected_chunks(i, columns)
-            }
+        roundtrip path passes its decoded indices through finalize).
+
+        Under on_error != 'raise' a corrupt chunk is quarantined instead of
+        aborting: 'null' substitutes an all-null chunk (optional columns
+        only), otherwise the WHOLE row group is dropped — columns of a group
+        must stay row-aligned, so a single undeliverable chunk poisons the
+        group. A dropped group returns {}."""
+        try:
+            if self.backend == "tpu_roundtrip":
+                try:
+                    plans = self._plan_row_group(i, columns)
+                    out = {
+                        path: plan.finalize(keep_dict_indices=path in dict_paths)
+                        for path, plan in plans.items()
+                    }
+                except PARQUET_ERRORS as e:
+                    # chunks plan/finalize as a batch here, so isolation is
+                    # group-granular on this backend
+                    if self.on_error == "raise":
+                        raise
+                    bump("chunks_quarantined")
+                    raise _GroupQuarantined() from e
+            else:
+                out = {}
+                for path, cc, column in self._selected_chunks(i, columns):
+                    try:
+                        out[path] = read_chunk(
+                            self._f,
+                            cc,
+                            column,
+                            validate_crc=self.validate_crc,
+                            alloc=self.alloc,
+                            keep_dict_indices=path in dict_paths,
+                        )
+                    except PARQUET_ERRORS as e:
+                        if self.on_error == "raise":
+                            raise
+                        bump("chunks_quarantined")
+                        if self.on_error == "null":
+                            nc = self._null_chunk(i, column)
+                            if nc is not None:
+                                bump("chunks_nulled")
+                                out[path] = nc
+                                continue
+                        raise _GroupQuarantined() from e
+        except _GroupQuarantined:
+            bump("row_groups_quarantined")
+            return {}
         if pack and self.compact_levels:
             for path, cd in out.items():
                 self._pack_chunk_levels(path, cd)
         return out
+
+    def _null_chunk(self, i: int, column) -> "ChunkData | None":
+        """An all-null stand-in for a quarantined chunk (on_error='null'):
+        one level entry per row at definition 0. Only possible when the
+        column is optional somewhere along its path (max_def > 0) — a
+        REQUIRED column has no null representation, so the caller degrades
+        to quarantining the group."""
+        if column.max_def <= 0:
+            return None
+        rows = self.row_group(i).num_rows or 0
+        from ..meta.parquet_types import Type
+        from .arrays import ByteArrayData
+        from .chunk import _empty_dtype
+
+        if column.type == Type.BYTE_ARRAY:
+            values = ByteArrayData(offsets=np.zeros(1, dtype=np.int64), data=b"")
+        elif column.type == Type.FIXED_LEN_BYTE_ARRAY:
+            # fixed-width values decode as (n, width) uint8 rows; a 1-D empty
+            # here would type the Arrow chunk uint8 and crash concatenation
+            # against clean groups' fixed_size_binary chunks
+            values = np.empty((0, column.type_length or 0), dtype=np.uint8)
+        elif column.type == Type.INT96:
+            values = np.empty((0, 12), dtype=np.uint8)
+        else:
+            values = np.empty(0, dtype=_empty_dtype(column))
+        return ChunkData(
+            column=column,
+            num_values=rows,
+            values=values,
+            def_levels=np.zeros(rows, dtype=np.uint16),
+            rep_levels=(
+                np.zeros(rows, dtype=np.uint16) if column.max_rep > 0 else None
+            ),
+        )
 
     def _effective_device(self, device=None):
         """Precedence rule, in one place: per-call override > reader default
@@ -1134,6 +1256,8 @@ class FileReader:
                 bump("selective_page_decode")
         if chunks is None:
             chunks = self._read_row_group(i, columns, pack=False)
+        if not chunks:
+            return []  # quarantined group (on_error='skip'), or empty selection
         with stage("assemble"):
             with _gc_paused():
                 rc = fast_row_columns(self.schema, chunks, raw)
@@ -1330,6 +1454,8 @@ class FileReader:
             chunks = self._read_row_group(
                 i, columns, pack=False, dict_paths=dict_paths
             )
+            if not chunks:
+                continue  # quarantined group (on_error != 'raise')
             by_top: dict[str, dict] = {}
             for path, cd in chunks.items():
                 by_top.setdefault(path[0], {})[path] = cd
@@ -1410,6 +1536,13 @@ class FileReader:
         if names is None:
             names = []
         if not per_group:
+            if indices:
+                # every selected group was quarantined (on_error != 'raise'):
+                # deliver the zero-row table WITH the selected schema, like
+                # an empty row-group selection would
+                return self.to_arrow(
+                    row_groups=[], columns=columns, read_dictionary=read_dictionary
+                )
             return pa.table({})
         arrays = []
         for name in names:
@@ -1472,11 +1605,6 @@ class FileReader:
             )
             if dnf_group_may_match(self.row_group(i), dnf, self._bloom_excludes, i)
         ]
-        table = self.to_arrow(
-            row_groups=indices, columns=columns, read_dictionary=read_dictionary
-        )
-        if not dnf or any(not conj for conj in dnf) or table.num_rows == 0:
-            return table  # an empty conjunction is vacuously true
         # flat top-level filter columns already in the projection evaluate
         # straight off `table`; only projected-out or nested paths pay a
         # second (filter-leaves-only) read
@@ -1487,9 +1615,46 @@ class FileReader:
             for p in fpaths
             if len(p) > 1 or (sel is not None and p not in sel)
         ]
-        ftab = (
-            self.to_arrow(row_groups=indices, columns=extra) if extra else None
-        )
+        vacuous = not dnf or any(not conj for conj in dnf)
+        ftab = None
+        if extra and not vacuous and self.on_error != "raise":
+            # Quarantine decisions depend on which columns a read touches,
+            # so the projection read and the filter-leaves read can drop
+            # DIFFERENT groups (a corrupt chunk outside one projection) —
+            # misaligned row masks below would escape as a raw pyarrow
+            # length error. Read both sides group-by-group, keep only groups
+            # BOTH deliver in full, and concatenate the kept per-group
+            # tables directly (each group decodes exactly once, same as the
+            # bulk read — to_arrow iterates per group internally anyway).
+            kept_t, kept_f = [], []
+            for i in indices:
+                expect = self.row_group(i).num_rows or 0
+                t_i = self.to_arrow(
+                    row_groups=[i], columns=columns,
+                    read_dictionary=read_dictionary,
+                )
+                if t_i.num_rows != expect:
+                    continue  # group already dropped: skip the filter read
+                f_i = self.to_arrow(row_groups=[i], columns=extra)
+                if f_i.num_rows == expect:
+                    kept_t.append(t_i)
+                    kept_f.append(f_i)
+            table = _concat_group_tables(pa, kept_t)
+            if table is None:
+                table = self.to_arrow(
+                    row_groups=[], columns=columns,
+                    read_dictionary=read_dictionary,
+                )
+            ftab = _concat_group_tables(pa, kept_f)
+        else:
+            table = self.to_arrow(
+                row_groups=indices, columns=columns,
+                read_dictionary=read_dictionary,
+            )
+        if vacuous or table.num_rows == 0:
+            return table  # an empty conjunction is vacuously true
+        if ftab is None and extra:
+            ftab = self.to_arrow(row_groups=indices, columns=extra)
 
         # A column referenced in N DNF conjunctions must combine its chunks
         # once, not N times (combine_chunks copies the whole column); the

@@ -843,65 +843,77 @@ _PC_EXTRA, _PC_DFIRST = 16, 17
 
 def _native_prepare(f, chunk, column, validate_crc, alloc, stats):
     """Whole-chunk native prepare: ONE GIL-free C call walks every page
-    (header parse, decompress, level decode, value prescan) and returns
-    packed tables; batch assembly is then a handful of vectorized NumPy ops
-    instead of a per-page Python loop (the dominant host cost — reference
-    page walk: chunk_reader.go:182-263). Returns a ready _ChunkPlan or None
-    when the chunk needs the Python walk (CRC validation, memory ceiling,
-    non-builtin codec, corrupt input — the Python path reproduces exact
-    error semantics). PQT_FUSED_PREPARE=0 forces the staged walk (the
-    differential-test control). Under an active decode_trace the outcome is
-    pinned by the prepare_fused_engaged / prepare_fused_declined counters
-    and the walk's internal stage split lands in prepare.* stages."""
+    (header parse, CRC verify when validate_crc, decompress, level decode,
+    value prescan) and returns packed tables; batch assembly is then a
+    handful of vectorized NumPy ops instead of a per-page Python loop (the
+    dominant host cost — reference page walk: chunk_reader.go:182-263).
+
+    Returns (plan, fault): a ready _ChunkPlan and None, or None and an
+    optional PrepareFault. fault is set when the native walk RAN and aborted
+    (corrupt/unsupported/capacity, with stage + page + byte offset); it is
+    None when the walk was never attempted (memory ceiling, non-builtin
+    codec, library absent). Either way the caller falls back to the staged
+    per-page Python walk — the error-semantics reference — which raises the
+    exact typed error if the chunk is genuinely corrupt (the fused -> staged
+    -> raise fallback ladder; prepare_fallback_recovered counts chunks the
+    staged walk salvaged after a native abort). PQT_FUSED_PREPARE=0 forces
+    the staged walk (the differential-test control). Under an active
+    decode_trace the outcome is pinned by the prepare_fused_engaged /
+    prepare_fused_declined counters and the walk's internal stage split
+    lands in prepare.* stages."""
     import os as _os
 
     from ..utils import trace as _trace
 
     if _os.environ.get("PQT_FUSED_PREPARE", "1") == "0":
-        return None  # forced staged path: not a decline, no counter
-    plan = _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats)
+        return None, None  # forced staged path: not a decline, no counter
+    plan, fault = _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats)
     if plan is None:
         _trace.bump("prepare_fused_declined")
+        if fault is not None:
+            _trace.bump(f"prepare_fused_fault_{fault.stage}")
     else:
         _trace.bump("prepare_fused_engaged")
-    return plan
+    return plan, fault
 
 
 def _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats):
-    if validate_crc or alloc is not None:
-        return None
-    from ..utils.native import get_native
+    if alloc is not None:
+        # a memory ceiling needs the per-page accounting only the staged
+        # walk performs (validate_crc, by contrast, is fused natively)
+        return None, None
+    from ..utils.native import PrepareFault, get_native
 
     lib = get_native()
     if lib is None or not lib.has_chunk_prepare:
-        return None
+        return None, None
     md = chunk.meta_data
     codec = int(md.codec or 0)
     from ..core.compress import is_builtin_codec
 
     if codec not in (0, 1, 2, 5, 7) or not is_builtin_codec(codec):
-        return None
+        return None, None
     if codec == 1 and not lib.has_snappy:
-        return None
+        return None, None
     if codec in (5, 7) and not lib.has_lz4:
-        return None
+        return None, None
     from ..core.chunk import chunk_byte_range
 
     try:
         offset, total = chunk_byte_range(chunk)
     except Exception:
-        return None
+        return None, None
     f.seek(offset)
     buf = f.read(total)
     if len(buf) != total:
-        return None  # truncated: Python walk raises the exact error
+        return None, None  # truncated: Python walk raises the exact error
     ptype = column.type
     np_dt = _NUMERIC_DTYPE.get(ptype)
     type_size = np.dtype(np_dt).itemsize if np_dt is not None else 0
     delta_nbits = 32 if ptype == Type.INT32 else (64 if ptype == Type.INT64 else 0)
     expected = int(md.num_values or 0)
     if expected < 0:
-        return None
+        return None, None
     from ..utils import trace as _trace
 
     res = lib.chunk_prepare(
@@ -914,22 +926,32 @@ def _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats):
         expected,
         int(md.total_uncompressed_size or 0),
         collect_stages=_trace.active(),
+        validate_crc=validate_crc,
     )
-    if res is None:
-        return None
+    if isinstance(res, PrepareFault):
+        return None, res
     stage_ns = res.get("stage_ns")
     if stage_ns is not None:
         for slot, name in enumerate(
-            ("prepare.decompress", "prepare.levels", "prepare.prescan", "prepare.copy")
+            (
+                "prepare.decompress",
+                "prepare.levels",
+                "prepare.prescan",
+                "prepare.copy",
+                "prepare.crc",
+            )
         ):
             if stage_ns[slot]:
                 _trace.add_seconds(name, int(stage_ns[slot]) / 1e9)
     try:
-        return _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits)
+        return (
+            _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits),
+            None,
+        )
     except (PageError, ChunkError):
         raise
     except Exception:
-        return None  # unexpected table shape: let the Python walk decide
+        return None, None  # unexpected table shape: let the Python walk decide
 
 
 def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
@@ -1486,11 +1508,33 @@ def prepare_chunk_plan(
     returned plan's batches go to the device via plan.dispatch_device() on
     the dispatching thread. The whole-chunk native walk handles the common
     shapes in one C call; anything it declines takes the per-page Python
-    walk below (the error-semantics reference).
+    walk below (the error-semantics reference) — the decode fallback
+    ladder's middle rung. A chunk the native walk ABORTED on (fault set)
+    that the staged walk then decodes cleanly counts as
+    prepare_fallback_recovered; a genuinely corrupt chunk raises the staged
+    walk's typed error (the ladder's final rung).
     """
-    plan = _native_prepare(f, chunk, column, validate_crc, alloc, stats)
+    from ..utils import trace as _trace
+
+    plan, fault = _native_prepare(f, chunk, column, validate_crc, alloc, stats)
     if plan is not None:
         return plan
+    plan = _staged_prepare(f, chunk, column, validate_crc, alloc, stats)
+    if fault is not None:
+        # the native walk aborted but the staged walk decoded cleanly
+        _trace.bump("prepare_fallback_recovered")
+    return plan
+
+
+def _staged_prepare(
+    f,
+    chunk,
+    column: Column,
+    validate_crc: bool = False,
+    alloc=None,
+    stats: TpuDecodeStats | None = None,
+) -> _ChunkPlan:
+    """The per-page Python prepare walk (the error-semantics reference)."""
     md = chunk.meta_data
     codec = md.codec or 0
     expected = md.num_values or 0
@@ -1544,14 +1588,21 @@ def prepare_chunk_plan(
         # -- route the value stream --------------------------------------------
         if enc in (int(Encoding.RLE_DICTIONARY), int(Encoding.PLAIN_DICTIONARY)):
             if plan.dictionary is None:
-                raise PageError("page: dictionary encoding without dictionary")
+                from ..core.page import MissingDictionaryError
+
+                raise MissingDictionaryError(
+                    "page: dictionary encoding without dictionary"
+                )
             if non_null == 0:
                 plan.page_infos.append((n, dfl, rep, "empty", None))
                 continue
             width = values_buf[0] if values_buf else 0
             if width > 32:
                 raise PageError(f"page: invalid dict index width {width}")
-            table = prescan_hybrid(values_buf[1:], non_null, width)
+            from ..core.page import typed_page_errors
+
+            with typed_page_errors("dict index stream"):
+                table = prescan_hybrid(values_buf[1:], non_null, width)
             if len(table.packed) * 8 > _BATCH_BITS_CAP:
                 # One page alone exceeds the int32 bit-offset range of the
                 # device kernel: decode it on host (adversarially large pages;
@@ -1567,7 +1618,10 @@ def prepare_chunk_plan(
             Type.INT64,
         ):
             nbits = 32 if ptype == Type.INT32 else 64
-            table = prescan_delta_packed(values_buf, nbits, max_total=non_null)
+            from ..core.page import typed_page_errors
+
+            with typed_page_errors("delta stream"):
+                table = prescan_delta_packed(values_buf, nbits, max_total=non_null)
             if table.consumed * 8 > _BATCH_BITS_CAP:
                 # Same int32-range guard as the hybrid path: host decode.
                 plan.page_infos.append(
@@ -1678,16 +1732,19 @@ def _host_decode_dict_page(table, width: int, non_null: int, stats):
 
 def _host_decode_delta_page(values_buf, nbits: int, non_null: int, stats):
     """Host fallback for a delta page: ('values', decoded values)."""
+    from ..core.page import typed_page_errors
     from ..ops.delta import decode_delta
 
     if stats is not None:
         stats.host_fallback_pages += 1
-    vals, _ = decode_delta(values_buf, nbits, max_total=non_null)
+    with typed_page_errors("delta stream"):
+        vals, _ = decode_delta(values_buf, nbits, max_total=non_null)
     return "values", vals[:non_null]
 
 
 def _split_page(raw, header, pt, codec, column: Column):
     """Split a data page into levels (host-decoded) and the value stream."""
+    from ..core.page import typed_page_errors
     from ..ops.levels import decode_levels_v1, decode_levels_v2
 
     if pt == int(PageType.DATA_PAGE):
@@ -1699,18 +1756,21 @@ def _split_page(raw, header, pt, codec, column: Column):
         buf = memoryview(block)
         pos = 0
         rep = None
-        if column.max_rep > 0:
-            rep, used = decode_levels_v1(buf, n, column.max_rep)
-            pos += used
-        dfl = None
-        non_null = n
-        if column.max_def > 0:
-            dfl, used, cv = decode_levels_v1(buf[pos:], n, column.max_def, want_const=True)
-            pos += used
-            if cv is not None:
-                non_null = n if cv == column.max_def else 0
-            else:
-                non_null = int((dfl == column.max_def).sum())
+        with typed_page_errors("v1 level stream"):
+            if column.max_rep > 0:
+                rep, used = decode_levels_v1(buf, n, column.max_rep)
+                pos += used
+            dfl = None
+            non_null = n
+            if column.max_def > 0:
+                dfl, used, cv = decode_levels_v1(
+                    buf[pos:], n, column.max_def, want_const=True
+                )
+                pos += used
+                if cv is not None:
+                    non_null = n if cv == column.max_def else 0
+                else:
+                    non_null = int((dfl == column.max_def).sum())
         return n, dfl, rep, non_null, h.encoding, buf[pos:]
 
     h = header.data_page_header_v2
@@ -1720,19 +1780,24 @@ def _split_page(raw, header, pt, codec, column: Column):
     rep_len = h.repetition_levels_byte_length or 0
     def_len = h.definition_levels_byte_length or 0
     buf = memoryview(raw.payload)
-    if rep_len + def_len > len(buf):
+    if rep_len < 0 or def_len < 0 or rep_len + def_len > len(buf):
         raise ChunkError("chunk: v2 level sizes exceed page")
-    rep = decode_levels_v2(buf[:rep_len], n, column.max_rep) if column.max_rep > 0 else None
-    dfl = None
-    non_null = n
-    if column.max_def > 0:
-        dfl, cv = decode_levels_v2(
-            buf[rep_len : rep_len + def_len], n, column.max_def, want_const=True
+    with typed_page_errors("v2 level stream"):
+        rep = (
+            decode_levels_v2(buf[:rep_len], n, column.max_rep)
+            if column.max_rep > 0
+            else None
         )
-        if cv is not None:
-            non_null = n if cv == column.max_def else 0
-        else:
-            non_null = int((dfl == column.max_def).sum())
+        dfl = None
+        non_null = n
+        if column.max_def > 0:
+            dfl, cv = decode_levels_v2(
+                buf[rep_len : rep_len + def_len], n, column.max_def, want_const=True
+            )
+            if cv is not None:
+                non_null = n if cv == column.max_def else 0
+            else:
+                non_null = int((dfl == column.max_def).sum())
     values_buf = buf[rep_len + def_len :]
     if h.is_compressed is None or h.is_compressed:
         un = (header.uncompressed_page_size or 0) - rep_len - def_len
@@ -1787,10 +1852,18 @@ def _materialize(dictionary, indices):
     front), and bouncing them through the device for the gather costs an
     upload + a fetch per page — measured ~100ms/page on the transfer link —
     for work NumPy does in microseconds. The device dictionary (dict_dev)
-    exists solely for device-resident delivery (device_column)."""
-    if isinstance(dictionary, ByteArrayData):
-        return dictionary.take(np.asarray(indices, dtype=np.int64))
-    return np.asarray(dictionary)[np.asarray(indices)]
+    exists solely for device-resident delivery (device_column).
+
+    An index past the dictionary is corrupt input (a rotted bit in the index
+    stream), not a programming error: surface it typed, never as a raw
+    IndexError (fault-harness contract — the staged walk validates indices
+    at decode time, this is the fused walk's equivalent boundary)."""
+    try:
+        if isinstance(dictionary, ByteArrayData):
+            return dictionary.take(np.asarray(indices, dtype=np.int64))
+        return np.asarray(dictionary)[np.asarray(indices)]
+    except (IndexError, ValueError) as e:
+        raise PageError(f"page: dictionary index out of range: {e}") from e
 
 
 def _concat_values(parts, column: Column):

@@ -1,0 +1,1 @@
+"""Test-support tooling shipped with the package (fault injection)."""

@@ -11,10 +11,40 @@ from __future__ import annotations
 import ctypes
 import os
 from pathlib import Path
+from typing import NamedTuple
 
 _SO_NAMES = ("libparquet_tpu_native.so",)
 _cached = None
 _probed = False
+
+# ptq_chunk_prepare err_info[0] stage codes (parquet_tpu_native.h PTQ_STAGE_*).
+PREPARE_STAGES = {
+    0: "none",
+    1: "header",
+    2: "crc",
+    3: "decompress",
+    4: "levels",
+    5: "prescan",
+    6: "values",
+}
+
+# ptq_chunk_prepare terminal return codes (parquet_tpu_native.h PTQ_E_*).
+PREPARE_E_CORRUPT = -1
+PREPARE_E_CAPACITY = -5
+PREPARE_E_CRC = -6
+
+
+class PrepareFault(NamedTuple):
+    """Structured failure report from the fused native chunk walk: the
+    negative return code (PREPARE_E_*) plus the stage/page/byte-offset
+    context the walk recorded when it aborted. NOT an exception — the
+    pipeline's fallback ladder retries the chunk on the staged Python walk,
+    which raises the exact typed error if the input is genuinely corrupt."""
+
+    code: int
+    stage: str
+    page: int
+    offset: int
 
 
 def _ptr(data):
@@ -272,7 +302,8 @@ class NativeLib:
             lib.ptq_chunk_prepare.restype = ctypes.c_ssize_t
             lib.ptq_chunk_prepare.argtypes = (
                 [ctypes.c_void_p, ctypes.c_size_t]  # src
-                + [ctypes.c_int] * 5  # codec, max_def, max_rep, type_size, delta_nbits
+                # codec, validate_crc, max_def, max_rep, type_size, delta_nbits
+                + [ctypes.c_int] * 6
                 + [ctypes.c_int64]  # expected_values
                 + [ctypes.c_void_p, ctypes.c_size_t]  # pages
                 + [ctypes.c_void_p, ctypes.c_void_p]  # def_out, rep_out
@@ -281,6 +312,7 @@ class NativeLib:
                 + [ctypes.c_void_p] * 4 + [ctypes.c_size_t]  # delta tables
                 + [ctypes.c_void_p]  # totals
                 + [ctypes.c_void_p]  # stage_ns (nullable per-stage clock)
+                + [ctypes.c_void_p]  # err_info (nullable int64[4])
             )
         # The CPython-extension binding of the same walk: one call, every
         # buffer through the buffer protocol, the whole walk under
@@ -621,16 +653,20 @@ class NativeLib:
         expected_values: int,
         uncompressed_cap: int,
         collect_stages: bool = False,
+        validate_crc: bool = False,
     ):
         """Whole-chunk prepare walk (ptq_chunk_prepare): one native call does
-        header parse + decompress + level decode + value-stream prescan for
-        every page, GIL-free (the CPython-extension binding releases it
-        explicitly via Py_BEGIN_ALLOW_THREADS; the ctypes fallback drops it
-        at the foreign-call boundary). Returns a dict of packed tables, or
-        None when the chunk needs the Python walk (corrupt / unsupported /
-        capacity-exceeded — the Python path reproduces the exact error
-        semantics). collect_stages=True adds a "stage_ns" int64[4] entry
-        (decompress, levels, prescan, copy accumulated wall ns)."""
+        header parse + (opt-in) CRC verify + decompress + level decode +
+        value-stream prescan for every page, GIL-free (the CPython-extension
+        binding releases it explicitly via Py_BEGIN_ALLOW_THREADS; the ctypes
+        fallback drops it at the foreign-call boundary). Returns a dict of
+        packed tables on success, or a PrepareFault naming the failing
+        {code, stage, page, offset} when the chunk needs the Python walk
+        (corrupt / unsupported / capacity-exceeded — the Python path
+        reproduces the exact error semantics; the fault detail feeds the
+        fallback-ladder counters and parquet-tool verify).
+        collect_stages=True adds a "stage_ns" int64[5] entry (decompress,
+        levels, prescan, copy, crc accumulated wall ns)."""
         import numpy as np
 
         addr, n_in, _keep = _ptr(data)
@@ -667,7 +703,8 @@ class NativeLib:
         if scratch is None or len(scratch) < cap + 64:
             scratch = tl.scratch = np.empty(cap + 64, dtype=np.uint8)
         totals = np.zeros(8, dtype=np.int64)
-        stage_ns = np.zeros(4, dtype=np.int64) if collect_stages else None
+        stage_ns = np.zeros(5, dtype=np.int64) if collect_stages else None
+        err_info = np.zeros(4, dtype=np.int64)
         ext = self._ext_chunk_prepare
         p = ctypes.c_void_p
         while True:
@@ -691,7 +728,8 @@ class NativeLib:
                 # hand back a larger staging buffer than requested)
                 rc = ext(
                     data if isinstance(data, (bytes, memoryview)) else _keep,
-                    codec, max_def, max_rep, type_size, delta_nbits,
+                    codec, 1 if validate_crc else 0,
+                    max_def, max_rep, type_size, delta_nbits,
                     expected_values,
                     pages, def_out, rep_out,
                     memoryview(values_out)[:cap],
@@ -699,11 +737,12 @@ class NativeLib:
                     delta_out, scratch,
                     h_is_rle, h_counts, h_values, h_byteoff,
                     d_widths, d_bytestart, d_outstart, d_mins,
-                    totals, stage_ns,
+                    totals, stage_ns, err_info,
                 )
             else:
                 rc = self._lib.ptq_chunk_prepare(
-                    addr, n_in, codec, max_def, max_rep, type_size, delta_nbits,
+                    addr, n_in, codec, 1 if validate_crc else 0,
+                    max_def, max_rep, type_size, delta_nbits,
                     expected_values,
                     pages.ctypes.data_as(p), max_pages,
                     def_out.ctypes.data_as(p), rep_out.ctypes.data_as(p),
@@ -717,6 +756,7 @@ class NativeLib:
                     d_outstart.ctypes.data_as(p), d_mins.ctypes.data_as(p), max_minis,
                     totals.ctypes.data_as(p),
                     None if stage_ns is None else stage_ns.ctypes.data_as(p),
+                    err_info.ctypes.data_as(p),
                 )
             if rc == -2 and max_pages < (1 << 24):
                 max_pages *= 8
@@ -728,7 +768,12 @@ class NativeLib:
                 max_minis = min(max_minis * 8, n_in + 8)
                 continue
             if rc < 0:
-                return None
+                return PrepareFault(
+                    code=int(rc),
+                    stage=PREPARE_STAGES.get(int(err_info[0]), "none"),
+                    page=int(err_info[1]),
+                    offset=int(err_info[2]),
+                )
             n = int(rc)
             R = int(totals[4])
             M = int(totals[5])

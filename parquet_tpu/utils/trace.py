@@ -51,6 +51,13 @@ class DecodeTrace:
             s = self.stages[name] = StageStats()
         return s
 
+    def counters(self) -> dict:
+        """{name: calls} for every bump()-style event collected — the
+        robustness counters ride here: prepare_fused_engaged/_declined,
+        prepare_fused_fault_<stage>, prepare_fallback_recovered,
+        chunks_quarantined, chunks_nulled, row_groups_quarantined."""
+        return {name: s.calls for name, s in self.stages.items() if s.calls}
+
     def report(self) -> str:
         lines = []
         for name, s in sorted(self.stages.items()):

@@ -15,3 +15,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: extended sweeps (fault-injection etc.) excluded from the "
+        "tier-1 `-m 'not slow'` run; `make fuzz` includes them",
+    )

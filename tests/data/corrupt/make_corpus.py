@@ -1,0 +1,102 @@
+"""Regenerate the hand-crafted corrupt-file corpus (committed alongside).
+
+Every file derives deterministically from one pristine base written by OUR
+FileWriter (seeded values, with_crc=True, snappy) so the corpus does not
+depend on the installed pyarrow's byte output. Each mutation targets one
+failure family of the decode ladder; tests/test_faults.py asserts every file
+raises a typed Parquet error on both the staged and the fused read path.
+
+    python tests/data/corrupt/make_corpus.py   # rewrites the corpus in place
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.abspath(os.path.join(HERE, "..", "..", "..")))
+
+
+def build_base() -> bytes:
+    import numpy as np
+
+    from parquet_tpu.core.writer import FileWriter
+    from parquet_tpu.meta.parquet_types import Type
+    from parquet_tpu.schema.builder import message, optional, required, string
+
+    rng = np.random.default_rng(2026)
+    schema = message(
+        required("id", Type.INT64),
+        optional("name", string()),
+        optional("score", Type.DOUBLE),
+    )
+    rows = [
+        {
+            "id": int(i),
+            "name": None if i % 11 == 0 else f"name_{i % 23}",
+            "score": None if i % 7 == 0 else float(rng.random()),
+        }
+        for i in range(600)
+    ]
+    buf = io.BytesIO()
+    with FileWriter(buf, schema, codec="snappy", with_crc=True) as w:
+        for lo in range(0, len(rows), 200):  # 3 row groups
+            w.write_rows(rows[lo : lo + 200])
+            w.flush_row_group()
+    return buf.getvalue()
+
+
+def main() -> None:
+    from parquet_tpu.testing.faults import _try_patch, map_pages
+
+    base = build_base()
+    sites = map_pages(base)
+    data_sites = [s for s in sites if s.kind in (0, 3) and s.payload_len > 0]
+    out: dict[str, bytes] = {"pristine.parquet": base}
+
+    n = len(base)
+    out["truncated_footer.parquet"] = base[: n - 9]  # mid footer-len/magic
+    out["truncated_mid_page.parquet"] = base[: data_sites[0].payload_offset + 5]
+    out["bad_magic.parquet"] = base[:-4] + b"XXXX"
+    out["empty.parquet"] = b""
+
+    s = data_sites[0]
+    flipped = bytearray(base)
+    flipped[s.payload_offset + s.payload_len // 2] ^= 0x10
+    out["crc_mismatch.parquet"] = bytes(flipped)
+
+    garbage = bytearray(base)
+    garbage[s.header_offset] = 0xFF  # delta 15 / wire 15: unknown wire type
+    out["page_header_garbage.parquet"] = bytes(garbage)
+
+    def bump_nv(h):
+        hh = h.data_page_header or h.data_page_header_v2
+        hh.num_values += 1
+
+    patched = _try_patch(base, s, bump_nv)
+    assert patched is not None, "num_values patch must be length-preserving"
+    out["lying_num_values.parquet"] = patched
+
+    def shrink_us(h):
+        h.uncompressed_page_size -= 1
+
+    patched = _try_patch(base, s, shrink_us)
+    assert patched is not None, "size patch must be length-preserving"
+    out["lying_uncompressed_size.parquet"] = patched
+
+    footer_len = int.from_bytes(base[-8:-4], "little")
+    fstart = n - 8 - footer_len
+    poisoned = bytearray(base)
+    poisoned[fstart : fstart + 7] = bytes([0x19, 0xF6]) + b"\xff\xff\xff\xff\x7f"
+    out["footer_giant_list.parquet"] = bytes(poisoned)
+
+    for name, blob in out.items():
+        with open(os.path.join(HERE, name), "wb") as f:
+            f.write(blob)
+        print(f"wrote {name} ({len(blob)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,358 @@
+"""Fault-injection matrix: corrupt input must surface as TYPED errors.
+
+Contracts pinned here (the corruption-hardening ladder):
+  * every mutation the seeded harness (parquet_tpu.testing.faults) produces
+    reads as a typed Parquet error or a byte-identical success — never a raw
+    struct.error/zlib.error/IndexError/OverflowError, never a hang (each
+    case runs under a watchdog), never silently wrong data;
+  * the contract holds on BOTH ladder rungs: the staged per-page Python walk
+    (host backend) and the fused native prepare (tpu_roundtrip backend);
+  * with validate_crc=True the fused native path stays ENGAGED on clean
+    files (prepare_fused_engaged, not prepare_fused_declined) and a CRC
+    mismatch falls fused -> staged -> typed ChunkError;
+  * FileReader(on_error=...) quarantines corrupt chunks/groups instead of
+    aborting, with chunks_quarantined/row_groups_quarantined counters;
+  * the committed corpus under tests/data/corrupt/ stays typed-failing.
+
+The fast subset here is tier-1; the extended sweep is `slow` (make fuzz).
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.reader import PARQUET_ERRORS, FileReader
+from parquet_tpu.testing.faults import (
+    FaultViolation,
+    _read_all,
+    iter_fault_cases,
+    map_pages,
+    run_case,
+)
+from parquet_tpu.utils.trace import decode_trace
+
+WATCHDOG_SECONDS = 30.0
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "data", "corrupt")
+
+
+def with_watchdog(fn, timeout: float = WATCHDOG_SECONDS):
+    """Run fn on a daemon thread; a case that hangs FAILS instead of
+    stalling the suite (the thread leaks, but the test dies loudly)."""
+    result: dict = {}
+
+    def target():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the main thread
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        pytest.fail(f"watchdog: case still running after {timeout}s (hang)")
+    if "error" in result:
+        raise result["error"]
+    return result.get("value")
+
+
+def _base_table(rows: int = 3000):
+    rng = np.random.default_rng(42)
+    mask = rng.random(rows) < 0.2
+    return pa.table(
+        {
+            "i": pa.array(rng.integers(0, 1 << 40, rows), pa.int64(), mask=mask),
+            "s": pa.array([f"v{k % 37}" for k in range(rows)]),
+            "f": pa.array(rng.random(rows).astype(np.float64)),
+        }
+    )
+
+
+def _base_bytes(version: str, compression: str = "snappy", crc: bool = True):
+    buf = io.BytesIO()
+    pq.write_table(
+        _base_table(),
+        buf,
+        compression=compression,
+        data_page_version=version,
+        write_page_checksum=crc,
+        row_group_size=1500,
+    )
+    return buf.getvalue()
+
+
+# -- the seeded quick-fuzz (fast subset: tier-1) -------------------------------
+
+
+@pytest.mark.parametrize("version", ["1.0", "2.0"])
+@pytest.mark.parametrize("backend", ["host", "tpu_roundtrip"])
+def test_quick_fuzz(version, backend):
+    data = _base_bytes(version)
+    pristine = _read_all(data, True, backend)
+    cases = list(iter_fault_cases(data, seed=7))
+    assert len(cases) >= 12  # the matrix families are all represented
+    for case in cases:
+        with_watchdog(lambda c=case: run_case(c, pristine=pristine, backend=backend))
+
+
+def test_quick_fuzz_no_crc():
+    """CRC-less files: mutations may be benign or undetectable, but raw
+    exceptions and hangs are still forbidden on both ladder rungs."""
+    data = _base_bytes("1.0", compression="none", crc=False)
+    pristine = _read_all(data, False, "host")
+    for case in iter_fault_cases(data, seed=13, validate_crc=False):
+        for backend in ("host", "tpu_roundtrip"):
+            with_watchdog(
+                lambda c=case, b=backend: run_case(c, pristine=pristine, backend=b)
+            )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("version", ["1.0", "2.0"])
+@pytest.mark.parametrize("compression,crc", [("snappy", True), ("gzip", True), ("none", False)])
+def test_extended_sweep(version, compression, crc):
+    """The full codec x version x seed sweep (make fuzz / -m slow)."""
+    data = _base_bytes(version, compression=compression, crc=crc)
+    pristine = _read_all(data, crc, "host")
+    for seed in (3, 11, 99, 1234, 31337):
+        for case in iter_fault_cases(
+            data, seed=seed, truncations=8, bit_flips=8, header_flips=6,
+            validate_crc=crc,
+        ):
+            for backend in ("host", "tpu_roundtrip"):
+                with_watchdog(
+                    lambda c=case, b=backend: run_case(
+                        c, pristine=pristine, backend=b
+                    )
+                )
+
+
+def test_harness_flags_raw_exceptions():
+    """The harness itself must catch a decoder leaking a raw exception —
+    pin that FaultViolation machinery actually trips (meta-test)."""
+    from parquet_tpu.testing.faults import FaultCase
+
+    case = FaultCase(
+        name="meta", data=b"PAR1 not a real file PAR1", must_fail=True,
+        validate_crc=False,
+    )
+    # a garbage file raises typed -> run_case returns "error", no violation
+    assert run_case(case) == "error"
+    ok_case = FaultCase(
+        name="meta2", data=_base_bytes("1.0"), must_fail=True, validate_crc=True
+    )
+    with pytest.raises(FaultViolation):
+        run_case(ok_case)  # pristine file "must fail" -> violation
+
+
+# -- committed corpus ----------------------------------------------------------
+
+
+def _corpus_files():
+    return sorted(
+        p
+        for p in glob.glob(os.path.join(CORPUS_DIR, "*.parquet"))
+        if not p.endswith("pristine.parquet")
+    )
+
+
+def test_corpus_exists():
+    assert len(_corpus_files()) >= 8
+
+
+@pytest.mark.parametrize("backend", ["host", "tpu_roundtrip"])
+@pytest.mark.parametrize(
+    "path", _corpus_files(), ids=[os.path.basename(p) for p in _corpus_files()]
+)
+def test_corpus_raises_typed(path, backend):
+    with open(path, "rb") as f:
+        data = f.read()
+
+    def read():
+        with pytest.raises(PARQUET_ERRORS):
+            _read_all(data, True, backend)
+
+    with_watchdog(read)
+
+
+def test_corpus_pristine_control():
+    with open(os.path.join(CORPUS_DIR, "pristine.parquet"), "rb") as f:
+        data = f.read()
+    host = _read_all(data, True, "host")
+    fused = _read_all(data, True, "tpu_roundtrip")
+    assert host == fused and host
+
+
+# -- fused CRC validation keeps the fast path ----------------------------------
+
+
+@pytest.mark.parametrize("version", ["1.0", "2.0"])
+def test_fused_crc_keeps_fast_path(version, tmp_path):
+    """validate_crc=True no longer forfeits the fused walk: clean pages
+    verify INSIDE the native prepare (prepare_fused_engaged bumps)."""
+    data = _base_bytes(version)
+    p = tmp_path / "clean.parquet"
+    p.write_bytes(data)
+    with decode_trace() as tr:
+        with FileReader(str(p), validate_crc=True, backend="tpu_roundtrip") as r:
+            for gi in range(r.num_row_groups):
+                r.read_row_group(gi)
+    engaged = tr.stages.get("prepare_fused_engaged")
+    assert engaged is not None and engaged.calls > 0
+    assert "prepare_fused_declined" not in tr.stages
+    assert "prepare.crc" in tr.stages  # the walk really checksummed
+
+
+def test_fused_crc_mismatch_falls_back_typed(tmp_path):
+    """A rotted payload under validate_crc: the fused walk aborts at stage
+    crc, the staged walk re-raises the exact typed ChunkError."""
+    data = _base_bytes("1.0")
+    sites = [s for s in map_pages(data) if s.kind in (0, 3)]
+    mutated = bytearray(data)
+    mutated[sites[0].payload_offset + 3] ^= 0x01
+    p = tmp_path / "rotten.parquet"
+    p.write_bytes(bytes(mutated))
+    with decode_trace() as tr:
+        with FileReader(str(p), validate_crc=True, backend="tpu_roundtrip") as r:
+            with pytest.raises(PARQUET_ERRORS, match="CRC mismatch"):
+                for gi in range(r.num_row_groups):
+                    r.read_row_group(gi)
+    assert tr.stages.get("prepare_fused_fault_crc") is not None
+
+
+def test_fallback_recovered_counter(tmp_path, monkeypatch):
+    """The ladder's middle rung: when the native walk ABORTS on a chunk the
+    staged walk can decode, the read still succeeds and
+    prepare_fallback_recovered records the save. Forced here by making the
+    native binding report a fault for every chunk (the natural triggers are
+    native-walk limitations, which the differential suite keeps rare)."""
+    from parquet_tpu.utils.native import NativeLib, PrepareFault, get_native
+
+    if get_native() is None:
+        pytest.skip("native library not built")
+    data = _base_bytes("1.0")
+    p = tmp_path / "clean.parquet"
+    p.write_bytes(data)
+    forced = PrepareFault(code=-1, stage="prescan", page=0, offset=0)
+    monkeypatch.setattr(
+        NativeLib, "chunk_prepare", lambda self, *a, **kw: forced
+    )
+    with decode_trace() as tr:
+        with FileReader(str(p), backend="tpu_roundtrip") as r:
+            out = [r.read_row_group(gi) for gi in range(r.num_row_groups)]
+    assert all(out)
+    rec = tr.stages.get("prepare_fallback_recovered")
+    assert rec is not None and rec.calls > 0
+    assert tr.stages.get("prepare_fused_fault_prescan").calls == rec.calls
+    # clean reads never touch the counter
+    with decode_trace() as tr2:
+        monkeypatch.undo()
+        with FileReader(str(p), backend="tpu_roundtrip") as r:
+            [r.read_row_group(gi) for gi in range(r.num_row_groups)]
+    assert "prepare_fallback_recovered" not in tr2.stages
+
+
+# -- on_error quarantine modes -------------------------------------------------
+
+
+def _poisoned_file(tmp_path):
+    """3-group checksummed file with one bit-flipped chunk in group 1."""
+    from parquet_tpu.core.chunk import chunk_byte_range
+
+    rng = np.random.default_rng(5)
+    rows = 6000
+    mask = rng.random(rows) < 0.25
+    t = pa.table(
+        {
+            "a": pa.array(rng.integers(0, 1000, rows), pa.int64(), mask=mask),
+            "b": pa.array([f"s{i % 50}" for i in range(rows)]),
+        }
+    )
+    p = str(tmp_path / "poisoned.parquet")
+    pq.write_table(
+        t, p, compression="snappy", row_group_size=2000,
+        write_page_checksum=True, use_dictionary=False,
+        column_encoding={"a": "PLAIN", "b": "PLAIN"},
+    )
+    data = bytearray(open(p, "rb").read())
+    with FileReader(p) as r:
+        cc = r.row_group(1).columns[0]  # column "a" of group 1
+        off, total = chunk_byte_range(cc)
+    data[off + total // 2] ^= 0xFF
+    bad = str(tmp_path / "poisoned_bad.parquet")
+    open(bad, "wb").write(bytes(data))
+    return bad
+
+
+def test_on_error_raise_default(tmp_path):
+    bad = _poisoned_file(tmp_path)
+    with FileReader(bad, validate_crc=True) as r:
+        with pytest.raises(PARQUET_ERRORS):
+            list(r.iter_rows())
+
+
+def test_on_error_skip_quarantines_group(tmp_path):
+    bad = _poisoned_file(tmp_path)
+    with decode_trace() as tr:
+        with FileReader(bad, validate_crc=True, on_error="skip") as r:
+            rows = list(r.iter_rows())
+            tbl = r.to_arrow()
+    assert len(rows) == 4000  # groups 0 and 2 survive
+    assert tbl.num_rows == 4000
+    assert tr.stages["chunks_quarantined"].calls == 2  # iter_rows + to_arrow
+    assert tr.stages["row_groups_quarantined"].calls == 2
+
+
+def test_on_error_null_keeps_rows(tmp_path):
+    bad = _poisoned_file(tmp_path)
+    with decode_trace() as tr:
+        with FileReader(bad, validate_crc=True, on_error="null") as r:
+            rows = list(r.iter_rows())
+    assert len(rows) == 6000
+    # quarantined column delivered as nulls in group 1, intact elsewhere
+    assert all(row["a"] is None for row in rows[2000:4000])
+    assert any(row["a"] is not None for row in rows[:2000])
+    assert all(row["b"] is not None for row in rows[2000:4000])
+    assert tr.stages["chunks_nulled"].calls == 1
+
+
+def test_on_error_rejects_unknown_mode(tmp_path):
+    bad = _poisoned_file(tmp_path)
+    with pytest.raises(ValueError, match="on_error"):
+        FileReader(bad, on_error="ignore")
+
+
+# -- thrift preflight guards ---------------------------------------------------
+
+
+def test_thrift_list_size_preflight():
+    from parquet_tpu.meta.thrift import CompactReader, ThriftError
+
+    # list header claiming 2^35 elements in a 4-byte buffer
+    r = CompactReader(bytes([0xF6, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F]))
+    with pytest.raises(ThriftError, match="exceeds remaining"):
+        r.read_list_header()
+
+
+def test_thrift_map_skip_no_hang():
+    from parquet_tpu.meta.thrift import CT_MAP, CompactReader, ThriftError
+
+    # map with a huge claimed size and bool value type: each kv would skip
+    # zero bytes without the preflight guard (an unbounded loop)
+    payload = b"\xff\xff\xff\xff\xff\xff\xff\xff\x7f" + b"\x11"
+    r = CompactReader(payload)
+
+    def skip():
+        with pytest.raises(ThriftError):
+            r.skip(CT_MAP)
+
+    with_watchdog(skip, timeout=10.0)

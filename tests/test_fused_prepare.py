@@ -207,19 +207,37 @@ def test_fused_stage_breakdown_collected(tmp_path):
 
 
 @requires_native
-def test_fused_declines_on_crc_validation(tmp_path):
-    """validate_crc routes to the staged walk and says so in the counters."""
-    path = _build(tmp_path, "plain_i64", "snappy", "1.0")
+def test_fused_crc_validation_stays_engaged(tmp_path):
+    """validate_crc no longer forfeits the fused walk: stored CRCs verify
+    INSIDE the native prepare, so clean chunks stay on the fast path (the
+    counters say so), and the decode matches the staged walk exactly."""
+    import pyarrow.parquet as _pq
+
+    arr, kw = _column("plain_i64")
+    path = str(tmp_path / "crc.parquet")
+    _pq.write_table(
+        pa.table({"v": arr}), path, compression="snappy",
+        write_page_checksum=True, row_group_size=ROWS // 3, **kw,
+    )
     with decode_trace() as tr:
         with FileReader(path) as r:
+            plans = []
             for i in range(r.num_row_groups):
                 for _p, cc, col in r._selected_chunks(i):
                     off, total = chunk_byte_range(cc)
                     win = ChunkWindow(r._pread(off, total), off)
-                    prepare_chunk_plan(win, cc, col, validate_crc=True)
-    declined = tr.stages.get("prepare_fused_declined")
-    assert declined is not None and declined.calls > 0
-    assert "prepare_fused_engaged" not in tr.stages
+                    plans.append(
+                        prepare_chunk_plan(win, cc, col, validate_crc=True)
+                        .dispatch_device()
+                        .finalize()
+                    )
+    engaged = tr.stages.get("prepare_fused_engaged")
+    assert engaged is not None and engaged.calls == len(plans)
+    assert "prepare_fused_declined" not in tr.stages
+    assert "prepare.crc" in tr.stages
+    host = _host_chunks(path)
+    for a, b in zip(plans, host):
+        _assert_chunkdata_equal(a, b, "crc-validated fused")
 
 
 @requires_native

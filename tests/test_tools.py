@@ -333,3 +333,74 @@ class TestMergeCli:
         assert pq.read_table(str(out)).num_rows == 1  # untouched
         assert tool_main(["merge", str(a), str(a), "-o", str(out), "--force"]) == 0
         assert pq.read_table(str(out)).num_rows == 12
+
+
+class TestVerifySalvage:
+    """parquet-tool verify / salvage (the corruption triage lane)."""
+
+    def _poisoned(self, tmp_path, n_groups=3):
+        """(clean path, damaged path): one bit flipped in rg1's first chunk."""
+        from parquet_tpu.core.chunk import chunk_byte_range
+
+        schema = message(required("id", Type.INT64), optional("name", string()))
+        clean = str(tmp_path / "clean.parquet")
+        with FileWriter(clean, schema, codec="snappy", with_crc=True) as w:
+            for g in range(n_groups):
+                w.write_rows(
+                    [
+                        {"id": g * 50 + i, "name": f"n{i % 7}"}
+                        for i in range(50)
+                    ]
+                )
+                w.flush_row_group()
+        data = bytearray(open(clean, "rb").read())
+        with FileReader(clean) as r:
+            cc = r.row_group(1).columns[0]
+            off, total = chunk_byte_range(cc)
+        data[off + total // 2] ^= 0x20
+        bad = str(tmp_path / "bad.parquet")
+        open(bad, "wb").write(bytes(data))
+        return clean, bad
+
+    def test_verify_clean(self, tmp_path, capsys):
+        clean, _bad = self._poisoned(tmp_path)
+        assert tool_main(["verify", clean]) == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_verify_reports_offset_stage_error(self, tmp_path, capsys):
+        _clean, bad = self._poisoned(tmp_path)
+        assert tool_main(["verify", bad]) == 1
+        out = capsys.readouterr().out
+        assert "rg1 id page 0" in out
+        assert "@byte" in out
+        assert "stage=crc" in out
+        assert "ChunkError" in out
+        assert "CORRUPT: 1 problem(s) in 1 row group(s)" in out
+
+    def test_verify_corrupt_footer(self, tmp_path, capsys):
+        p = tmp_path / "garbage.parquet"
+        p.write_bytes(b"PAR1 this is not parquet PAR1")
+        assert tool_main(["verify", str(p)]) == 1
+        out = capsys.readouterr().out
+        assert "stage=footer" in out
+
+    def test_salvage_recovers_good_groups(self, tmp_path, capsys):
+        clean, bad = self._poisoned(tmp_path)
+        out = str(tmp_path / "saved.parquet")
+        assert tool_main(["salvage", bad, "-o", out]) == 0
+        cap = capsys.readouterr()
+        assert "salvaged 2/3 row groups (100/150 rows)" in cap.out
+        assert "dropped rg1" in cap.err
+        # the salvaged file verifies clean and holds exactly rg0+rg2's rows
+        assert tool_main(["verify", out]) == 0
+        with FileReader(out, validate_crc=True) as r:
+            rows = list(r.iter_rows())
+        assert [row["id"] for row in rows] == list(range(50)) + list(range(100, 150))
+
+    def test_salvage_refuses_overwrite(self, tmp_path, capsys):
+        clean, bad = self._poisoned(tmp_path)
+        out = tmp_path / "exists.parquet"
+        out.write_bytes(b"x")
+        assert tool_main(["salvage", bad, "-o", str(out)]) == 1
+        assert "already exists" in capsys.readouterr().err
+        assert tool_main(["salvage", bad, "-o", str(out), "--force"]) == 0
