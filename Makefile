@@ -2,7 +2,7 @@
 # .github/workflows/ci.yml (ruff runs there; this image has no linter, so the
 # syntax gate is compileall).
 
-.PHONY: check test native bench bench-prepare dryrun fuzz
+.PHONY: check test native bench bench-prepare dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
 check: native
@@ -31,4 +31,12 @@ dryrun:
 # replays exactly; the fast subset also rides the tier-1 `-m 'not slow'` run
 fuzz: native
 	python -m pytest tests/test_faults.py -q
+
+# observability smoke: generate a file, decode it under the span tracer via
+# `parquet-tool profile` (jax forced onto CPU so the accelerator tunnel is
+# never touched), then validate the Chrome trace-event JSON parses
+profile:
+	python -c "import numpy as np; from parquet_tpu.core.writer import FileWriter; from parquet_tpu.schema.dsl import parse_schema; s = parse_schema('message m { required int64 id; required binary name (UTF8); }'); w = FileWriter('/tmp/pqt_profile.parquet', s, codec='snappy'); w.write_column('id', np.arange(200000, dtype=np.int64)); w.write_column('name', ['n%d' % (i % 97) for i in range(200000)]); w.close()"
+	python -m parquet_tpu.tools.parquet_tool profile /tmp/pqt_profile.parquet -o /tmp/pqt_profile_trace.json --metrics --cpu
+	python -c "import json; d = json.load(open('/tmp/pqt_profile_trace.json')); assert d['traceEvents'], 'empty trace'; print('profile: %d trace events parse OK' % len(d['traceEvents']))"
 

@@ -30,6 +30,12 @@ the north star, measured at the same delivery point.
 Env knobs: PQT_BENCH_ROWS (default 2_000_000), PQT_BENCH_REPEATS (default 3),
 PQT_BENCH_MATRIX=0 to skip the BASELINE.md 5-config matrix (on by default),
 PQT_MATRIX_ROWS (default 1_000_000) rows per matrix config.
+
+`--json out.json` (or PQT_BENCH_JSON=out.json) additionally writes the
+final structured result — headline + per-stage prepare breakdown + matrix —
+to a file, so the BENCH_* trajectory artifacts are produced by the harness
+itself instead of by hand. Works in phase mode too
+(`bench.py --phase prepare --json out.json` writes that phase's object).
 """
 
 from __future__ import annotations
@@ -48,9 +54,29 @@ ROWS = int(os.environ.get("PQT_BENCH_ROWS", 2_000_000))
 REPEATS = int(os.environ.get("PQT_BENCH_REPEATS", 5))
 CACHE = Path(f"/tmp/pqt_bench_{ROWS}.parquet")
 
+# `--json PATH` / PQT_BENCH_JSON: where to write the final structured result
+_JSON_OUT = os.environ.get("PQT_BENCH_JSON")
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def _write_artifact(obj) -> None:
+    """Write the structured result to the --json/PQT_BENCH_JSON path (no-op
+    when unset)."""
+    if _JSON_OUT:
+        try:
+            Path(_JSON_OUT).write_text(json.dumps(obj, indent=1) + "\n")
+        except OSError as e:  # pragma: no cover
+            log(f"bench: could not write {_JSON_OUT}: {e}")
+
+
+def _emit(obj) -> None:
+    """Print the result line (the machine-readable contract) and, when
+    --json/PQT_BENCH_JSON is set, write the same object to that file."""
+    print(json.dumps(obj))
+    _write_artifact(obj)
 
 
 def build_file() -> Path:
@@ -366,7 +392,7 @@ def _phase_matrix(cfg: int) -> None:
         out["rows_s_assembled"] = round(rows / t_rows, 1)
     if t_arrow is not None:
         out["rows_s_to_arrow"] = round(rows / t_arrow, 1)
-    print(json.dumps(out))
+    _emit(out)
 
 
 def _phase_write() -> None:
@@ -442,8 +468,8 @@ def _phase_write() -> None:
         rows=rows,
     )
     t_ours, t_ours_arrow, t_pa = s_ours["t"], s_ours_arrow["t"], s_pa["t"]
-    print(
-        json.dumps(
+    _emit(
+        (
             {
                 "config": "write",
                 "rows_s_ours": round(rows / t_ours, 1),
@@ -558,7 +584,7 @@ def _phase_verify(path) -> None:
     host = decode_all_host(path)
     tpu = decode_all_tpu_to_host(path)
     _verify_host_paths(host, tpu)
-    print(json.dumps({"ok": True}))
+    _emit({"ok": True})
 
 
 def _phase_prepare() -> None:
@@ -641,7 +667,7 @@ def _phase_prepare() -> None:
         "thread_scaling": scaling,
     }
     log(f"bench: prepare breakdown {out}")
-    print(json.dumps(out))
+    _emit(out)
 
 
 _PHASE_FNS = {
@@ -659,16 +685,21 @@ def _phase_timed(name: str, path) -> None:
     # the two headline phases take extra samples: the tunnel's run-to-run
     # drift is the dominant noise in the reported ratio
     reps = max(REPEATS, 7) if name in ("baseline", "device", "pyarrow") else REPEATS
-    print(json.dumps(timed_stats(lambda: fn(path), reps, name)))
+    _emit(timed_stats(lambda: fn(path), reps, name))
 
 
 def _run_phase(name: str, timeout_s: float = 1800.0) -> dict | None:
     import subprocess
 
     cmd = [sys.executable, os.path.abspath(__file__), "--phase", name]
+    # strip the artifact path from phase subprocesses: only the TOP-level
+    # invocation writes the --json/PQT_BENCH_JSON file, otherwise each phase
+    # would clobber it mid-run and a crash would leave a mislabeled partial
+    env = {k: v for k, v in os.environ.items() if k != "PQT_BENCH_JSON"}
     try:
         proc = subprocess.run(
-            cmd, stdout=subprocess.PIPE, timeout=timeout_s, cwd=str(Path(__file__).parent)
+            cmd, stdout=subprocess.PIPE, timeout=timeout_s, env=env,
+            cwd=str(Path(__file__).parent)
         )
     except subprocess.TimeoutExpired:
         log(f"bench: phase {name} timed out after {timeout_s:.0f}s")
@@ -689,18 +720,16 @@ def main() -> None:
     if not _device_ready():
         log("bench: accelerator unavailable — reporting host path only")
         t_host = timed(lambda: decode_all_host(path), REPEATS, "host")
-        print(
-            json.dumps(
-                {
-                    "metric": (
-                        "rows/sec decoded, NYC-taxi-like file (int64 + dict-string "
-                        "+ delta-ts cols), HOST fallback (accelerator unreachable)"
-                    ),
-                    "value": round(ROWS / t_host, 1),
-                    "unit": "rows/s",
-                    "vs_baseline": 1.0,
-                }
-            )
+        _emit(
+            {
+                "metric": (
+                    "rows/sec decoded, NYC-taxi-like file (int64 + dict-string "
+                    "+ delta-ts cols), HOST fallback (accelerator unreachable)"
+                ),
+                "value": round(ROWS / t_host, 1),
+                "unit": "rows/s",
+                "vs_baseline": 1.0,
+            }
         )
         return
 
@@ -731,6 +760,7 @@ def main() -> None:
         )
 
     # BASELINE.md 5-config matrix (per-config JSON on stderr + BENCH_MATRIX.json)
+    results = None
     if os.environ.get("PQT_BENCH_MATRIX", "1") != "0":
         results = run_matrix()
         try:
@@ -762,49 +792,55 @@ def main() -> None:
         f"(medians of {max(REPEATS, 7)}; device spread "
         f"{ROWS / r_dev['t_max'] / 1e6:.1f}-{ROWS / r_dev['t_min'] / 1e6:.1f} M rows/s)"
     )
-    print(
-        json.dumps(
+    headline = {
+        "metric": (
+            "rows/sec decoded into TPU HBM, NYC-taxi-like file "
+            "(int64 + dict-string + delta-ts cols), device decode "
+            "vs host decode + upload"
+        ),
+        "value": round(rate, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+        "stat": "median",
+        "value_min": round(ROWS / r_dev["t_max"], 1),
+        "value_max": round(ROWS / r_dev["t_min"], 1),
+        "vs_baseline_min": round(r_base["t_min"] / r_dev["t_max"], 3),
+        "vs_baseline_max": round(r_base["t_max"] / r_dev["t_min"], 3),
+        # the EXTERNAL comparator (pyarrow decode + upload at the
+        # same delivery point): stable across rounds, unlike our
+        # own host baseline, which each round's host-lane work
+        # speeds up (see BASELINE.md "Headline trajectory")
+        **(
             {
-                "metric": (
-                    "rows/sec decoded into TPU HBM, NYC-taxi-like file "
-                    "(int64 + dict-string + delta-ts cols), device decode "
-                    "vs host decode + upload"
-                ),
-                "value": round(rate, 1),
-                "unit": "rows/s",
-                "vs_baseline": round(vs, 3),
-                "stat": "median",
-                "value_min": round(ROWS / r_dev["t_max"], 1),
-                "value_max": round(ROWS / r_dev["t_min"], 1),
-                "vs_baseline_min": round(r_base["t_min"] / r_dev["t_max"], 3),
-                "vs_baseline_max": round(r_base["t_max"] / r_dev["t_min"], 3),
-                # the EXTERNAL comparator (pyarrow decode + upload at the
-                # same delivery point): stable across rounds, unlike our
-                # own host baseline, which each round's host-lane work
-                # speeds up (see BASELINE.md "Headline trajectory")
-                **(
-                    {
-                        "rows_s_pyarrow": round(ROWS / r_pa["t"], 1),
-                        "vs_pyarrow": round(r_pa["t"] / t_dev, 3),
-                    }
-                    if r_pa
-                    else {}
-                ),
-                # host prepare breakdown (make bench-prepare for the full
-                # standalone report): the serial stage split that bounds
-                # prepare/RPC overlap
-                **(
-                    {
-                        "prepare_ms_per_1m_rows": r_prep["prepare_ms_per_1m_rows"],
-                        "prepare_stage_ms": r_prep["stage_ms"],
-                        "prepare_thread_scaling": r_prep["thread_scaling"],
-                    }
-                    if r_prep
-                    else {}
-                ),
+                "rows_s_pyarrow": round(ROWS / r_pa["t"], 1),
+                "vs_pyarrow": round(r_pa["t"] / t_dev, 3),
             }
-        )
-    )
+            if r_pa
+            else {}
+        ),
+        # host prepare breakdown (make bench-prepare for the full
+        # standalone report): the serial stage split that bounds
+        # prepare/RPC overlap
+        **(
+            {
+                "prepare_ms_per_1m_rows": r_prep["prepare_ms_per_1m_rows"],
+                "prepare_stage_ms": r_prep["stage_ms"],
+                "prepare_thread_scaling": r_prep["thread_scaling"],
+            }
+            if r_prep
+            else {}
+        ),
+    }
+    print(json.dumps(headline))
+    # the file artifact carries the full structured round: headline +
+    # complete prepare breakdown + the matrix configs (stdout keeps the
+    # one-line headline contract)
+    artifact = dict(headline)
+    if r_prep:
+        artifact["prepare"] = r_prep
+    if results is not None:
+        artifact["matrix"] = results
+    _write_artifact(artifact)
 
 
 def _verify_host_paths(host, tpu) -> None:
@@ -832,8 +868,15 @@ def _verify_host_paths(host, tpu) -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--phase":
-        name = sys.argv[2]
+    argv = sys.argv[1:]
+    if "--json" in argv:
+        k = argv.index("--json")
+        if k + 1 >= len(argv):
+            raise SystemExit("bench: --json needs a path")
+        _JSON_OUT = argv[k + 1]
+        del argv[k : k + 2]
+    if len(argv) >= 2 and argv[0] == "--phase":
+        name = argv[1]
         if name.startswith("matrix"):
             _phase_matrix(int(name[len("matrix") :]))
         elif name == "write":

@@ -13,6 +13,7 @@ and assemble ColumnMetaData with encodings, stats and offsets (:264-314).
 
 from __future__ import annotations
 
+import time as _time
 import zlib
 from dataclasses import dataclass
 
@@ -27,7 +28,9 @@ from ..meta.parquet_types import (
 )
 from ..meta.thrift import CompactReader, ThriftError
 from ..ops.packed_levels import PackedLevels
-from ..utils.trace import bump, stage
+from ..utils import metrics as _metrics
+from ..utils.trace import active as trace_active
+from ..utils.trace import bump, span, stage
 from .alloc import decoded_nbytes
 from .arrays import ByteArrayData
 from .compress import decompress_block
@@ -498,7 +501,28 @@ def read_chunk(
     keep_dict_indices=True returns ChunkData with `indices` set (and
     values=None) when EVERY data page is dictionary-encoded — the
     dictionary-preserving columnar lane (to_arrow read_dictionary=);
-    mixed chunks fall back to materialized values."""
+    mixed chunks fall back to materialized values.
+
+    Observability: the whole chunk runs under a "chunk" span (page spans and
+    decompress/decode stages nest inside it when a trace is active) and
+    feeds the always-on chunk_decode_seconds histogram."""
+    t0 = _time.perf_counter()
+    with span("chunk", {"column": column.path_str}):
+        out = _read_chunk_impl(
+            f, chunk, column, validate_crc, alloc, keep_dict_indices
+        )
+    _metrics.observe("chunk_decode_seconds", _time.perf_counter() - t0)
+    return out
+
+
+def _read_chunk_impl(
+    f,
+    chunk: ColumnChunk,
+    column: Column,
+    validate_crc: bool,
+    alloc,
+    keep_dict_indices: bool,
+) -> ChunkData:
     md = chunk.meta_data
     codec = md.codec or 0
     dictionary = None
@@ -509,61 +533,68 @@ def read_chunk(
     # staged (per-page Python) walk: the counterpart of the fused native
     # prepare's prepare_fused_engaged — lets traces attribute a read to a path
     bump("prepare_staged_chunk")
+    collecting = trace_active()  # build span args only when someone listens
+    page_idx = 0
     for raw in iter_chunk_pages(f, chunk):
         header = raw.header
         if alloc is not None:
             alloc.check(header.uncompressed_page_size or 0)
         ptype = header.type
-        if ptype == int(PageType.DICTIONARY_PAGE):
-            if dictionary is not None:
-                raise ChunkError("chunk: more than one dictionary page")
-            if pages:
-                raise ChunkError("chunk: dictionary page after data pages")
-            if validate_crc:
-                _check_crc(header, raw.payload)
-            with stage("decompress", len(raw.payload)):
-                block = decompress_block(
-                    raw.payload, codec, header.uncompressed_page_size or 0
+        with span(
+            "page", {"page": page_idx, "type": int(ptype)} if collecting else None
+        ):
+            if ptype == int(PageType.DICTIONARY_PAGE):
+                if dictionary is not None:
+                    raise ChunkError("chunk: more than one dictionary page")
+                if pages:
+                    raise ChunkError("chunk: dictionary page after data pages")
+                if validate_crc:
+                    _check_crc(header, raw.payload)
+                with stage("decompress", len(raw.payload)):
+                    block = decompress_block(
+                        raw.payload, codec, header.uncompressed_page_size or 0
+                    )
+                dictionary = decode_dict_page(header, block, column)
+                if alloc is not None:
+                    alloc.register_buffers(dictionary)
+            elif ptype == int(PageType.DATA_PAGE):
+                if validate_crc:
+                    _check_crc(header, raw.payload)
+                with stage("decompress", len(raw.payload)):
+                    block = decompress_block(
+                        raw.payload, codec, header.uncompressed_page_size or 0
+                    )
+                dict_size = len(dictionary) if dictionary is not None else None
+                est = _precharge(
+                    alloc, header.data_page_header, len(block)
                 )
-            dictionary = decode_dict_page(header, block, column)
-            if alloc is not None:
-                alloc.register_buffers(dictionary)
-        elif ptype == int(PageType.DATA_PAGE):
-            if validate_crc:
-                _check_crc(header, raw.payload)
-            with stage("decompress", len(raw.payload)):
-                block = decompress_block(
-                    raw.payload, codec, header.uncompressed_page_size or 0
+                with stage("decode", len(block)):
+                    page = decode_data_page_v1(header, block, column, dict_size)
+                deferred_gather += _account_page(
+                    alloc, est, page, dictionary, keep_dict_indices
+                ) or 0
+                pages.append(page)  # dict pages materialize at chunk level
+                seen_data_values += page.num_values
+            elif ptype == int(PageType.DATA_PAGE_V2):
+                if validate_crc:
+                    _check_crc(header, raw.payload)
+                dict_size = len(dictionary) if dictionary is not None else None
+                est = _precharge(
+                    alloc, header.data_page_header_v2, header.uncompressed_page_size or 0
                 )
-            dict_size = len(dictionary) if dictionary is not None else None
-            est = _precharge(
-                alloc, header.data_page_header, len(block)
-            )
-            with stage("decode", len(block)):
-                page = decode_data_page_v1(header, block, column, dict_size)
-            deferred_gather += _account_page(
-                alloc, est, page, dictionary, keep_dict_indices
-            ) or 0
-            pages.append(page)  # dict pages materialize at chunk level
-            seen_data_values += page.num_values
-        elif ptype == int(PageType.DATA_PAGE_V2):
-            if validate_crc:
-                _check_crc(header, raw.payload)
-            dict_size = len(dictionary) if dictionary is not None else None
-            est = _precharge(
-                alloc, header.data_page_header_v2, header.uncompressed_page_size or 0
-            )
-            with stage("decode", header.uncompressed_page_size or 0):
-                page = decode_data_page_v2(header, raw.payload, column, dict_size, codec)
-            deferred_gather += _account_page(
-                alloc, est, page, dictionary, keep_dict_indices
-            ) or 0
-            pages.append(page)  # dict pages materialize at chunk level
-            seen_data_values += page.num_values
-        elif ptype == int(PageType.INDEX_PAGE):
-            continue  # skip, like the reference
-        else:
-            raise ChunkError(f"chunk: unknown page type {ptype}")
+                with stage("decode", header.uncompressed_page_size or 0):
+                    page = decode_data_page_v2(header, raw.payload, column, dict_size, codec)
+                deferred_gather += _account_page(
+                    alloc, est, page, dictionary, keep_dict_indices
+                ) or 0
+                pages.append(page)  # dict pages materialize at chunk level
+                seen_data_values += page.num_values
+            elif ptype == int(PageType.INDEX_PAGE):
+                page_idx += 1
+                continue  # skip, like the reference
+            else:
+                raise ChunkError(f"chunk: unknown page type {ptype}")
+        page_idx += 1
     if seen_data_values != expected:
         raise ChunkError(
             f"chunk: pages hold {seen_data_values} values, metadata says {expected}"

@@ -20,6 +20,7 @@ import zlib
 
 from ..meta.file_meta import ParquetFileError
 from ..meta.parquet_types import CompressionCodec
+from ..utils import metrics as _metrics
 
 __all__ = [
     "compress_block",
@@ -263,8 +264,9 @@ def decompress_block(data: bytes, codec, uncompressed_size: int) -> bytes:
     (reference: compress.go:107-120)."""
     if uncompressed_size < 0:
         raise CompressionError(f"invalid uncompressed size {uncompressed_size}")
+    impl = _get(codec)
     try:
-        out = _get(codec).decompress(data, uncompressed_size)
+        out = impl.decompress(data, uncompressed_size)
     except CompressionError:
         raise
     except Exception as e:
@@ -273,6 +275,10 @@ def decompress_block(data: bytes, codec, uncompressed_size: int) -> bytes:
         raise CompressionError(
             f"decompressed size {len(out)} != advertised {uncompressed_size}"
         )
+    # every staged decode path funnels through here, making this the one
+    # choke point for the always-on byte counters (the fused native walk
+    # bypasses it and reports its own totals in kernels/pipeline.py)
+    _metrics.io_bytes(len(data), len(out), impl.name)
     return out
 
 

@@ -45,6 +45,7 @@ from ..ops.levels import (
 from .arrays import ByteArrayData
 from .compress import compress_block, decompress_block
 from .schema import Column
+from ..utils import metrics as _metrics
 from ..utils.trace import stage
 
 __all__ = ["DecodedPage", "PageError", "decode_data_page_v1", "decode_data_page_v2",
@@ -220,6 +221,7 @@ def decode_data_page_v1(
         values, indices = _decode_values(
             buf[pos:], non_null, h.encoding, column, dict_size
         )
+    _metrics.page_decoded(_metrics.encoding_name(h.encoding), nbytes=len(block))
     return DecodedPage(
         num_values=n, def_levels=dfl, rep_levels=rep, values=values, indices=indices
     )
@@ -273,12 +275,22 @@ def decode_data_page_v2(
         values, indices = _decode_values(
             values_block, non_null, h.encoding, column, dict_size
         )
+    _metrics.page_decoded(
+        _metrics.encoding_name(h.encoding),
+        nbytes=header.uncompressed_page_size or 0,
+    )
     return DecodedPage(
         num_values=n, def_levels=dfl, rep_levels=rep, values=values, indices=indices
     )
 
 
-def decode_dict_page(header: PageHeader, block: bytes, column: Column):
+def decode_dict_page(
+    header: PageHeader, block: bytes, column: Column, count_metrics: bool = True
+):
+    """count_metrics=False lets the fused native lane defer its page
+    counters until the whole chunk plan commits (kernels/pipeline.py) —
+    counting here would double the dict page if the plan later falls back
+    to the staged walk."""
     h: DictionaryPageHeader = header.dictionary_page_header
     if h is None:
         raise PageError("page: DICTIONARY_PAGE without header")
@@ -298,6 +310,8 @@ def decode_dict_page(header: PageHeader, block: bytes, column: Column):
         raise PageError(
             f"page: dictionary page has {len(block) - consumed} trailing bytes"
         )
+    if count_metrics:
+        _metrics.page_decoded(_metrics.encoding_name(enc), nbytes=len(block))
     return values
 
 

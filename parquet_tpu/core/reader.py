@@ -41,7 +41,7 @@ from .chunk import ChunkData, ChunkError, read_chunk
 from .page import PageError
 from .schema import Schema
 from ..meta.thrift import ThriftError
-from ..utils.trace import bump, stage
+from ..utils.trace import bump, span, stage, traced_submit
 
 __all__ = ["FileReader", "PARQUET_ERRORS"]
 
@@ -103,6 +103,14 @@ def _with_device(fn, device):
         return fn()
 
 
+def _dispatch_traced(fn, device):
+    """Dispatch-thread task wrapper: device pinning plus a 'dispatch' stage
+    so traces attribute transfer/launch wall time to the pqt-dispatch lane
+    (the trace itself arrives via traced_submit's context carry)."""
+    with stage("dispatch"):
+        return _with_device(fn, device)
+
+
 def _dispatch_pool() -> ThreadPoolExecutor:
     """Single-thread executor that owns device dispatch (uploads + kernel
     launches): keeps jax calls serialized in deterministic order while
@@ -118,10 +126,12 @@ def _dispatch_pool() -> ThreadPoolExecutor:
 
 def _timed_rows(assembler):
     """Stream rows from the recursive assembler, billing per-row time to the
-    'assemble' stage without materializing the row group."""
+    'assemble' stage without materializing the row group. record_span=False:
+    one sub-microsecond span PER ROW would flood the trace's event budget
+    and crowd out the chunk/page hierarchy — the aggregate stays exact."""
     it = iter(assembler)
     while True:
-        with stage("assemble"):
+        with stage("assemble", record_span=False):
             try:
                 row = next(it)
             except StopIteration:
@@ -451,6 +461,12 @@ class FileReader:
         only), otherwise the WHOLE row group is dropped — columns of a group
         must stay row-aligned, so a single undeliverable chunk poisons the
         group. A dropped group returns {}."""
+        with span("row_group", {"group": i}):
+            return self._read_row_group_impl(i, columns, pack, dict_paths)
+
+    def _read_row_group_impl(
+        self, i: int, columns, pack: bool, dict_paths=frozenset()
+    ) -> dict[tuple, ChunkData]:
         try:
             if self.backend == "tpu_roundtrip":
                 try:
@@ -561,13 +577,14 @@ class FileReader:
     def _read_row_group_device(self, i: int, columns, pack: bool, device=None):
         """pack=False mirrors _read_row_group: the batch iterator consumes
         levels immediately (mask build), so packing them would be overhead."""
-        plans = self._plan_row_group(i, columns, device=device)
-        with self._devctx(device):
-            out = {path: plan.device_column() for path, plan in plans.items()}
-        if pack and self.compact_levels:
-            for path, dc in out.items():
-                self._pack_chunk_levels(path, dc)
-        return out
+        with span("row_group.device", {"group": i}):
+            plans = self._plan_row_group(i, columns, device=device)
+            with self._devctx(device):
+                out = {path: plan.device_column() for path, plan in plans.items()}
+            if pack and self.compact_levels:
+                for path, dc in out.items():
+                    self._pack_chunk_levels(path, dc)
+            return out
 
     def read_row_groups_device(self, row_groups=None, columns=None, device=None):
         """Decode row groups into device memory with full pipelining.
@@ -890,16 +907,23 @@ class FileReader:
 
         groups = [list(self._selected_chunks(i, columns)) for i in indices]
 
-        def prep(cc, column):
-            offset, total = chunk_byte_range(cc)
-            win = ChunkWindow(self._pread(offset, total), offset)
-            return prepare_chunk_plan(
-                win, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
-            )
+        def prep(path, cc, column):
+            with span("chunk.prepare", {"column": ".".join(path)}):
+                offset, total = chunk_byte_range(cc)
+                win = ChunkWindow(self._pread(offset, total), offset)
+                return prepare_chunk_plan(
+                    win, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
+                )
 
         dev = self._effective_device(device)
         dispatcher = _dispatch_pool()
         pool = _host_pool()
+        # Both pool hops use traced_submit: an active decode_trace is a
+        # contextvar, which ThreadPoolExecutor does NOT carry into workers
+        # by itself — without the explicit copy_context() carry a traced
+        # device read would lose every prepare/dispatch stage to the void
+        # (and two concurrent traced readers sharing the pools would have no
+        # way to attribute worker time to the right trace).
         staged = []
         if pool is None or sum(len(g) for g in groups) <= 1:
             # Single-core host: prepare serially; device dispatch (transfer
@@ -907,15 +931,23 @@ class FileReader:
             for chunks in groups:
                 out = []
                 for path, cc, column in chunks:
-                    plan = prep(cc, column)
+                    plan = prep(path, cc, column)
                     out.append(
-                        (path, dispatcher.submit(_with_device, plan.dispatch_device, dev))
+                        (
+                            path,
+                            traced_submit(
+                                dispatcher, _dispatch_traced, plan.dispatch_device, dev
+                            ),
+                        )
                     )
                 staged.append(out)
             return staged
         get_native()  # thread-safe lazy init before fan-out
         prep_futs = [
-            [(path, pool.submit(prep, cc, column)) for path, cc, column in chunks]
+            [
+                (path, traced_submit(pool, prep, path, cc, column))
+                for path, cc, column in chunks
+            ]
             for chunks in groups
         ]
         for group in prep_futs:
@@ -923,7 +955,12 @@ class FileReader:
             for path, fut in group:
                 plan = fut.result()
                 out.append(
-                    (path, dispatcher.submit(_with_device, plan.dispatch_device, dev))
+                    (
+                        path,
+                        traced_submit(
+                            dispatcher, _dispatch_traced, plan.dispatch_device, dev
+                        ),
+                    )
                 )
             staged.append(out)
         return staged

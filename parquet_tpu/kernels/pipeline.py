@@ -55,6 +55,7 @@ from ..core.schema import Column
 from ..ops.packed_levels import PackedLevels
 from ..ops.rle_hybrid import prescan_hybrid
 from ..ops.delta import prescan_delta_packed
+from ..utils import metrics as _metrics
 from .device_ops import (
     MAX_DEVICE_BATCH_BITS,
     bytes_to_words32,
@@ -914,8 +915,11 @@ def _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats):
     expected = int(md.num_values or 0)
     if expected < 0:
         return None, None
+    import time as _time
+
     from ..utils import trace as _trace
 
+    t_walk = _time.perf_counter()
     res = lib.chunk_prepare(
         buf,
         codec,
@@ -930,28 +934,54 @@ def _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats):
     )
     if isinstance(res, PrepareFault):
         return None, res
+    t_walk = _time.perf_counter() - t_walk
     stage_ns = res.get("stage_ns")
     if stage_ns is not None:
-        for slot, name in enumerate(
-            (
-                "prepare.decompress",
-                "prepare.levels",
-                "prepare.prescan",
-                "prepare.copy",
-                "prepare.crc",
-            )
-        ):
-            if stage_ns[slot]:
-                _trace.add_seconds(name, int(stage_ns[slot]) / 1e9)
-    try:
-        return (
-            _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits),
-            None,
+        # one batch: the sub-stage spans lay back-to-back ending now, so
+        # they nest inside the enclosing chunk.prepare span
+        _trace.add_seconds_batch(
+            [
+                (name, int(stage_ns[slot]) / 1e9)
+                for slot, name in enumerate(
+                    (
+                        "prepare.decompress",
+                        "prepare.levels",
+                        "prepare.prescan",
+                        "prepare.copy",
+                        "prepare.crc",
+                    )
+                )
+                if stage_ns[slot]
+            ]
         )
+    try:
+        plan = _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits)
     except (PageError, ChunkError):
         raise
     except Exception:
         return None, None  # unexpected table shape: let the Python walk decide
+    # Always-on process counters, recorded ONLY once the plan is committed —
+    # a chunk that falls back to the staged walk is counted by that walk
+    # instead (never both; _plan_from_tables decodes the dict page with
+    # count_metrics=False for the same reason). The fused walk bypasses
+    # decompress_block's byte choke point and the per-page value decoders,
+    # so it reports its own totals. Semantics vs the staged lane, by
+    # necessity approximate: io_bytes covers the whole chunk window /
+    # metadata uncompressed size (page headers included, where the staged
+    # lane counts payload-only), and page_bytes uses each page's
+    # value-stream length (levels excluded).
+    _metrics.observe("chunk_decode_seconds", t_walk)
+    _metrics.io_bytes(len(buf), int(md.total_uncompressed_size or 0), codec)
+    pages_arr = res["pages"]
+    if len(pages_arr):
+        for e in np.unique(pages_arr[:, _PC_ENC]):
+            sel = pages_arr[pages_arr[:, _PC_ENC] == e]
+            _metrics.page_decoded(
+                _metrics.encoding_name(int(e)),
+                n=len(sel),
+                nbytes=int(sel[:, _PC_VLEN].sum()),
+            )
+    return plan, None
 
 
 def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
@@ -976,7 +1006,13 @@ def _plan_from_tables(column, expected, res, stats, np_dt, delta_nbits):
                 ),
             )
             block = memoryview(values_buf)[P[_PC_VOFF] : P[_PC_VOFF] + P[_PC_VLEN]]
-            plan.dictionary = decode_dict_page(header, block, column)
+            # count_metrics=False: the native lane's counters commit only
+            # once the whole plan succeeds (see _native_prepare_impl) — a
+            # later fallback to the staged walk must not leave this page
+            # already counted
+            plan.dictionary = decode_dict_page(
+                header, block, column, count_metrics=False
+            )
         elif P[_PC_KIND] == 0:
             data_pages.append(P)
     if column.max_def > 0 and data_pages:
@@ -1514,12 +1550,16 @@ def prepare_chunk_plan(
     prepare_fallback_recovered; a genuinely corrupt chunk raises the staged
     walk's typed error (the ladder's final rung).
     """
+    import time as _time
+
     from ..utils import trace as _trace
 
     plan, fault = _native_prepare(f, chunk, column, validate_crc, alloc, stats)
     if plan is not None:
         return plan
+    t0 = _time.perf_counter()
     plan = _staged_prepare(f, chunk, column, validate_crc, alloc, stats)
+    _metrics.observe("chunk_decode_seconds", _time.perf_counter() - t0)
     if fault is not None:
         # the native walk aborted but the staged walk decoded cleanly
         _trace.bump("prepare_fallback_recovered")
@@ -1570,6 +1610,12 @@ def _staged_prepare(
 
         n, dfl, rep, non_null, enc, values_buf = _split_page(
             raw, header, pt, codec, column
+        )
+        # byte volumes ride decompress_block's choke point; pages-per-encoding
+        # is counted here because this walk prescans value streams without
+        # going through the core.page decoders
+        _metrics.page_decoded(
+            _metrics.encoding_name(enc), nbytes=header.uncompressed_page_size or 0
         )
         if stats is not None:
             stats.pages += 1

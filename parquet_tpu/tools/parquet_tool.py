@@ -1,11 +1,13 @@
-"""parquet-tool: cat / head / meta / schema / rowcount / split / verify / salvage.
+"""parquet-tool: cat / head / meta / schema / rowcount / split / verify / salvage / profile.
 
 Equivalent of the reference's cobra CLI (reference: cmd/parquet-tool/cmds —
 cat.go:14, head.go:17, meta.go:14, schema.go:16, rowcount.go:16, split.go:31),
 plus corruption triage beyond the reference: `verify` walks every page of
 every chunk and reports each corrupt one with its byte offset, failing stage
 and error type; `salvage` copies the readable row groups of a damaged file
-into a fresh one (verbatim chunk bytes, rewritten footer).
+into a fresh one (verbatim chunk bytes, rewritten footer); `profile` decodes
+the whole file under the span tracer and writes Chrome trace-event JSON
+(load it in ui.perfetto.dev or chrome://tracing) plus the per-stage report.
 
     python -m parquet_tpu.tools.parquet_tool cat file.parquet
     python -m parquet_tpu.tools.parquet_tool head -n 5 file.parquet
@@ -15,6 +17,7 @@ into a fresh one (verbatim chunk bytes, rewritten footer).
     python -m parquet_tpu.tools.parquet_tool split -n 100000 src.parquet out_%d.parquet
     python -m parquet_tpu.tools.parquet_tool verify damaged.parquet
     python -m parquet_tpu.tools.parquet_tool salvage damaged.parquet -o saved.parquet
+    python -m parquet_tpu.tools.parquet_tool profile file.parquet -o trace.json --metrics
 """
 
 from __future__ import annotations
@@ -202,6 +205,17 @@ def cmd_meta(args) -> int:
                     f"maxR={leaf.max_rep} maxD={leaf.max_def} values={md.num_values} "
                     f"codec={codec} encodings=[{encs}]{stats}{extra}"
                 )
+        # per-column totals across every row group (the same shape the live
+        # metrics registry accumulates per encoding during decode)
+        from ..utils.metrics import summarize_columns
+
+        for name, s in summarize_columns(m).items():
+            ratio = f"{s['ratio']:.2f}x" if s["ratio"] else "n/a"
+            print(
+                f"column {name}: encodings=[{','.join(s['encodings'])}] "
+                f"compressed={s['compressed']:,} B "
+                f"uncompressed={s['uncompressed']:,} B ratio={ratio}"
+            )
     return 0
 
 
@@ -595,6 +609,57 @@ def cmd_salvage(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Decode the whole file under the span tracer; write the hierarchical
+    spans (file → row-group → chunk → page → stage, native prepare
+    sub-clocks included) as Chrome trace-event JSON and print the per-stage
+    report, hottest stages first.
+
+    The default path is the device-decode pipeline (backend="tpu_roundtrip"
+    — the parity oracle), which exercises the prepare pool's worker lanes,
+    the fused native walk's internal clocks, and the dispatch thread.
+    --host profiles the pure host decode instead (no jax touched);
+    --cpu forces jax onto the CPU platform first (profiling decode on a
+    machine whose accelerator tunnel should stay untouched)."""
+    from ..utils import metrics
+    from ..utils.trace import decode_trace, span
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    backend = "host" if args.host else "tpu_roundtrip"
+    snap0 = metrics.snapshot()
+    with FileReader(args.file, backend=backend) as r:
+        rows = r.num_rows
+        with decode_trace() as t:
+            with span("file", {"path": str(args.file), "backend": backend}):
+                for i in range(r.num_row_groups):
+                    r.read_row_group(i)
+    doc = t.to_chrome_trace()
+    # computed once: the registry is live process state, so a re-read could
+    # disagree with what the file artifact recorded
+    mdelta = metrics.delta(snap0)
+    doc["otherData"]["metrics_delta"] = mdelta
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    print(t.report())
+    print()
+    print(
+        f"profile: {rows:,} rows via backend={backend}, "
+        f"{len(doc['traceEvents'])} trace events -> {args.out} "
+        "(load in ui.perfetto.dev or chrome://tracing)"
+    )
+    if args.metrics:
+        print()
+        print("metrics delta (this profile run):")
+        for k, v in sorted(mdelta.items()):
+            print(f"  {k} = {v}")
+        print()
+        print(metrics.report())
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="parquet-tool", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -664,6 +729,32 @@ def main(argv=None) -> int:
         help="treat CRC-mismatched pages as readable (decode checks still run)",
     )
     pz.set_defaults(fn=cmd_salvage)
+
+    pf = sub.add_parser(
+        "profile",
+        help="decode the file under the span tracer; write Chrome "
+        "trace-event JSON (Perfetto/chrome://tracing) + per-stage report",
+    )
+    pf.add_argument("file")
+    pf.add_argument("-o", "--out", required=True, help="trace JSON output path")
+    pf.add_argument(
+        "--metrics",
+        action="store_true",
+        help="also print the process metrics delta + summary for the run",
+    )
+    pf.add_argument(
+        "--host",
+        action="store_true",
+        help="profile the pure host decode path (no jax) instead of the "
+        "device-decode pipeline",
+    )
+    pf.add_argument(
+        "--cpu",
+        action="store_true",
+        help="force jax onto the CPU platform before profiling (keeps the "
+        "accelerator tunnel untouched)",
+    )
+    pf.set_defaults(fn=cmd_profile)
 
     pp = sub.add_parser("split", help="split into parts by rows or file size")
     pp.add_argument("-n", type=int, help="rows per part")

@@ -1,0 +1,338 @@
+"""Process-wide, always-on metrics registry.
+
+The decode trace (utils/trace.py) answers "where did THIS read spend its
+time" — it only exists inside a `with decode_trace()` block. This registry
+answers "what has this PROCESS decoded since it started": counters and
+histograms that every read feeds unconditionally, cheap enough to stay on in
+production (one small lock around a dict update per page/chunk, not per
+value). SURVEY §5 calls the reference out for having neither; serving heavy
+traffic needs both.
+
+    from parquet_tpu.utils import metrics
+
+    before = metrics.snapshot()
+    reader.read_row_group(0)                  # no trace needed
+    print(metrics.delta(before))              # what that read added
+    print(metrics.render_prometheus())        # text exposition for scrapes
+    print(metrics.report())                   # human summary (ratio, MB/s)
+
+Key families (all under the `parquet_tpu_` prefix in exposition):
+  pages_decoded_total{encoding=}    pages decoded, per wire encoding
+  page_bytes_total{encoding=}       uncompressed page bytes, per encoding
+  bytes_compressed_total{codec=}    wire bytes entering decompression
+  bytes_uncompressed_total{codec=}  bytes leaving decompression
+  chunk_decode_seconds              histogram of per-chunk decode wall time
+  events_total{event=}              every trace.bump() event, always-on —
+                                    prepare_fused_engaged/_declined,
+                                    prepare_fallback_recovered,
+                                    chunks_quarantined, ... dual-report here
+
+Snapshot keys are flat strings in Prometheus sample syntax without the
+prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as
+`<name>_count` / `<name>_sum` / `<name>_min` / `<name>_max`; min/max are
+not monotonic, so `delta()` skips them.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+__all__ = [
+    "MetricsRegistry",
+    "REGISTRY",
+    "inc",
+    "observe",
+    "get",
+    "snapshot",
+    "delta",
+    "render_prometheus",
+    "report",
+    "event",
+    "page_decoded",
+    "io_bytes",
+    "encoding_name",
+    "codec_name",
+    "summarize_columns",
+]
+
+_PREFIX = "parquet_tpu_"
+
+# log-ish spacing covering sub-ms page decodes through multi-second chunks
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+
+def _key(name: str, labels: dict) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+class _Hist:
+    __slots__ = ("count", "total", "vmin", "vmax", "buckets", "bucket_counts")
+
+    def __init__(self, buckets=_DEFAULT_BUCKETS):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.buckets = buckets
+        self.bucket_counts = [0] * len(buckets)
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.vmin = min(self.vmin, v)
+        self.vmax = max(self.vmax, v)
+        for i, le in enumerate(self.buckets):
+            if v <= le:
+                self.bucket_counts[i] += 1
+
+
+class MetricsRegistry:
+    """Lock-cheap counters + histograms with snapshot/delta and Prometheus
+    text exposition. One instance (REGISTRY) serves the whole process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple[str, tuple], int | float] = {}
+        self._hists: dict[tuple[str, tuple], _Hist] = {}
+
+    # -- write side ------------------------------------------------------------
+
+    def inc(self, name: str, n=1, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Hist()
+            h.observe(value)
+
+    # -- read side -------------------------------------------------------------
+
+    def get(self, name: str, **labels):
+        """Current value of one counter (0 when never incremented)."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            return self._counters.get(key, 0)
+
+    def snapshot(self) -> dict:
+        """Flat {sample key: value} of every counter and histogram."""
+        out = {}
+        with self._lock:
+            for (name, labels), v in self._counters.items():
+                out[_key(name, dict(labels))] = v
+            for (name, labels), h in self._hists.items():
+                ld = dict(labels)
+                out[_key(name + "_count", ld)] = h.count
+                out[_key(name + "_sum", ld)] = h.total
+                if h.count:
+                    out[_key(name + "_min", ld)] = h.vmin
+                    out[_key(name + "_max", ld)] = h.vmax
+        return out
+
+    def delta(self, previous: dict) -> dict:
+        """What changed since `previous` (a snapshot()): {key: now - then},
+        zero-diff keys omitted. Histogram _min/_max are skipped — they are
+        not monotonic, so their difference is meaningless."""
+        now = self.snapshot()
+        out = {}
+        for k, v in now.items():
+            base = k.split("{", 1)[0]
+            if base.endswith("_min") or base.endswith("_max"):
+                continue
+            d = v - previous.get(k, 0)
+            if d:
+                out[k] = d
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (families prefixed parquet_tpu_)."""
+        lines = []
+        with self._lock:
+            counters = sorted(self._counters.items())
+            hists = sorted(self._hists.items())
+        seen_types = set()
+        for (name, labels), v in counters:
+            if name not in seen_types:
+                seen_types.add(name)
+                lines.append(f"# TYPE {_PREFIX}{name} counter")
+            lines.append(f"{_PREFIX}{_key(name, dict(labels))} {v}")
+        for (name, labels), h in hists:
+            if name not in seen_types:
+                seen_types.add(name)
+                lines.append(f"# TYPE {_PREFIX}{name} histogram")
+            ld = dict(labels)
+            # bucket_counts are cumulative already (observe() increments
+            # every bucket whose bound admits the value)
+            for le, c in zip(h.buckets, h.bucket_counts):
+                lines.append(
+                    f"{_PREFIX}{_key(name + '_bucket', {**ld, 'le': repr(le)})} {c}"
+                )
+            lines.append(
+                f"{_PREFIX}{_key(name + '_bucket', {**ld, 'le': '+Inf'})} {h.count}"
+            )
+            lines.append(f"{_PREFIX}{_key(name + '_sum', ld)} {h.total}")
+            lines.append(f"{_PREFIX}{_key(name + '_count', ld)} {h.count}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        """Drop every metric (tests only — production counters are
+        monotonic for the life of the process)."""
+        with self._lock:
+            self._counters.clear()
+            self._hists.clear()
+
+
+REGISTRY = MetricsRegistry()
+
+# -- module-level convenience (the registry everyone means) --------------------
+
+
+def inc(name: str, n=1, **labels) -> None:
+    REGISTRY.inc(name, n, **labels)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    REGISTRY.observe(name, value, **labels)
+
+
+def get(name: str, **labels):
+    return REGISTRY.get(name, **labels)
+
+
+def snapshot() -> dict:
+    return REGISTRY.snapshot()
+
+
+def delta(previous: dict) -> dict:
+    return REGISTRY.delta(previous)
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+# -- the decode plumbing's vocabulary ------------------------------------------
+
+
+def event(name: str, n: int = 1) -> None:
+    """Always-on counterpart of trace.bump(): every bump dual-reports here
+    so fused/fallback/quarantine counts survive outside any trace."""
+    REGISTRY.inc("events_total", n, event=name)
+
+
+def page_decoded(encoding: str, n: int = 1, nbytes: int = 0) -> None:
+    REGISTRY.inc("pages_decoded_total", n, encoding=encoding)
+    if nbytes:
+        REGISTRY.inc("page_bytes_total", nbytes, encoding=encoding)
+
+
+def io_bytes(compressed: int, uncompressed: int, codec) -> None:
+    c = codec_name(codec)
+    REGISTRY.inc("bytes_compressed_total", compressed, codec=c)
+    REGISTRY.inc("bytes_uncompressed_total", uncompressed, codec=c)
+
+
+def encoding_name(enc) -> str:
+    try:
+        from ..meta.parquet_types import Encoding
+
+        return Encoding(int(enc)).name
+    except Exception:
+        return str(enc)
+
+
+def codec_name(codec) -> str:
+    if isinstance(codec, str):
+        return codec
+    try:
+        from ..meta.parquet_types import CompressionCodec
+
+        return CompressionCodec(int(codec)).name
+    except Exception:
+        return str(codec)
+
+
+_LABEL_RE = re.compile(r'^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$')
+
+
+def _sum_family(snap: dict, family: str) -> int:
+    total = 0
+    for k, v in snap.items():
+        m = _LABEL_RE.match(k)
+        if m and m.group("name") == family:
+            total += v
+    return total
+
+
+def report(snap: dict | None = None) -> str:
+    """Human summary of the process counters (or of a snapshot/delta dict):
+    page counts per encoding, byte volumes, compression ratio, decode MB/s."""
+    if snap is None:
+        snap = REGISTRY.snapshot()
+    pages = {}
+    events = {}
+    for k, v in snap.items():
+        m = _LABEL_RE.match(k)
+        if not m:
+            continue
+        name, labels = m.group("name"), m.group("labels") or ""
+        if name == "pages_decoded_total":
+            pages[labels.split('"')[1] if '"' in labels else labels] = v
+        elif name == "events_total" and '"' in labels:
+            events[labels.split('"')[1]] = v
+    comp = _sum_family(snap, "bytes_compressed_total")
+    uncomp = _sum_family(snap, "bytes_uncompressed_total")
+    secs = _sum_family(snap, "chunk_decode_seconds_sum")
+    lines = []
+    enc_part = ", ".join(f"{e}={n}" for e, n in sorted(pages.items()))
+    lines.append(f"pages decoded:      {sum(pages.values()):>12,}  ({enc_part})")
+    lines.append(f"bytes compressed:   {comp:>12,}")
+    lines.append(f"bytes uncompressed: {uncomp:>12,}")
+    ratio = f"{uncomp / comp:.2f}x" if comp else "n/a"
+    lines.append(f"compression ratio:  {ratio:>12}")
+    if secs:
+        lines.append(
+            f"chunk decode wall:  {secs:>12.4f} s  "
+            f"(~{uncomp / secs / 1e6:.0f} MB/s uncompressed)"
+        )
+    if events:
+        ev = ", ".join(f"{k}={v}" for k, v in sorted(events.items()))
+        lines.append(f"events:             {ev}")
+    return "\n".join(lines)
+
+
+def summarize_columns(metadata) -> dict:
+    """Per-column totals across every row group of a FileMetaData:
+    {dotted path: {encodings, compressed, uncompressed, ratio}} — the
+    metadata-sourced feed for `parquet-tool meta`'s summary lines (the same
+    shape the live registry accumulates per encoding during decode)."""
+    out: dict[str, dict] = {}
+    for rg in metadata.row_groups or []:
+        for cc in rg.columns or []:
+            md = cc.meta_data
+            if md is None:
+                continue
+            name = ".".join(md.path_in_schema or [])
+            s = out.setdefault(
+                name, {"encodings": [], "compressed": 0, "uncompressed": 0}
+            )
+            for e in md.encodings or []:
+                en = encoding_name(e)
+                if en not in s["encodings"]:
+                    s["encodings"].append(en)
+            s["compressed"] += md.total_compressed_size or 0
+            s["uncompressed"] += md.total_uncompressed_size or 0
+    for s in out.values():
+        s["encodings"] = sorted(s["encodings"])
+        s["ratio"] = (
+            s["uncompressed"] / s["compressed"] if s["compressed"] else None
+        )
+    return out

@@ -1,14 +1,30 @@
-"""Per-stage decode instrumentation + JAX profiler integration.
+"""Hierarchical span tracing + per-stage decode instrumentation.
 
 The reference has no observability at all (SURVEY §5: 'no pprof hooks, no
-timing instrumentation'); this module adds the per-stage counters the survey
-calls for. Zero overhead when no trace is active (one global check).
+timing instrumentation'). This module provides the opt-in, per-read layer:
+a `decode_trace()` collects BOTH flat per-stage aggregates (wall time, bytes,
+calls — the report() table) and hierarchical spans (file → row-group → chunk
+→ page → stage, including the native prepare sub-clocks) exportable as Chrome
+trace-event JSON for Perfetto / chrome://tracing. The always-on process
+counters live in utils/metrics.py; `bump()` dual-reports into them.
+
+Zero overhead when no trace is active: one contextvar read, no span
+allocations (asserted by test via the span_allocations() counter).
+
+Thread model: the active trace propagates through a `contextvars.ContextVar`,
+so concurrent traces on different threads are ISOLATED (the old module-global
+was racy under the 16-thread prepare pool), while pool workers doing a traced
+read's prepare/dispatch work join the submitting read's trace via
+`traced_submit()` (an explicit `copy_context()` carry — ThreadPoolExecutor
+does not propagate context by itself). All merges into a shared trace are
+lock-protected.
 
     from parquet_tpu.utils.trace import decode_trace
 
     with decode_trace() as t:
         reader.read_row_group(0)
-    print(t.report())        # per-stage wall time + bytes
+    print(t.report())                 # per-stage table, hottest first
+    t.write_chrome_trace("trace.json")  # load in ui.perfetto.dev
 
     with jax_profile("/tmp/trace"):   # wraps jax.profiler.trace
         reader.read_row_group(0)      # inspect with TensorBoard/XProf
@@ -16,22 +32,45 @@ calls for. Zero overhead when no trace is active (one global check).
 
 from __future__ import annotations
 
+import json
+import os
+import threading
 import time
 from contextlib import contextmanager
-from dataclasses import dataclass, field
+from contextvars import ContextVar, copy_context
+from dataclasses import dataclass
+
+from . import metrics as _metrics
 
 __all__ = [
     "decode_trace",
     "stage",
+    "span",
     "add_bytes",
     "add_seconds",
+    "add_seconds_batch",
     "bump",
     "active",
+    "current",
+    "traced_submit",
+    "span_allocations",
     "jax_profile",
     "DecodeTrace",
 ]
 
-_active: "DecodeTrace | None" = None
+_active_var: ContextVar = ContextVar("pqt_decode_trace", default=None)
+
+# Process-wide count of span-event allocations: the zero-overhead oracle.
+# A read with no trace active must leave it untouched — tests assert that by
+# counter, not timing. Mutated only while some trace's lock is held, so the
+# count is exact for single-trace workloads and best-effort across
+# concurrently active traces.
+_span_allocs = 0
+
+# Per-trace span cap: a traced 10M-row assembled read bills stage("assemble")
+# per row; past the cap events drop (counted in events_dropped) while the
+# stage AGGREGATES stay exact.
+_MAX_EVENTS = 1 << 17
 
 
 @dataclass
@@ -41,92 +80,293 @@ class StageStats:
     calls: int = 0
 
 
-@dataclass
 class DecodeTrace:
-    stages: dict = field(default_factory=dict)
+    """One read's collected stages + spans. Safe to mutate from many threads
+    (every merge takes the trace lock); read it after the `with` block."""
+
+    def __init__(self):
+        self.stages: dict[str, StageStats] = {}
+        self.events_dropped = 0
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter_ns()
+        # finished spans: (name, tid, start_ns rel to _t0, dur_ns, args|None)
+        self._events: list[tuple] = []
+        self._threads: dict[int, str] = {}
+
+    # -- collection (lock-protected merge; called from pool threads) ----------
 
     def _stat(self, name: str) -> StageStats:
+        # caller holds self._lock
         s = self.stages.get(name)
         if s is None:
             s = self.stages[name] = StageStats()
         return s
+
+    def _commit(
+        self,
+        name: str,
+        seconds: float = 0.0,
+        nbytes: int = 0,
+        calls: int = 0,
+        start_ns: int | None = None,
+        dur_ns: int = 0,
+        args: dict | None = None,
+    ) -> None:
+        global _span_allocs
+        with self._lock:
+            if calls or nbytes or seconds:
+                s = self._stat(name)
+                s.seconds += seconds
+                s.bytes += nbytes
+                s.calls += calls
+            if start_ns is not None:
+                tid = threading.get_ident()
+                if tid not in self._threads:
+                    self._threads[tid] = threading.current_thread().name
+                if len(self._events) >= _MAX_EVENTS:
+                    self.events_dropped += 1
+                else:
+                    _span_allocs += 1
+                    self._events.append(
+                        (name, tid, start_ns - self._t0, dur_ns, args)
+                    )
+
+    # -- reporting -------------------------------------------------------------
 
     def counters(self) -> dict:
         """{name: calls} for every bump()-style event collected — the
         robustness counters ride here: prepare_fused_engaged/_declined,
         prepare_fused_fault_<stage>, prepare_fallback_recovered,
         chunks_quarantined, chunks_nulled, row_groups_quarantined."""
-        return {name: s.calls for name, s in self.stages.items() if s.calls}
+        with self._lock:
+            return {name: s.calls for name, s in self.stages.items() if s.calls}
 
-    def report(self) -> str:
-        lines = []
-        for name, s in sorted(self.stages.items()):
-            rate = f" ({s.bytes / s.seconds / 1e6:.0f} MB/s)" if s.seconds > 0 and s.bytes else ""
-            lines.append(
-                f"{name:12s} {s.seconds * 1000:8.1f} ms  {s.bytes:>12,} B  "
-                f"{s.calls:>6} calls{rate}"
+    def report(self, sort: str = "time") -> str:
+        """Per-stage table. sort="time" (default) lists the hottest stages
+        first (wall seconds, descending); sort="name" is alphabetical.
+        A TOTAL footer sums seconds/bytes/calls across stages."""
+        if sort not in ("time", "name"):
+            raise ValueError(f'report sort must be "time" or "name", got {sort!r}')
+        with self._lock:
+            items = list(self.stages.items())
+        if sort == "name":
+            items.sort(key=lambda kv: kv[0])
+        else:
+            items.sort(key=lambda kv: (-kv[1].seconds, kv[0]))
+
+        def line(name, seconds, nbytes, calls):
+            rate = f" ({nbytes / seconds / 1e6:.0f} MB/s)" if seconds > 0 and nbytes else ""
+            return (
+                f"{name:12s} {seconds * 1000:8.1f} ms  {nbytes:>12,} B  "
+                f"{calls:>6} calls{rate}"
             )
+
+        lines = [line(n, s.seconds, s.bytes, s.calls) for n, s in items]
+        lines.append(
+            line(
+                "TOTAL",
+                sum(s.seconds for _, s in items),
+                sum(s.bytes for _, s in items),
+                sum(s.calls for _, s in items),
+            )
+        )
         return "\n".join(lines)
+
+    # -- Chrome trace-event export ---------------------------------------------
+
+    def to_chrome_trace(self) -> dict:
+        """The collected spans as a Chrome trace-event JSON object (the
+        format Perfetto and chrome://tracing load). Every span is a complete
+        ("X") event with microsecond ts/dur relative to trace start, on its
+        real thread lane; one thread_name metadata ("M") event names each
+        lane (MainThread / pqt-host_* / pqt-dispatch_*). Aggregates and
+        bump() counters ride in otherData."""
+        pid = os.getpid()
+        with self._lock:
+            events = list(self._events)
+            threads = dict(self._threads)
+            stages = {
+                n: {"seconds": s.seconds, "bytes": s.bytes, "calls": s.calls}
+                for n, s in self.stages.items()
+            }
+            dropped = self.events_dropped
+        out = [
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": tid,
+                "ts": 0,
+                "dur": 0,
+                "args": {"name": tname},
+            }
+            for tid, tname in sorted(threads.items())
+        ]
+        events.sort(key=lambda e: (e[1], e[2], -e[3]))  # (tid, start, -dur)
+        for name, tid, rel_ns, dur_ns, args in events:
+            ev = {
+                "ph": "X",
+                "name": name,
+                "cat": name.split(".", 1)[0],
+                "pid": pid,
+                "tid": tid,
+                "ts": rel_ns / 1e3,
+                "dur": dur_ns / 1e3,
+            }
+            if args:
+                ev["args"] = dict(args)
+            out.append(ev)
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "stages": stages,
+                "counters": {n: v["calls"] for n, v in stages.items() if v["calls"]},
+                "events_dropped": dropped,
+            },
+        }
+
+    def write_chrome_trace(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
 
 
 @contextmanager
 def decode_trace():
-    """Activate stage collection for the enclosed reads."""
-    global _active
-    prev = _active
+    """Activate stage + span collection for the enclosed reads (this thread,
+    plus any pool work submitted from it via traced_submit). Nested traces
+    shadow; traces on OTHER threads are unaffected (contextvar isolation)."""
     t = DecodeTrace()
-    _active = t
+    token = _active_var.set(t)
     try:
         yield t
     finally:
-        _active = prev
+        _active_var.reset(token)
+        # root span: the whole traced region, on the activating thread
+        t._commit(
+            "decode_trace",
+            start_ns=t._t0,
+            dur_ns=time.perf_counter_ns() - t._t0,
+        )
 
 
 @contextmanager
-def stage(name: str, nbytes: int = 0):
-    """Time a pipeline stage (no-op when no trace is active)."""
-    t = _active  # capture: the trace may deactivate concurrently
+def stage(name: str, nbytes: int = 0, record_span: bool = True):
+    """Time a pipeline stage: aggregates into stages[name] AND records a
+    span (no-op without an active trace). record_span=False keeps the
+    aggregate but skips the span event — for per-ROW micro-stages (the
+    assembled-rows loop) that would otherwise flood the event budget with
+    sub-microsecond spans and crowd out the meaningful hierarchy."""
+    t = _active_var.get()
     if t is None:
         yield
         return
-    t0 = time.perf_counter()
+    t0 = time.perf_counter_ns()
     try:
         yield
     finally:
-        s = t._stat(name)
-        s.seconds += time.perf_counter() - t0
-        s.bytes += nbytes
-        s.calls += 1
+        dt = time.perf_counter_ns() - t0
+        t._commit(
+            name,
+            dt / 1e9,
+            nbytes,
+            1,
+            start_ns=t0 if record_span else None,
+            dur_ns=dt,
+        )
+
+
+@contextmanager
+def span(name: str, args: dict | None = None):
+    """Pure hierarchy span (file / row_group / chunk levels): records a
+    trace event with optional args but does NOT enter the stage aggregates —
+    its children (stages) already bill the time, and double-billing would
+    corrupt the TOTAL row."""
+    t = _active_var.get()
+    if t is None:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        t._commit(name, start_ns=t0, dur_ns=time.perf_counter_ns() - t0, args=args)
 
 
 def active() -> bool:
-    """True while a decode_trace() is collecting — callers use this to skip
-    instrumentation work (e.g. native per-stage clocks) when nobody listens."""
-    return _active is not None
+    """True while a decode_trace() is collecting in this context — callers
+    use this to skip instrumentation work (e.g. native per-stage clocks)
+    when nobody listens."""
+    return _active_var.get() is not None
+
+
+def current() -> "DecodeTrace | None":
+    """The trace active in this context, or None."""
+    return _active_var.get()
+
+
+def traced_submit(executor, fn, *args):
+    """Submit `fn(*args)` to `executor` carrying the caller's contextvars —
+    including the active decode_trace — into the worker thread.
+    ThreadPoolExecutor does not do this by itself; every pool hop of a
+    traced read must route through here or its stages vanish."""
+    return executor.submit(copy_context().run, fn, *args)
 
 
 def add_bytes(name: str, nbytes: int) -> None:
-    if _active is not None:
-        _active._stat(name).bytes += nbytes
+    t = _active_var.get()
+    if t is not None:
+        t._commit(name, 0.0, nbytes, 0)
 
 
 def add_seconds(name: str, seconds: float, nbytes: int = 0) -> None:
-    """Credit externally-measured wall time to a stage (how the native fused
-    prepare walk reports its internal decompress/levels/prescan/copy split)."""
-    if _active is not None:
-        s = _active._stat(name)
-        s.seconds += seconds
-        s.bytes += nbytes
-        s.calls += 1
+    """Credit externally-measured wall time to a stage. The span is placed
+    ending 'now' (the measurement must have just finished)."""
+    t = _active_var.get()
+    if t is not None:
+        dur = int(seconds * 1e9)
+        t._commit(
+            name,
+            seconds,
+            nbytes,
+            1,
+            start_ns=time.perf_counter_ns() - dur,
+            dur_ns=dur,
+        )
+
+
+def add_seconds_batch(pairs) -> None:
+    """Credit a list of (name, seconds) sub-stage clocks that together just
+    finished (how the fused native chunk walk reports its internal
+    decompress/levels/prescan/copy/crc split). Spans are laid back-to-back
+    ENDING now, so they nest inside the enclosing span (their sum never
+    exceeds the native call's wall time)."""
+    t = _active_var.get()
+    if t is None:
+        return
+    pairs = [(n, s) for n, s in pairs if s > 0]
+    cursor = time.perf_counter_ns() - sum(int(s * 1e9) for _, s in pairs)
+    for name, sec in pairs:
+        dur = int(sec * 1e9)
+        t._commit(name, sec, 0, 1, start_ns=cursor, dur_ns=dur)
+        cursor += dur
 
 
 def bump(name: str, nbytes: int = 0) -> None:
     """Count an event (with optional byte volume) under an active trace —
-    how tests pin down that an opportunistic path actually engaged."""
-    if _active is not None:
-        s = _active._stat(name)
-        s.calls += 1
-        s.bytes += nbytes
+    how tests pin down that an opportunistic path actually engaged. Always
+    dual-reports into the process-wide metrics registry (metrics.event), so
+    the count survives outside any trace."""
+    _metrics.event(name)
+    t = _active_var.get()
+    if t is not None:
+        t._commit(name, 0.0, nbytes, 1)
+
+
+def span_allocations() -> int:
+    """Process-wide span-event allocation count — the zero-overhead oracle:
+    reads with no active trace must not move it."""
+    return _span_allocs
 
 
 @contextmanager

@@ -278,7 +278,8 @@ class TestTrace:
     def test_no_overhead_when_inactive(self, tmp_path):
         from parquet_tpu.utils import trace
 
-        assert trace._active is None  # nothing leaks between tests
+        assert trace.current() is None  # nothing leaks between tests
+        assert not trace.active()
 
 
 class TestAllocCeiling:

@@ -1,0 +1,161 @@
+"""Process-wide metrics registry tests: always-on counting with NO trace
+active, snapshot/delta semantics, histogram accounting, Prometheus text
+exposition, bump() dual-reporting, and the meta summary helper."""
+
+import re
+
+import numpy as np
+import pytest
+
+from parquet_tpu.core.reader import FileReader
+from parquet_tpu.core.writer import FileWriter
+from parquet_tpu.meta.parquet_types import Type
+from parquet_tpu.schema.builder import message, required, string
+from parquet_tpu.utils import metrics
+from parquet_tpu.utils.trace import active, bump
+
+
+@pytest.fixture(scope="module")
+def sample(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("metrics") / "m.parquet")
+    schema = message(required("id", Type.INT64), required("name", string()))
+    with FileWriter(path, schema, codec="snappy") as w:
+        w.write_column("id", np.arange(3000, dtype=np.int64))
+        w.write_column("name", [f"n{i % 41}" for i in range(3000)])
+    return path
+
+
+class TestAlwaysOn:
+    def test_plain_read_reports_pages_bytes_encodings(self, sample):
+        """The acceptance bar: nonzero page/byte/encoding counters after a
+        plain FileReader read with NO trace active."""
+        assert not active()
+        snap0 = metrics.snapshot()
+        with FileReader(sample) as r:
+            for i in range(r.num_row_groups):
+                r.read_row_group(i)
+        d = metrics.delta(snap0)
+        page_keys = [k for k in d if k.startswith("pages_decoded_total")]
+        assert page_keys and all(d[k] > 0 for k in page_keys)
+        # encoding labels are real parquet encoding names
+        assert any(
+            'encoding="PLAIN"' in k or 'encoding="RLE_DICTIONARY"' in k
+            or 'encoding="PLAIN_DICTIONARY"' in k
+            for k in page_keys
+        ), page_keys
+        assert sum(
+            v for k, v in d.items() if k.startswith("bytes_compressed_total")
+        ) > 0
+        assert sum(
+            v for k, v in d.items() if k.startswith("bytes_uncompressed_total")
+        ) > 0
+        assert d.get("chunk_decode_seconds_count", 0) >= 2  # one per chunk
+        assert d.get("chunk_decode_seconds_sum", 0) > 0
+
+    def test_device_plan_read_also_reports(self, sample):
+        snap0 = metrics.snapshot()
+        with FileReader(sample, backend="tpu_roundtrip") as r:
+            r.read_row_group(0)
+        d = metrics.delta(snap0)
+        assert any(k.startswith("pages_decoded_total") for k in d), d
+        assert sum(
+            v for k, v in d.items() if k.startswith("bytes_uncompressed_total")
+        ) > 0
+
+
+class TestSnapshotDelta:
+    def test_counter_delta_exact(self):
+        s0 = metrics.snapshot()
+        metrics.inc("pqt_test_counter_total", 3, kind="x")
+        metrics.inc("pqt_test_counter_total", 2, kind="x")
+        d = metrics.delta(s0)
+        assert d['pqt_test_counter_total{kind="x"}'] == 5
+
+    def test_delta_omits_unchanged(self):
+        metrics.inc("pqt_test_quiet_total", 1)
+        s0 = metrics.snapshot()
+        assert metrics.delta(s0) == {}
+
+    def test_delta_skips_hist_min_max(self):
+        s0 = metrics.snapshot()
+        metrics.observe("pqt_test_seconds", 0.25)
+        d = metrics.delta(s0)
+        assert d["pqt_test_seconds_count"] == 1
+        assert d["pqt_test_seconds_sum"] == pytest.approx(0.25)
+        assert not any(
+            k.startswith("pqt_test_seconds_min")
+            or k.startswith("pqt_test_seconds_max")
+            for k in d
+        )
+
+    def test_histogram_snapshot_min_max(self):
+        metrics.observe("pqt_test_hist2", 0.5)
+        metrics.observe("pqt_test_hist2", 1.5)
+        s = metrics.snapshot()
+        assert s["pqt_test_hist2_count"] >= 2
+        assert s["pqt_test_hist2_min"] <= 0.5
+        assert s["pqt_test_hist2_max"] >= 1.5
+
+    def test_get(self):
+        metrics.inc("pqt_test_get_total", 7, who="me")
+        assert metrics.get("pqt_test_get_total", who="me") == 7
+        assert metrics.get("pqt_test_get_total", who="nobody") == 0
+
+
+class TestBumpDualReport:
+    def test_bump_counts_without_trace(self):
+        assert not active()
+        before = metrics.get("events_total", event="pqt_test_event")
+        bump("pqt_test_event")
+        bump("pqt_test_event")
+        assert metrics.get("events_total", event="pqt_test_event") == before + 2
+
+
+class TestPrometheus:
+    def test_exposition_format(self):
+        metrics.inc("pqt_test_prom_total", 4, encoding="PLAIN")
+        metrics.observe("pqt_test_prom_seconds", 0.02)
+        text = metrics.render_prometheus()
+        assert "# TYPE parquet_tpu_pqt_test_prom_total counter" in text
+        assert 'parquet_tpu_pqt_test_prom_total{encoding="PLAIN"} ' in text
+        assert "# TYPE parquet_tpu_pqt_test_prom_seconds histogram" in text
+        assert 'le="+Inf"' in text
+        assert text.endswith("\n")
+        # every sample line is "name{labels} value" with a numeric value
+        for line in text.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            m = re.match(r"^parquet_tpu_\S+ (\S+)$", line)
+            assert m, line
+            float(m.group(1))
+
+    def test_histogram_bucket_counts_cumulative(self):
+        metrics.observe("pqt_test_buckets", 0.0001)
+        metrics.observe("pqt_test_buckets", 100.0)
+        text = metrics.render_prometheus()
+        lines = [
+            line for line in text.splitlines() if "pqt_test_buckets_bucket" in line
+        ]
+        counts = [int(line.rsplit(" ", 1)[1]) for line in lines]
+        assert counts == sorted(counts)  # cumulative
+        assert counts[-1] >= 1  # +Inf sees everything... via _count below
+        assert "parquet_tpu_pqt_test_buckets_count 2" in text
+
+
+class TestReportAndSummary:
+    def test_human_report(self, sample):
+        with FileReader(sample) as r:
+            r.read_row_group(0)
+        text = metrics.report()
+        assert "pages decoded" in text
+        assert "compression ratio" in text
+
+    def test_summarize_columns(self, sample):
+        with FileReader(sample) as r:
+            s = metrics.summarize_columns(r.metadata)
+        assert set(s) == {"id", "name"}
+        for col in s.values():
+            assert col["compressed"] > 0
+            assert col["uncompressed"] > 0
+            assert col["ratio"] is not None and col["ratio"] > 0
+            assert col["encodings"]

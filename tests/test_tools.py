@@ -404,3 +404,92 @@ class TestVerifySalvage:
         assert tool_main(["salvage", bad, "-o", str(out)]) == 1
         assert "already exists" in capsys.readouterr().err
         assert tool_main(["salvage", bad, "-o", str(out), "--force"]) == 0
+
+
+class TestProfile:
+    """`parquet-tool profile`: Chrome trace-event JSON + per-stage report +
+    metrics delta (the observability surface)."""
+
+    def test_profile_writes_valid_chrome_trace(self, sample, tmp_path, capsys):
+        out = str(tmp_path / "trace.json")
+        assert tool_main(["profile", sample, "-o", out, "--metrics"]) == 0
+        with open(out) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        assert events
+        for ev in events:
+            for key in ("ph", "ts", "dur", "pid", "tid", "name"):
+                assert key in ev, ev
+        # the device pipeline's hierarchy + lanes are present
+        names = {e["name"] for e in events}
+        assert "file" in names and "chunk.prepare" in names
+        assert doc["otherData"]["metrics_delta"]
+        text = capsys.readouterr().out
+        assert "TOTAL" in text  # per-stage report footer
+        assert "trace events" in text
+        assert "pages decoded" in text  # --metrics summary
+
+    def test_profile_host_backend(self, sample, tmp_path, capsys):
+        out = str(tmp_path / "trace_host.json")
+        assert tool_main(["profile", sample, "-o", out, "--host"]) == 0
+        with open(out) as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]}
+        # host path hierarchy: row groups, chunks, pages, leaf stages
+        for expected in ("file", "row_group", "chunk", "page"):
+            assert expected in names, names
+
+    def test_meta_per_column_summary(self, sample, capsys):
+        assert tool_main(["meta", sample]) == 0
+        out = capsys.readouterr().out
+        assert "column id:" in out
+        assert "column name:" in out
+        line = [x for x in out.splitlines() if x.startswith("column id:")][0]
+        assert "encodings=[" in line
+        assert "compressed=" in line and "uncompressed=" in line
+        assert "ratio=" in line
+
+
+class TestBenchJson:
+    def test_bench_json_round_trips(self, tmp_path):
+        """`bench.py --phase prepare --json out.json` writes the structured
+        per-stage breakdown; the artifact must round-trip through
+        json.load (the BENCH_* trajectory files come from here now)."""
+        import os
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        out = tmp_path / "bench.json"
+        root = Path(__file__).resolve().parent.parent
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PQT_BENCH_ROWS="20000",
+            PQT_BENCH_REPEATS="1",
+        )
+        proc = subprocess.run(
+            [
+                sys.executable,
+                str(root / "bench.py"),
+                "--phase",
+                "prepare",
+                "--json",
+                str(out),
+            ],
+            cwd=str(root),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=280,
+        )
+        assert proc.returncode == 0, proc.stderr.decode()[-2000:]
+        with open(out) as f:
+            doc = json.load(f)
+        assert "prepare_serial_s" in doc
+        assert "stage_ms" in doc
+        # stdout keeps the one-line JSON contract too
+        line = [
+            x for x in proc.stdout.decode().splitlines() if x.strip().startswith("{")
+        ][-1]
+        assert json.loads(line) == doc

@@ -1,0 +1,322 @@
+"""Span tracer tests: contextvar isolation under threads, lock-protected
+merge exactness, pool attribution under the prepare pool, report() ordering,
+Chrome trace-event schema, and the zero-overhead (no span allocations when
+inactive) guarantee."""
+
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from parquet_tpu.core.reader import FileReader
+from parquet_tpu.core.writer import FileWriter
+from parquet_tpu.meta.parquet_types import Type
+from parquet_tpu.schema.builder import message, optional, required, string
+from parquet_tpu.utils import trace as trace_mod
+from parquet_tpu.utils.trace import (
+    add_seconds,
+    add_seconds_batch,
+    bump,
+    decode_trace,
+    span,
+    stage,
+    traced_submit,
+)
+
+
+def _write_sample(path: str, rows: int = 4000, groups: int = 2) -> str:
+    schema = message(required("id", Type.INT64), optional("name", string()))
+    with FileWriter(path, schema, codec="snappy") as w:
+        for g in range(groups):
+            w.write_rows(
+                {
+                    "id": int(g * rows + i),
+                    "name": f"g{g}n{i % 53}" if i % 7 else None,
+                }
+                for i in range(rows)
+            )
+            w.flush_row_group()
+    return path
+
+
+@pytest.fixture(scope="module")
+def sample(tmp_path_factory):
+    return _write_sample(str(tmp_path_factory.mktemp("trace") / "t.parquet"))
+
+
+def _traced_read_totals(path) -> dict:
+    """{stage name: (bytes, calls)} of one fully traced host read."""
+    with decode_trace() as t:
+        with FileReader(path) as r:
+            for i in range(r.num_row_groups):
+                r.read_row_group(i)
+    return {name: (s.bytes, s.calls) for name, s in t.stages.items()}
+
+
+class TestThreadSafety:
+    def test_eight_thread_hammer_exact_byte_totals(self, sample):
+        """Regression for the pre-contextvar bug: nested decode_trace() from
+        two threads clobbered the module-global and corrupted byte totals.
+        Eight threads each trace their own read; every trace must hold the
+        EXACT solo totals (bytes and call counts, which are deterministic —
+        seconds are not)."""
+        expected = _traced_read_totals(sample)
+        assert expected, "solo traced read collected nothing"
+        assert any(b for b, _ in expected.values()), "no byte totals collected"
+
+        barrier = threading.Barrier(8)
+        results: list = [None] * 8
+        errors: list = []
+
+        def worker(k):
+            try:
+                barrier.wait()
+                results[k] = _traced_read_totals(sample)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors, errors
+        for k, got in enumerate(results):
+            assert got == expected, f"thread {k} totals diverged: {got}"
+
+    def test_shared_trace_concurrent_merge_exact(self):
+        """Many threads merging into ONE trace (the pool-worker shape): the
+        lock-protected merge must lose nothing."""
+        n_threads, n_iter = 8, 5000
+        with decode_trace() as t:
+            with ThreadPoolExecutor(max_workers=n_threads) as pool:
+
+                def hammer():
+                    for _ in range(n_iter):
+                        bump("hammer", 3)
+
+                futs = [traced_submit(pool, hammer) for _ in range(n_threads)]
+                for f in futs:
+                    f.result()
+        s = t.stages["hammer"]
+        assert s.calls == n_threads * n_iter
+        assert s.bytes == 3 * n_threads * n_iter
+
+    def test_concurrent_traces_do_not_cross_attribute(
+        self, sample, tmp_path, monkeypatch
+    ):
+        """Two traced roundtrip reads sharing a 16-thread prepare pool: each
+        trace must account exactly its own file's chunks (the explicit
+        copy_context carry into pool workers), not a mix."""
+        import parquet_tpu.core.reader as reader_mod
+
+        # force the full-width pool regardless of host core count
+        monkeypatch.setenv("PQT_HOST_THREADS", "16")
+        pool = ThreadPoolExecutor(max_workers=16, thread_name_prefix="pqt-host")
+        monkeypatch.setattr(reader_mod, "_pool", pool)
+        small = _write_sample(str(tmp_path / "small.parquet"), rows=500, groups=1)
+
+        def chunk_events(path):
+            with decode_trace() as t:
+                with FileReader(path, backend="tpu_roundtrip") as r:
+                    for i in range(r.num_row_groups):
+                        r.read_row_group(i)
+            c = t.counters()
+            # every chunk prepared lands on exactly one ladder rung
+            return (
+                c.get("prepare_fused_engaged", 0)
+                + c.get("prepare_fused_declined", 0)
+                + c.get("prepare_staged_chunk", 0)
+            )
+
+        expected_big = chunk_events(sample)  # 2 groups x 2 cols = 4 chunks
+        expected_small = chunk_events(small)  # 1 group x 2 cols = 2 chunks
+        assert expected_big == 4 and expected_small == 2
+
+        barrier = threading.Barrier(2)
+        out: dict = {}
+
+        def run(name, path):
+            barrier.wait()
+            out[name] = chunk_events(path)
+
+        a = threading.Thread(target=run, args=("big", sample))
+        b = threading.Thread(target=run, args=("small", small))
+        a.start(); b.start(); a.join(); b.join()
+        pool.shutdown(wait=True)
+        assert out == {"big": expected_big, "small": expected_small}
+
+
+class TestReport:
+    def test_sort_time_default_and_total_footer(self):
+        with decode_trace() as t:
+            add_seconds("zz_slow", 0.2, 1000)
+            add_seconds("aa_fast", 0.01, 50)
+        rep = t.report()
+        lines = rep.splitlines()
+        assert lines[-1].startswith("TOTAL")
+        assert lines.index([x for x in lines if x.startswith("zz_slow")][0]) < \
+            lines.index([x for x in lines if x.startswith("aa_fast")][0])
+        # TOTAL sums seconds/bytes/calls
+        assert "1,050 B" in lines[-1]
+
+    def test_sort_name(self):
+        with decode_trace() as t:
+            add_seconds("zz_slow", 0.2)
+            add_seconds("aa_fast", 0.01)
+        lines = t.report(sort="name").splitlines()
+        assert lines[0].startswith("aa_fast")
+        assert lines[1].startswith("zz_slow")
+
+    def test_bad_sort_raises(self):
+        with decode_trace() as t:
+            pass
+        with pytest.raises(ValueError):
+            t.report(sort="bytes")
+
+
+def _check_event_schema(events):
+    assert events, "no trace events"
+    for ev in events:
+        for key in ("ph", "ts", "dur", "pid", "tid", "name"):
+            assert key in ev, (key, ev)
+        assert ev["ph"] in ("X", "M")
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        assert ev["pid"] == os.getpid()
+
+
+def _check_nesting(events):
+    """Complete events on one thread lane must nest or be disjoint."""
+    xs = [e for e in events if e["ph"] == "X"]
+    for tid in {e["tid"] for e in xs}:
+        lane = sorted(
+            (e for e in xs if e["tid"] == tid), key=lambda e: (e["ts"], -e["dur"])
+        )
+        stack = []  # open interval end times
+        for e in lane:
+            start, end = e["ts"], e["ts"] + e["dur"]
+            while stack and start >= stack[-1] - 1e-6:
+                stack.pop()
+            if stack:
+                assert end <= stack[-1] + 1e-3, (e, stack[-1])
+            stack.append(end)
+
+
+class TestChromeTrace:
+    def test_schema_host_path(self, sample):
+        with decode_trace() as t, span("file", {"path": sample}):
+            with FileReader(sample) as r:
+                for i in range(r.num_row_groups):
+                    r.read_row_group(i)
+        doc = t.to_chrome_trace()
+        # valid JSON end to end
+        doc = json.loads(json.dumps(doc))
+        events = doc["traceEvents"]
+        _check_event_schema(events)
+        _check_nesting(events)
+        names = {e["name"] for e in events}
+        # the hierarchy levels all present
+        for expected in ("file", "row_group", "chunk", "page", "decode_trace"):
+            assert expected in names, names
+        # stage leaves under them
+        assert names & {"io", "decompress", "decode"}
+        # thread lanes are named
+        assert any(
+            e["ph"] == "M" and e["name"] == "thread_name" for e in events
+        )
+        assert doc["otherData"]["stages"]
+
+    def test_schema_device_pipeline_lanes_and_native_substages(self, sample):
+        """The device-plan path: spans must land on the REAL worker threads
+        (pqt-host/pqt-dispatch lanes) and, when the fused native walk ran,
+        its internal sub-stage clocks must appear as nested spans."""
+        with decode_trace() as t, span("file", {"path": sample}):
+            with FileReader(sample, backend="tpu_roundtrip") as r:
+                for i in range(r.num_row_groups):
+                    r.read_row_group(i)
+        doc = t.to_chrome_trace()
+        events = doc["traceEvents"]
+        _check_event_schema(events)
+        _check_nesting(events)
+        names = {e["name"] for e in events}
+        assert "chunk.prepare" in names
+        assert "dispatch" in names
+        lanes = {
+            e["args"]["name"] for e in events if e["ph"] == "M"
+        }
+        assert any(name.startswith("pqt-dispatch") for name in lanes), lanes
+        if t.counters().get("prepare_fused_engaged"):
+            assert any(n.startswith("prepare.") for n in names), names
+            # the sub-stage spans nest inside their chunk.prepare span
+            preps = [e for e in events if e["name"] == "chunk.prepare"]
+            subs = [e for e in events if e["name"].startswith("prepare.")]
+            for s in subs:
+                assert any(
+                    p["tid"] == s["tid"]
+                    and p["ts"] <= s["ts"] + 1e-3
+                    and s["ts"] + s["dur"] <= p["ts"] + p["dur"] + 1e-3
+                    for p in preps
+                ), s
+
+    def test_add_seconds_batch_lays_spans_back_to_back(self):
+        import time
+
+        with decode_trace() as t:
+            with span("outer"):
+                # the batch's seconds must fit inside the enclosing span's
+                # real elapsed time (as the native walk's sub-clocks do)
+                time.sleep(0.006)
+                add_seconds_batch([("a", 0.001), ("b", 0.002)])
+        evs = [e for e in t.to_chrome_trace()["traceEvents"] if e["ph"] == "X"]
+        by = {e["name"]: e for e in evs}
+        a, b, outer = by["a"], by["b"], by["outer"]
+        assert abs((a["ts"] + a["dur"]) - b["ts"]) < 1e-3  # contiguous
+        assert outer["ts"] <= a["ts"] and b["ts"] + b["dur"] <= outer["ts"] + outer["dur"]
+        assert t.stages["a"].calls == 1 and t.stages["b"].calls == 1
+
+    def test_write_chrome_trace_file(self, sample, tmp_path):
+        out = tmp_path / "trace.json"
+        with decode_trace() as t:
+            with FileReader(sample) as r:
+                r.read_row_group(0)
+        t.write_chrome_trace(str(out))
+        doc = json.loads(out.read_text())
+        assert doc["traceEvents"]
+
+
+class TestZeroOverhead:
+    def test_untraced_read_allocates_no_spans(self, sample):
+        """The inactive-trace guarantee, asserted via counter (not timing):
+        a read with no decode_trace active must not allocate span events."""
+        # warm every lazy path first (imports, native load)
+        with FileReader(sample) as r:
+            r.read_row_group(0)
+        before = trace_mod.span_allocations()
+        with FileReader(sample) as r:
+            for i in range(r.num_row_groups):
+                r.read_row_group(i)
+            list(r.iter_rows(row_groups=[0]))
+        assert trace_mod.span_allocations() == before
+
+    def test_stage_and_span_noop_without_trace(self):
+        before = trace_mod.span_allocations()
+        with stage("nothing", 10):
+            pass
+        with span("nothing"):
+            pass
+        assert trace_mod.span_allocations() == before
+        assert not trace_mod.active()
+
+
+class TestEventCap:
+    def test_span_cap_drops_events_but_keeps_aggregates(self, monkeypatch):
+        monkeypatch.setattr(trace_mod, "_MAX_EVENTS", 16)
+        with decode_trace() as t:
+            for _ in range(50):
+                with stage("tick"):
+                    pass
+        assert t.stages["tick"].calls == 50  # aggregates exact past the cap
+        assert t.events_dropped > 0
+        assert len(t.to_chrome_trace()["traceEvents"]) <= 16 + 1  # + thread M
