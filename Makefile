@@ -2,7 +2,7 @@
 # .github/workflows/ci.yml (ruff runs there; this image has no linter, so the
 # syntax gate is compileall).
 
-.PHONY: check test native bench bench-prepare dryrun fuzz profile
+.PHONY: check test native bench bench-prepare bench-dataset dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
 check: native
@@ -22,6 +22,11 @@ bench:
 # levels / prescan / copy) + GIL-free thread scaling; no accelerator needed
 bench-prepare: native
 	python bench.py --phase prepare
+
+# streaming-loader bench: multi-file glob through ParquetDataset at a
+# prefetch-depth sweep (rows/s + wait-time share); host-only, no accelerator
+bench-dataset: native
+	python bench.py --dataset
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -29,6 +29,8 @@ Layout:
   ops/       host (NumPy-vectorized) encoders/decoders — the correctness oracle
   kernels/   device (JAX/XLA + Pallas) decode ops + the batched page pipeline
   core/      pages, chunks, column stores, schema tree, FileReader/FileWriter
+  data/      streaming dataset: sharded/shuffled multi-file plans, bounded
+             prefetch, fixed-size rebatching, mid-epoch checkpoint/resume
   schema/    textual schema DSL (parser/printer/validator) + builder API
   floor/     high-level record marshal/unmarshal + dataclass autoschema
   parallel/  shard_map/mesh scale-out over pages, columns, and row groups
@@ -68,6 +70,7 @@ from .schema.dsl import (  # noqa: F401
 )
 from .schema import builder  # noqa: F401
 from . import floor  # noqa: F401
+from .data import ParquetDataset  # noqa: F401  (host-only at import; jax lazy)
 
 
 def __getattr__(name):

@@ -84,9 +84,6 @@ def _host_pool() -> ThreadPoolExecutor | None:
         return _pool
 
 
-_dispatcher: ThreadPoolExecutor | None = None
-
-
 def _with_device(fn, device):
     """Run `fn` under jax.default_device(device) (plain call when None).
 
@@ -112,16 +109,13 @@ def _dispatch_traced(fn, device):
 
 
 def _dispatch_pool() -> ThreadPoolExecutor:
-    """Single-thread executor that owns device dispatch (uploads + kernel
-    launches): keeps jax calls serialized in deterministic order while
-    overlapping their RPC latency with host-side chunk preparation."""
-    global _dispatcher
-    with _pool_lock:
-        if _dispatcher is None:
-            _dispatcher = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="pqt-dispatch"
-            )
-        return _dispatcher
+    """The process-wide single-thread device-dispatch executor. Lives in
+    kernels/pipeline.py (next to the device pipeline it feeds, shared with
+    the dataset layer's batch uploads); imported lazily so pure host reads
+    never pull jax in."""
+    from ..kernels.pipeline import dispatch_pool
+
+    return dispatch_pool()
 
 
 def _timed_rows(assembler):
@@ -303,6 +297,7 @@ class FileReader:
         validate_crc: bool = False,
         max_memory: int | None = None,
         metadata: FileMetaData | None = None,
+        schema: Schema | None = None,
         backend: str = "host",
         compact_levels: bool = False,
         device=None,
@@ -319,7 +314,14 @@ class FileReader:
             self.metadata = (
                 metadata if metadata is not None else read_file_metadata(self._f)
             )
-            self.schema = Schema.from_thrift(self.metadata.schema)
+            # schema=: a pre-built Schema for this metadata (high-churn
+            # callers like the dataset layer open one reader per row group;
+            # rebuilding the schema tree from thrift every open is waste)
+            self.schema = (
+                schema
+                if schema is not None
+                else Schema.from_thrift(self.metadata.schema)
+            )
             self.validate_crc = validate_crc
             self.alloc = AllocTracker(max_memory) if max_memory else None
             if backend not in ("host", "tpu", "tpu_roundtrip"):
@@ -1869,8 +1871,38 @@ class FileReader:
 
     # -- lifecycle -------------------------------------------------------------
 
+    @classmethod
+    def open_metadata(cls, path) -> FileMetaData:
+        """Parse ONLY the footer of `path` — no data pages are touched and
+        no reader object (or open handle) survives the call. The cheap
+        multi-file planning primitive: a dataset scanning a thousand-file
+        glob footers every file once here, then opens per-unit readers
+        with `metadata=` so the footer never re-parses."""
+        with open(path, "rb") as f:
+            return read_file_metadata(f)
+
+    @classmethod
+    def open_many(cls, paths, columns=None, **options) -> "list[FileReader]":
+        """Open several files at once (footer parse only — FileReader's
+        constructor never touches data pages). All-or-nothing: if any open
+        fails, the already-opened readers are closed before the error
+        propagates, so no handles leak. Every option forwards to each
+        reader (`on_error=`, `validate_crc=`, ...)."""
+        readers: list[FileReader] = []
+        try:
+            for p in paths:
+                readers.append(cls(p, columns=columns, **options))
+        except BaseException:
+            for r in readers:
+                r.close()
+            raise
+        return readers
+
     def close(self) -> None:
-        if self._owns_file:
+        """Release the underlying file when this reader owns it. Idempotent:
+        the dataset layer's lazy open/close churn (and `with` blocks wrapped
+        in error paths) may close the same reader more than once."""
+        if self._owns_file and not getattr(self._f, "closed", False):
             self._f.close()
 
     def __enter__(self):

@@ -1,0 +1,19 @@
+"""parquet_tpu.data — sharded, prefetching, checkpointable streaming datasets.
+
+The scheduler/runtime layer a training or bulk-inference loop consumes:
+multi-file plans (plan.py: footer-only planning, deterministic shard/shuffle
+math) driven by a bounded prefetch-and-rebatch pipeline (dataset.py). See
+ParquetDataset for the full contract.
+"""
+
+from .dataset import DatasetIterator, ParquetDataset  # noqa: F401
+from .plan import ScanPlan, Unit, build_plan, expand_paths  # noqa: F401
+
+__all__ = [
+    "ParquetDataset",
+    "DatasetIterator",
+    "ScanPlan",
+    "Unit",
+    "build_plan",
+    "expand_paths",
+]

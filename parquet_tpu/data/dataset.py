@@ -1,0 +1,641 @@
+"""ParquetDataset: sharded, prefetching, checkpointable streaming batches.
+
+The scheduler/runtime layer on top of the decode core — what a training or
+bulk-inference job actually consumes. Every consumer used to hand-roll a
+loop over `FileReader.read_row_group` on one file; this subsystem gives the
+multi-file, multi-host, overlap-I/O-with-compute path:
+
+    ds = ParquetDataset("shard-*.parquet", columns=["x", "y"],
+                        batch_size=4096, shuffle=True, seed=7,
+                        prefetch=2, on_error="skip")
+    for batch in ds:                      # {leaf path: np.ndarray[4096, ...]}
+        step(batch)
+
+Semantics, in the order the pipeline applies them:
+
+  plan      footers parse lazily (once per file); one work unit per
+            (file, row group); `filters` prune units through the
+            statistics/bloom path before any data page is read.
+  shard     the epoch's unit order is a pure function of (seed, epoch),
+            computed identically on every host, then striped over
+            `shard_count * worker_count` slots — each unit visited by
+            exactly one (process, worker) per epoch.
+  prefetch  a bounded pool ("pqt-data" threads) decodes units k+1..k+depth
+            while the consumer works on k's batches; depth 0 = fully
+            synchronous. Wait time is always measured (dataset_wait_seconds
+            histogram + dataset.wait trace stage): a starved loop is
+            visible, not mysterious.
+  rebatch   decoded row groups re-slice into fixed `batch_size` batches,
+            remainders carrying ACROSS unit boundaries; the epoch tail
+            follows `remainder=` ("drop" | "keep" | "pad").
+  deliver   host numpy dicts by default; `device=` (a jax.Device or a
+            Sharding) double-buffers `jax.device_put` so batch k+1's upload
+            overlaps the consumer's step on k.
+  resume    iter(ds) -> DatasetIterator with state_dict()/load_state_dict():
+            (epoch, unit cursor, intra-unit row offset) — a resumed
+            iterator reproduces the remaining batch stream byte-identically,
+            mid-epoch, under sharding and shuffling.
+
+Corruption follows FileReader's on_error policy per unit: with "skip" a
+corrupt row group (or a file with an unreadable footer) drops with a counter
+(dataset_units_skipped / dataset_files_skipped) and every clean unit still
+arrives exactly once; "null" substitutes nulls where the schema allows
+(pair it with nullable="zero"). Device-resident training jobs that would
+rather die than silently lose rows keep the default "raise".
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..core.arrays import ByteArrayData
+from ..core.reader import PARQUET_ERRORS, FileReader
+from ..meta.file_meta import ParquetFileError
+from ..utils import metrics as _metrics
+from ..utils.trace import bump, span, timed_stage, traced_submit
+from .plan import ScanPlan, build_plan
+
+__all__ = ["ParquetDataset", "DatasetIterator"]
+
+_STATE_VERSION = 1
+
+# The prefetch queue-depth gauge is process-wide (one Prometheus sample),
+# while iterators are many and concurrent — each tracks its own delta here
+# so the exposed value is the TOTAL in-flight unit count, not whichever
+# iterator wrote last (a finishing iterator must not zero a live one's
+# starvation signal).
+_inflight_lock = threading.Lock()
+_inflight_units = 0
+
+
+def _inflight_add(n: int) -> None:
+    global _inflight_units
+    with _inflight_lock:
+        _inflight_units += n
+        _metrics.set_gauge("dataset_prefetch_depth", _inflight_units)
+
+
+class ParquetDataset:
+    """A multi-file Parquet scan shaped for training loops.
+
+    Construction is cheap: footers parse on first use (iteration, or any
+    plan-derived property). Iterating yields {leaf path tuple: np.ndarray}
+    batches of exactly `batch_size` rows (tail per `remainder=`); with
+    `device=` the arrays are device-resident jax arrays instead.
+    """
+
+    def __init__(
+        self,
+        paths_or_glob,
+        *,
+        batch_size: int,
+        columns=None,
+        filters=None,
+        shuffle: bool = False,
+        seed: int = 0,
+        num_epochs: int | None = 1,
+        prefetch: int = 2,
+        remainder: str = "drop",
+        shard=None,
+        worker=None,
+        on_error: str = "raise",
+        nullable: str = "error",
+        validate_crc: bool = False,
+        device=None,
+    ):
+        if batch_size <= 0:
+            raise ValueError("dataset: batch_size must be positive")
+        if remainder not in ("drop", "keep", "pad"):
+            raise ValueError(
+                f'dataset: remainder must be "drop", "keep" or "pad", '
+                f"got {remainder!r}"
+            )
+        if on_error not in ("raise", "skip", "null"):
+            raise ValueError(
+                f'dataset: on_error must be "raise", "skip" or "null", '
+                f"got {on_error!r}"
+            )
+        if nullable not in ("error", "zero"):
+            raise ValueError(
+                f'dataset: nullable must be "error" or "zero", got {nullable!r}'
+            )
+        if on_error == "null" and nullable != "zero":
+            raise ValueError(
+                'dataset: on_error="null" delivers nulled chunks, which need '
+                'nullable="zero" to batch'
+            )
+        if num_epochs is not None and num_epochs < 0:
+            raise ValueError("dataset: num_epochs must be >= 0 or None")
+        if prefetch < 0:
+            raise ValueError("dataset: prefetch depth must be >= 0")
+        self.paths_or_glob = paths_or_glob
+        self.batch_size = int(batch_size)
+        self.columns = list(columns) if columns is not None else None
+        self.filters = filters
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.num_epochs = num_epochs
+        self.prefetch = int(prefetch)
+        self.remainder = remainder
+        self.on_error = on_error
+        self.nullable = nullable
+        self.validate_crc = bool(validate_crc)
+        self.device = device
+        si, sc = self._resolve_split(shard, "shard")
+        wi, wc = self._resolve_split(worker, "worker")
+        # one flat slot space: process-major, worker-minor — host p's worker
+        # w owns stripe p*wc + w of sc*wc
+        self.shard_index = si * wc + wi
+        self.shard_count = sc * wc
+        self._plan: ScanPlan | None = None
+        self._plan_lock = threading.Lock()
+        self._pool: ThreadPoolExecutor | None = None
+        self._closed = False
+        # per-file parsed Schema cache: _load_unit opens one reader PER ROW
+        # GROUP, and rebuilding the schema tree from thrift every unit is
+        # pure waste when the footer is already cached on the plan
+        self._schemas: dict[int, object] = {}
+
+    @staticmethod
+    def _resolve_split(spec, what: str) -> tuple[int, int]:
+        if spec is None:
+            return 0, 1
+        if spec == "jax":
+            if what != "shard":
+                # worker="jax" would square the process stripe into a
+                # diagonal — (P-1)/P of all units visited by nobody
+                raise ValueError(
+                    'dataset: only shard= accepts "jax"; worker= is the '
+                    "per-host sub-split and needs an explicit (index, count)"
+                )
+            # opt-in only: importing jax initializes the backend, which a
+            # pure host data loader must never do implicitly
+            import jax
+
+            return jax.process_index(), jax.process_count()
+        i, n = spec
+        i, n = int(i), int(n)
+        if n <= 0 or not 0 <= i < n:
+            raise ValueError(f"dataset: bad {what} split ({i}, {n})")
+        return i, n
+
+    # -- plan ------------------------------------------------------------------
+
+    @property
+    def plan(self) -> ScanPlan:
+        """The global unit plan (footers parse on first access)."""
+        with self._plan_lock:
+            if self._plan is None:
+                plan = build_plan(
+                    self.paths_or_glob,
+                    filters=self.filters,
+                    on_error=self.on_error,
+                )
+                # Validate the projection ONCE against the first readable
+                # schema, outside the skip policy: a misspelled columns=
+                # entry is a configuration error — under on_error="skip" it
+                # would otherwise quarantine every unit and deliver an
+                # empty dataset with no error.
+                if self.columns is not None:
+                    for fi, meta in enumerate(plan.metas):
+                        if meta is not None:
+                            with FileReader(
+                                plan.files[fi], columns=self.columns,
+                                metadata=meta,
+                            ):
+                                pass
+                            break
+                self._plan = plan
+            return self._plan
+
+    def _file_schema(self, file_index: int):
+        """The parsed Schema of one plan file (cached; footers come from
+        the plan, so each file's schema tree builds exactly once no matter
+        how many row groups stream from it)."""
+        s = self._schemas.get(file_index)
+        if s is None:
+            from ..core.schema import Schema
+
+            s = Schema.from_thrift(self.plan.metas[file_index].schema)
+            # benign race: two workers may build the same schema; last
+            # write wins and both values are equivalent
+            self._schemas[file_index] = s
+        return s
+
+    @property
+    def total_rows(self) -> int:
+        """Rows the footers promise across ALL shards (before any on_error
+        skipping at decode time)."""
+        return self.plan.total_rows
+
+    def epoch_order(self, epoch: int) -> list[int]:
+        """This shard's unit visit order for `epoch` (plan unit indices)."""
+        return self.plan.epoch_order(
+            epoch,
+            seed=self.seed,
+            shuffle=self.shuffle,
+            shard_index=self.shard_index,
+            shard_count=self.shard_count,
+        )
+
+    # -- prefetch pool ---------------------------------------------------------
+
+    def _worker_pool(self) -> ThreadPoolExecutor:
+        """The dataset's own bounded decode pool ("pqt-data", sized
+        min(prefetch, PQT_DATA_THREADS or cpu)). Deliberately SEPARATE from
+        the chunk-prepare pool: unit-level tasks that internally fan out
+        chunk work into the same pool they run in would deadlock once the
+        pool saturates."""
+        with self._plan_lock:
+            if self._closed:
+                raise RuntimeError("dataset: closed")
+            if self._pool is None:
+                env = os.environ.get("PQT_DATA_THREADS")
+                cap = int(env) if env else (os.cpu_count() or 1)
+                workers = max(1, min(self.prefetch, cap))
+                self._pool = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="pqt-data"
+                )
+            return self._pool
+
+    def close(self) -> None:
+        """Shut the prefetch pool down (idempotent). The dataset and its
+        iterators stop being usable: further iteration raises instead of
+        silently resurrecting an untracked worker pool."""
+        with self._plan_lock:
+            self._closed = True
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- iteration -------------------------------------------------------------
+
+    def __iter__(self) -> "DatasetIterator":
+        if self._closed:
+            raise RuntimeError("dataset: closed")
+        return DatasetIterator(self)
+
+    def iterator(self, state: dict | None = None) -> "DatasetIterator":
+        """A fresh iterator, optionally resumed from a state_dict()."""
+        it = iter(self)
+        if state is not None:
+            it.load_state_dict(state)
+        return it
+
+
+class DatasetIterator:
+    """One pass (or N epochs) over a ParquetDataset's shard of the plan.
+
+    Checkpointable: state_dict() captures (epoch, unit cursor, intra-unit
+    row offset) AS OF THE BATCHES ALREADY DELIVERED — load_state_dict() on a
+    fresh iterator reproduces the remaining batch stream byte-identically.
+    """
+
+    def __init__(self, dataset: ParquetDataset):
+        self._ds = dataset
+        self._epoch = 0
+        self._pos = 0  # epoch-order position of the next row to deliver
+        self._off = 0  # row offset within that unit
+        self._exhausted = False
+        self._started = False
+        self._dtypes: dict | None = None  # cross-file schema consistency
+        self._gen = None
+
+    # -- checkpointing ---------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """Resume point covering every batch already delivered."""
+        ds = self._ds
+        return {
+            "version": _STATE_VERSION,
+            "epoch": self._epoch,
+            "unit_pos": self._pos,
+            "row_offset": self._off,
+            "exhausted": self._exhausted,
+            "seed": ds.seed,
+            "shuffle": ds.shuffle,
+            "batch_size": ds.batch_size,
+            "remainder": ds.remainder,
+            "shard": [ds.shard_index, ds.shard_count],
+            "plan": ds.plan.fingerprint(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Position this (not-yet-started) iterator at a checkpoint.
+
+        The configuration a cursor's meaning depends on must match: the
+        epoch permutation (seed/shuffle), the stripe (shard), the batch
+        grid (batch_size/remainder) and the plan itself. Anything else
+        (prefetch depth, device, worker pool size) is free to differ —
+        it affects speed, never the stream."""
+        if self._started:
+            raise RuntimeError(
+                "dataset: load_state_dict on a started iterator (make a "
+                "fresh one)"
+            )
+        if state.get("version") != _STATE_VERSION:
+            raise ValueError(
+                f"dataset: unknown checkpoint version {state.get('version')!r}"
+            )
+        ds = self._ds
+        for key, ours in (
+            ("seed", ds.seed),
+            ("shuffle", ds.shuffle),
+            ("batch_size", ds.batch_size),
+            ("remainder", ds.remainder),
+            ("shard", [ds.shard_index, ds.shard_count]),
+            ("plan", ds.plan.fingerprint()),
+        ):
+            if state.get(key) != ours:
+                raise ValueError(
+                    f"dataset: checkpoint {key} mismatch "
+                    f"({state.get(key)!r} != {ours!r}); the cursor would "
+                    "not mean the same stream"
+                )
+        self._epoch = int(state["epoch"])
+        self._pos = int(state["unit_pos"])
+        self._off = int(state["row_offset"])
+        self._exhausted = bool(state.get("exhausted", False))
+
+    # -- iteration -------------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        if self._gen is None:
+            self._started = True
+            self._gen = self._stream()
+        try:
+            batch, state = next(self._gen)
+        except StopIteration:
+            self._exhausted = True
+            raise
+        # commit ONLY at delivery: with device put-pipelining, batches ahead
+        # of the consumer are in flight — a checkpoint must not cover them
+        self._epoch, self._pos, self._off = state
+        return batch
+
+    def close(self) -> None:
+        """Abandon the iterator: queued (not yet running) prefetch work is
+        cancelled; running unit decodes finish and are dropped."""
+        gen, self._gen = self._gen, None
+        self._exhausted = True
+        if gen is not None:
+            gen.close()
+
+    # -- internals -------------------------------------------------------------
+
+    def _stream(self):
+        """(batch, state-after-batch) pairs, device-put-pipelined when the
+        dataset is device-destined."""
+        gen = self._batches()
+        placement = self._ds.device
+        if placement is None:
+            yield from gen
+            return
+        from ..kernels.pipeline import device_put_pipelined
+
+        states: deque = deque()
+
+        def host_side():
+            for b, s in gen:
+                states.append(s)  # appended before the yield: stays aligned
+                yield b
+
+        for db in device_put_pipelined(
+            host_side(), placement=placement, depth=2,
+            stage_name="dataset.device_put",
+        ):
+            yield db, states.popleft()
+
+    def _batches(self):
+        ds = self._ds
+        B = ds.batch_size
+        epoch, pos, off = self._epoch, self._pos, self._off
+        while ds.num_epochs is None or epoch < ds.num_epochs:
+            order = ds.epoch_order(epoch)
+            pending: deque = deque()  # [upos, base, cols, consumed, n]
+            buffered = 0
+            for upos, base, cols, n in self._fetch_units(order, pos, off):
+                self._check_template(cols)
+                pending.append([upos, base, cols, 0, n])
+                buffered += n
+                while buffered >= B:
+                    batch, buffered, resume_pos, resume_off = self._emit(
+                        pending, buffered, B
+                    )
+                    yield batch, (epoch, resume_pos, resume_off)
+            if buffered and ds.remainder != "drop":
+                batch, _, _, _ = self._emit(pending, buffered, buffered)
+                if ds.remainder == "pad" and buffered < B:
+                    batch = {
+                        p: _pad_rows(a, B) for p, a in batch.items()
+                    }
+                yield batch, (epoch + 1, 0, 0)
+            epoch += 1
+            pos = 0
+            off = 0
+
+    def _emit(self, pending: deque, buffered: int, take: int):
+        """Assemble one `take`-row batch from the buffered spans; returns
+        (batch, remaining buffered rows, cursor pos, cursor off)."""
+        parts: dict[tuple, list] = {}
+        need = take
+        last_upos = -1
+        while need:
+            e = pending[0]
+            upos, base, cols, consumed, n = e
+            chunk = min(need, n - consumed)
+            for p, a in cols.items():
+                parts.setdefault(p, []).append(a[consumed : consumed + chunk])
+            e[3] = consumed + chunk
+            need -= chunk
+            last_upos = upos
+            if e[3] == n:
+                pending.popleft()
+        batch = {
+            p: (ps[0] if len(ps) == 1 else np.concatenate(ps))
+            for p, ps in parts.items()
+        }
+        if pending:
+            head = pending[0]
+            cursor = (head[0], head[1] + head[3])
+        else:
+            cursor = (last_upos + 1, 0)
+        _metrics.inc("dataset_batches_total")
+        _metrics.inc("dataset_rows_total", take)
+        return batch, buffered - take, cursor[0], cursor[1]
+
+    def _check_template(self, cols: dict) -> None:
+        """Cross-file consistency: every unit must deliver the same columns
+        with the same dtype/trailing shape, or concatenation would silently
+        upcast (or crash deep in numpy with no file context)."""
+        tmpl = {p: (a.dtype, a.shape[1:]) for p, a in cols.items()}
+        if self._dtypes is None:
+            self._dtypes = tmpl
+            return
+        if tmpl != self._dtypes:
+            raise ParquetFileError(
+                f"dataset: unit schema mismatch: {tmpl} != {self._dtypes} "
+                "(files in one dataset must agree on columns and types)"
+            )
+
+    # -- unit fetch (the bounded prefetch pipeline) ----------------------------
+
+    def _fetch_units(self, order: list[int], start_pos: int, start_off: int):
+        """Yield (order position, base row offset, column arrays, rows) for
+        every unit from start_pos on that delivers rows, in order, decoding
+        up to `prefetch` units ahead on the pqt-data pool."""
+        ds = self._ds
+        units = ds.plan.units
+        depth = ds.prefetch
+        if depth <= 0:
+            for k in range(start_pos, len(order)):
+                off = start_off if k == start_pos else 0
+                # the synchronous path waits for the WHOLE decode: record
+                # it, or wait_share would read 0% exactly when the consumer
+                # is 100% decode-bound (the tuning signal inverted)
+                with timed_stage("dataset.wait") as w:
+                    cols, n = self._load_unit(units[order[k]], off)
+                _metrics.observe("dataset_wait_seconds", w.seconds)
+                if cols is not None and n > 0:
+                    yield k, off, cols, n
+            return
+        pool = ds._worker_pool()
+        pending: deque = deque()
+        nxt = start_pos
+
+        def fill():
+            nonlocal nxt
+            added = 0
+            while nxt < len(order) and len(pending) < depth:
+                off = start_off if nxt == start_pos else 0
+                pending.append(
+                    (nxt, off, traced_submit(pool, self._load_unit,
+                                             units[order[nxt]], off))
+                )
+                nxt += 1
+                added += 1
+            if added:
+                _inflight_add(added)
+
+        fill()
+        try:
+            while pending:
+                k, off, fut = pending.popleft()
+                try:
+                    with timed_stage("dataset.wait") as w:
+                        cols, n = fut.result()
+                finally:
+                    _inflight_add(-1)  # popped units always leave the gauge
+                _metrics.observe("dataset_wait_seconds", w.seconds)
+                fill()
+                if cols is not None and n > 0:
+                    yield k, off, cols, n
+        finally:
+            if pending:
+                _inflight_add(-len(pending))
+            for _k, _o, fut in pending:
+                fut.cancel()
+
+    def _load_unit(self, unit, row_offset: int):
+        """Decode one (file, row group) into batchable column arrays,
+        sliced from `row_offset`. Runs on pqt-data worker threads (the trace
+        context arrives via traced_submit). Returns (None, 0) for a unit the
+        on_error policy dropped."""
+        ds = self._ds
+        with span(
+            "dataset.unit", {"file": unit.path, "group": unit.row_group}
+        ):
+            try:
+                reader = FileReader(
+                    unit.path,
+                    columns=ds.columns,
+                    metadata=ds.plan.metas[unit.file_index],
+                    schema=ds._file_schema(unit.file_index),
+                    validate_crc=ds.validate_crc,
+                    on_error=ds.on_error,
+                )
+            except PARQUET_ERRORS + (OSError,):
+                if ds.on_error == "raise":
+                    raise
+                bump("dataset_units_skipped")
+                return None, 0
+            try:
+                chunks = reader._read_row_group(unit.row_group, None, pack=False)
+                if not chunks:
+                    # quarantined by on_error (or empty selection)
+                    bump("dataset_units_skipped")
+                    return None, 0
+                cols = {
+                    p: self._batch_array(p, cd, reader.schema.column(p))
+                    for p, cd in chunks.items()
+                }
+            finally:
+                reader.close()
+        lens = {a.shape[0] for a in cols.values()}
+        if len(lens) != 1:
+            raise ParquetFileError(
+                f"dataset: columns disagree on row count in "
+                f"{unit.path} group {unit.row_group}: {sorted(lens)}"
+            )
+        n = lens.pop()
+        if row_offset:
+            if row_offset >= n:
+                return None, 0
+            cols = {p: a[row_offset:] for p, a in cols.items()}
+            n -= row_offset
+        return cols, n
+
+    def _batch_array(self, path, cd, leaf) -> np.ndarray:
+        """One decoded chunk -> a row-aligned numpy array (the host-side
+        analogue of iter_device_batches' _array_of)."""
+        name = ".".join(path)
+        if cd.rep_levels is not None or leaf.max_rep > 0:
+            raise ParquetFileError(
+                f"dataset: column {name} is repeated; its leaf slots are "
+                "not rows, so it cannot batch (project it out)"
+            )
+        values = cd.values
+        if isinstance(values, ByteArrayData):
+            raise ParquetFileError(
+                f"dataset: column {name} is a raw byte array with no fixed-"
+                "width batch form (project it out, or encode it as a "
+                "fixed-size or integer feature upstream)"
+            )
+        arr = np.asarray(values)
+        n = cd.num_values
+        if arr.shape[0] != n:  # nulls: values are dense non-null cells
+            if self._ds.nullable != "zero":
+                raise ParquetFileError(
+                    f"dataset: column {name} contains nulls; pass "
+                    'nullable="zero" to zero-fill them (or filter upstream)'
+                )
+            valid = np.asarray(cd.def_levels) == leaf.max_def
+            out = np.zeros((n,) + arr.shape[1:], dtype=arr.dtype)
+            out[valid] = arr
+            arr = out
+        return arr
+
+
+def _pad_rows(a, target: int):
+    """Zero-pad the leading axis to `target` rows (remainder="pad")."""
+    if a.shape[0] >= target:
+        return a
+    pad = np.zeros((target - a.shape[0],) + a.shape[1:], dtype=a.dtype)
+    return np.concatenate([a, pad])

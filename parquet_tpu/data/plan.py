@@ -1,0 +1,202 @@
+"""Scan planning for the streaming dataset: files -> sharded unit order.
+
+The plan layer is pure bookkeeping, deliberately separated from the
+prefetch/decode machinery in dataset.py so its determinism contracts are
+trivially testable:
+
+  * a ScanPlan is built from FOOTERS ONLY (FileReader.open_metadata — no
+    data pages touched), one work unit per (file, row group) with the
+    row count the footer promises;
+  * `filters` prune units at plan time through the reader's normal
+    statistics/bloom pruning — excluded row groups never enter the plan,
+    so they are never opened, decoded, or prefetched;
+  * `epoch_order(epoch)` derives each epoch's unit visit order from
+    (seed, epoch) alone — any process at any time recomputes the same
+    permutation, which is what makes mid-epoch checkpoint/resume and
+    multi-host sharding exact: the global order is permuted identically
+    everywhere, then striped across `shard_count * worker_count` slots so
+    every unit is visited by EXACTLY ONE (process, worker) per epoch.
+
+A corrupt file (unreadable footer) follows the dataset's on_error policy:
+"raise" propagates, otherwise the file's units are dropped from the plan
+(counted: dataset_files_skipped) and the scan degrades instead of dying.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import hashlib
+import os
+from pathlib import Path
+from typing import NamedTuple
+
+import numpy as np
+
+from ..core.reader import PARQUET_ERRORS, FileReader
+from ..utils.trace import bump
+
+__all__ = ["Unit", "ScanPlan", "expand_paths", "build_plan"]
+
+
+class Unit(NamedTuple):
+    """One schedulable work unit: a single row group of a single file."""
+
+    file_index: int  # index into ScanPlan.files
+    path: str
+    row_group: int
+    num_rows: int
+
+
+def expand_paths(paths_or_glob) -> list[str]:
+    """Resolve the dataset's input spec into a deterministic file list.
+
+    A string (or Path) is treated as a glob pattern when it contains magic
+    characters, otherwise as a single file; a list/tuple passes through.
+    The result is lexicographically sorted — glob order is filesystem-
+    dependent, and the shard/shuffle math needs every process to see the
+    SAME file indices."""
+    if isinstance(paths_or_glob, (str, Path)):
+        s = str(paths_or_glob)
+        if _glob.has_magic(s):
+            hits = _glob.glob(s)
+            if not hits:
+                raise FileNotFoundError(f"dataset: glob {s!r} matched no files")
+            return sorted(hits)
+        if not os.path.exists(s):
+            raise FileNotFoundError(f"dataset: no such file {s!r}")
+        return [s]
+    out = [str(p) for p in paths_or_glob]
+    if not out:
+        raise ValueError("dataset: empty path list")
+    return sorted(out)
+
+
+class ScanPlan:
+    """The global (pre-shard) work list of a dataset scan."""
+
+    def __init__(
+        self,
+        files: list[str],
+        metas: list,
+        units: list[Unit],
+        skipped_files: list[tuple[str, str]],
+    ):
+        self.files = files
+        # per-file FileMetaData (None for skipped files): per-unit readers
+        # open with metadata= so each footer parses exactly once
+        self.metas = metas
+        self.units = units
+        self.skipped_files = skipped_files
+
+    @property
+    def num_units(self) -> int:
+        return len(self.units)
+
+    @property
+    def total_rows(self) -> int:
+        return sum(u.num_rows for u in self.units)
+
+    def fingerprint(self) -> dict:
+        """What a checkpoint pins: a resumed iterator must see the same
+        unit list or its cursor means nothing. The digest covers every
+        unit's (file basename, row group, row count) — a renamed, reordered,
+        resharded or re-rowed file set is rejected at load_state_dict even
+        when the aggregate counts happen to match. Basenames, not full
+        paths: moving the whole dataset directory between runs is fine.
+        (File CONTENTS are not hashed — rewriting a shard in place with
+        identical name and row counts is undetectable.)"""
+        h = hashlib.sha1()
+        for u in self.units:
+            h.update(
+                f"{os.path.basename(u.path)}#{u.row_group}#{u.num_rows};".encode()
+            )
+        return {
+            "files": len(self.files),
+            "units": self.num_units,
+            "rows": self.total_rows,
+            "digest": h.hexdigest(),
+        }
+
+    def epoch_order(
+        self,
+        epoch: int,
+        *,
+        seed: int = 0,
+        shuffle: bool = False,
+        shard_index: int = 0,
+        shard_count: int = 1,
+    ) -> list[int]:
+        """This shard's unit visit order for `epoch` (indices into .units).
+
+        The permutation is a pure function of (seed, epoch) over the GLOBAL
+        unit list; every shard computes it identically and takes its
+        stride-slice, so the shards' slices partition the epoch exactly.
+        Without shuffle the order is the file-major plan order."""
+        if not 0 <= shard_index < shard_count:
+            raise ValueError(
+                f"dataset: shard_index {shard_index} out of range for "
+                f"shard_count {shard_count}"
+            )
+        n = self.num_units
+        if shuffle:
+            order = np.random.default_rng([seed, epoch]).permutation(n)
+        else:
+            order = np.arange(n)
+        return [int(i) for i in order[shard_index::shard_count]]
+
+
+def build_plan(paths_or_glob, *, filters=None, on_error: str = "raise") -> ScanPlan:
+    """Parse every file's footer and lay out the unit list.
+
+    `filters` (the (column, op, value) DNF convention shared with
+    FileReader) prunes row groups through the statistics/bloom path —
+    pruned groups never become units. With on_error != "raise" a file whose
+    footer (or schema/filter resolution) fails is skipped with a counter
+    instead of killing the scan."""
+    files = expand_paths(paths_or_glob)
+    metas: list = []
+    units: list[Unit] = []
+    skipped: list[tuple[str, str]] = []
+    filters_checked = filters is None
+    for fi, path in enumerate(files):
+        try:
+            meta = FileReader.open_metadata(path)
+        except PARQUET_ERRORS + (OSError,) as e:
+            if on_error == "raise":
+                raise
+            bump("dataset_files_skipped")
+            metas.append(None)
+            skipped.append((path, f"{type(e).__name__}: {e}"))
+            continue
+        if not filters_checked:
+            # Validate the filter ONCE against the first readable schema,
+            # OUTSIDE the skip policy: a misspelled filter column is a
+            # configuration error that would otherwise "skip" every file
+            # and silently plan an empty dataset.
+            from ..core.filter import normalize_dnf
+            from ..core.schema import Schema
+
+            normalize_dnf(Schema.from_thrift(meta.schema), filters)
+            filters_checked = True
+        try:
+            if filters is not None:
+                # statistics/bloom pruning needs a live reader (bloom pages
+                # read from the file); footer-only cost when no blooms exist
+                with FileReader(path, metadata=meta) as r:
+                    admitted = r.prune_row_groups(filters)
+            else:
+                admitted = range(len(meta.row_groups or []))
+        except PARQUET_ERRORS + (OSError,) as e:
+            # OSError: the file vanished (or lost read permission) between
+            # the glob and the open — same degradation policy as corruption
+            if on_error == "raise":
+                raise
+            bump("dataset_files_skipped")
+            metas.append(None)
+            skipped.append((path, f"{type(e).__name__}: {e}"))
+            continue
+        metas.append(meta)
+        groups = meta.row_groups or []
+        for gi in admitted:
+            units.append(Unit(fi, path, gi, int(groups[gi].num_rows or 0)))
+    return ScanPlan(files, metas, units, skipped)

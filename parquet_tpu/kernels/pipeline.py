@@ -37,6 +37,7 @@ int32 (device_ops.py); batches are split at MAX_DEVICE_BATCH_BITS.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import NamedTuple
 
@@ -70,10 +71,101 @@ __all__ = [
     "plan_chunk_tpu",
     "DeviceColumn",
     "TpuDecodeStats",
+    "dispatch_pool",
+    "device_put_pipelined",
 ]
 
 # Patchable in tests to force multi-batch splitting on small inputs.
 _BATCH_BITS_CAP = MAX_DEVICE_BATCH_BITS
+
+
+# -- the dispatch thread -------------------------------------------------------
+#
+# One process-wide single-thread executor owns device dispatch (uploads +
+# kernel launches). It lives HERE — next to the device pipeline it feeds —
+# and is shared by every consumer (FileReader's chunk plans, the dataset
+# layer's batch uploads): jax calls stay serialized in deterministic order
+# while their RPC latency overlaps host-side work on other threads.
+
+_dispatcher = None
+_dispatcher_lock = threading.Lock()
+
+
+def dispatch_pool():
+    """The process-wide single-thread device-dispatch executor."""
+    global _dispatcher
+    from concurrent.futures import ThreadPoolExecutor
+
+    with _dispatcher_lock:
+        if _dispatcher is None:
+            _dispatcher = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="pqt-dispatch"
+            )
+        return _dispatcher
+
+
+def device_put_pipelined(
+    batches, placement=None, depth: int = 2, stage_name: str = "device_put"
+):
+    """Yield device-resident copies of host pytrees, keeping up to `depth`
+    transfers in flight ahead of the consumer (depth 2 = classic double
+    buffering: while the consumer works on batch k, batch k+1's upload is
+    already running on the dispatch thread).
+
+    `placement` is anything jax.device_put accepts — a jax.Device, a
+    Sharding laying each batch over a mesh, or None for the process default.
+    Order is preserved; an exception from `batches` or from a transfer
+    surfaces at the yield that would have produced that batch. Each upload
+    runs under a `stage_name` stage (traced_submit carries the caller's
+    active decode_trace onto the dispatch thread)."""
+    from collections import deque
+
+    from ..utils.trace import stage as _stage, traced_submit
+
+    if depth <= 0:
+        for b in batches:
+            # upload INSIDE the stage, yield OUTSIDE it: a yield under the
+            # context would bill arbitrary consumer time to the transfer
+            with _stage(stage_name):
+                out = jax.device_put(b, placement)
+            yield out
+        return
+
+    def put(b):
+        with _stage(stage_name):
+            return jax.device_put(b, placement)
+
+    pool = dispatch_pool()
+    it = iter(batches)
+    pending = deque()
+    source_err = None
+
+    def fill():
+        # A source failure is DEFERRED, not raised here: batches already
+        # decoded and uploaded must still reach the consumer, and the error
+        # must surface at the stream position where the source actually
+        # failed — raising mid-fill would drop up to `depth` in-flight
+        # batches and misattribute the failure (docstring contract).
+        nonlocal source_err
+        if source_err is not None:
+            return
+        while len(pending) < depth:
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            except BaseException as e:  # noqa: BLE001 — re-raised in order
+                source_err = e
+                return
+            pending.append(traced_submit(pool, put, b))
+
+    fill()
+    while pending:
+        fut = pending.popleft()
+        fill()
+        yield fut.result()
+    if source_err is not None:
+        raise source_err
 
 
 def _bucket(n: int, floor: int = 1024) -> int:

@@ -31,6 +31,12 @@ Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as
 `<name>_count` / `<name>_sum` / `<name>_min` / `<name>_max`; min/max are
 not monotonic, so `delta()` skips them.
+
+Three kinds: counters (`inc`, monotonic), histograms (`observe`), and gauges
+(`set` / module-level `set_gauge` — a last-written level such as the
+dataset prefetch queue depth). Gauges snapshot at their current value and
+expose as `# TYPE ... gauge`; like histogram min/max they are not
+monotonic, so `delta()` skips them.
 """
 
 from __future__ import annotations
@@ -43,6 +49,7 @@ __all__ = [
     "REGISTRY",
     "inc",
     "observe",
+    "set_gauge",
     "get",
     "snapshot",
     "delta",
@@ -98,6 +105,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: dict[tuple[str, tuple], int | float] = {}
         self._hists: dict[tuple[str, tuple], _Hist] = {}
+        self._gauges: dict[tuple[str, tuple], int | float] = {}
+        # family names that are gauges: delta() must skip them (a gauge
+        # difference is as meaningless as a histogram min/max difference)
+        self._gauge_names: set[str] = set()
 
     # -- write side ------------------------------------------------------------
 
@@ -105,6 +116,14 @@ class MetricsRegistry:
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + n
+
+    def set(self, name: str, value, **labels) -> None:
+        """Set a gauge to its current level (last write wins) — for
+        non-monotonic quantities like queue depths or in-flight counts."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._gauges[key] = value
+            self._gauge_names.add(name)
 
     def observe(self, name: str, value: float, **labels) -> None:
         key = (name, tuple(sorted(labels.items())))
@@ -117,16 +136,20 @@ class MetricsRegistry:
     # -- read side -------------------------------------------------------------
 
     def get(self, name: str, **labels):
-        """Current value of one counter (0 when never incremented)."""
+        """Current value of one counter or gauge (0 when never written)."""
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
+            if key in self._gauges:
+                return self._gauges[key]
             return self._counters.get(key, 0)
 
     def snapshot(self) -> dict:
-        """Flat {sample key: value} of every counter and histogram."""
+        """Flat {sample key: value} of every counter, gauge and histogram."""
         out = {}
         with self._lock:
             for (name, labels), v in self._counters.items():
+                out[_key(name, dict(labels))] = v
+            for (name, labels), v in self._gauges.items():
                 out[_key(name, dict(labels))] = v
             for (name, labels), h in self._hists.items():
                 ld = dict(labels)
@@ -139,13 +162,17 @@ class MetricsRegistry:
 
     def delta(self, previous: dict) -> dict:
         """What changed since `previous` (a snapshot()): {key: now - then},
-        zero-diff keys omitted. Histogram _min/_max are skipped — they are
-        not monotonic, so their difference is meaningless."""
+        zero-diff keys omitted. Histogram _min/_max and gauges are skipped —
+        they are not monotonic, so their difference is meaningless."""
         now = self.snapshot()
+        with self._lock:
+            gauge_names = set(self._gauge_names)
         out = {}
         for k, v in now.items():
             base = k.split("{", 1)[0]
             if base.endswith("_min") or base.endswith("_max"):
+                continue
+            if base in gauge_names:
                 continue
             d = v - previous.get(k, 0)
             if d:
@@ -157,12 +184,18 @@ class MetricsRegistry:
         lines = []
         with self._lock:
             counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
             hists = sorted(self._hists.items())
         seen_types = set()
         for (name, labels), v in counters:
             if name not in seen_types:
                 seen_types.add(name)
                 lines.append(f"# TYPE {_PREFIX}{name} counter")
+            lines.append(f"{_PREFIX}{_key(name, dict(labels))} {v}")
+        for (name, labels), v in gauges:
+            if name not in seen_types:
+                seen_types.add(name)
+                lines.append(f"# TYPE {_PREFIX}{name} gauge")
             lines.append(f"{_PREFIX}{_key(name, dict(labels))} {v}")
         for (name, labels), h in hists:
             if name not in seen_types:
@@ -188,6 +221,8 @@ class MetricsRegistry:
         with self._lock:
             self._counters.clear()
             self._hists.clear()
+            self._gauges.clear()
+            self._gauge_names.clear()
 
 
 REGISTRY = MetricsRegistry()
@@ -201,6 +236,10 @@ def inc(name: str, n=1, **labels) -> None:
 
 def observe(name: str, value: float, **labels) -> None:
     REGISTRY.observe(name, value, **labels)
+
+
+def set_gauge(name: str, value, **labels) -> None:
+    REGISTRY.set(name, value, **labels)
 
 
 def get(name: str, **labels):

@@ -45,6 +45,7 @@ from . import metrics as _metrics
 __all__ = [
     "decode_trace",
     "stage",
+    "timed_stage",
     "span",
     "add_bytes",
     "add_seconds",
@@ -274,6 +275,41 @@ def stage(name: str, nbytes: int = 0, record_span: bool = True):
             start_ns=t0 if record_span else None,
             dur_ns=dt,
         )
+
+
+class _Elapsed:
+    """Result holder for timed_stage(): .seconds is valid after the block."""
+
+    __slots__ = ("seconds",)
+
+    def __init__(self):
+        self.seconds = 0.0
+
+
+@contextmanager
+def timed_stage(name: str, nbytes: int = 0, record_span: bool = True):
+    """Like stage(), but ALWAYS measures: yields a holder whose `.seconds`
+    is the block's wall time even when no trace is active. For callers that
+    feed an always-on metric (e.g. the dataset's wait-time histogram) from
+    the same clock read that bills the trace stage — one perf_counter pair,
+    two consumers, no skew between what the trace and the registry report."""
+    t = _active_var.get()
+    out = _Elapsed()
+    t0 = time.perf_counter_ns()
+    try:
+        yield out
+    finally:
+        dt = time.perf_counter_ns() - t0
+        out.seconds = dt / 1e9
+        if t is not None:
+            t._commit(
+                name,
+                out.seconds,
+                nbytes,
+                1,
+                start_ns=t0 if record_span else None,
+                dur_ns=dt,
+            )
 
 
 @contextmanager

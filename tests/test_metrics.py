@@ -142,6 +142,34 @@ class TestPrometheus:
         assert "parquet_tpu_pqt_test_buckets_count 2" in text
 
 
+class TestGauges:
+    def test_set_last_write_wins(self):
+        metrics.set_gauge("pqt_test_gauge", 3)
+        metrics.set_gauge("pqt_test_gauge", 1)
+        assert metrics.get("pqt_test_gauge") == 1
+        assert metrics.snapshot()["pqt_test_gauge"] == 1
+
+    def test_labeled_gauges_are_independent(self):
+        metrics.set_gauge("pqt_test_gauge_lbl", 2, lane="a")
+        metrics.set_gauge("pqt_test_gauge_lbl", 5, lane="b")
+        assert metrics.get("pqt_test_gauge_lbl", lane="a") == 2
+        assert metrics.get("pqt_test_gauge_lbl", lane="b") == 5
+
+    def test_exposition_declares_gauge_type(self):
+        metrics.set_gauge("pqt_test_gauge_expo", 7)
+        text = metrics.render_prometheus()
+        assert "# TYPE parquet_tpu_pqt_test_gauge_expo gauge" in text
+        assert "parquet_tpu_pqt_test_gauge_expo 7" in text
+
+    def test_delta_skips_gauges(self):
+        snap = metrics.snapshot()
+        metrics.set_gauge("pqt_test_gauge_delta", 42)
+        metrics.inc("pqt_test_gauge_sibling_counter")
+        d = metrics.delta(snap)
+        assert "pqt_test_gauge_delta" not in d  # non-monotonic: no diff
+        assert d.get("pqt_test_gauge_sibling_counter") == 1
+
+
 class TestReportAndSummary:
     def test_human_report(self, sample):
         with FileReader(sample) as r:

@@ -450,6 +450,89 @@ class TestProfile:
         assert "ratio=" in line
 
 
+class TestScan:
+    @pytest.fixture
+    def shards(self, tmp_path):
+        import numpy as np
+        import pyarrow as pa
+
+        rng = np.random.default_rng(0)
+        for i in range(3):
+            t = pa.table(
+                {
+                    "a": pa.array(rng.integers(0, 100, 500).astype(np.int64)),
+                    "b": pa.array(rng.standard_normal(500).astype(np.float32)),
+                }
+            )
+            pq.write_table(
+                t, tmp_path / f"s-{i}.parquet", row_group_size=200
+            )
+        return str(tmp_path / "s-*.parquet")
+
+    def test_scan_reports_rows_and_wait_share(self, shards, capsys):
+        assert tool_main(
+            ["scan", shards, "--batch-size", "256", "--json"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "3 files" in out and "1,500 rows" in out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["rows"] == 1500
+        assert doc["files"] == 3
+        assert doc["rows_s"] > 0
+        assert 0 <= doc["wait_share"] <= 1
+        assert doc["units_skipped"] == 0
+
+    def test_scan_on_error_skip_degrades(self, shards, tmp_path, capsys):
+        (tmp_path / "s-zz.parquet").write_bytes(b"PAR1junkPAR1")
+        pattern = str(tmp_path / "s-*.parquet")
+        # default raise: the corrupt footer fails the scan (ParquetFileError
+        # is a ValueError, so the CLI trap turns it into exit 1)
+        assert tool_main(["scan", pattern, "--batch-size", "256"]) == 1
+        assert "invalid footer" in capsys.readouterr().err
+        assert tool_main(
+            ["scan", pattern, "--batch-size", "256", "--on-error", "skip",
+             "--json"]
+        ) == 0
+        captured = capsys.readouterr()
+        assert "skipped" in captured.err
+        doc = json.loads(captured.out.strip().splitlines()[-1])
+        assert doc["rows"] == 1500
+
+    def test_scan_projection_and_prefetch_zero(self, shards, capsys):
+        assert tool_main(
+            ["scan", shards, "--columns", "a", "--prefetch", "0",
+             "--batch-size", "512", "--json"]
+        ) == 0
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert doc["rows"] == 1500 and doc["prefetch"] == 0
+        # the synchronous path still measures its decode waits
+        assert doc["wait_s"] > 0 and doc["wait_share"] > 0
+
+    def test_scan_nullable_data_by_default(self, tmp_path, capsys):
+        import numpy as np
+        import pyarrow as pa
+
+        rng = np.random.default_rng(0)
+        t = pa.table({
+            "a": pa.array(rng.standard_normal(400),
+                          mask=rng.random(400) < 0.3),
+        })
+        pq.write_table(t, tmp_path / "n.parquet", row_group_size=200)
+        # default --nullable zero: a throughput scan survives nullable data
+        assert tool_main(
+            ["scan", str(tmp_path / "n.parquet"), "--batch-size", "128",
+             "--json"]
+        ) == 0
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert doc["rows"] == 400
+        # explicit --nullable error keeps the strict behavior
+        assert tool_main(
+            ["scan", str(tmp_path / "n.parquet"), "--batch-size", "128",
+             "--nullable", "error"]
+        ) == 1
+        assert "nulls" in capsys.readouterr().err
+
+
 class TestBenchJson:
     def test_bench_json_round_trips(self, tmp_path):
         """`bench.py --phase prepare --json out.json` writes the structured
