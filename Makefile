@@ -1,13 +1,21 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
-# .github/workflows/ci.yml (ruff runs there; this image has no linter, so the
-# syntax gate is compileall).
+# .github/workflows/ci.yml.
 
-.PHONY: check test native bench bench-prepare bench-dataset dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
-check: native
-	python -m compileall -q parquet_tpu tests bench.py __graft_entry__.py
+check: native lint
 	python -m pytest tests/ -q -m 'not slow'
+
+# ruff (config in ruff.toml) when installed; images without it fall back to
+# the compileall syntax gate so `make check` stays runnable everywhere
+lint:
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check parquet_tpu/ tests/ bench.py; \
+	else \
+		echo "lint: ruff not installed; running compileall syntax gate instead"; \
+		python -m compileall -q parquet_tpu tests bench.py __graft_entry__.py; \
+	fi
 
 test:
 	python -m pytest tests/ -q -m 'not slow'
@@ -27,6 +35,11 @@ bench-prepare: native
 # prefetch-depth sweep (rows/s + wait-time share); host-only, no accelerator
 bench-dataset: native
 	python bench.py --dataset
+
+# io-layer bench: coalesce-gap + readahead-depth sweeps against a
+# latency-injected FlakySource (the object-store shape); host-only
+bench-io: native
+	python bench.py --io
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -32,7 +32,16 @@ PQT_BENCH_MATRIX=0 to skip the BASELINE.md 5-config matrix (on by default),
 PQT_MATRIX_ROWS (default 1_000_000) rows per matrix config,
 PQT_DATASET_ROWS / PQT_DATASET_FILES (default 2_000_000 over 8 files) and
 PQT_DATASET_STEP_MS (default 2) for the `--dataset` loader benchmark,
-PQT_BENCH_DATASET=0 to skip it in a full run.
+PQT_BENCH_DATASET=0 to skip it in a full run. PQT_IO_ROWS (default 400_000)
+and PQT_IO_LAT_MS (default 0.3) shape the `--io` io-layer sweep;
+PQT_BENCH_IO=0 skips it in a full run.
+
+`--io` benchmarks the io layer (parquet_tpu.io) against a latency-injected
+FlakySource (every read pays a simulated range-GET latency plus a transient
+EIO rate absorbed by the retry ladder): a coalesce-gap sweep (0 / 64 KiB /
+1 MiB) over a gappy 4-of-8-column projection, then a readahead-depth sweep
+(0/2/4 row groups prefetched into a shared block cache on the pqt-io pool).
+The result rides the --json artifact under "io".
 
 `--dataset` benchmarks the streaming loader (parquet_tpu.data) end to end
 over a multi-file glob: rows/s through ParquetDataset at a sweep of prefetch
@@ -682,6 +691,185 @@ def _phase_prepare() -> None:
     _emit(out)
 
 
+# -- the IO-layer benchmark (--io / phase "io") --------------------------------
+
+IO_ROWS = int(os.environ.get("PQT_IO_ROWS", 400_000))
+IO_LAT_MS = float(os.environ.get("PQT_IO_LAT_MS", "0.3"))
+
+
+def _io_file() -> Path:
+    """An 8-column fixture for the io sweeps: wide enough that a projected
+    read leaves real gaps between selected chunks (what coalescing has to
+    decide about) and several row groups so readahead has a pipeline."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = Path(f"/tmp/pqt_io_{IO_ROWS}.parquet")
+    if not path.exists():
+        rng = np.random.default_rng(11)
+        log(f"bench: generating {IO_ROWS:,}-row 8-column io fixture at {path}")
+        t = pa.table(
+            {
+                f"c{k}": pa.array(
+                    rng.integers(0, 1 << 40, IO_ROWS).astype(np.int64)
+                )
+                for k in range(8)
+            }
+        )
+        pq.write_table(
+            t, path, compression="snappy", row_group_size=1 << 16,
+            use_dictionary=False,
+        )
+    return path
+
+
+def _phase_io() -> None:
+    """IO-layer sweeps against a latency-injected flaky source.
+
+    Models an object-store read: every source read pays PQT_IO_LAT_MS of
+    injected latency (the range-GET shape) plus a small transient-EIO rate
+    the retry ladder must absorb. Sweep 1 holds the projection fixed
+    (4 of 8 columns — real gaps between selected chunks) and sweeps the
+    coalesce gap 0 / 64 KiB / 1 MiB: wall time falls as read calls merge.
+    Sweep 2 fixes the gap and sweeps readahead depth 0/2/4 row groups via
+    the pqt-io scheduler fetching into a shared block cache ahead of
+    decode. Host-only; the result rides the --json artifact as "io"."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from parquet_tpu.core.reader import FileReader
+    from parquet_tpu.io import (
+        BlockCache,
+        LocalFileSource,
+        Readahead,
+        RetryingSource,
+        plan_ranges,
+    )
+    from parquet_tpu.testing.flaky import FlakySource
+    from parquet_tpu.utils import metrics
+
+    path = _io_file()
+    cols = [f"c{k}" for k in range(0, 8, 2)]  # 4-of-8 projection: gappy
+    lat_s = IO_LAT_MS / 1e3
+
+    def flaky(seed=3):
+        return RetryingSource(
+            FlakySource(
+                LocalFileSource(path), seed=seed, error_rate=0.02,
+                latency_s=lat_s,
+            ),
+            attempts=5,
+            base_delay_s=0.001,
+            max_delay_s=0.01,
+            seed=seed,
+        )
+
+    def read_all(gap, cache_bytes=0, readahead_depth=0):
+        # a FRESH cache per run: a warm cache across repeats would measure
+        # memory hits, not the readahead overlap under test
+        cache = BlockCache(cache_bytes) if cache_bytes else None
+        src = flaky()
+        try:
+            with FileReader(
+                src, columns=cols, block_cache=cache, coalesce_gap=gap
+            ) as r:
+                ra = None
+                ra_srcs = []
+                if readahead_depth and cache is not None:
+                    ra = Readahead(cache, gap=gap)
+                    paths = {tuple(c.split(".")) for c in cols}
+                    spans = [
+                        plan_ranges(
+                            r.metadata, row_groups=[g], columns=paths
+                        )
+                        for g in range(r.num_row_groups)
+                    ]
+                rows = 0
+                scheduled = set()
+                for g in range(r.num_row_groups):
+                    if ra is not None:
+                        for j in range(g + 1, min(g + 1 + readahead_depth,
+                                                  r.num_row_groups)):
+                            if j in scheduled:
+                                continue
+                            scheduled.add(j)
+                            # one PRIVATE source per scheduled fetch: the
+                            # seeded fault/latency rngs are not thread-safe,
+                            # so sharing `src` with pqt-io workers would make
+                            # the schedule racy and the sweep irreproducible
+                            s2 = flaky(seed=100 + j)
+                            ra_srcs.append(s2)
+                            ra.schedule(s2, spans[j])
+                    cols_g = r.read_row_group(g)
+                    rows += next(iter(cols_g.values())).num_values
+                if ra is not None:
+                    ra.drain()
+                for s2 in ra_srcs:
+                    s2.close()
+                return rows
+        finally:
+            src.close()
+
+    out = {
+        "config": "io",
+        "rows": IO_ROWS,
+        "file_mb": round(path.stat().st_size / 1e6, 2),
+        "projection": cols,
+        "latency_ms_per_read": IO_LAT_MS,
+        "stat": "median",
+    }
+    gap_sweep = {}
+    for gap in (0, 64 << 10, 1 << 20):
+        s0 = metrics.snapshot()
+        t = timed_stats(
+            lambda g=gap: read_all(g), REPEATS, f"io gap={gap}", rows=IO_ROWS
+        )
+        d = metrics.delta(s0)
+        gap_sweep[str(gap)] = {
+            "t": t["t"],
+            "rows_s": round(IO_ROWS / t["t"], 1),
+            "read_calls": d.get("io_read_calls_total", 0) // REPEATS,
+            "bytes_read": d.get("io_bytes_read_total", 0) // REPEATS,
+            "retries": sum(
+                v for k, v in d.items() if k.startswith("io_retries_total")
+            ),
+            "samples_s": t["samples"],
+        }
+    out["gap_sweep"] = gap_sweep
+    ra_sweep = {}
+    for depth in (0, 2, 4):
+        s0 = metrics.snapshot()
+        t = timed_stats(
+            lambda d=depth: read_all(64 << 10, cache_bytes=256 << 20,
+                                     readahead_depth=d),
+            REPEATS, f"io readahead={depth}", rows=IO_ROWS,
+        )
+        d = metrics.delta(s0)
+        hits = d.get("io_cache_hits_total", 0)
+        misses = d.get("io_cache_misses_total", 0)
+        ra_sweep[str(depth)] = {
+            "t": t["t"],
+            "rows_s": round(IO_ROWS / t["t"], 1),
+            "cache_hit_rate": (
+                round(hits / (hits + misses), 4) if hits + misses else None
+            ),
+            "samples_s": t["samples"],
+        }
+    out["readahead_sweep"] = ra_sweep
+    best_gap = min(gap_sweep, key=lambda k: gap_sweep[k]["t"])
+    out["best_gap"] = int(best_gap)
+    out["gap_speedup"] = round(
+        gap_sweep["0"]["t"] / gap_sweep[best_gap]["t"], 3
+    )
+    log(
+        f"bench: io gap sweep best={best_gap} "
+        f"({out['gap_speedup']:.2f}x over gap 0); readahead "
+        + ", ".join(
+            f"d{k}={v['rows_s'] / 1e6:.2f}M rows/s"
+            for k, v in ra_sweep.items()
+        )
+    )
+    _emit(out)
+
+
 # -- the streaming-loader benchmark (--dataset / phase "dataset") -------------
 
 DATASET_ROWS = int(os.environ.get("PQT_DATASET_ROWS", 2_000_000))
@@ -924,6 +1112,17 @@ def main() -> None:
                 f"({r_ds['vs_depth0']:.2f}x over depth 0)"
             )
 
+    # io-layer sweeps (PQT_BENCH_IO=0 to skip): coalesce gap + readahead
+    # depth against a latency-injected flaky source
+    r_io = None
+    if os.environ.get("PQT_BENCH_IO", "1") != "0":
+        r_io = _run_phase("io")
+        if r_io:
+            log(
+                f"bench: io coalesce best gap {r_io['best_gap']} "
+                f"({r_io['gap_speedup']:.2f}x over gap 0)"
+            )
+
     # BASELINE.md 5-config matrix (per-config JSON on stderr + BENCH_MATRIX.json)
     results = None
     if os.environ.get("PQT_BENCH_MATRIX", "1") != "0":
@@ -1005,6 +1204,8 @@ def main() -> None:
         artifact["prepare"] = r_prep
     if r_ds:
         artifact["dataset"] = r_ds
+    if r_io:
+        artifact["io"] = r_io
     if results is not None:
         artifact["matrix"] = results
     _write_artifact(artifact)
@@ -1044,6 +1245,8 @@ if __name__ == "__main__":
         del argv[k : k + 2]
     if argv and argv[0] == "--dataset":
         _phase_dataset()
+    elif argv and argv[0] == "--io":
+        _phase_io()
     elif len(argv) >= 2 and argv[0] == "--phase":
         name = argv[1]
         if name.startswith("matrix"):
@@ -1056,6 +1259,8 @@ if __name__ == "__main__":
             _phase_prepare()
         elif name == "dataset":
             _phase_dataset()
+        elif name == "io":
+            _phase_io()
         else:
             _phase_timed(name, build_file())
     else:

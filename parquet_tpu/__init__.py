@@ -29,6 +29,9 @@ Layout:
   ops/       host (NumPy-vectorized) encoders/decoders — the correctness oracle
   kernels/   device (JAX/XLA + Pallas) decode ops + the batched page pipeline
   core/      pages, chunks, column stores, schema tree, FileReader/FileWriter
+  io/        pluggable byte sources (lock-free local pread, in-memory,
+             retrying remote-shaped), footer-driven range planning with
+             coalescing + readahead, block/footer caches
   data/      streaming dataset: sharded/shuffled multi-file plans, bounded
              prefetch, fixed-size rebatching, mid-epoch checkpoint/resume
   schema/    textual schema DSL (parser/printer/validator) + builder API
@@ -71,6 +74,15 @@ from .schema.dsl import (  # noqa: F401
 from .schema import builder  # noqa: F401
 from . import floor  # noqa: F401
 from .data import ParquetDataset  # noqa: F401  (host-only at import; jax lazy)
+from .io import (  # noqa: F401
+    BlockCache,
+    ByteSource,
+    FooterCache,
+    LocalFileSource,
+    MemorySource,
+    RetryingSource,
+    SourceError,
+)
 
 
 def __getattr__(name):

@@ -15,7 +15,6 @@ WithDecoderBackend(TPU) of the north star.
 from __future__ import annotations
 
 import gc
-import io
 import itertools
 import os
 import threading
@@ -27,6 +26,8 @@ import numpy as np
 from pathlib import Path
 from typing import NamedTuple
 
+from ..io.planner import DEFAULT_COALESCE_GAP, fetch_ranges
+from ..io.source import SourceFile, open_source
 from ..meta.file_meta import ParquetFileError, read_file_metadata
 from ..meta.parquet_types import FileMetaData, RowGroup
 from .alloc import AllocTracker
@@ -302,18 +303,44 @@ class FileReader:
         compact_levels: bool = False,
         device=None,
         on_error: str = "raise",
+        block_cache=None,
+        footer_cache=None,
+        coalesce_gap: int | None = None,
     ):
-        if isinstance(source, (str, Path)):
-            self._f = open(source, "rb")
-            self._owns_file = True
-        else:
-            self._f = source
-            self._owns_file = False
-        self._f_lock = threading.Lock()
+        # Every byte this reader touches flows through a ByteSource
+        # (parquet_tpu.io.source): str/Path opens a lock-free pread-backed
+        # LocalFileSource, a ByteSource (e.g. a RetryingSource over a remote
+        # store) passes through, bytes/BytesIO/file-likes adapt. self._f is
+        # a per-reader SourceFile cursor for the stream-shaped page walks.
+        self._source, self._owns_file = open_source(source)
+        self._f = SourceFile(self._source)
+        # block_cache: a shared io.cache.BlockCache chunk/range reads check
+        # before touching the source (the dataset layer passes one so
+        # readahead and repeated epochs hit memory). footer_cache: an
+        # io.cache.FooterCache consulted/filled for path sources, so a
+        # re-opened file parses its footer zero times.
+        self._block_cache = block_cache
+        self._coalesce_gap = (
+            DEFAULT_COALESCE_GAP if coalesce_gap is None else int(coalesce_gap)
+        )
         try:
-            self.metadata = (
-                metadata if metadata is not None else read_file_metadata(self._f)
-            )
+            if metadata is not None:
+                self.metadata = metadata
+            else:
+                path_key = (
+                    str(source) if isinstance(source, (str, Path)) else None
+                )
+                cached = (
+                    footer_cache.get(path_key)
+                    if footer_cache is not None and path_key is not None
+                    else None
+                )
+                if cached is not None:
+                    self.metadata = cached
+                else:
+                    self.metadata = read_file_metadata(self._f)
+                    if footer_cache is not None and path_key is not None:
+                        footer_cache.put(path_key, self.metadata)
             # schema=: a pre-built Schema for this metadata (high-churn
             # callers like the dataset layer open one reader per row group;
             # rebuilding the schema tree from thrift every open is waste)
@@ -362,7 +389,7 @@ class FileReader:
             self._selected = self._resolve_columns(columns)
         except BaseException:
             if self._owns_file:
-                self._f.close()
+                self._source.close()
             raise
 
     # -- properties ------------------------------------------------------------
@@ -486,10 +513,15 @@ class FileReader:
                     raise _GroupQuarantined() from e
             else:
                 out = {}
-                for path, cc, column in self._selected_chunks(i, columns):
+                selected = list(self._selected_chunks(i, columns))
+                # batched range fetch (coalesced, cache-aware); None falls
+                # back to streaming page-by-page through the shared cursor
+                windows = self._chunk_windows(selected)
+                for path, cc, column in selected:
+                    f = windows[path] if windows is not None else self._f
                     try:
                         out[path] = read_chunk(
-                            self._f,
+                            f,
                             cc,
                             column,
                             validate_crc=self.validate_crc,
@@ -912,7 +944,7 @@ class FileReader:
         def prep(path, cc, column):
             with span("chunk.prepare", {"column": ".".join(path)}):
                 offset, total = chunk_byte_range(cc)
-                win = ChunkWindow(self._pread(offset, total), offset)
+                win = ChunkWindow(self._fetch_chunk(offset, total), offset)
                 return prepare_chunk_plan(
                     win, cc, column, validate_crc=self.validate_crc, alloc=self.alloc
                 )
@@ -982,26 +1014,67 @@ class FileReader:
         }
 
     def _pread(self, offset: int, size: int) -> bytes:
-        """Positional read that never moves the shared file cursor."""
-        try:
-            fd = self._f.fileno()
-        except (AttributeError, OSError, io.UnsupportedOperation):
-            fd = None
-        pread = getattr(os, "pread", None)  # POSIX-only
-        if fd is not None and pread is not None:
+        """Positional read through the reader's ByteSource — os.pread on
+        local files, so there is no shared cursor, no lock, and no position
+        save/restore. Clamps at EOF (short return, like a plain handle):
+        truncated files surface as the decode ladder's typed errors, not a
+        raw source exception."""
+        end = self._source.size()
+        if offset >= end or offset < 0 or size <= 0:
+            return b""
+        return self._source.read_at(offset, min(size, end - offset))
+
+    def _fetch_chunk(self, offset: int, size: int):
+        """One chunk's page bytes, through the block cache when attached.
+        Out-of-bounds or degenerate ranges (truncated/lying files) bypass
+        the cache and return short via _pread so corruption keeps its typed
+        decode error."""
+        if size <= 0 or offset < 0 or offset + size > self._source.size():
+            return self._pread(offset, size)
+        if self._block_cache is None:
+            return self._source.read_at(offset, size)
+        return fetch_ranges(
+            self._source,
+            [(offset, size)],
+            cache=self._block_cache,
+            gap=0,
+        )[(offset, size)]
+
+    def _chunk_windows(self, selected) -> "dict | None":
+        """Planner-driven batched fetch of the selected chunks' byte ranges:
+        exact extents from the footer, neighbors coalesced (io.coalesce)
+        into batched source reads (io.read), each chunk handed back as a
+        preloaded ChunkWindow. Returns None when the planner path does not
+        apply — memory-ceiling readers (preloading a whole group would
+        charge every page at once) and chunks whose metadata ranges are
+        unusable or out of bounds (the streaming walk raises the precise
+        typed error there)."""
+        if self.alloc is not None or not selected:
+            return None
+        from .chunk import ChunkWindow, chunk_byte_range
+
+        ranges = {}
+        end = self._source.size()
+        for path, cc, _col in selected:
             try:
-                buf = pread(fd, size, offset)
-                if len(buf) == size:
-                    return buf
-            except OSError:
-                pass
-        with self._f_lock:
-            pos = self._f.tell()
-            try:
-                self._f.seek(offset)
-                return self._f.read(size)
-            finally:
-                self._f.seek(pos)
+                off, total = chunk_byte_range(cc)
+            except ChunkError:
+                return None
+            # total == 0 included: coalesce() drops empty ranges, so the
+            # fetch would come back without the key — the streaming walk
+            # instead raises the exact typed value-count error
+            if off < 0 or total <= 0 or off + total > end:
+                return None
+            ranges[path] = (off, total)
+        fetched = fetch_ranges(
+            self._source,
+            list(ranges.values()),
+            cache=self._block_cache,
+            gap=self._coalesce_gap,
+        )
+        return {
+            path: ChunkWindow(fetched[r], r[0]) for path, r in ranges.items()
+        }
 
     def _selected_chunks(self, i: int, columns=None):
         """Yield (path, ColumnChunk, Column) for the selected leaves of group i."""
@@ -1872,14 +1945,26 @@ class FileReader:
     # -- lifecycle -------------------------------------------------------------
 
     @classmethod
-    def open_metadata(cls, path) -> FileMetaData:
+    def open_metadata(cls, path, footer_cache=None) -> FileMetaData:
         """Parse ONLY the footer of `path` — no data pages are touched and
         no reader object (or open handle) survives the call. The cheap
         multi-file planning primitive: a dataset scanning a thousand-file
         glob footers every file once here, then opens per-unit readers
-        with `metadata=` so the footer never re-parses."""
-        with open(path, "rb") as f:
-            return read_file_metadata(f)
+        with `metadata=` so the footer never re-parses. `footer_cache` (an
+        io.cache.FooterCache) makes the parse once-per-file-GENERATION: a
+        warm hit performs zero source reads; staleness is checked against
+        the file's (size, mtime)."""
+        if footer_cache is not None:
+            meta = footer_cache.get(path)
+            if meta is not None:
+                return meta
+        from ..io.source import LocalFileSource
+
+        with LocalFileSource(path) as src:
+            meta = read_file_metadata(SourceFile(src))
+        if footer_cache is not None:
+            footer_cache.put(path, meta)
+        return meta
 
     @classmethod
     def open_many(cls, paths, columns=None, **options) -> "list[FileReader]":
@@ -1899,11 +1984,13 @@ class FileReader:
         return readers
 
     def close(self) -> None:
-        """Release the underlying file when this reader owns it. Idempotent:
-        the dataset layer's lazy open/close churn (and `with` blocks wrapped
-        in error paths) may close the same reader more than once."""
-        if self._owns_file and not getattr(self._f, "closed", False):
-            self._f.close()
+        """Release the underlying source when this reader owns it (paths,
+        bytes). Idempotent: the dataset layer's lazy open/close churn (and
+        `with` blocks wrapped in error paths) may close the same reader
+        more than once. Caller-provided sources/file objects stay open —
+        their lifetime belongs to the caller."""
+        if self._owns_file:
+            self._source.close()
 
     def __enter__(self):
         return self

@@ -107,6 +107,8 @@ class ParquetDataset:
         nullable: str = "error",
         validate_crc: bool = False,
         device=None,
+        cache_bytes: int = 0,
+        readahead_bytes: int | None = None,
     ):
         if batch_size <= 0:
             raise ValueError("dataset: batch_size must be positive")
@@ -133,6 +135,8 @@ class ParquetDataset:
             raise ValueError("dataset: num_epochs must be >= 0 or None")
         if prefetch < 0:
             raise ValueError("dataset: prefetch depth must be >= 0")
+        if cache_bytes < 0:
+            raise ValueError("dataset: cache_bytes must be >= 0")
         self.paths_or_glob = paths_or_glob
         self.batch_size = int(batch_size)
         self.columns = list(columns) if columns is not None else None
@@ -156,6 +160,30 @@ class ParquetDataset:
         self._plan_lock = threading.Lock()
         self._pool: ThreadPoolExecutor | None = None
         self._closed = False
+        # IO layer: footers cache process-wide (validated per generation by
+        # size+mtime, so it is always safe); cache_bytes > 0 adds a shared
+        # byte-budgeted block cache — unit decodes read through it, repeat
+        # epochs hit memory, and the pqt-io readahead scheduler streams the
+        # NEXT units' planned byte ranges into it while pqt-data decodes the
+        # current window (readahead_bytes bounds its in-flight budget,
+        # default = cache_bytes / 4).
+        from ..io.cache import BlockCache, shared_footer_cache
+        from ..io.planner import Readahead
+
+        self._footer_cache = shared_footer_cache()
+        self._block_cache = BlockCache(cache_bytes) if cache_bytes else None
+        self._readahead = (
+            Readahead(
+                self._block_cache,
+                budget_bytes=(
+                    readahead_bytes
+                    if readahead_bytes is not None
+                    else max(cache_bytes // 4, 1 << 20)
+                ),
+            )
+            if self._block_cache is not None
+            else None
+        )
         # per-file parsed Schema cache: _load_unit opens one reader PER ROW
         # GROUP, and rebuilding the schema tree from thrift every unit is
         # pure waste when the footer is already cached on the plan
@@ -195,6 +223,7 @@ class ParquetDataset:
                     self.paths_or_glob,
                     filters=self.filters,
                     on_error=self.on_error,
+                    footer_cache=self._footer_cache,
                 )
                 # Validate the projection ONCE against the first readable
                 # schema, outside the skip policy: a misspelled columns=
@@ -212,6 +241,42 @@ class ParquetDataset:
                             break
                 self._plan = plan
             return self._plan
+
+    def _selected_leaf_paths(self, file_index: int):
+        """The projection as leaf path tuples for one plan file (None = all
+        columns) — what the io planner needs to compute a unit's exact byte
+        ranges for readahead. Best-effort: resolution failures return None
+        (readahead fetches everything; decode still raises the precise
+        error)."""
+        if self.columns is None:
+            return None
+        try:
+            schema = self._file_schema(file_index)
+        except Exception:  # noqa: BLE001 — advisory path only
+            return None
+        selected = set()
+        for c in self.columns:
+            path = tuple(c.split(".")) if isinstance(c, str) else tuple(c)
+            selected.update(
+                leaf.path
+                for leaf in schema.leaves
+                if leaf.path[: len(path)] == path
+            )
+        return selected or None
+
+    def _unit_ranges(self, unit) -> list:
+        """The planned (offset, length) byte ranges of one unit under the
+        dataset's projection (readahead's shopping list)."""
+        from ..io.planner import plan_ranges
+
+        meta = self.plan.metas[unit.file_index]
+        if meta is None:
+            return []
+        return plan_ranges(
+            meta,
+            row_groups=[unit.row_group],
+            columns=self._selected_leaf_paths(unit.file_index),
+        )
 
     def _file_schema(self, file_index: int):
         """The parsed Schema of one plan file (cached; footers come from
@@ -266,10 +331,15 @@ class ParquetDataset:
     def close(self) -> None:
         """Shut the prefetch pool down (idempotent). The dataset and its
         iterators stop being usable: further iteration raises instead of
-        silently resurrecting an untracked worker pool."""
+        silently resurrecting an untracked worker pool. The readahead
+        scheduler stops accepting work and cancels queued fetches (running
+        ones finish — they touch only the shared cache, never the pools
+        being torn down)."""
         with self._plan_lock:
             self._closed = True
             pool, self._pool = self._pool, None
+        if self._readahead is not None:
+            self._readahead.close()
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
 
@@ -431,15 +501,23 @@ class DatasetIterator:
             order = ds.epoch_order(epoch)
             pending: deque = deque()  # [upos, base, cols, consumed, n]
             buffered = 0
-            for upos, base, cols, n in self._fetch_units(order, pos, off):
-                self._check_template(cols)
-                pending.append([upos, base, cols, 0, n])
-                buffered += n
-                while buffered >= B:
-                    batch, buffered, resume_pos, resume_off = self._emit(
-                        pending, buffered, B
-                    )
-                    yield batch, (epoch, resume_pos, resume_off)
+            fetch = self._fetch_units(order, pos, off)
+            try:
+                for upos, base, cols, n in fetch:
+                    self._check_template(cols)
+                    pending.append([upos, base, cols, 0, n])
+                    buffered += n
+                    while buffered >= B:
+                        batch, buffered, resume_pos, resume_off = self._emit(
+                            pending, buffered, B
+                        )
+                        yield batch, (epoch, resume_pos, resume_off)
+            finally:
+                # closing the iterator mid-epoch must release the fetch
+                # pipeline's in-flight accounting NOW — relying on GC to
+                # close the sub-generator leaves the prefetch-depth gauge
+                # stuck until an arbitrary later collection
+                fetch.close()
             if buffered and ds.remainder != "drop":
                 batch, _, _, _ = self._emit(pending, buffered, buffered)
                 if ds.remainder == "pad" and buffered < B:
@@ -519,6 +597,23 @@ class DatasetIterator:
         pool = ds._worker_pool()
         pending: deque = deque()
         nxt = start_pos
+        ra_scheduled: set[int] = set()
+
+        def readahead():
+            # one IO stage ahead of decode: while pqt-data decodes the
+            # window [start..nxt), pqt-io streams the NEXT units' planned
+            # byte ranges into the shared block cache (advisory: budget
+            # overflow drops, decode reads through either way)
+            if ds._readahead is None:
+                return
+            for j in range(nxt, min(nxt + max(depth, 1), len(order))):
+                if j in ra_scheduled:
+                    continue
+                ra_scheduled.add(j)
+                unit = units[order[j]]
+                ranges = ds._unit_ranges(unit)
+                if ranges:
+                    ds._readahead.schedule(unit.path, ranges)
 
         def fill():
             nonlocal nxt
@@ -533,6 +628,7 @@ class DatasetIterator:
                 added += 1
             if added:
                 _inflight_add(added)
+            readahead()
 
         fill()
         try:
@@ -570,6 +666,7 @@ class DatasetIterator:
                     schema=ds._file_schema(unit.file_index),
                     validate_crc=ds.validate_crc,
                     on_error=ds.on_error,
+                    block_cache=ds._block_cache,
                 )
             except PARQUET_ERRORS + (OSError,):
                 if ds.on_error == "raise":

@@ -145,14 +145,22 @@ class ScanPlan:
         return [int(i) for i in order[shard_index::shard_count]]
 
 
-def build_plan(paths_or_glob, *, filters=None, on_error: str = "raise") -> ScanPlan:
+def build_plan(
+    paths_or_glob,
+    *,
+    filters=None,
+    on_error: str = "raise",
+    footer_cache=None,
+) -> ScanPlan:
     """Parse every file's footer and lay out the unit list.
 
     `filters` (the (column, op, value) DNF convention shared with
     FileReader) prunes row groups through the statistics/bloom path —
     pruned groups never become units. With on_error != "raise" a file whose
     footer (or schema/filter resolution) fails is skipped with a counter
-    instead of killing the scan."""
+    instead of killing the scan. `footer_cache` (io.cache.FooterCache)
+    makes re-planning the same files — new epochs, new dataset objects,
+    open_many callers — parse each footer once per file generation."""
     files = expand_paths(paths_or_glob)
     metas: list = []
     units: list[Unit] = []
@@ -160,7 +168,7 @@ def build_plan(paths_or_glob, *, filters=None, on_error: str = "raise") -> ScanP
     filters_checked = filters is None
     for fi, path in enumerate(files):
         try:
-            meta = FileReader.open_metadata(path)
+            meta = FileReader.open_metadata(path, footer_cache=footer_cache)
         except PARQUET_ERRORS + (OSError,) as e:
             if on_error == "raise":
                 raise

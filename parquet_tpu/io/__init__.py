@@ -1,0 +1,48 @@
+"""parquet_tpu.io — pluggable byte sources, range planning, and caching.
+
+The IO seam under the decode stack: ByteSource implementations (lock-free
+local pread, in-memory, retrying remote-shaped wrappers), a planner that
+derives the exact byte ranges a projected read needs from the footer and
+coalesces them into batched reads, a bounded pqt-io readahead scheduler,
+and byte-budgeted block + footer caches. See each module's docstring.
+"""
+
+from .cache import BlockCache, FooterCache, shared_footer_cache  # noqa: F401
+from .planner import (  # noqa: F401
+    DEFAULT_COALESCE_GAP,
+    Readahead,
+    coalesce,
+    fetch_ranges,
+    io_pool,
+    plan_ranges,
+)
+from .source import (  # noqa: F401
+    ByteSource,
+    FileObjectSource,
+    LocalFileSource,
+    MemorySource,
+    RetryingSource,
+    SourceError,
+    SourceFile,
+    open_source,
+)
+
+__all__ = [
+    "ByteSource",
+    "SourceError",
+    "LocalFileSource",
+    "MemorySource",
+    "FileObjectSource",
+    "RetryingSource",
+    "SourceFile",
+    "open_source",
+    "BlockCache",
+    "FooterCache",
+    "shared_footer_cache",
+    "plan_ranges",
+    "coalesce",
+    "fetch_ranges",
+    "Readahead",
+    "io_pool",
+    "DEFAULT_COALESCE_GAP",
+]

@@ -1,1 +1,4 @@
-"""Test-support tooling shipped with the package (fault injection)."""
+"""Test-support tooling shipped with the package: byte-level fault
+injection (faults.py) and transport-level fault injection (flaky.py)."""
+
+from .flaky import FlakySource  # noqa: F401

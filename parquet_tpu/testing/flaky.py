@@ -1,0 +1,122 @@
+"""FlakySource: seeded transient-fault injection for the IO retry ladder.
+
+The fault-injection harness in testing/faults.py corrupts BYTES (what a
+rotten disk or lying writer produces); this module corrupts the TRANSPORT —
+what a loaded object store or flaky NFS mount produces: transient EIO,
+short reads, injected latency, and (optionally) permanent failure. Wrapped
+around any ByteSource and driven from an integer seed, it gives the retry
+ladder (io.source.RetryingSource) a deterministic adversary:
+
+    src = RetryingSource(
+        FlakySource(LocalFileSource(path), seed=7, error_rate=0.3),
+        sleep=lambda s: None,    # tests: no real backoff waits
+    )
+
+Every fault draw comes from one numpy default_rng stream, so a failing test
+replays exactly; each CALL re-rolls, so a retried read naturally has a fresh
+chance to succeed — the transient-fault shape. `fault_window` confines
+faults to a byte region (e.g. only the footer tail); `permanent=True` makes
+every read fail, the budget-exhaustion shape.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import time
+
+import numpy as np
+
+__all__ = ["FlakySource"]
+
+
+class FlakySource:
+    """A ByteSource wrapper injecting seeded transport faults.
+
+    Parameters
+    ----------
+    inner        the wrapped ByteSource
+    seed         rng seed; one stream across all fault draws
+    error_rate   probability a read raises a transient OSError(EIO)
+    short_rate   probability a read returns a truncated buffer (a contract
+                 violation real transports commit; RetryingSource re-reads)
+    latency_s    fixed sleep added to every read (the range-GET shape);
+                 latency_jitter_s adds a uniform extra draw on top
+    permanent    every read fails with EIO — the budget-exhaustion case
+    fault_window (offset, length) confining faults to reads that overlap
+                 the window (None = everywhere)
+    """
+
+    def __init__(
+        self,
+        inner,
+        *,
+        seed: int = 0,
+        error_rate: float = 0.0,
+        short_rate: float = 0.0,
+        latency_s: float = 0.0,
+        latency_jitter_s: float = 0.0,
+        permanent: bool = False,
+        fault_window: tuple[int, int] | None = None,
+        sleep=time.sleep,
+    ):
+        self.inner = inner
+        self._rng = np.random.default_rng(seed)
+        self.error_rate = float(error_rate)
+        self.short_rate = float(short_rate)
+        self.latency_s = float(latency_s)
+        self.latency_jitter_s = float(latency_jitter_s)
+        self.permanent = bool(permanent)
+        self.fault_window = fault_window
+        self._sleep = sleep
+        self.faults_injected = 0
+        self.reads = 0
+
+    @property
+    def source_id(self) -> str:
+        return self.inner.source_id
+
+    def size(self) -> int:
+        return self.inner.size()
+
+    def _in_window(self, offset: int, n: int) -> bool:
+        if self.fault_window is None:
+            return True
+        w_off, w_len = self.fault_window
+        return offset < w_off + w_len and offset + n > w_off
+
+    def read_at(self, offset: int, n: int) -> bytes:
+        self.reads += 1
+        if self.latency_s or self.latency_jitter_s:
+            extra = (
+                float(self._rng.uniform(0, self.latency_jitter_s))
+                if self.latency_jitter_s
+                else 0.0
+            )
+            self._sleep(self.latency_s + extra)
+        if self._in_window(offset, n):
+            if self.permanent:
+                self.faults_injected += 1
+                raise OSError(_errno.EIO, f"injected permanent EIO at {offset}")
+            roll = float(self._rng.random())
+            if roll < self.error_rate:
+                self.faults_injected += 1
+                raise OSError(_errno.EIO, f"injected transient EIO at {offset}")
+            if roll < self.error_rate + self.short_rate and n > 1:
+                self.faults_injected += 1
+                cut = int(self._rng.integers(0, n))
+                return self.inner.read_at(offset, cut)
+        return self.inner.read_at(offset, n)
+
+    def read_ranges(self, ranges) -> list:
+        # per-range faults: one flaky range in a batch, not all-or-nothing
+        return [self.read_at(off, n) for off, n in ranges]
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -630,13 +630,16 @@ def cmd_profile(args) -> int:
     from ..utils import metrics
     from ..utils.trace import decode_trace, span
 
+    import os
+
     if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
     backend = "host" if args.host else "tpu_roundtrip"
+    cols = args.columns.split(",") if args.columns else None
     snap0 = metrics.snapshot()
-    with FileReader(args.file, backend=backend) as r:
+    with FileReader(args.file, columns=cols, backend=backend) as r:
         rows = r.num_rows
         with decode_trace() as t:
             with span("file", {"path": str(args.file), "backend": backend}):
@@ -656,6 +659,17 @@ def cmd_profile(args) -> int:
         f"{len(doc['traceEvents'])} trace events -> {args.out} "
         "(load in ui.perfetto.dev or chrome://tracing)"
     )
+    # projection efficiency: the planner fetches only the projected chunks'
+    # exact byte ranges, so bytes-read vs bytes-in-file shows what a
+    # columns= projection actually saves at the source
+    bytes_read = mdelta.get("io_bytes_read_total", 0)
+    fsize = os.path.getsize(args.file)
+    print(
+        f"profile: io {bytes_read:,} B read / {fsize:,} B in file "
+        f"({bytes_read / fsize:.1%} of file bytes)"
+        if fsize
+        else f"profile: io {bytes_read:,} B read"
+    )
     if args.metrics:
         print()
         print("metrics delta (this profile run):")
@@ -674,6 +688,7 @@ def cmd_scan(args) -> int:
     and the wait share shows whether prefetch is keeping up: near 0% the
     consumer never starves, near 100% the loop is decode-bound (raise
     --prefetch, add workers, or shard wider)."""
+    import os
     import time
 
     from ..data import ParquetDataset
@@ -692,6 +707,7 @@ def cmd_scan(args) -> int:
         remainder="keep",
         on_error=args.on_error,
         nullable=args.nullable,
+        cache_bytes=args.cache_mb << 20,
     )
     plan = ds.plan
     for path, why in plan.skipped_files:
@@ -722,6 +738,24 @@ def cmd_scan(args) -> int:
         f"scan: wait {wait:.3f}s ({share:.1%} of wall)"
         + (f", {skipped} unit(s) skipped" if skipped else "")
     )
+    # projection efficiency + cache effect: what the io layer actually
+    # fetched vs what lives on disk, and how much of it came from memory
+    bytes_read = d.get("io_bytes_read_total", 0)
+    file_bytes = sum(
+        os.path.getsize(p) for p in plan.files if os.path.exists(p)
+    )
+    hits = d.get("io_cache_hits_total", 0)
+    misses = d.get("io_cache_misses_total", 0)
+    hit_rate = hits / (hits + misses) if (hits + misses) else None
+    io_line = f"scan: io {bytes_read:,} B read"
+    if file_bytes:
+        io_line += (
+            f" / {file_bytes:,} B in files "
+            f"({bytes_read / file_bytes:.1%} of file bytes)"
+        )
+    if hit_rate is not None:
+        io_line += f", cache hit rate {hit_rate:.1%}"
+    print(io_line)
     if args.json:
         print(
             json.dumps(
@@ -736,6 +770,11 @@ def cmd_scan(args) -> int:
                     "wait_share": round(share, 4),
                     "units_skipped": skipped,
                     "prefetch": ds.prefetch,
+                    "io_bytes_read": bytes_read,
+                    "file_bytes": file_bytes,
+                    "io_cache_hit_rate": (
+                        round(hit_rate, 4) if hit_rate is not None else None
+                    ),
                 }
             )
         )
@@ -820,6 +859,11 @@ def main(argv=None) -> int:
     pf.add_argument("file")
     pf.add_argument("-o", "--out", required=True, help="trace JSON output path")
     pf.add_argument(
+        "--columns",
+        help="comma-separated column projection (the io line then shows the "
+        "projection's bytes-read vs bytes-in-file efficiency)",
+    )
+    pf.add_argument(
         "--metrics",
         action="store_true",
         help="also print the process metrics delta + summary for the run",
@@ -848,6 +892,13 @@ def main(argv=None) -> int:
     pn.add_argument("--filter", action="append", help=filter_help)
     pn.add_argument("--batch-size", type=int, default=8192)
     pn.add_argument("--prefetch", type=int, default=2, help="units decoded ahead")
+    pn.add_argument(
+        "--cache-mb",
+        type=int,
+        default=0,
+        help="shared block-cache budget in MiB (0 = off); enables pqt-io "
+        "readahead of upcoming units' byte ranges",
+    )
     pn.add_argument("--epochs", type=int, default=1)
     pn.add_argument("--shuffle", action="store_true")
     pn.add_argument("--seed", type=int, default=0)

@@ -26,6 +26,15 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
                                     prepare_fused_engaged/_declined,
                                     prepare_fallback_recovered,
                                     chunks_quarantined, ... dual-report here
+  io_bytes_read_total               bytes actually read from byte sources
+  io_read_calls_total               source read calls (coalescing shrinks it)
+  io_retries_total{reason=}         failed source attempts absorbed by the
+                                    RetryingSource ladder
+  io_cache_hits/misses_total        block-cache outcomes; io_cache_bytes is
+                                    the resident-bytes gauge
+  io_footer_cache_hits/misses_total footer/metadata cache outcomes
+  io_readahead_fetched/dropped_total  pqt-io readahead accepted vs shed
+                                      (budget full); _errors_total swallowed
 
 Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as

@@ -508,6 +508,26 @@ class TestScan:
         # the synchronous path still measures its decode waits
         assert doc["wait_s"] > 0 and doc["wait_share"] > 0
 
+    def test_scan_reports_io_bytes_and_cache(self, shards, capsys):
+        import glob
+        import os
+
+        assert tool_main(
+            ["scan", shards, "--batch-size", "256", "--cache-mb", "32",
+             "--epochs", "2", "--json"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "scan: io" in out and "of file bytes" in out
+        doc = json.loads(out.strip().splitlines()[-1])
+        assert doc["rows"] == 3000  # 2 epochs
+        assert doc["file_bytes"] == sum(
+            os.path.getsize(p) for p in glob.glob(shards)
+        )
+        assert doc["io_bytes_read"] > 0
+        # epoch 2 decodes out of the shared block cache
+        assert doc["io_cache_hit_rate"] is not None
+        assert doc["io_cache_hit_rate"] > 0
+
     def test_scan_nullable_data_by_default(self, tmp_path, capsys):
         import numpy as np
         import pyarrow as pa
