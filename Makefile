@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
 check: native lint
@@ -40,6 +40,11 @@ bench-dataset: native
 # latency-injected FlakySource (the object-store shape); host-only
 bench-io: native
 	python bench.py --io
+
+# write-path bench: FileWriter vs pyarrow + the pqt-encode parallelism
+# sweep (pool 1/4/8 x 8/16 row groups, byte-identical to serial); host-only
+bench-write: native
+	python bench.py --write
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

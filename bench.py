@@ -43,6 +43,12 @@ EIO rate absorbed by the retry ladder): a coalesce-gap sweep (0 / 64 KiB /
 (0/2/4 row groups prefetched into a shared block cache on the pqt-io pool).
 The result rides the --json artifact under "io".
 
+`--write` benchmarks the write path: FileWriter vs pyarrow (snappy headline)
+plus the pqt-encode PARALLELISM sweep — pool 1/4/8 x 8/16 row groups on a
+GZIP log-ingest table (PQT_WRITE_ROWS rows, default 400K), every parallel
+output asserted byte-identical to the serial file before timing. The result
+rides the --json artifact under "write" (also as the matrix "write" config).
+
 `--dataset` benchmarks the streaming loader (parquet_tpu.data) end to end
 over a multi-file glob: rows/s through ParquetDataset at a sweep of prefetch
 depths against a device-bound consumer (host blocked PQT_DATASET_STEP_MS per
@@ -416,11 +422,25 @@ def _phase_matrix(cfg: int) -> None:
     _emit(out)
 
 
+WRITE_ROWS = int(os.environ.get("PQT_WRITE_ROWS", 400_000))
+
+
 def _phase_write() -> None:
-    """Write-path benchmark (matrix config "write"): rows/s writing the
-    headline-like 3-column table (dict-int64 + dict-string + delta-ts) with
-    our FileWriter vs pyarrow.write_table, both SNAPPY. Output is verified
-    by reading it back with pyarrow (cross-implementation) before timing."""
+    """Write-path benchmark (matrix config "write"; `bench.py --write`).
+
+    Part 1 (headline): rows/s writing the headline-like 3-column table
+    (dict-int64 + dict-string + delta-ts) with our FileWriter vs
+    pyarrow.write_table, both SNAPPY. Output is verified by reading it back
+    with pyarrow (cross-implementation) before timing.
+
+    Part 2 (parallelism sweep): the pqt-encode pipeline vs the serial
+    writer on a log-ingest-shaped table (PQT_WRITE_ROWS rows: random int64
+    id, ~90-byte log-line strings, delta timestamps, random doubles; GZIP,
+    no dictionary — the archival-ingest shape where encode+compress
+    dominate and the encode work is native/GIL-free). Sweeps pool size
+    1/4/8 x row-group count 8/16; every parallel output is asserted
+    BYTE-IDENTICAL to the serial file before any timing run. The result
+    rides the --json artifact's "write" section."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -489,26 +509,136 @@ def _phase_write() -> None:
         rows=rows,
     )
     t_ours, t_ours_arrow, t_pa = s_ours["t"], s_ours_arrow["t"], s_pa["t"]
-    _emit(
-        (
-            {
-                "config": "write",
-                "rows_s_ours": round(rows / t_ours, 1),
-                "rows_s_ours_arrow_in": round(rows / t_ours_arrow, 1),
-                "rows_s_pyarrow": round(rows / t_pa, 1),
-                "vs_pyarrow": round(t_pa / t_ours, 3),
-                "vs_pyarrow_arrow_in": round(t_pa / t_ours_arrow, 3),
-                "written_MB": round(
-                    Path("/tmp/pqt_bench_write_ours.parquet").stat().st_size / 1e6, 1
-                ),
-                "readback_ok": True,
-                "stat": "median",
-                "samples_ours_s": s_ours["samples"],
-                "samples_ours_arrow_in_s": s_ours_arrow["samples"],
-                "samples_pyarrow_s": s_pa["samples"],
-            }
-        )
+    out = {
+        "config": "write",
+        "rows_s_ours": round(rows / t_ours, 1),
+        "rows_s_ours_arrow_in": round(rows / t_ours_arrow, 1),
+        "rows_s_pyarrow": round(rows / t_pa, 1),
+        "vs_pyarrow": round(t_pa / t_ours, 3),
+        "vs_pyarrow_arrow_in": round(t_pa / t_ours_arrow, 3),
+        "written_MB": round(
+            Path("/tmp/pqt_bench_write_ours.parquet").stat().st_size / 1e6, 1
+        ),
+        "readback_ok": True,
+        "stat": "median",
+        "samples_ours_s": s_ours["samples"],
+        "samples_ours_arrow_in_s": s_ours_arrow["samples"],
+        "samples_pyarrow_s": s_pa["samples"],
+    }
+
+    # -- part 2: the pqt-encode parallelism sweep ------------------------------
+    wrows = WRITE_ROWS
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 1 << 60, wrows).astype(np.int64)
+    hexes = rng.integers(0, 1 << 40, wrows)
+    logs = pa.array(
+        [
+            f"2026-08-03T12:00:00Z level=info svc=ingest "
+            f"shard-{int(h) % 64:02d} req={int(h):012x} status=200"
+            for h in hexes
+        ]
     )
+    wts = (
+        1_600_000_000_000_000 + np.cumsum(rng.integers(0, 1000, wrows))
+    ).astype(np.int64)
+    wx = rng.random(wrows)
+    wschema = parse_schema(
+        "message m { required int64 id; required binary s (UTF8); "
+        "required int64 ts (TIMESTAMP_MICROS); required double x; }"
+    )
+
+    def write_ingest(path, parallel, n_groups):
+        with FileWriter(
+            path,
+            wschema,
+            codec="gzip",
+            column_encodings={"ts": "DELTA_BINARY_PACKED"},
+            use_dictionary=False,
+            parallel=parallel,
+        ) as w:
+            per = wrows // n_groups
+            for g in range(n_groups):
+                a = g * per
+                b = wrows if g == n_groups - 1 else (g + 1) * per
+                w.write_column("id", ids[a:b])
+                w.write_column("s", logs.slice(a, b - a))
+                w.write_column("ts", wts[a:b])
+                w.write_column("x", wx[a:b])
+                w.flush_row_group()
+
+    # PAIRED sampling: every repeat times the serial writer and then each
+    # pool config back to back, and the reported speedup is the MEDIAN OF
+    # PAIRED RATIOS. On a shared box the load drift between runs dwarfs the
+    # config effect (observed serial spread ~1.3x across minutes); pairing
+    # puts both sides of each ratio in the same load window, the same
+    # rationale that picked medians over best-of (VERDICT r3).
+    pools = (1, 4, 8)
+    sweep = {}
+    best_speedup = 0.0
+    for n_groups in (8, 16):
+        ser_path = f"/tmp/pqt_write_serial_{n_groups}.parquet"
+        write_ingest(ser_path, False, n_groups)  # warm + the identity oracle
+        ser_bytes = Path(ser_path).read_bytes()
+        for pool in pools:  # warm each pool config + the identity check
+            par_path = f"/tmp/pqt_write_pool{pool}_{n_groups}.parquet"
+            write_ingest(par_path, pool, n_groups)
+            if Path(par_path).read_bytes() != ser_bytes:
+                # a divergence is a correctness bug, not a data point:
+                # timing divergent configs would launder it into the artifact
+                raise SystemExit(
+                    f"bench: write pool={pool} g={n_groups} output is NOT "
+                    "byte-identical to the serial writer"
+                )
+        ser_samples = []
+        par_samples = {p: [] for p in pools}
+        ratios = {p: [] for p in pools}
+        for rep in range(REPEATS):
+            t0 = time.perf_counter()
+            write_ingest(ser_path, False, n_groups)
+            t_s = time.perf_counter() - t0
+            ser_samples.append(round(t_s, 5))
+            for pool in pools:
+                par_path = f"/tmp/pqt_write_pool{pool}_{n_groups}.parquet"
+                t0 = time.perf_counter()
+                write_ingest(par_path, pool, n_groups)
+                t_p = time.perf_counter() - t0
+                par_samples[pool].append(round(t_p, 5))
+                ratios[pool].append(t_s / t_p)
+            log(
+                f"bench:   write g={n_groups} rep {rep + 1}/{REPEATS}: "
+                f"serial {t_s:.3f}s, " + ", ".join(
+                    f"pool{p} {par_samples[p][-1]:.3f}s "
+                    f"({ratios[p][-1]:.2f}x)" for p in pools
+                )
+            )
+        med_ser = sorted(ser_samples)[len(ser_samples) // 2]
+        entry = {
+            "serial_rows_s": round(wrows / med_ser, 1),
+            "serial_samples_s": ser_samples,
+        }
+        for pool in pools:
+            med_par = sorted(par_samples[pool])[len(par_samples[pool]) // 2]
+            r = sorted(ratios[pool])[len(ratios[pool]) // 2]
+            entry[f"pool_{pool}"] = {
+                "rows_s": round(wrows / med_par, 1),
+                "speedup": round(r, 3),  # median of PAIRED ratios
+                "samples_s": par_samples[pool],
+            }
+            if pool >= 4 and n_groups >= 8:
+                best_speedup = max(best_speedup, round(r, 3))
+        sweep[f"groups_{n_groups}"] = entry
+    out["parallel_rows"] = wrows
+    out["parallel_codec"] = "gzip"
+    out["parallel_sweep"] = sweep
+    # every config was asserted byte-identical above (divergence exits)
+    out["parallel_byte_identical"] = True
+    # the acceptance pin: best (pool >= 4, >= 8 groups) config vs serial
+    out["parallel_speedup"] = best_speedup
+    log(
+        f"bench: write parallel sweep: best pool>=4 speedup "
+        f"{best_speedup:.2f}x vs serial (all configs byte-identical)"
+    )
+    _emit(out)
 
 
 def run_matrix() -> list:
@@ -1208,6 +1338,9 @@ def main() -> None:
         artifact["io"] = r_io
     if results is not None:
         artifact["matrix"] = results
+        for r in results:
+            if r.get("config") == "write":
+                artifact["write"] = r  # the write-path result, addressable
     _write_artifact(artifact)
 
 
@@ -1247,6 +1380,8 @@ if __name__ == "__main__":
         _phase_dataset()
     elif argv and argv[0] == "--io":
         _phase_io()
+    elif argv and argv[0] == "--write":
+        _phase_write()
     elif len(argv) >= 2 and argv[0] == "--phase":
         name = argv[1]
         if name.startswith("matrix"):

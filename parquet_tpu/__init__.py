@@ -32,6 +32,9 @@ Layout:
   io/        pluggable byte sources (lock-free local pread, in-memory,
              retrying remote-shaped), footer-driven range planning with
              coalescing + readahead, block/footer caches
+  sink/      pluggable byte sinks (atomic tmp+rename local files,
+             in-memory, write-combining buffer) + the parallel row-group
+             encode pipeline on the pqt-encode pool
   data/      streaming dataset: sharded/shuffled multi-file plans, bounded
              prefetch, fixed-size rebatching, mid-epoch checkpoint/resume
   schema/    textual schema DSL (parser/printer/validator) + builder API
@@ -82,6 +85,14 @@ from .io import (  # noqa: F401
     MemorySource,
     RetryingSource,
     SourceError,
+)
+from .sink import (  # noqa: F401
+    BufferedSink,
+    ByteSink,
+    FileObjectSink,
+    LocalFileSink,
+    MemorySink,
+    SinkError,
 )
 
 

@@ -12,6 +12,11 @@ byte-identical); page indexes and bloom filters live OUTSIDE the chunk
 byte ranges in their source files and are NOT carried — re-write the file
 with `write_page_index=`/`bloom_filters=` if you need them on the merged
 output.
+
+Output goes through the ByteSink seam (parquet_tpu.sink): a path gets the
+atomic tmp+rename LocalFileSink, so a failed or interrupted merge/split
+never leaves a torn output where the inputs' readers (or a compaction
+daemon's glob) would pick it up; any ByteSink can be passed directly.
 """
 
 from __future__ import annotations
@@ -23,6 +28,7 @@ from ..meta.file_meta import (
     serialize_footer,
 )
 from ..meta.parquet_types import FileMetaData, KeyValue
+from ..sink.sink import open_sink
 from .chunk import chunk_byte_range
 
 __all__ = ["merge_files", "split_row_groups"]
@@ -124,39 +130,51 @@ def _copy_groups(out_path, in_path, meta, group_indices, created_by) -> None:
         pass
     out_groups = []
     num_rows = 0
-    with open(out_path, "wb") as out, open(in_path, "rb") as f:
-        out.write(MAGIC)
-        pos = len(MAGIC)
-        for gi in group_indices:
-            rg = RowGroup.loads((meta.row_groups[gi]).dumps())  # private copy
-            pos = _copy_group(out, pos, f, rg, len(out_groups), str(in_path))
-            out_groups.append(rg)
-            num_rows += rg.num_rows or 0
-        out_meta = FileMetaData(
-            version=2,
-            schema=meta.schema,
-            num_rows=num_rows,
-            row_groups=out_groups,
-            created_by=created_by,
-            key_value_metadata=meta.key_value_metadata,
-            column_orders=meta.column_orders,
-        )
-        out.write(serialize_footer(out_meta))
+    out, owns = open_sink(out_path)
+    try:
+        with open(in_path, "rb") as f:
+            out.write(MAGIC)
+            pos = len(MAGIC)
+            for gi in group_indices:
+                rg = RowGroup.loads((meta.row_groups[gi]).dumps())  # private copy
+                pos = _copy_group(out, pos, f, rg, len(out_groups), str(in_path))
+                out_groups.append(rg)
+                num_rows += rg.num_rows or 0
+            out_meta = FileMetaData(
+                version=2,
+                schema=meta.schema,
+                num_rows=num_rows,
+                row_groups=out_groups,
+                created_by=created_by,
+                key_value_metadata=meta.key_value_metadata,
+                column_orders=meta.column_orders,
+            )
+            out.write(serialize_footer(out_meta))
+    except BaseException:
+        out.abort()  # atomic sinks leave no partial part file
+        raise
+    if owns:
+        out.close()  # commit
+    else:
+        out.flush()
 
 
 def merge_files(out_path, in_paths, created_by: str | None = None,
                 key_value_metadata: dict | None = None) -> FileMetaData:
-    """Merge `in_paths` (order preserved) into `out_path` by copying row
-    groups byte-for-byte. Returns the written FileMetaData."""
+    """Merge `in_paths` (order preserved) into `out_path` (a path, committed
+    atomically, or any ByteSink) by copying row groups byte-for-byte.
+    Returns the written FileMetaData."""
     if not in_paths:
         raise ParquetFileError("parquet: merge needs at least one input")
     import os
 
-    try:
-        out_id = os.stat(out_path)
-        out_key = (out_id.st_dev, out_id.st_ino)
-    except OSError:
-        out_key = None  # output doesn't exist yet: cannot collide
+    out_key = None
+    if isinstance(out_path, (str, os.PathLike)):
+        try:
+            out_id = os.stat(out_path)
+            out_key = (out_id.st_dev, out_id.st_ino)
+        except OSError:
+            out_key = None  # output doesn't exist yet: cannot collide
     for p in in_paths:
         st = os.stat(p)
         if out_key is not None and (st.st_dev, st.st_ino) == out_key:
@@ -184,7 +202,8 @@ def merge_files(out_path, in_paths, created_by: str | None = None,
             )
     out_groups = []
     num_rows = 0
-    with open(out_path, "wb") as out:
+    out, owns = open_sink(out_path)
+    try:
         out.write(MAGIC)
         pos = len(MAGIC)
         for path, meta in zip(in_paths, metas):
@@ -208,4 +227,11 @@ def merge_files(out_path, in_paths, created_by: str | None = None,
             column_orders=metas[0].column_orders,
         )
         out.write(serialize_footer(out_meta))
+    except BaseException:
+        out.abort()  # atomic sinks leave no partial merge output
+        raise
+    if owns:
+        out.close()  # commit
+    else:
+        out.flush()
     return out_meta

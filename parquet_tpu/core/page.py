@@ -329,13 +329,17 @@ def encode_data_page_v1(
     with_crc: bool = False,
 ) -> tuple[PageHeader, bytes]:
     n = _count_level_entries(values, def_levels)
-    payload = bytearray()
-    if column.max_rep > 0:
-        payload += encode_levels_v1(rep_levels, column.max_rep)
-    if column.max_def > 0:
-        payload += encode_levels_v1(def_levels, column.max_def)
-    payload += _encode_values(values, encoding, column, dict_size)
-    raw = bytes(payload)
+    vals = _encode_values(values, encoding, column, dict_size)
+    if column.max_rep > 0 or column.max_def > 0:
+        payload = bytearray()
+        if column.max_rep > 0:
+            payload += encode_levels_v1(rep_levels, column.max_rep)
+        if column.max_def > 0:
+            payload += encode_levels_v1(def_levels, column.max_def)
+        payload += vals
+        raw = payload
+    else:
+        raw = vals  # flat required column: the value stream IS the page
     block = compress_block(raw, codec)
     header = PageHeader(
         type=0,

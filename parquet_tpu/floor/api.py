@@ -80,7 +80,17 @@ def _to_storage(v):
 
 
 class Writer:
-    """High-level writer: schema from the dataclass, rows from instances."""
+    """High-level writer: schema from the dataclass, rows from instances.
+
+    `sink` and every keyword pass straight through to FileWriter: a path
+    commits ATOMICALLY at close (tmp+rename — an exception mid-write never
+    leaves a torn file), any parquet_tpu.sink.ByteSink plugs in directly,
+    and `parallel=` engages the pqt-encode row-group pipeline — the
+    high-level API gets the fast write path for free:
+
+        with floor.Writer("f.parquet", Trip, parallel=True) as w:
+            w.write_all(trips)
+    """
 
     def __init__(self, sink, record_type=None, schema=None, **writer_kw):
         if schema is None:
@@ -119,7 +129,8 @@ class Writer:
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        # Delegate so the underlying file is closed (without a footer) on error.
+        # Delegate so an error ABORTS the underlying sink (temp file
+        # deleted, destination untouched) instead of committing.
         return self._w.__exit__(exc_type, exc, tb)
 
 

@@ -1,0 +1,47 @@
+"""parquet_tpu.sink — pluggable byte sinks and the parallel encode pipeline.
+
+The write-side counterpart of parquet_tpu.io: ByteSink implementations
+(atomic tmp+rename local files, in-memory, file-object adapters, a
+write-combining buffer), and the row-group encode pipeline that fans chunk
+encodes out on the dedicated pqt-encode pool while a single in-order
+flusher commits groups to the sink — output bytes identical to the serial
+path. See each module's docstring.
+"""
+
+from .encoder import (  # noqa: F401
+    EncodePipeline,
+    EncodedChunk,
+    EncodedRowGroup,
+    EncoderConfig,
+    assemble_group,
+    commit_group,
+    encode_chunk,
+    encode_pool,
+)
+from .sink import (  # noqa: F401
+    BufferedSink,
+    ByteSink,
+    FileObjectSink,
+    LocalFileSink,
+    MemorySink,
+    SinkError,
+    open_sink,
+)
+
+__all__ = [
+    "ByteSink",
+    "SinkError",
+    "LocalFileSink",
+    "MemorySink",
+    "FileObjectSink",
+    "BufferedSink",
+    "open_sink",
+    "EncoderConfig",
+    "EncodedChunk",
+    "EncodedRowGroup",
+    "encode_chunk",
+    "assemble_group",
+    "commit_group",
+    "EncodePipeline",
+    "encode_pool",
+]

@@ -1,0 +1,723 @@
+"""Parallel row-group encode pipeline: the write-side mirror of the read
+architecture (fused prepare pool + io seam).
+
+The original FileWriter encoded and wrote serially: one host loop converting
+buffered values, building dictionaries, encoding pages and pushing bytes
+straight at one file handle. But row groups are independent by construction
+(that's what makes parallel READS work), and so are the column chunks inside
+one group — the only serial obligation is the byte ORDER in the file. This
+module splits the two concerns:
+
+  encode_chunk()      one column chunk -> page bytes + metadata with offsets
+                      RELATIVE to the chunk start. Pure function of
+                      (config, builder snapshot): no writer state, no sink,
+                      safe on any thread. Reuses the existing C fast paths
+                      (ops.rle_hybrid.encode_hybrid, ops.delta.encode_delta,
+                      the vectorized/native dictionary build in
+                      core.column_store) — ctypes calls drop the GIL, which
+                      is what makes the thread pool actually scale.
+  assemble_group()    stitch encoded chunks into one row group, offsets
+                      relative to the GROUP start
+  commit_group()      rebase a group to its absolute file position and write
+                      its bytes to the sink — the only stateful step, and
+                      the same few lines for the serial and parallel paths,
+                      so the two can never diverge on bytes
+  EncodePipeline      the parallel orchestrator: chunk encodes fan out on
+                      the dedicated "pqt-encode" pool while ONE in-order
+                      flusher thread commits finished groups to the sink in
+                      submission order — output bytes are identical to the
+                      serial path. Backpressure bounds in-flight encoded
+                      bytes; faults are captured and re-raised as typed
+                      errors on the next writer call (deferred propagation).
+
+Observability: every chunk encode bills the write.encode trace stage and the
+encode_seconds histogram + pages_written_total{encoding}; every commit bills
+write.flush and write_bytes_total{codec}.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.arrays import ByteArrayData
+from ..core.page import (
+    encode_data_page_v1,
+    encode_data_page_v2,
+    encode_dict_page,
+)
+from ..core.stats import column_is_unsigned, compute_statistics
+from ..meta.parquet_types import (
+    BoundaryOrder,
+    ColumnChunk,
+    ColumnIndex,
+    ColumnMetaData,
+    Encoding,
+    KeyValue,
+    OffsetIndex,
+    PageEncodingStats,
+    PageLocation,
+    PageType,
+    RowGroup,
+)
+from ..utils import metrics as _metrics
+from ..utils.trace import stage, timed_stage, traced_submit
+
+__all__ = [
+    "EncoderConfig",
+    "EncodedChunk",
+    "EncodedRowGroup",
+    "encode_chunk",
+    "assemble_group",
+    "commit_group",
+    "EncodePipeline",
+    "encode_pool",
+]
+
+
+@dataclass(frozen=True)
+class EncoderConfig:
+    """The immutable slice of FileWriter configuration a chunk encode needs —
+    snapshotting it (instead of passing the writer) is what lets encodes run
+    on pool threads while the writer keeps accepting rows."""
+
+    codec: int
+    data_page_version: int
+    max_page_size: int
+    with_crc: bool
+    write_page_index: bool
+    column_encodings: dict  # leaf path tuple -> fallback Encoding
+    bloom_specs: dict  # leaf path tuple -> (ndv or None, fpp)
+    sorting: tuple | None = None  # resolved SortingColumn list (or None)
+
+
+@dataclass
+class EncodedChunk:
+    """One encoded column chunk: page bytes + footer structs with offsets
+    relative to the CHUNK start (rebased twice: group stitch, then file)."""
+
+    parts: list  # page byte strings, in file order
+    nbytes: int
+    chunk: ColumnChunk
+    index: tuple | None  # (ColumnIndex, OffsetIndex) when the page index is on
+    bloom: object | None  # (ColumnMetaData, BloomFilter) awaiting close()
+
+
+@dataclass
+class EncodedRowGroup:
+    chunks: list  # list[EncodedChunk], leaf order
+    row_group: RowGroup
+    nbytes: int
+    n_rows: int
+    indexes: list = field(default_factory=list)  # [(cc, ci, oi)]
+    blooms: list = field(default_factory=list)  # [(md, bf)]
+
+
+class _PageIndexBuilder:
+    """Accumulates one chunk's per-page locations + statistics into
+    (ColumnIndex, OffsetIndex) — the Parquet page index (beyond the
+    reference, which writes no page index)."""
+
+    def __init__(self, column, dictionary):
+        self.column = column
+        self.unsigned = column_is_unsigned(column)
+        self.dictionary = dictionary  # dict VALUES when pages carry indices
+        self.locations: list[PageLocation] = []
+        self.null_pages: list[bool] = []
+        self.mins: list[bytes] = []
+        self.maxs: list[bytes] = []
+        self.null_counts: list[int] = []
+        self.first_row = 0
+        self.ok = True  # a page without computable stats voids the index
+
+    def add_page(self, offset: int, size: int, v_slice, d_slice, r_slice) -> None:
+        if not self.ok:
+            return
+        if r_slice is not None and len(r_slice):
+            rows = int((np.asarray(r_slice) == 0).sum())
+        elif d_slice is not None:
+            rows = len(d_slice)
+        else:
+            rows = len(v_slice)
+        self.locations.append(
+            PageLocation(
+                offset=offset, compressed_page_size=size, first_row_index=self.first_row
+            )
+        )
+        self.first_row += rows
+        nulls = (
+            int((np.asarray(d_slice) != self.column.max_def).sum())
+            if d_slice is not None
+            else 0
+        )
+        self.null_counts.append(nulls)
+        values = v_slice
+        if self.dictionary is not None:
+            idx = np.asarray(v_slice)
+            values = (
+                self.dictionary.take(idx.astype(np.int64))
+                if isinstance(self.dictionary, ByteArrayData)
+                else np.asarray(self.dictionary)[idx]
+            )
+        if len(values) == 0:
+            self.null_pages.append(True)
+            self.mins.append(b"")
+            self.maxs.append(b"")
+            return
+        st = compute_statistics(self.column.type, values, nulls, self.unsigned)
+        if st.min_value is None or st.max_value is None:
+            # all-NaN page / oversized binary: a legal index can't represent
+            # it, so write no index for this chunk at all
+            self.ok = False
+            return
+        self.null_pages.append(False)
+        self.mins.append(st.min_value)
+        self.maxs.append(st.max_value)
+
+    def _boundary_order(self) -> int:
+        # the tables that packed these exact bytes
+        from ..core.stats import _PACK, _PACK_UNSIGNED
+        from ..meta.parquet_types import ConvertedType, Type
+
+        unpack = (
+            _PACK_UNSIGNED.get(self.column.type)
+            if self.unsigned
+            else _PACK.get(self.column.type)
+        )
+        if unpack is None:
+            if self.column.type in (Type.BYTE_ARRAY, Type.FIXED_LEN_BYTE_ARRAY):
+                ct = self.column.converted_type
+                lt = self.column.logical_type
+                if ct in (ConvertedType.DECIMAL, ConvertedType.INTERVAL) or (
+                    lt is not None
+                    and (lt.DECIMAL is not None or lt.FLOAT16 is not None)
+                ):
+                    # signed / no defined order: lexicographic bytes would
+                    # mislead a reader's binary search
+                    return int(BoundaryOrder.UNORDERED)
+                # unsigned lexicographic IS the defined order for binary
+                # columns, and it's how these bounds were computed — sorted
+                # string columns keep readers' binary search
+                unpack = None
+            else:
+                return int(BoundaryOrder.UNORDERED)  # INT96 etc.: stay safe
+        if unpack is None:
+            pairs = [
+                (mn, mx)
+                for mn, mx, null in zip(self.mins, self.maxs, self.null_pages)
+                if not null
+            ]
+        else:
+            pairs = [
+                (unpack.unpack(mn)[0], unpack.unpack(mx)[0])
+                for mn, mx, null in zip(self.mins, self.maxs, self.null_pages)
+                if not null
+            ]
+        if len(pairs) < 2:
+            return int(BoundaryOrder.ASCENDING)
+        if all(
+            b[0] >= a[0] and b[1] >= a[1] for a, b in zip(pairs, pairs[1:])
+        ):
+            return int(BoundaryOrder.ASCENDING)
+        if all(
+            b[0] <= a[0] and b[1] <= a[1] for a, b in zip(pairs, pairs[1:])
+        ):
+            return int(BoundaryOrder.DESCENDING)
+        return int(BoundaryOrder.UNORDERED)
+
+    def build(self):
+        if not self.ok:
+            return ()
+        ci = ColumnIndex(
+            null_pages=self.null_pages,
+            min_values=self.mins,
+            max_values=self.maxs,
+            boundary_order=self._boundary_order(),
+            null_counts=self.null_counts,
+        )
+        oi = OffsetIndex(page_locations=self.locations)
+        return (ci, oi)
+
+
+def _slice_values(values, a: int, b: int):
+    if isinstance(values, ByteArrayData):
+        off = values.offsets
+        sub = off[a : b + 1] - off[a]
+        return ByteArrayData(offsets=sub, data=values.data[off[a] : off[b]])
+    return values[a:b]
+
+
+def _value_width(values) -> int:
+    if isinstance(values, ByteArrayData):
+        n = len(values)
+        return max(int(len(values.data) / n) + 4, 5) if n else 8
+    arr = np.asarray(values)
+    if arr.ndim == 2:
+        return arr.shape[1]
+    return max(arr.itemsize, 1)
+
+
+def _split_pages(values, def_levels, rep_levels, column, max_page_size: int):
+    """Split a chunk into page-sized slices (~max_page_size of value data),
+    keeping repeated-value rows intact (page boundaries at rep==0)."""
+    n = len(def_levels) if def_levels is not None else len(values)
+    if n == 0:
+        yield values, def_levels, rep_levels
+        return
+    per_value = _value_width(values)
+    per_page = max(int(max_page_size // max(per_value, 1)), 1)
+    if n <= per_page:
+        yield values, def_levels, rep_levels
+        return
+    # candidate boundaries: rows (rep==0) if repeated, else any index
+    starts = list(range(0, n, per_page)) + [n]
+    if rep_levels is not None and len(rep_levels):
+        # Page boundaries must fall on row starts (rep == 0) so a row's
+        # repeated values never straddle pages.
+        row_starts = np.nonzero(np.asarray(rep_levels) == 0)[0]
+        fixed = [0]
+        for s in starts[1:-1]:
+            k = np.searchsorted(row_starts, s, side="left")
+            b = int(row_starts[k]) if k < len(row_starts) else n
+            if b > fixed[-1]:
+                fixed.append(b)
+        if fixed[-1] != n:
+            fixed.append(n)
+        starts = fixed
+    vpos = 0
+    for a, b in zip(starts[:-1], starts[1:]):
+        if def_levels is not None:
+            d_slice = def_levels[a:b]
+            nn = int((d_slice == column.max_def).sum())
+            v_slice = _slice_values(values, vpos, vpos + nn)
+            vpos += nn
+        else:
+            d_slice = None
+            v_slice = _slice_values(values, a, b)
+        r_slice = rep_levels[a:b] if rep_levels is not None else None
+        yield v_slice, d_slice, r_slice
+
+
+def encode_chunk(cfg: EncoderConfig, builder, kv: dict | None) -> EncodedChunk:
+    """Encode one buffered column chunk into page bytes + footer structs,
+    offsets relative to the chunk start. Pure w.r.t. the writer: the only
+    inputs are the frozen config, the builder SNAPSHOT (the writer has
+    already swapped in fresh builders), and this flush's KV metadata."""
+    column = builder.column
+    parts: list = []
+    pos = 0
+    uncompressed_total = 0
+
+    def write_page(header, block) -> None:
+        nonlocal pos, uncompressed_total
+        hdr = header.dumps()
+        parts.append(hdr)
+        parts.append(block)
+        pos += len(hdr) + len(block)
+        uncompressed_total += len(hdr) + (header.uncompressed_page_size or 0)
+
+    with timed_stage("write.encode", record_span=True) as clock:
+        typed = builder.typed_values()
+        def_levels = (
+            np.asarray(builder.def_levels, dtype=np.uint16)
+            if column.max_def > 0
+            else None
+        )
+        rep_levels = (
+            np.asarray(builder.rep_levels, dtype=np.uint16)
+            if column.max_rep > 0
+            else None
+        )
+        if def_levels is None:
+            num_entries = len(typed)
+        else:
+            num_entries = len(def_levels)
+            if builder._columnar_values is not None and len(def_levels) == 0:
+                # columnar input for optional column without explicit levels:
+                # treat as fully present
+                def_levels = np.full(len(typed), column.max_def, dtype=np.uint16)
+                num_entries = len(def_levels)
+        if rep_levels is not None and len(rep_levels) == 0:
+            rep_levels = np.zeros(num_entries, dtype=np.uint16)
+        null_count = (
+            int((def_levels != column.max_def).sum()) if def_levels is not None else 0
+        )
+
+        dict_result = builder.build_dictionary(typed)
+        dict_offset = None
+        encodings = {int(Encoding.RLE)}
+        enc_stats: list[PageEncodingStats] = []
+
+        if dict_result is not None:
+            dict_values, indices = dict_result
+            header, block = encode_dict_page(
+                column, dict_values, cfg.codec, cfg.with_crc
+            )
+            dict_offset = pos
+            write_page(header, block)
+            _metrics.inc("pages_written_total", encoding="PLAIN")
+            encodings.add(int(Encoding.PLAIN))
+            encodings.add(int(Encoding.RLE_DICTIONARY))
+            enc_stats.append(
+                PageEncodingStats(
+                    page_type=int(PageType.DICTIONARY_PAGE),
+                    encoding=int(Encoding.PLAIN),
+                    count=1,
+                )
+            )
+            value_encoding = Encoding.RLE_DICTIONARY
+            page_values = indices
+            dict_size = len(dict_values)
+        else:
+            value_encoding = cfg.column_encodings.get(column.path, Encoding.PLAIN)
+            page_values = typed
+            dict_size = None
+
+        data_offset = pos
+        n_pages = 0
+        index = (
+            _PageIndexBuilder(column, dict_result[0] if dict_result else None)
+            if cfg.write_page_index
+            else None
+        )
+        for v_slice, d_slice, r_slice in _split_pages(
+            page_values, def_levels, rep_levels, column, cfg.max_page_size
+        ):
+            page_offset = pos
+            if cfg.data_page_version == 1:
+                header, block = encode_data_page_v1(
+                    column, v_slice, d_slice, r_slice, value_encoding,
+                    cfg.codec, dict_size, cfg.with_crc,
+                )
+            else:
+                header, block = encode_data_page_v2(
+                    column, v_slice, d_slice, r_slice, value_encoding,
+                    cfg.codec, dict_size, cfg.with_crc,
+                )
+            write_page(header, block)
+            if index is not None:
+                index.add_page(
+                    page_offset, pos - page_offset, v_slice, d_slice, r_slice
+                )
+            n_pages += 1
+        _metrics.inc(
+            "pages_written_total", n_pages,
+            encoding=_metrics.encoding_name(value_encoding),
+        )
+        page_type = (
+            int(PageType.DATA_PAGE)
+            if cfg.data_page_version == 1
+            else int(PageType.DATA_PAGE_V2)
+        )
+        encodings.add(int(value_encoding))
+        enc_stats.append(
+            PageEncodingStats(
+                page_type=page_type, encoding=int(value_encoding), count=n_pages
+            )
+        )
+        stats = compute_statistics(
+            column.type, typed, null_count, column_is_unsigned(column)
+        )
+        if dict_result is not None:
+            # the dictionary IS the distinct set: record the exact count
+            stats.distinct_count = len(dict_result[0])
+        md = ColumnMetaData(
+            type=int(column.type),
+            encodings=sorted(encodings),
+            path_in_schema=list(column.path),
+            codec=cfg.codec,
+            num_values=num_entries,
+            total_uncompressed_size=uncompressed_total,
+            total_compressed_size=pos,
+            data_page_offset=data_offset,
+            dictionary_page_offset=dict_offset,
+            statistics=stats,
+            encoding_stats=enc_stats,
+            key_value_metadata=(
+                [KeyValue(key=k, value=v) for k, v in kv.items()] if kv else None
+            ),
+        )
+        bloom = None
+        spec = cfg.bloom_specs.get(column.path)
+        if spec is not None:
+            hash_src = dict_result[0] if dict_result is not None else typed
+            if len(hash_src):
+                from ..core.bloom import BloomFilter, bloom_hash_values
+
+                ndv, fpp = spec
+                bf = BloomFilter.sized_for(ndv or len(hash_src), fpp)
+                bf.insert_hashes(bloom_hash_values(column.type, hash_src))
+                bloom = (md, bf)
+        # file_offset: where this chunk's pages begin (parquet-cpp's
+        # convention; some readers sanity-check it against the page offsets)
+        cc = ColumnChunk(
+            file_offset=dict_offset if dict_offset is not None else data_offset,
+            meta_data=md,
+        )
+        built = index.build() if index is not None else None
+    _metrics.observe("encode_seconds", clock.seconds)
+    return EncodedChunk(
+        parts=parts, nbytes=pos, chunk=cc, index=built or None, bloom=bloom
+    )
+
+
+def _shift_chunk(ec: EncodedChunk, delta: int) -> None:
+    """Rebase one encoded chunk's offsets by `delta` (group stitch or final
+    file placement — the same arithmetic both times)."""
+    if delta == 0:
+        return
+    md = ec.chunk.meta_data
+    for attr in ("data_page_offset", "dictionary_page_offset", "index_page_offset"):
+        v = getattr(md, attr)
+        if v is not None:
+            setattr(md, attr, v + delta)
+    if ec.chunk.file_offset is not None:
+        ec.chunk.file_offset += delta
+    if ec.index:
+        for loc in ec.index[1].page_locations:
+            loc.offset += delta
+
+
+def assemble_group(
+    cfg: EncoderConfig, chunks: list, n_rows: int
+) -> EncodedRowGroup:
+    """Stitch per-chunk encodes (leaf order) into one row group with offsets
+    relative to the GROUP start."""
+    base = 0
+    total_bytes = 0
+    total_compressed = 0
+    ccs = []
+    indexes = []
+    blooms = []
+    for ec in chunks:
+        _shift_chunk(ec, base)
+        base += ec.nbytes
+        ccs.append(ec.chunk)
+        md = ec.chunk.meta_data
+        total_bytes += md.total_uncompressed_size
+        total_compressed += md.total_compressed_size
+        if cfg.write_page_index and ec.index:
+            indexes.append((ec.chunk, *ec.index))
+        if ec.bloom is not None:
+            blooms.append(ec.bloom)
+    first_md = ccs[0].meta_data if ccs else None
+    first_page_offset = None
+    if first_md is not None:
+        # file_offset = first page of the group, dictionary page included.
+        first_page_offset = (
+            first_md.dictionary_page_offset
+            if first_md.dictionary_page_offset is not None
+            else first_md.data_page_offset
+        )
+    rg = RowGroup(
+        columns=ccs,
+        total_byte_size=total_bytes,
+        total_compressed_size=total_compressed,
+        num_rows=n_rows,
+        file_offset=first_page_offset,
+        sorting_columns=list(cfg.sorting) if cfg.sorting else None,
+    )
+    return EncodedRowGroup(
+        chunks=chunks,
+        row_group=rg,
+        nbytes=base,
+        n_rows=n_rows,
+        indexes=indexes,
+        blooms=blooms,
+    )
+
+
+def commit_group(erg: EncodedRowGroup, sink, pos: int, codec_label: str) -> int:
+    """Rebase `erg` to absolute file position `pos` and write its bytes to
+    the sink. Returns the new position. The ONE place group bytes meet the
+    sink — serial and parallel writes are byte-identical because they both
+    end here, in submission order."""
+    for ec in erg.chunks:
+        _shift_chunk(ec, pos)  # chunks are group-relative: one shift places all
+    if erg.row_group.file_offset is not None:
+        erg.row_group.file_offset += pos
+    with stage("write.flush", erg.nbytes):
+        for ec in erg.chunks:
+            for part in ec.parts:
+                sink.write(part)
+    _metrics.inc("write_bytes_total", erg.nbytes, codec=codec_label)
+    return pos + erg.nbytes
+
+
+# -- the dedicated encode pool -------------------------------------------------
+
+_pool: ThreadPoolExecutor | None = None
+_pool_lock = threading.Lock()
+
+
+def encode_pool() -> ThreadPoolExecutor:
+    """The process-wide parallel-encode executor ("pqt-encode",
+    PQT_ENCODE_THREADS or min(cpu, 8) workers). Deliberately its OWN pool:
+    encode tasks are CPU-bound native/numpy work, and parking them in the
+    prepare, io or dataset pools would let a heavy write starve reads (or
+    deadlock a pool waiting on work it must itself run)."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            env = os.environ.get("PQT_ENCODE_THREADS")
+            workers = int(env) if env else min(os.cpu_count() or 1, 8)
+            _pool = ThreadPoolExecutor(
+                max_workers=max(1, workers), thread_name_prefix="pqt-encode"
+            )
+        return _pool
+
+
+class EncodePipeline:
+    """Per-writer parallel encode + in-order flush orchestrator.
+
+    submit() fans one row group's chunk encodes out on the pool (every chunk
+    an independent task — intra-group AND inter-group parallelism with no
+    nested submission, so the pool can never deadlock on itself) and hands
+    the ordered future list to the single flusher thread, which assembles,
+    rebases and commits finished groups to the sink STRICTLY in submission
+    order — the file's bytes are identical to the serial path's.
+
+    Backpressure: submit() blocks while the estimated in-flight encoded
+    bytes exceed `max_inflight_bytes` (at least one group is always
+    admitted, so a group larger than the budget still makes progress).
+
+    Faults (encode or flush) are captured, the queue is drained without
+    writing further groups, and the error re-raises from the next submit()/
+    drain() — the writer surfaces it as a typed WriterError. After an error
+    the pipeline never writes another byte (abort semantics are the sink's:
+    an atomic sink leaves no torn file)."""
+
+    def __init__(
+        self,
+        cfg: EncoderConfig,
+        sink,
+        start_pos: int,
+        *,
+        pool: ThreadPoolExecutor,
+        max_inflight_bytes: int = 256 << 20,
+    ):
+        self.cfg = cfg
+        self.sink = sink
+        self.pos = start_pos
+        self.pool = pool
+        self.max_inflight_bytes = int(max_inflight_bytes)
+        self.row_groups: list[RowGroup] = []
+        self.page_indexes: list[list] = []  # per committed group, when enabled
+        self.blooms: list = []  # (md, bf) in file order
+        self.error: BaseException | None = None
+        self._codec_label = _metrics.codec_name(cfg.codec)
+        self._lock = threading.Lock()
+        self._have_work = threading.Condition(self._lock)
+        self._room = threading.Condition(self._lock)
+        self._queue: deque = deque()  # (chunk_futures, n_rows, est_bytes)
+        self._inflight_bytes = 0
+        self._inflight_groups = 0
+        self._stopping = False
+        self._thread: threading.Thread | None = None
+
+    # -- producer side (writer thread) -----------------------------------------
+
+    def submit(self, builders: list, kvs: list, n_rows: int, est_bytes: int) -> None:
+        """Fan out one row group's chunk encodes (builders in leaf order,
+        kvs aligned) and queue it for in-order commit. Blocks for
+        backpressure; raises the captured pipeline error if one is set."""
+        with self._lock:
+            self._raise_pending()
+            while (
+                self._inflight_groups > 0
+                and self._inflight_bytes + est_bytes > self.max_inflight_bytes
+            ):
+                self._room.wait()
+                self._raise_pending()
+        futs = [
+            traced_submit(self.pool, encode_chunk, self.cfg, b, kv)
+            for b, kv in zip(builders, kvs)
+        ]
+        with self._lock:
+            self._queue.append((futs, n_rows, est_bytes))
+            self._inflight_bytes += est_bytes
+            self._inflight_groups += 1
+            if self._thread is None:
+                # the flusher carries the submitting context (an active
+                # decode_trace at first flush keeps collecting its spans)
+                from contextvars import copy_context
+
+                ctx = copy_context()
+                self._thread = threading.Thread(
+                    target=ctx.run, args=(self._run,), name="pqt-flush", daemon=True
+                )
+                self._thread.start()
+            self._have_work.notify()
+
+    def _raise_pending(self) -> None:
+        # caller holds self._lock
+        if self.error is not None:
+            raise self.error
+
+    # -- consumer side (the one flusher thread) --------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._stopping:
+                    self._have_work.wait()
+                if not self._queue:
+                    return  # stopping and drained
+                futs, n_rows, est = self._queue.popleft()
+            try:
+                if self.error is None:
+                    chunks = [f.result() for f in futs]
+                    erg = assemble_group(self.cfg, chunks, n_rows)
+                    erg.row_group.ordinal = len(self.row_groups)
+                    self.pos = commit_group(
+                        erg, self.sink, self.pos, self._codec_label
+                    )
+                    self.row_groups.append(erg.row_group)
+                    if self.cfg.write_page_index:
+                        self.page_indexes.append(erg.indexes)
+                    self.blooms.extend(erg.blooms)
+                else:
+                    for f in futs:  # error set: drop, but don't leak workers
+                        f.cancel()
+            except BaseException as e:  # noqa: BLE001 — deferred to the writer
+                with self._lock:
+                    if self.error is None:
+                        self.error = e
+            finally:
+                with self._lock:
+                    self._inflight_bytes -= est
+                    self._inflight_groups -= 1
+                    self._room.notify_all()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Block until every submitted group is committed; re-raise the
+        pipeline error if any group failed."""
+        with self._lock:
+            self._stopping = True
+            self._have_work.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join()
+        with self._lock:
+            self._raise_pending()
+
+    def abort(self) -> None:
+        """Stop without committing queued groups (their encodes are dropped).
+        Never raises — abort is the error path."""
+        from .sink import SinkError
+
+        with self._lock:
+            if self.error is None:
+                self.error = SinkError("write pipeline aborted")  # poison
+            self._stopping = True
+            self._have_work.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join()

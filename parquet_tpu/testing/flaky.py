@@ -1,4 +1,4 @@
-"""FlakySource: seeded transient-fault injection for the IO retry ladder.
+"""FlakySource/FlakySink: seeded transport-fault injection for the IO layers.
 
 The fault-injection harness in testing/faults.py corrupts BYTES (what a
 rotten disk or lying writer produces); this module corrupts the TRANSPORT —
@@ -17,6 +17,16 @@ replays exactly; each CALL re-rolls, so a retried read naturally has a fresh
 chance to succeed — the transient-fault shape. `fault_window` confines
 faults to a byte region (e.g. only the footer tail); `permanent=True` makes
 every read fail, the budget-exhaustion shape.
+
+FlakySink is the WRITE-side mirror: wrapped around any ByteSink it injects
+seeded write/flush/commit faults, the adversary for the FileWriter error
+path — flush failures must surface as typed WriterError and, because path
+sinks commit atomically, the destination must never hold a torn file:
+
+    sink = FlakySink(LocalFileSink(path), seed=7, error_rate=0.3)
+    with pytest.raises(WriterError):
+        with FileWriter(sink, schema) as w: ...
+    assert not os.path.exists(path)          # nothing committed
 """
 
 from __future__ import annotations
@@ -26,7 +36,7 @@ import time
 
 import numpy as np
 
-__all__ = ["FlakySource"]
+__all__ = ["FlakySource", "FlakySink"]
 
 
 class FlakySource:
@@ -119,4 +129,110 @@ class FlakySource:
 
     def __exit__(self, *exc):
         self.close()
+        return False
+
+
+class FlakySink:
+    """A ByteSink wrapper injecting seeded write-path faults (the mirror of
+    FlakySource for the FileWriter/sink error ladder).
+
+    Parameters
+    ----------
+    inner            the wrapped ByteSink
+    seed             rng seed; one stream across all fault draws
+    error_rate       probability a write raises a transient OSError(EIO)
+                     BEFORE any bytes reach the inner sink (clean failure)
+    fail_after_bytes when set, every write past this many successfully
+                     written bytes fails — the disk-full / quota shape
+    flush_error_rate probability flush() raises OSError(EIO)
+    commit_error     close() (the commit) raises OSError(EIO) — the
+                     rename-fails shape; abort stays clean
+    latency_s        fixed sleep added to every write (the PUT shape)
+    permanent        every write fails with EIO
+    """
+
+    def __init__(
+        self,
+        inner,
+        *,
+        seed: int = 0,
+        error_rate: float = 0.0,
+        fail_after_bytes: int | None = None,
+        flush_error_rate: float = 0.0,
+        commit_error: bool = False,
+        latency_s: float = 0.0,
+        permanent: bool = False,
+        sleep=time.sleep,
+    ):
+        self.inner = inner
+        self._rng = np.random.default_rng(seed)
+        self.error_rate = float(error_rate)
+        self.fail_after_bytes = fail_after_bytes
+        self.flush_error_rate = float(flush_error_rate)
+        self.commit_error = bool(commit_error)
+        self.latency_s = float(latency_s)
+        self.permanent = bool(permanent)
+        self._sleep = sleep
+        self.faults_injected = 0
+        self.writes = 0
+        self.bytes_written = 0
+
+    @property
+    def sink_id(self) -> str:
+        return self.inner.sink_id
+
+    def write(self, data) -> int:
+        self.writes += 1
+        if self.latency_s:
+            self._sleep(self.latency_s)
+        if self.permanent:
+            self.faults_injected += 1
+            raise OSError(_errno.EIO, "injected permanent EIO on write")
+        if (
+            self.fail_after_bytes is not None
+            and self.bytes_written + len(data) > self.fail_after_bytes
+        ):
+            self.faults_injected += 1
+            raise OSError(
+                _errno.ENOSPC,
+                f"injected write failure past {self.fail_after_bytes} bytes",
+            )
+        if self.error_rate and float(self._rng.random()) < self.error_rate:
+            self.faults_injected += 1
+            raise OSError(
+                _errno.EIO, f"injected transient EIO at write {self.writes}"
+            )
+        n = self.inner.write(data)
+        self.bytes_written += len(data)
+        return n
+
+    def tell(self) -> int:
+        return self.inner.tell()
+
+    def flush(self) -> None:
+        if self.flush_error_rate and float(self._rng.random()) < self.flush_error_rate:
+            self.faults_injected += 1
+            raise OSError(_errno.EIO, "injected EIO on flush")
+        self.inner.flush()
+
+    def close(self) -> None:
+        if self.commit_error:
+            self.faults_injected += 1
+            # the inner sink must not commit either: a failed commit that
+            # still renamed the temp file would be the torn-file bug itself
+            self.inner.abort()
+            raise OSError(_errno.EIO, "injected EIO on commit")
+        self.inner.close()
+
+    def abort(self) -> None:
+        self.inner.abort()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()
         return False

@@ -111,6 +111,12 @@ def main(argv=None) -> int:
         help="comma-separated columns recorded as the row ordering "
         "(metadata only; data is written as-is)",
     )
+    p.add_argument(
+        "--parallel", type=int, default=0, metavar="N",
+        help="encode row groups on N pqt-encode workers (0 = serial; "
+        "output bytes are identical either way, and the file commits "
+        "atomically at close)",
+    )
     p.add_argument("csv", help="input CSV file with header row")
     args = p.parse_args(argv)
 
@@ -142,6 +148,8 @@ def main(argv=None) -> int:
             wkw["bloom_filters"] = [c.strip() for c in args.bloom.split(",") if c.strip()]
         if args.sort:
             wkw["sorting_columns"] = [c.strip() for c in args.sort.split(",") if c.strip()]
+        if args.parallel:
+            wkw["parallel"] = args.parallel
         try:
             with FileWriter(args.output, schema, codec=args.codec, **wkw) as w:
                 for i, rec in enumerate(reader, start=2):

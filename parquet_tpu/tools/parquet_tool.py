@@ -353,6 +353,8 @@ def cmd_merge(args) -> int:
     chunk bytes copy verbatim, only footer offsets rewrite (compaction —
     the parquet-mr `parquet-tools merge` primitive; beyond the reference).
     Schemas must match exactly; page indexes/blooms are not carried.
+    The output goes through the atomic ByteSink (tmp+rename): an
+    interrupted merge never leaves a torn output file.
 
     Canonical form matches parquet-mr's argument order (inputs first):
         merge <inputs...> -o <output>

@@ -35,6 +35,15 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   io_footer_cache_hits/misses_total footer/metadata cache outcomes
   io_readahead_fetched/dropped_total  pqt-io readahead accepted vs shed
                                       (budget full); _errors_total swallowed
+  pages_written_total{encoding=}    pages ENCODED by the write side, per
+                                    wire encoding (dict pages count PLAIN)
+  write_bytes_total{codec=}         encoded row-group bytes committed to
+                                    byte sinks, per codec
+  encode_seconds                    histogram of per-chunk encode wall time
+                                    (the write-side chunk_decode_seconds)
+  sink_bytes_written_total          bytes actually written to byte sinks
+  sink_write_calls_total            sink write calls (BufferedSink's
+                                    write-combining shrinks it)
 
 Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as

@@ -345,14 +345,51 @@ def test_multithreaded_fused_prepare_scales(tmp_path):
         # A held GIL serializes the C walks, so threaded can NEVER beat
         # serial; a shared/loaded CI host merely makes any single sample
         # noisy. Retrying distinguishes the two: real parallelism wins some
-        # attempt, a serialized walk wins none.
+        # attempt, a serialized walk wins none. (8 attempts: on cgroup
+        # cpu-shares-throttled 2-vCPU boxes the quiet windows where threads
+        # can actually run side by side are minutes apart — observed 2-of-4
+        # spurious failures at 3 attempts with the walk fully GIL-free.)
         ts = tp = None
-        for _attempt in range(3):
+        for _attempt in range(8):
             ts = min(_walltime(serial) for _ in range(7))
             tp = min(_walltime(threaded) for _ in range(7))
             if tp < ts:
                 break
+    if tp >= ts and not _host_can_thread():
+        # the PREMISE failed, not the contract: this host (throttled
+        # shared vCPUs) cannot run even two known-GIL-free zlib threads
+        # side by side right now, so no walk could demonstrate scaling
+        pytest.skip("host cannot run 2 GIL-free C threads concurrently")
     assert tp < ts, f"no scaling: serial {ts * 1e3:.1f}ms threaded {tp * 1e3:.1f}ms"
+
+
+def _host_can_thread() -> bool:
+    """Calibration: can THIS host, RIGHT NOW, run two threads of plain C
+    work (zlib.compress — drops the GIL unconditionally) faster than the
+    same work serially? Distinguishes 'the fused walk holds the GIL' (a
+    real bug, fails everywhere) from 'this CI box has no second core to
+    give' (cgroup shares / SMT-sibling vCPUs / noisy neighbors)."""
+    import threading
+    import zlib
+
+    data = bytes(range(256)) * 8192  # ~2 MiB, big enough to dwarf overhead
+
+    def crunch():
+        for _ in range(4):
+            zlib.compress(data, 6)
+
+    crunch()
+    best_serial = min(_walltime(lambda: (crunch(), crunch())) for _ in range(3))
+
+    def pair():
+        threads = [threading.Thread(target=crunch) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    best_pair = min(_walltime(pair) for _ in range(3))
+    return best_pair < best_serial * 0.85
 
 
 def _walltime(fn):
