@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
 check: native lint
@@ -45,6 +45,12 @@ bench-io: native
 # sweep (pool 1/4/8 x 8/16 row groups, byte-identical to serial); host-only
 bench-write: native
 	python bench.py --write
+
+# record-assembly bench: vectorized level-scan engine vs scalar cursor walk
+# vs pyarrow to_pylist on flat/1-level/2-level tables (rows asserted
+# identical before timing); host-only, no accelerator
+bench-assembly: native
+	python bench.py --assembly
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -36,6 +36,15 @@ PQT_BENCH_DATASET=0 to skip it in a full run. PQT_IO_ROWS (default 400_000)
 and PQT_IO_LAT_MS (default 0.3) shape the `--io` io-layer sweep;
 PQT_BENCH_IO=0 skips it in a full run.
 
+`--assembly` benchmarks record assembly: the vectorized level-scan engine
+(core/assembly_vec, the iter_rows default) vs the scalar cursor walk
+(PQT_VEC_ASSEMBLY=0) vs pyarrow to_pylist, on flat / 1-level (the
+BENCH_r02 cfg5 LIST<int32> shape) / 2-level nested tables. Vec and scalar
+assemble the SAME pre-decoded chunks and the vec rows are asserted
+identical to the scalar rows before timing. PQT_ASSEMBLY_ROWS (default
+300_000) sizes the tables; PQT_BENCH_ASSEMBLY=0 skips it in a full run.
+The result rides the --json artifact under "assembly".
+
 `--io` benchmarks the io layer (parquet_tpu.io) against a latency-injected
 FlakySource (every read pays a simulated range-GET latency plus a transient
 EIO rate absorbed by the retry ladder): a coalesce-gap sweep (0 / 64 KiB /
@@ -821,6 +830,142 @@ def _phase_prepare() -> None:
     _emit(out)
 
 
+# -- the record-assembly benchmark (--assembly / phase "assembly") -------------
+
+ASSEMBLY_ROWS = int(os.environ.get("PQT_ASSEMBLY_ROWS", 300_000))
+
+
+def _assembly_tables(rows: int) -> dict:
+    """flat / 1-level / 2-level tables for the assembly-engine sweep. The
+    1-level config reproduces the BENCH_r02 cfg5 shape (LIST<int32>, avg 2
+    elements, empties) PLUS a null mask over ~1/16 of the rows, so the
+    pre-timing vec==scalar identity assert also covers the null-list
+    (slices-mask) path cfg5 itself never exercises."""
+    import pyarrow as pa
+
+    rng = np.random.default_rng(5)
+    flat = pa.table(
+        {
+            "i": pa.array(rng.integers(0, 1 << 50, rows), pa.int64()),
+            "f": pa.array(rng.standard_normal(rows)),
+            "s": pa.array(
+                [None if k % 11 == 0 else f"v{k % 97}" for k in range(rows)]
+            ),
+        }
+    )
+    lengths = rng.integers(0, 5, rows)
+    null_rows = rng.integers(0, 16, rows) == 0
+    lengths[null_rows] = 0
+    flat_vals = rng.integers(0, 1 << 30, int(lengths.sum())).astype(np.int32)
+    offsets = np.zeros(rows + 1, dtype=np.int64)
+    np.cumsum(lengths, out=offsets[1:])
+    lst = pa.table(
+        {
+            "v": pa.ListArray.from_arrays(
+                pa.array(offsets, pa.int32()),
+                pa.array(flat_vals),
+                mask=pa.array(null_rows),
+            )
+        }
+    )
+    ll = pa.table(
+        {
+            "ll": pa.array(
+                [
+                    None
+                    if i % 13 == 0
+                    else [list(range(j % 3)) for j in range(i % 4)]
+                    for i in range(rows)
+                ],
+                pa.list_(pa.list_(pa.int64())),
+            )
+        }
+    )
+    return {"flat": flat, "list1": lst, "list2": ll}
+
+
+def _phase_assembly() -> None:
+    """Record-assembly engine sweep: the vectorized level-scan engine
+    (core/assembly_vec) vs the scalar cursor walk vs pyarrow to_pylist, on
+    flat / 1-level / 2-level tables. Vec and scalar assemble from the SAME
+    pre-decoded chunks (pure engine time, gc paused like the production
+    reader's windows); pyarrow's to_pylist includes its own decode — it is
+    the external "rows in Python" comparator, not an engine isolate. Vec
+    output is asserted identical to scalar BEFORE any timing. The result
+    rides the --json artifact under "assembly"."""
+    import gc
+
+    import pyarrow.parquet as pq
+
+    from parquet_tpu.core.assembly import RecordAssembler
+    from parquet_tpu.core.assembly_vec import assemble_rows
+    from parquet_tpu.core.reader import FileReader
+
+    rows = ASSEMBLY_ROWS
+    scalar_repeats = max(1, REPEATS - 2)
+    out = {"config": "assembly", "rows": rows, "tables": {}}
+    for name, table in _assembly_tables(rows).items():
+        path = Path(f"/tmp/pqt_assembly_{name}_{rows}.parquet")
+        pq.write_table(table, path, row_group_size=1 << 20, compression="snappy")
+        with FileReader(str(path)) as r:
+            chunks = [r.read_row_group(i) for i in range(r.num_row_groups)]
+            schema = r.schema
+
+        def vec_all():
+            gc.disable()
+            try:
+                return [assemble_rows(schema, c, False) for c in chunks]
+            finally:
+                gc.enable()
+
+        def scalar_all():
+            gc.disable()
+            try:
+                return [
+                    list(RecordAssembler(schema, c, raw=False, engine="scalar"))
+                    for c in chunks
+                ]
+            finally:
+                gc.enable()
+
+        # identity BEFORE timing: the engines must agree on every row
+        v, s = vec_all(), scalar_all()
+        assert all(g is not None for g in v), f"{name}: vec engine declined"
+        assert v == s, f"{name}: vec rows differ from scalar rows"
+        del v, s
+
+        t_vec = timed(vec_all, REPEATS, f"assembly {name} vec", rows=rows)
+        t_scl = timed(
+            scalar_all, scalar_repeats, f"assembly {name} scalar", rows=rows
+        )
+        t_pa = timed(
+            lambda: pq.read_table(path).to_pylist(),
+            REPEATS,
+            f"assembly {name} pyarrow",
+            rows=rows,
+        )
+        out["tables"][name] = {
+            "rows_s_vec": round(rows / t_vec, 1),
+            "rows_s_scalar": round(rows / t_scl, 1),
+            "rows_s_pyarrow": round(rows / t_pa, 1),
+            "vs_scalar": round(t_scl / t_vec, 2),
+            "vs_pyarrow": round(t_pa / t_vec, 2),
+            "t_vec": round(t_vec, 4),
+            "t_scalar": round(t_scl, 4),
+            "t_pyarrow": round(t_pa, 4),
+        }
+        log(
+            f"bench: assembly {name}: vec {rows / t_vec / 1e6:.2f} M rows/s | "
+            f"scalar {rows / t_scl / 1e6:.3f} M rows/s | pyarrow "
+            f"{rows / t_pa / 1e6:.2f} M rows/s | vec/scalar "
+            f"{t_scl / t_vec:.1f}x | vec/pyarrow {t_pa / t_vec:.1f}x"
+        )
+    # the acceptance pin: >= 10x over the scalar engine on the cfg5-style
+    # 1-level nested table
+    out["nested_vec_vs_scalar"] = out["tables"]["list1"]["vs_scalar"]
+    _emit(out)
+
+
 # -- the IO-layer benchmark (--io / phase "io") --------------------------------
 
 IO_ROWS = int(os.environ.get("PQT_IO_ROWS", 400_000))
@@ -1242,6 +1387,18 @@ def main() -> None:
                 f"({r_ds['vs_depth0']:.2f}x over depth 0)"
             )
 
+    # record-assembly engine sweep (PQT_BENCH_ASSEMBLY=0 to skip): vec vs
+    # scalar vs pyarrow on flat/1-level/2-level tables
+    r_asm = None
+    if os.environ.get("PQT_BENCH_ASSEMBLY", "1") != "0":
+        r_asm = _run_phase("assembly")
+        if r_asm:
+            t1 = r_asm["tables"]["list1"]
+            log(
+                f"bench: assembly: nested vec {t1['rows_s_vec'] / 1e6:.2f} M rows/s, "
+                f"{r_asm['nested_vec_vs_scalar']:.1f}x over the scalar engine"
+            )
+
     # io-layer sweeps (PQT_BENCH_IO=0 to skip): coalesce gap + readahead
     # depth against a latency-injected flaky source
     r_io = None
@@ -1336,6 +1493,8 @@ def main() -> None:
         artifact["dataset"] = r_ds
     if r_io:
         artifact["io"] = r_io
+    if r_asm:
+        artifact["assembly"] = r_asm
     if results is not None:
         artifact["matrix"] = results
         for r in results:
@@ -1378,6 +1537,8 @@ if __name__ == "__main__":
         del argv[k : k + 2]
     if argv and argv[0] == "--dataset":
         _phase_dataset()
+    elif argv and argv[0] == "--assembly":
+        _phase_assembly()
     elif argv and argv[0] == "--io":
         _phase_io()
     elif argv and argv[0] == "--write":
@@ -1396,6 +1557,8 @@ if __name__ == "__main__":
             _phase_dataset()
         elif name == "io":
             _phase_io()
+        elif name == "assembly":
+            _phase_assembly()
         else:
             _phase_timed(name, build_file())
     else:

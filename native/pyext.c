@@ -14,6 +14,15 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+/* _PyDict_SetItem_KnownHash left the private headers in 3.13; the
+ * precomputed-hash insert is an optimization, not a dependency. */
+#if PY_VERSION_HEX >= 0x030D0000
+#define DICT_SETITEM_KNOWNHASH(d, k, v, h) PyDict_SetItem((d), (k), (v))
+#else
+#define DICT_SETITEM_KNOWNHASH(d, k, v, h) \
+  _PyDict_SetItem_KnownHash((d), (k), (v), (h))
+#endif
+
 #include <stdint.h>
 #include <string.h>
 
@@ -396,6 +405,9 @@ done:
 #define COLK_SLICES_ARR 2
 typedef struct {
   int kind;
+  PyObject *name;      /* interned column name */
+  Py_hash_t hash;      /* precomputed name hash: the per-cell insert skips
+                          PyObject_Hash (one call per CELL otherwise) */
   PyObject *list;      /* COLK_LIST: values; COLK_SLICES: elems */
   const int64_t *off;  /* COLK_SLICES* */
   const uint8_t *mask; /* COLK_SLICES*, may be NULL */
@@ -454,6 +466,9 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
     colspec *s = &cs[j];
     s->has_mb = 0;
     s->has_eb = 0;
+    s->name = PyTuple_GET_ITEM(names, j);
+    s->hash = PyObject_Hash(s->name);
+    if (s->hash == -1) goto fail;
     Py_ssize_t cn;
     if (PyList_Check(c)) {
       s->kind = COLK_LIST;
@@ -550,8 +565,8 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
     for (Py_ssize_t j = 0; j < k; j++) {
       colspec *s = &cs[j];
       if (s->kind == COLK_LIST) {
-        if (PyDict_SetItem(d, PyTuple_GET_ITEM(names, j),
-                           PyList_GET_ITEM(s->list, i)) < 0) {
+        if (DICT_SETITEM_KNOWNHASH(d, s->name, PyList_GET_ITEM(s->list, i),
+                                    s->hash) < 0) {
           Py_DECREF(d);
           goto fail_out;
         }
@@ -584,7 +599,7 @@ static PyObject *dict_rows(PyObject *self, PyObject *args) {
             goto fail_out;
           }
         }
-        int rc = PyDict_SetItem(d, PyTuple_GET_ITEM(names, j), v);
+        int rc = DICT_SETITEM_KNOWNHASH(d, s->name, v, s->hash);
         Py_DECREF(v);
         if (rc < 0) {
           Py_DECREF(d);

@@ -2,24 +2,19 @@
 
 The flat and canonical-LIST fast paths live in reader.to_arrow; this module
 covers everything else — structs, MAPs, multi-level lists, list-of-struct,
-struct-of-list, legacy repeated groups/leaves — by walking the schema tree
-once and deriving each node's Arrow layout (offsets, validity) from the
-repetition/definition level arrays with vectorized numpy, never touching
-values row by row (reference semantics: schema.go:216-312,
-floor/reader.go:302-409; the row-path analogue here is core/assembly.py).
+struct-of-list, legacy repeated groups/leaves — by converting the
+offsets/validity intermediate the vectorized assembly engine builds
+(core/assembly_vec.build_field_vec, mode="arrow") into pyarrow arrays: the
+SAME level prefix scan feeds row assembly and to_arrow, handed off
+zero-copy at the buffer level — offsets, null masks and dense value slices
+are shared numpy/chunk buffers, never re-derived or touched row by row
+(reference semantics: schema.go:216-312, floor/reader.go:302-409).
 
-Per-leaf stream state during the recursion:
-  sel      int64[k]  indices into the leaf's full level arrays that belong to
-                     the current node's element stream (always ascending)
-  slot_of  int64[k]  which slot of the current node each entry belongs to
-                     (non-decreasing; every slot has >= 1 entry until a list
-                     node with zero elements drops its placeholder)
-
-Two invariants make the leaf step cheap:
-  * a value-bearing entry (def == leaf.max_def) survives every list filter
-    above it, so the selected values are one CONTIGUOUS dense slice;
-  * every slot at struct granularity keeps exactly one entry per leaf, so
-    struct validity reads one level per slot.
+What stays here is the pyarrow-facing half: leaf array construction over
+the dense value slice (buffer handoff for byte arrays, retyping to logical
+Arrow types), list/map/struct array assembly from IR offsets and masks,
+and the Arrow type derivation (nested_arrow_type) that the builder's
+dispatch must match exactly.
 """
 
 from __future__ import annotations
@@ -30,31 +25,6 @@ from ..meta.file_meta import ParquetFileError
 from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
 
 __all__ = ["build_top_field", "nested_arrow_type", "retype_leaf"]
-
-
-class _LeafState:
-    __slots__ = ("leaf", "chunk", "rl", "dl", "present", "nvals_before")
-
-    def __init__(self, leaf, chunk):
-        self.leaf = leaf
-        self.chunk = chunk
-        n = chunk.num_values
-        self.rl = (
-            np.asarray(chunk.rep_levels, dtype=np.int64)
-            if chunk.rep_levels is not None
-            else np.zeros(n, dtype=np.int64)
-        )
-        self.dl = (
-            np.asarray(chunk.def_levels, dtype=np.int64)
-            if chunk.def_levels is not None
-            else np.full(n, leaf.max_def, dtype=np.int64)
-        )
-        # number of value-bearing entries before each position (for locating
-        # the dense slice start of any selection)
-        self.present = self.dl == leaf.max_def
-        self.nvals_before = np.concatenate(
-            [[0], np.cumsum(self.present[:-1])]
-        ) if n else np.zeros(0, dtype=np.int64)
 
 
 def _is_list_annotated(node) -> bool:
@@ -328,181 +298,67 @@ def _struct_type(pa, node, selected=None):
 
 def build_top_field(pa, schema, top_name: str, chunks: dict) -> "pa.Array":
     """Assemble one top-level field (all its leaf chunks from one row group)
-    into a pyarrow Array of length = the group's row count."""
-    top = schema.column((top_name,))
-    leaves = {
-        path: _LeafState(schema.column(path), cd)
-        for path, cd in chunks.items()
-        if path[0] == top_name
-    }
-    if not leaves:
+    into a pyarrow Array of length = the group's row count, by converting
+    the assembly engine's offsets/validity IR."""
+    from .assembly_vec import VecStructureError, build_field_vec
+
+    sub = {p: cd for p, cd in chunks.items() if p[0] == top_name}
+    if not sub:
         raise ParquetFileError(f"parquet: no leaf chunks for field {top_name}")
-    # root slots = records: an entry starts a record iff rep level == 0
-    state = {}
-    n_slots = None
-    for path, ls in leaves.items():
-        starts = ls.rl == 0
-        slot_of = np.cumsum(starts) - 1
-        sel = np.arange(len(ls.rl), dtype=np.int64)
-        state[path] = (sel, slot_of)
-        count = int(starts.sum())
-        if n_slots is None:
-            n_slots = count
-        elif n_slots != count:
-            raise ParquetFileError(
-                f"parquet: leaves of {top_name} disagree on row count "
-                f"({n_slots} vs {count})"
+    try:
+        vec, _n = build_field_vec(schema, top_name, sub, mode="arrow")
+    except VecStructureError as e:
+        raise ParquetFileError(f"parquet: {e}") from e
+    return _field_from_vec(pa, vec)
+
+
+def _field_from_vec(pa, vec):
+    """IR node -> pyarrow array. Offsets/null-mask ndarrays and dense leaf
+    buffers pass through without per-row work."""
+    from .assembly_vec import LeafVec, ListVec
+
+    if isinstance(vec, LeafVec):
+        return _leaf_array(pa, vec)
+
+    if isinstance(vec, ListVec):
+        valid = None if vec.null_mask is None else vec.null_mask == 0
+        if vec.kind == "map":
+            # arrow mode guarantees both kv children selected here
+            keys = _field_from_vec(pa, vec.child.children[0])
+            items = _field_from_vec(pa, vec.child.children[1])
+            off32 = vec.offsets.astype(np.int32)
+            if valid is not None:
+                # a null offset at i marks map i null; the final offset (the
+                # appended False) must stay valid
+                moff = pa.array(
+                    off32,
+                    mask=np.append(vec.null_mask.astype(bool), False),
+                    type=pa.int32(),
+                )
+                return pa.MapArray.from_arrays(moff, keys, items)
+            return pa.MapArray.from_arrays(
+                pa.array(off32, type=pa.int32()), keys, items
             )
-    return _build(pa, top, leaves, state, n_slots, parent_def=0)
+        values = _field_from_vec(pa, vec.child)
+        return _list_with_validity(pa, vec.offsets, values, valid)
 
-
-def _first_entry_levels(leaves, state):
-    """def level at each slot's first entry (shared above any descendant
-    leaf, so any leaf serves)."""
-    path = next(iter(state))
-    sel, slot_of = state[path]
-    ls = leaves[path]
-    n_slots = int(slot_of[-1]) + 1 if len(slot_of) else 0
-    firsts = np.searchsorted(slot_of, np.arange(n_slots), side="left")
-    return ls.dl[sel[firsts]]
-
-
-def _build(pa, node, leaves, state, n_slots, parent_def):
-    if node.is_leaf:
-        if node.repetition == FieldRepetitionType.REPEATED:
-            # legacy bare repeated primitive: a one-level list of non-null
-            # elements, no outer validity (repeated fields cannot be null)
-            offsets, elem_state, n_elems = _list_expand(
-                node, leaves, state, n_slots
-            )
-            values = _leaf_array(pa, node, leaves, elem_state, n_elems)
-            return pa.LargeListArray.from_arrays(offsets, values)
-        return _leaf_array(pa, node, leaves, state, n_slots)
-
-    if _is_map_annotated(node):
-        kv = node.children[0]
-        valid = None
-        if node.repetition == FieldRepetitionType.OPTIONAL:
-            valid = _first_entry_levels(leaves, state) >= node.max_def
-        offsets, elem_state, n_elems = _list_expand(kv, leaves, state, n_slots)
-        have = [
-            c
-            for c in kv.children
-            if any(p[: len(c.path)] == c.path for p in elem_state)
-        ]
-        if len(have) < 2:
-            # key or value projected out: no Arrow MAP without both —
-            # assemble the underlying list-of-struct over what's selected
-            values = _build_struct(
-                pa, kv, leaves, elem_state, n_elems, kv.max_def, force_valid=True
-            )
-            return _list_with_validity(pa, offsets, values, valid)
-        key_node, val_node = kv.children
-        keys = _build_child(pa, key_node, leaves, elem_state, n_elems, kv.max_def)
-        items = _build_child(pa, val_node, leaves, elem_state, n_elems, kv.max_def)
-        off32 = offsets.astype(np.int32)
-        if valid is not None and not valid.all():
-            # a null offset at i marks map i null; the final offset (the
-            # appended False) must stay valid
-            moff = pa.array(
-                off32, mask=np.append(~valid, False), type=pa.int32()
-            )
-            return pa.MapArray.from_arrays(moff, keys, items)
-        return pa.MapArray.from_arrays(pa.array(off32, type=pa.int32()), keys, items)
-
-    if _is_list_annotated(node):
-        rep = node.children[0]
-        valid = None
-        if node.repetition == FieldRepetitionType.OPTIONAL:
-            valid = _first_entry_levels(leaves, state) >= node.max_def
-        offsets, elem_state, n_elems = _list_expand(rep, leaves, state, n_slots)
-        if len(rep.children) == 1:
-            elem = rep.children[0]
-            values = _build_child(pa, elem, leaves, elem_state, n_elems, rep.max_def)
-        else:
-            values = _build_struct(
-                pa, rep, leaves, elem_state, n_elems, rep.max_def, force_valid=True
-            )
-        return _list_with_validity(pa, offsets, values, valid)
-
-    if node.repetition == FieldRepetitionType.REPEATED:
-        # legacy repeated group: list of non-null structs
-        offsets, elem_state, n_elems = _list_expand(node, leaves, state, n_slots)
-        values = _build_struct(
-            pa, node, leaves, elem_state, n_elems, node.max_def, force_valid=True
-        )
-        return pa.LargeListArray.from_arrays(offsets, values)
-
-    return _build_struct(pa, node, leaves, state, n_slots, parent_def)
-
-
-def _build_child(pa, child, leaves, state, n_slots, parent_def):
-    sub = {p: st for p, st in state.items() if p[: len(child.path)] == child.path}
-    sub_leaves = {p: leaves[p] for p in sub}
-    return _build(pa, child, sub_leaves, sub, n_slots, parent_def)
-
-
-def _build_struct(pa, node, leaves, state, n_slots, parent_def, force_valid=False):
-    valid = None
-    if node.repetition == FieldRepetitionType.OPTIONAL and not force_valid:
-        valid = _first_entry_levels(leaves, state) >= node.max_def
+    # StructVec
     children = []
     fields = []
-    for c in node.children:
-        sub = {p: st for p, st in state.items() if p[: len(c.path)] == c.path}
-        if not sub:
-            continue  # projected out
-        sub_leaves = {p: leaves[p] for p in sub}
-        children.append(_build(pa, c, sub_leaves, sub, n_slots, node.max_def))
+    for name, child_vec in zip(vec.names, vec.children):
+        arr = _field_from_vec(pa, child_vec)
+        children.append(arr)
         fields.append(
             pa.field(
-                c.name,
-                children[-1].type,
-                nullable=c.repetition != FieldRepetitionType.REQUIRED,
+                name,
+                arr.type,
+                nullable=child_vec.node.repetition != FieldRepetitionType.REQUIRED,
             )
         )
     mask = None
-    if valid is not None and not valid.all():
-        mask = pa.array(~valid)
+    if vec.null_mask is not None:
+        mask = pa.array(vec.null_mask.astype(bool))
     return pa.StructArray.from_arrays(children, fields=fields, mask=mask)
-
-
-def _list_expand(rep_node, leaves, state, n_slots):
-    """Expand the current slots through one repeated node: returns
-    (int64 offsets [n_slots+1], per-leaf element stream state, n_elements).
-
-    An entry starts an element of this list iff its rep level <= the node's
-    rep depth; the element exists iff its def level >= the node's def
-    threshold (below that the entry is the placeholder of an empty or null
-    or ancestor-null list and is dropped from the child stream)."""
-    q = rep_node.max_rep
-    d_r = rep_node.max_def
-    offsets = None
-    elem_state = {}
-    n_elems = None
-    for path, (sel, slot_of) in state.items():
-        ls = leaves[path]
-        rl = ls.rl[sel]
-        dl = ls.dl[sel]
-        is_start = (rl <= q - 1) | (rl == q)  # rl <= q
-        exists = dl >= d_r
-        elem_start = is_start & exists
-        lengths = np.bincount(slot_of[elem_start], minlength=n_slots)
-        offs = np.zeros(n_slots + 1, dtype=np.int64)
-        np.cumsum(lengths, out=offs[1:])
-        if offsets is None:
-            offsets = offs
-            n_elems = int(offs[-1])
-        elif not np.array_equal(offsets, offs):
-            raise ParquetFileError(
-                f"parquet: leaves under {rep_node.path_str} disagree on "
-                "list structure"
-            )
-        keep = exists
-        new_sel = sel[keep]
-        new_slot = np.cumsum(elem_start)[keep] - 1
-        elem_state[path] = (new_sel, new_slot.astype(np.int64))
-    return offsets, elem_state, n_elems
 
 
 def _list_with_validity(pa, offsets, values, valid):
@@ -520,35 +376,35 @@ def _list_with_validity(pa, offsets, values, valid):
     return pa.LargeListArray.from_arrays(offsets, values)
 
 
-def _leaf_array(pa, leaf, leaves, state, n_slots):
-    """Build the leaf's Arrow array over the current slots (one entry per
-    slot). The dense values of the selected entries are one contiguous
-    slice: a value-bearing entry (def == max_def) can never be dropped by a
-    list filter above it."""
+def _leaf_array(pa, vec):
+    """Build a LeafVec's Arrow array (one entry per slot). The dense values
+    of the selected entries are one contiguous slice of the chunk: a
+    value-bearing entry (def == max_def) can never be dropped by a list
+    filter above it."""
     from .arrays import ByteArrayData
 
-    ls = leaves[leaf.path]
-    sel, slot_of = state[leaf.path]
-    if len(sel) != n_slots:
-        raise ParquetFileError(
-            f"parquet: leaf {leaf.path_str} stream does not align with its "
-            f"slots ({len(sel)} entries for {n_slots} slots)"
-        )
-    valid = ls.present[sel]
-    nv = int(valid.sum())
-    k0 = int(ls.nvals_before[sel[0]]) if len(sel) and nv else 0
-    values = ls.chunk.values
-    mask = None if bool(valid.all()) else ~valid
+    leaf = vec.node
+    values = vec.chunk.values
+    n_slots = vec.n
+    nv = vec.nv
+    k0 = vec.k0
+    valid = vec.valid  # bool[n_slots] | None (None = every slot present)
+    mask = None if valid is None else ~valid
 
     if isinstance(values, ByteArrayData):
         atype = pa.large_string() if leaf.is_string() else pa.large_binary()
         all_offsets = np.asarray(values.offsets, dtype=np.int64)
         dense_off = all_offsets[k0 : k0 + nv + 1]
-        lens = np.zeros(n_slots, dtype=np.int64)
-        if nv:
-            lens[valid] = np.diff(dense_off)
-        out_off = np.zeros(n_slots + 1, dtype=np.int64)
-        np.cumsum(lens, out=out_off[1:])
+        if mask is None:
+            out_off = np.zeros(n_slots + 1, dtype=np.int64)
+            if nv:
+                np.cumsum(np.diff(dense_off), out=out_off[1:])
+        else:
+            lens = np.zeros(n_slots, dtype=np.int64)
+            if nv:
+                lens[valid] = np.diff(dense_off)
+            out_off = np.zeros(n_slots + 1, dtype=np.int64)
+            np.cumsum(lens, out=out_off[1:])
         data = values.data[
             int(dense_off[0]) if nv else 0 : int(dense_off[-1]) if nv else 0
         ]

@@ -1,9 +1,17 @@
-"""Dremel record assembly: repetition/definition levels -> nested rows.
+"""Dremel record assembly: the scalar cursor walk and shared value plumbing.
 
 Host-side equivalent of the reference's record-assembly stack
 (reference: schema.go:216-312 getData/getNextData, data_store.go:262-309
 ColumnStore.get): walks the schema tree with one cursor per leaf and rebuilds
 each row's nested structure from the level streams.
+
+The DEFAULT assembly engine lives in core/assembly_vec.py: whole-column
+prefix scans over the level streams build an offsets/validity intermediate
+and materialize rows by batched slicing, ~10-100x faster than this walk.
+The cursor walk here remains as the PQT_VEC_ASSEMBLY=0 fallback, the
+engine for shapes the scans cannot prove, and the differential-test oracle
+(RecordAssembler iterates through the vectorized engine by default; pass
+engine="scalar" to force the walk).
 
 Two output modes:
   raw=True   reference-style nested maps: LIST/MAP annotations are not
@@ -18,7 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..meta.parquet_types import ConvertedType, FieldRepetitionType, Type
-from .arrays import ByteArrayData, _ext
+from .arrays import ByteArrayData
 from .chunk import ChunkData
 from .schema import Column, Schema
 
@@ -29,17 +37,10 @@ class AssemblyError(ValueError):
     pass
 
 
-# dtype chars the C dict_rows array-elems path accepts, with the itemsize it
-# assumes for each (mirrors pyext.c's format check so ineligible arrays fall
-# back to the tolist path instead of raising)
-_ARR_ELEM_SIZES = {
-    "b": 1, "B": 1, "?": 1, "h": 2, "H": 2, "i": 4, "I": 4, "f": 4,
-    "l": 8, "L": 8, "q": 8, "Q": 8, "d": 8,
-}
-
-
 class _LeafCursor:
-    __slots__ = ("chunk", "pos", "vpos", "max_def", "max_rep", "n")
+    __slots__ = (
+        "chunk", "pos", "vpos", "max_def", "max_rep", "n", "nvals", "defs", "reps",
+    )
 
     def __init__(self, chunk: ChunkData):
         self.chunk = chunk
@@ -48,14 +49,26 @@ class _LeafCursor:
         self.max_def = chunk.column.max_def
         self.max_rep = chunk.column.max_rep
         self.n = chunk.num_values
+        try:
+            self.nvals = len(chunk.values)
+        except TypeError:
+            self.nvals = chunk.num_values
+        # pre-convert the level arrays ONCE per chunk: a per-entry
+        # `int(levels[pos])` pays numpy scalar extraction + int() in the
+        # walk's innermost loop; a plain-int list indexes at C speed
+        # (~2-3x on the whole walk) and keeps the oracle usable in tests
+        d = chunk.def_levels
+        r = chunk.rep_levels
+        self.defs = np.asarray(d).tolist() if d is not None else None
+        self.reps = np.asarray(r).tolist() if r is not None else None
 
     def peek_def(self) -> int:
-        d = self.chunk.def_levels
-        return int(d[self.pos]) if d is not None else self.max_def
+        d = self.defs
+        return d[self.pos] if d is not None else self.max_def
 
     def peek_rep(self) -> int:
-        r = self.chunk.rep_levels
-        return int(r[self.pos]) if r is not None else 0
+        r = self.reps
+        return r[self.pos] if r is not None else 0
 
     def exhausted(self) -> bool:
         return self.pos >= self.n
@@ -65,6 +78,12 @@ class _LeafCursor:
 
     def pop_value(self):
         i = self.vpos
+        if i >= self.nvals:
+            # fewer values than the def levels promise: typed, not IndexError
+            raise AssemblyError(
+                f"assembly: {self.chunk.column.path_str}: value stream "
+                f"exhausted at {i} (levels promise more)"
+            )
         self.vpos += 1
         self.pos += 1
         return self.chunk.values[i]
@@ -88,732 +107,6 @@ def _leaf_python_values(node: Column, chunk: ChunkData, raw: bool) -> list:
         conv = convert_logical
         vals = [conv(node, x) for x in vals]
     return vals
-
-
-def _flat_column_values(node: Column, chunk: ChunkData, raw: bool) -> list:
-    """One flat leaf column as a row-aligned Python list (nulls expanded)."""
-    vals = _leaf_python_values(node, chunk, raw)
-    if node.max_def == 1 and chunk.def_levels is not None:
-        mask = chunk.def_levels == 1
-        full = [None] * chunk.num_values
-        it = iter(vals)
-        for idx in np.nonzero(mask)[0]:
-            full[idx] = next(it)
-        vals = full
-    return vals
-
-
-def _flat_columns(chunks: dict[tuple, ChunkData], raw: bool):
-    """(names, column value lists, n_rows) for flat schemas (no groups, no
-    repetition) — per-column null-expansion at C speed via ndarray.tolist().
-    None when the shape needs more than that."""
-    cols = []
-    for path, chunk in chunks.items():
-        node = chunk.column
-        if len(path) != 1 or not node.is_leaf or node.max_rep > 0 or node.max_def > 1:
-            return None
-        cols.append((node, chunk))
-    n = None
-    for _node, chunk in cols:
-        if n is None:
-            n = chunk.num_values
-        elif n != chunk.num_values:
-            return None
-    if n is None:
-        return [], [], 0
-    names = [node.name for node, _ in cols]
-    return names, [_flat_column_values(node, chunk, raw) for node, chunk in cols], n
-
-
-def fast_flat_rows(chunks: dict[tuple, ChunkData], raw: bool):
-    """Vectorized row assembly for flat schemas (the recursive assembler
-    costs ~14 us/row; this is one zip at C speed). None when the shape needs
-    the full Dremel walk."""
-    fc = _flat_columns(chunks, raw)
-    if fc is None:
-        return None
-    names, columns, _n = fc
-    if not names:
-        return []
-    return _zip_dict_rows(names, columns)
-
-
-def _list_wrapper(top: Column):
-    """The repeated middle group of a canonical LIST wrapper, or None."""
-    ct = top.converted_type
-    lt = top.logical_type
-    is_list = ct == ConvertedType.LIST or (lt is not None and lt.LIST is not None)
-    if not is_list or len(top.children) != 1:
-        return None
-    mid = top.children[0]
-    if mid.repetition != FieldRepetitionType.REPEATED or mid.max_rep != 1:
-        return None
-    return mid
-
-
-def _canonical_list_nodes(top: Column, chunks) -> tuple | None:
-    """(mid, leaf) when `top` is a canonical LIST of scalars whose single
-    leaf chunk is present: 3-level {top (LIST) -> repeated mid -> leaf} or
-    2-level legacy {top -> repeated leaf}. None otherwise."""
-    mid = _list_wrapper(top)
-    if mid is None:
-        return None
-    if mid.is_leaf:
-        return (mid, mid) if mid.path in chunks else None  # 2-level legacy
-    if len(mid.children) != 1:
-        return None
-    leaf = mid.children[0]
-    if not leaf.is_leaf or leaf.max_rep != 1:
-        return None
-    return (mid, leaf) if leaf.path in chunks else None
-
-
-def _list_column_values(top: Column, mid: Column, leaf: Column,
-                        chunk: ChunkData, raw: bool) -> list | None:
-    """Vectorized assembly of one canonical LIST-of-scalars column.
-
-    Entry classification is pure ndarray math on the level arrays; only the
-    final per-row slice-to-list runs in Python (two ops per row). The
-    recursive cursor walk costs ~10 us per ELEMENT; this costs ~0.3 us per
-    row + C-speed element copies.
-    """
-    dfl = chunk.def_levels
-    rep = chunk.rep_levels
-    if dfl is None or rep is None:
-        return None
-    row_start = np.flatnonzero(rep == 0)
-    n_rows = len(row_start)
-    if n_rows == 0:
-        return []
-    # plain numeric leaf with no logical conversion: keep the ndarray — the
-    # C dict_rows builds each row's element list straight from the buffer,
-    # skipping the whole-column tolist() (the assembly hot path's largest
-    # single cost on LIST<numeric> columns)
-    arr = None
-    if (
-        _ext is not None
-        and not isinstance(chunk.values, ByteArrayData)
-        and (raw or logical_kind(leaf) is None)
-    ):
-        a = np.asarray(chunk.values)
-        if (
-            a.ndim == 1
-            and a.dtype.isnative
-            and _ARR_ELEM_SIZES.get(a.dtype.char) == a.dtype.itemsize
-        ):
-            arr = np.ascontiguousarray(a)
-    vals = arr if arr is not None else _leaf_python_values(leaf, chunk, raw)
-    has_elem = dfl >= mid.max_def  # entry carries an element (maybe null)
-    n_elem = int(has_elem.sum())
-    if mid is leaf:
-        if len(vals) != n_elem:
-            raise AssemblyError(
-                f"assembly: {leaf.path_str}: {len(vals)} values for "
-                f"{n_elem} elements"
-            )
-        elems = vals
-    else:
-        is_val_within = dfl[has_elem] == leaf.max_def
-        n_present = int(is_val_within.sum())
-        if len(vals) != n_present:
-            raise AssemblyError(
-                f"assembly: {leaf.path_str}: {len(vals)} values for "
-                f"{n_present} present elements"
-            )
-        if n_present == n_elem:
-            elems = vals  # no null elements: the value list IS the entry list
-        else:
-            full = np.empty(n_elem, dtype=object)  # initialized to None
-            full[is_val_within] = (
-                arr.tolist() if arr is not None else vals
-            )
-            elems = full.tolist()
-    # per-row element counts WITHOUT a full cumsum/bincount pass: a
-    # no-element marker (null/empty list) appears only as a row's single
-    # record, so count = segment length minus that one marker
-    seg_len = np.diff(np.append(row_start, len(rep)))
-    counts = seg_len - np.where(has_elem[row_start], 0, 1)
-    offsets = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(counts, out=offsets[1:])
-    if int(offsets[-1]) != n_elem:
-        raise AssemblyError(
-            f"assembly: {leaf.path_str}: inconsistent repetition levels"
-        )
-    first_def = dfl[row_start]
-    if _ext is not None:
-        # defer the per-row slicing: dict_rows slices elements straight into
-        # each row dict (one pass instead of slice-list + dict-zip)
-        all_present = top.max_def == 0 or bool((first_def >= top.max_def).all())
-        mask = None if all_present else (first_def < top.max_def).astype(np.uint8)
-        return ("slices", elems, offsets, mask)
-    return _rows_from_entries(top, first_def, elems, offsets)
-
-
-def _canonical_list_of_struct_nodes(top: Column, chunks) -> tuple | None:
-    """(mid, elem, leaves) when `top` is a canonical LIST whose element is a
-    group of scalar leaves, all present in chunks; None otherwise."""
-    mid = _list_wrapper(top)
-    if mid is None or mid.is_leaf or len(mid.children) != 1:
-        return None
-    elem = mid.children[0]
-    if elem.is_leaf or elem.max_rep != 1:
-        return None
-    leaves = [c for c in elem.children if c.path in chunks]
-    if not leaves or any(not c.is_leaf or c.max_rep != 1 for c in leaves):
-        return None
-    return mid, elem, leaves
-
-
-def _list_of_struct_column_values(top: Column, mid: Column, elem: Column,
-                                  leaves: list, chunks, raw: bool):
-    """Vectorized assembly of LIST<struct-of-scalars> (e.g. list[Point]).
-
-    Entry structure (row boundaries, element presence, struct nullity) comes
-    from the FIRST leaf's level arrays; each leaf contributes a row-aligned
-    element array; elements zip into dicts at C speed.
-    """
-    first = chunks[leaves[0].path]
-    dfl0, rep0 = first.def_levels, first.rep_levels
-    if dfl0 is None or rep0 is None:
-        return None
-    row_start = np.flatnonzero(rep0 == 0)
-    n_rows = len(row_start)
-    if n_rows == 0:
-        return []
-    has_elem = dfl0 >= mid.max_def  # entry carries a (maybe-null) element
-    elem_present = dfl0 >= elem.max_def  # the struct itself is non-null
-    n_elem = int(has_elem.sum())
-    cols = []
-    for leaf in leaves:
-        chunk = chunks[leaf.path]
-        dfl = chunk.def_levels
-        if dfl is None or len(dfl) != len(dfl0):
-            return None
-        vals = _leaf_python_values(leaf, chunk, raw)
-        present = dfl[has_elem] == leaf.max_def
-        if len(vals) != int(present.sum()):
-            raise AssemblyError(
-                f"assembly: {leaf.path_str}: {len(vals)} values for "
-                f"{int(present.sum())} present entries"
-            )
-        full = np.empty(n_elem, dtype=object)
-        full[present] = vals
-        cols.append((leaf.name, full.tolist()))
-    names = [name for name, _ in cols]
-    structs = _zip_dict_rows(names, [v for _, v in cols])
-    # null struct elements (def between mid and elem thresholds)
-    null_elem = ~elem_present[has_elem]
-    if null_elem.any():
-        for i in np.flatnonzero(null_elem).tolist():
-            structs[i] = None
-    row_of = np.cumsum(rep0 == 0) - 1
-    counts = np.bincount(row_of[has_elem], minlength=n_rows)
-    offsets = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(counts, out=offsets[1:])
-    return _rows_from_entries(top, dfl0[row_start], structs, offsets)
-
-
-def _rows_from_entries(top: Column, first_def, elems_list: list, offsets) -> list:
-    """Slice per-entry element values into per-row lists, applying null-row
-    detection from the first entry's definition level (shared tail of the
-    LIST / MAP / LIST<struct> vectorized paths)."""
-    all_present = top.max_def == 0 or bool((first_def >= top.max_def).all())
-    if _ext is not None:
-        mask = None if all_present else (first_def < top.max_def).astype(np.uint8)
-        return _ext.rows_from_slices(elems_list, np.ascontiguousarray(offsets), mask)
-    off = offsets.tolist()
-    if all_present:
-        return [elems_list[a:b] for a, b in zip(off[:-1], off[1:])]
-    null_row = (first_def < top.max_def).tolist()
-    return [
-        None if is_null else elems_list[a:b]
-        for is_null, a, b in zip(null_row, off[:-1], off[1:])
-    ]
-
-
-def _col_len(col) -> int:
-    """Row count of a column value list or a deferred slices spec."""
-    if isinstance(col, tuple):
-        return len(col[2]) - 1
-    return len(col)
-
-
-def _zip_dict_rows(names: list, columns: list) -> list:
-    """Zip column value lists (or deferred slices specs, see
-    _list_column_values) into row dicts — C fast path when built; specs are
-    only produced when it is. Very wide tables (>256 columns, past the C
-    helper's stack table) take the Python zip."""
-    if _ext is not None and len(names) <= 256:
-        return _ext.dict_rows(tuple(names), tuple(columns))
-    columns = [
-        _rows_from_entries_spec(c) if isinstance(c, tuple) else c for c in columns
-    ]
-    return [dict(zip(names, row)) for row in zip(*columns)]
-
-
-def _rows_from_entries_spec(spec) -> list:
-    """Materialize a deferred ("slices", elems, offsets, mask) column."""
-    _tag, elems, offsets, mask = spec
-    if isinstance(elems, np.ndarray):  # array-backed spec (C path skipped)
-        # convert only this window's element range (a window-sliced spec
-        # keeps the FULL elems array with absolute offsets — a whole-column
-        # tolist here would repeat per window)
-        base = int(offsets[0]) if len(offsets) else 0
-        elems = elems[base : int(offsets[-1]) if len(offsets) else 0].tolist()
-        offsets = offsets - base
-    off = offsets.tolist()
-    if mask is None:
-        return [elems[a:b] for a, b in zip(off[:-1], off[1:])]
-    return [
-        None if m else elems[a:b]
-        for m, a, b in zip(mask.tolist(), off[:-1], off[1:])
-    ]
-
-
-def _canonical_map_nodes(top: Column, chunks) -> tuple | None:
-    """(kv, key, value) when `top` is a canonical MAP of scalar key/value
-    with both leaf chunks present; None otherwise."""
-    ct = top.converted_type
-    lt = top.logical_type
-    is_map = ct in (ConvertedType.MAP, ConvertedType.MAP_KEY_VALUE) or (
-        lt is not None and lt.MAP is not None
-    )
-    if not is_map or len(top.children) != 1:
-        return None
-    kv = top.children[0]
-    if (
-        kv.repetition != FieldRepetitionType.REPEATED
-        or kv.max_rep != 1
-        or len(kv.children) != 2
-    ):
-        return None
-    key, value = kv.children
-    if not (key.is_leaf and value.is_leaf):
-        return None
-    # the vectorized path assumes spec-compliant maps: REQUIRED keys, one
-    # level of repetition; legacy files that violate this (optional keys
-    # under MAP_KEY_VALUE) fall back to the Dremel assembler
-    if key.repetition != FieldRepetitionType.REQUIRED:
-        return None
-    if key.max_rep != 1 or value.max_rep != 1:
-        return None
-    if key.path not in chunks or value.path not in chunks:
-        return None
-    return kv, key, value
-
-
-def _map_column_values(top: Column, kv: Column, key: Column, value: Column,
-                       kchunk: ChunkData, vchunk: ChunkData, raw: bool):
-    """Vectorized assembly of one canonical MAP-of-scalars column into row
-    dicts (same entry math as _list_column_values; keys are REQUIRED within
-    a present key_value entry, values may be null)."""
-    kdfl, krep = kchunk.def_levels, kchunk.rep_levels
-    vdfl = vchunk.def_levels
-    if kdfl is None or krep is None or vdfl is None:
-        return None
-    if len(kdfl) != len(vdfl):
-        return None
-    row_start = np.flatnonzero(krep == 0)
-    n_rows = len(row_start)
-    if n_rows == 0:
-        return []
-    has_kv = kdfl >= kv.max_def
-    n_kv = int(has_kv.sum())
-    keys = _leaf_python_values(key, kchunk, raw)
-    if len(keys) != n_kv:
-        raise AssemblyError(
-            f"assembly: {key.path_str}: {len(keys)} keys for {n_kv} map entries"
-        )
-    vals = _leaf_python_values(value, vchunk, raw)
-    velems = np.empty(n_kv, dtype=object)
-    present = vdfl[has_kv] == value.max_def
-    if len(vals) != int(present.sum()):
-        raise AssemblyError(
-            f"assembly: {value.path_str}: {len(vals)} values for "
-            f"{int(present.sum())} present entries"
-        )
-    velems[present] = vals
-    row_of = np.cumsum(krep == 0) - 1
-    counts = np.bincount(row_of[has_kv], minlength=n_rows)
-    offsets = np.zeros(n_rows + 1, dtype=np.int64)
-    np.cumsum(counts, out=offsets[1:])
-    pairs = list(zip(keys, velems.tolist()))
-    rows = _rows_from_entries(top, kdfl[row_start], pairs, offsets)
-    return [None if r is None else dict(r) for r in rows]
-
-
-def _struct_column_values(top: Column, chunks, raw: bool):
-    """Vectorized assembly of a non-repeated group of scalar leaves.
-
-    Every selected leaf expands to a row-aligned list; the struct itself is
-    None on rows where its definition level shows the group absent (read
-    from any selected leaf's def levels). Returns None when the shape
-    doesn't fit (repeated/nested children)."""
-    if top.max_rep != 0:
-        return None
-    leaves = []
-    for child in top.children:
-        if not child.is_leaf or child.max_rep != 0:
-            return None
-        if child.path in chunks:
-            leaves.append(child)
-    if not leaves:
-        return None
-    first = chunks[leaves[0].path]
-    if first.def_levels is None and top.max_def > 0:
-        return None
-    n = first.num_values
-    cols = []
-    for leaf in leaves:
-        chunk = chunks[leaf.path]
-        if chunk.num_values != n:
-            return None
-        vals = _leaf_python_values(leaf, chunk, raw)
-        if leaf.max_def > 0 and chunk.def_levels is not None:
-            present = chunk.def_levels == leaf.max_def
-            if int(present.sum()) != len(vals):
-                raise AssemblyError(
-                    f"assembly: {leaf.path_str}: {len(vals)} values for "
-                    f"{int(present.sum())} present entries"
-                )
-            full = np.empty(n, dtype=object)
-            full[present] = vals
-            vals = full.tolist()
-        cols.append((leaf.name, vals))
-    names = [name for name, _ in cols]
-    rows = _zip_dict_rows(names, [v for _, v in cols])
-    if top.max_def > 0:
-        # struct is null where the def level sits below its own max_def
-        null_mask = (first.def_levels < top.max_def).tolist()
-        rows = [None if is_null else r for is_null, r in zip(null_mask, rows)]
-    return rows
-
-
-def fast_row_columns(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
-    """Column-oriented vectorized assembly for flat schemas plus canonical
-    LIST-of-scalars and MAP-of-scalars columns (the overwhelmingly common
-    nested shapes). Returns (names, columns, n_rows) where each column is a
-    row-aligned value list or a deferred ("slices", ...) spec (see
-    _list_column_values) that _zip_dict_rows materializes — callers may
-    window-slice columns to bound live row objects. None when any column
-    needs the full Dremel walk (deep nesting, structs, non-compliant legacy
-    maps, raw-mode nested columns — raw rows carry the wire shape the
-    vectorized path doesn't build)."""
-    flat_cols = _flat_columns(chunks, raw)
-    if flat_cols is not None:
-        names, columns, n = flat_cols
-        return names, columns, n
-    if raw:
-        return None
-    by_top: dict[str, list] = {}
-    for path in chunks:
-        by_top.setdefault(path[0], []).append(path)
-    columns = []  # (name, value list | slices spec)
-    n_rows = None
-    for top in schema.root.children:
-        paths = by_top.get(top.name)
-        if not paths:
-            continue  # not selected
-        if top.is_leaf and top.max_rep == 0 and top.max_def <= 1:
-            columns.append((top.name, _flat_column_values(top, chunks[paths[0]], raw)))
-        else:
-            ln = _canonical_list_nodes(top, chunks)
-            if ln is not None and len(paths) == 1:
-                mid, leaf = ln
-                vals = _list_column_values(top, mid, leaf, chunks[paths[0]], raw)
-            else:
-                mn = _canonical_map_nodes(top, chunks)
-                if mn is not None and len(paths) == 2:
-                    kv, key, value = mn
-                    vals = _map_column_values(
-                        top, kv, key, value, chunks[key.path], chunks[value.path], raw
-                    )
-                elif (
-                    (ls := _canonical_list_of_struct_nodes(top, chunks)) is not None
-                    and len(paths) == len(ls[2])
-                ):
-                    mid, elem, leaves = ls
-                    vals = _list_of_struct_column_values(
-                        top, mid, elem, leaves, chunks, raw
-                    )
-                elif not top.is_leaf:
-                    vals = _struct_column_values(top, chunks, raw)
-                else:
-                    return None
-            if vals is None:
-                return None
-            columns.append((top.name, vals))
-        if n_rows is None:
-            n_rows = _col_len(columns[-1][1])
-        elif n_rows != _col_len(columns[-1][1]):
-            return None  # inconsistent; let the assembler raise precisely
-    if n_rows is None:
-        return [], [], 0
-    return [name for name, _ in columns], [vals for _, vals in columns], n_rows
-
-
-def slice_column(col, start: int, end: int):
-    """Row-window of a fast_row_columns column (list or slices spec)."""
-    if isinstance(col, tuple):
-        tag, elems, offsets, mask = col
-        return (tag, elems, offsets[start : end + 1],
-                None if mask is None else mask[start:end])
-    return col[start:end]
-
-
-def fast_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
-    """Vectorized row assembly (fast_row_columns + one zip). Returns None
-    when the shape needs the full Dremel walk."""
-    rc = fast_row_columns(schema, chunks, raw)
-    if rc is None:
-        return None
-    names, columns, n_rows = rc
-    if not names:
-        return []
-    return _zip_dict_rows(names, columns)
-
-
-# -- general level-vectorized assembly (arbitrary nesting) ---------------------
-#
-# The canonical fast paths above cover flat / LIST / MAP / struct /
-# LIST<struct> shapes; everything deeper used to drop into the per-row
-# RecordAssembler cursor walk (~10 us per element, pure Python). This
-# recursion assembles ARBITRARY nesting (struct-of-list, list-of-list,
-# map-of-struct, ...) from whole-column level math instead: every node
-# produces a value list at its own repetition "slot" granularity, repeated
-# children aggregate one level up via the same run-boundary math the
-# canonical paths use, and groups zip children at C speed. Any structural
-# inconsistency falls back to the RecordAssembler, which raises the precise
-# error (or proves the data fine).
-
-
-def _is_list_node(node: Column) -> bool:
-    ct = node.converted_type
-    lt = node.logical_type
-    return ct == ConvertedType.LIST or (lt is not None and lt.LIST is not None)
-
-
-def _is_map_node(node: Column) -> bool:
-    ct = node.converted_type
-    lt = node.logical_type
-    return ct in (ConvertedType.MAP, ConvertedType.MAP_KEY_VALUE) or (
-        lt is not None and lt.MAP is not None
-    )
-
-
-class _ShapeMismatch(Exception):
-    """Internal: the vectorized walk met a shape it can't prove; fall back."""
-
-
-def _node_values(node: Column, chunks, raw: bool):
-    """(values, defs, reps) at `node`'s slot granularity (one entry per
-    record at node.max_rep). values[i] is the assembled value assuming
-    ancestors are present — None where the node itself is null; garbage
-    (masked by ancestors) where an ancestor is null. defs/reps are the level
-    arrays of the node's first covered leaf (None when the column has no
-    def/rep dimension)."""
-    if node.is_leaf:
-        chunk = chunks.get(node.path)
-        if chunk is None:
-            raise _ShapeMismatch(node.path_str)
-        vals = _leaf_python_values(node, chunk, raw)
-        dfl = chunk.def_levels
-        rep = chunk.rep_levels
-        if node.max_def > 0 and dfl is not None:
-            present = dfl == node.max_def
-            n_present = int(present.sum())
-            if len(vals) != n_present:
-                raise AssemblyError(
-                    f"assembly: {node.path_str}: {len(vals)} values for "
-                    f"{n_present} present entries"
-                )
-            if n_present != len(dfl):
-                full = np.empty(len(dfl), dtype=object)
-                full[present] = vals
-                vals = full.tolist()
-        elif node.max_def > 0 and dfl is None:
-            raise _ShapeMismatch(node.path_str)
-        return vals, dfl, rep
-
-    if not raw and _is_list_node(node) and len(node.children) == 1:
-        mid = node.children[0]
-        if mid.repetition == FieldRepetitionType.REPEATED and _subtree_covered(mid, chunks):
-            if mid.is_leaf or len(mid.children) != 1:
-                ev, ed, er = _node_values(mid, chunks, raw)  # 2-level legacy
-            else:
-                inner = mid.children[0]
-                if inner.repetition == FieldRepetitionType.REPEATED:
-                    ev, ed, er = _aggregated_child(mid, inner, chunks, raw)
-                else:
-                    ev, ed, er = _node_values(inner, chunks, raw)  # unwrap
-            return _slots_to_lists(node, mid, ev, ed, er)
-
-    if not raw and _is_map_node(node) and len(node.children) == 1:
-        kv = node.children[0]
-        if (
-            kv.repetition == FieldRepetitionType.REPEATED
-            and not kv.is_leaf
-            and len(kv.children) == 2
-            and _subtree_covered(kv, chunks)
-        ):
-            ev, ed, er = _node_values(kv, chunks, raw)
-            pair_lists, defs, reps = _slots_to_lists(node, kv, ev, ed, er)
-            kname, vname = kv.children[0].name, kv.children[1].name
-            out = []
-            for pairs in pair_lists:
-                if pairs is None:
-                    out.append(None)
-                    continue
-                try:
-                    out.append(
-                        {p.get(kname): p.get(vname) for p in pairs}
-                    )
-                except TypeError:  # unhashable key: keep the pair list
-                    out.append(pairs)
-            return out, defs, reps
-
-    # generic group (also the raw-mode path: no unwrapping)
-    names = []
-    cols = []
-    base_d = base_r = None
-    n_slots = None
-    for c in node.children:
-        if not _subtree_covered(c, chunks):
-            continue
-        if c.repetition == FieldRepetitionType.REPEATED:
-            v, d, r = _aggregated_child(node, c, chunks, raw)
-        else:
-            v, d, r = _node_values(c, chunks, raw)
-        if n_slots is None:
-            n_slots = len(v)
-            base_d, base_r = d, r
-        elif len(v) != n_slots:
-            raise _ShapeMismatch(node.path_str)
-        names.append(c.name)
-        cols.append(v)
-    if n_slots is None:
-        raise _ShapeMismatch(node.path_str)
-    values = _zip_dict_rows(names, cols)
-    if (
-        node.repetition == FieldRepetitionType.OPTIONAL
-        and node.max_def > 0
-        and base_d is not None
-    ):
-        absent = base_d < node.max_def
-        if absent.any():
-            for i in np.flatnonzero(absent).tolist():
-                values[i] = None
-    return values, base_d, base_r
-
-
-def _aggregate_entries(parent_rep: int, elem_def: int, null_def, ev, ed, er, where):
-    """Core of one level of repeated aggregation: group element entries
-    (ev, ed, er) into per-slot lists at `parent_rep` granularity. Elements
-    exist where ed >= elem_def; slots whose first def sits below `null_def`
-    (when given) become None instead of a list. Returns
-    (values, first_defs, first_reps)."""
-    if er is None or ed is None:
-        raise _ShapeMismatch(where)
-    is_boundary = er <= parent_rep
-    if len(er) and not is_boundary[0]:
-        # corrupt levels: the stream must open a slot before extending one
-        # (the Dremel fallback raises the precise error)
-        raise _ShapeMismatch(where)
-    starts = np.flatnonzero(is_boundary)
-    has_elem = ed >= elem_def
-    if bool(has_elem.all()):
-        elems = ev
-    else:
-        # fromiter keeps nested list/dict elements as objects (a 2-D
-        # broadcast would mangle equal-length list elements)
-        arr = np.fromiter(ev, dtype=object, count=len(ev))
-        elems = arr[has_elem].tolist()
-    row_of = np.cumsum(is_boundary) - 1
-    counts = np.bincount(row_of[has_elem], minlength=len(starts))
-    offsets = np.zeros(len(starts) + 1, dtype=np.int64)
-    np.cumsum(counts, out=offsets[1:])
-    first_def = ed[starts]
-    mask = None
-    if null_def is not None and null_def > 0:
-        if not bool((first_def >= null_def).all()):
-            mask = (first_def < null_def).astype(np.uint8)
-    if _ext is not None:
-        values = _ext.rows_from_slices(elems, offsets, mask)
-    else:
-        off = offsets.tolist()
-        if mask is None:
-            values = [elems[a:b] for a, b in zip(off[:-1], off[1:])]
-        else:
-            values = [
-                None if m else elems[a:b]
-                for m, a, b in zip(mask.tolist(), off[:-1], off[1:])
-            ]
-    return values, first_def, er[starts]
-
-
-def _aggregated_child(parent: Column, c: Column, chunks, raw: bool):
-    """A REPEATED child aggregated to the parent's slot granularity: each
-    parent slot gets the list of child elements (empty when the levels show
-    no element — reference data_store.go:294-308 loop-until-rep-drops)."""
-    cv, cd, cr = _node_values(c, chunks, raw)
-    return _aggregate_entries(
-        parent.max_rep, c.max_def, None, cv, cd, cr, c.path_str
-    )
-
-
-def _slots_to_lists(node: Column, mid: Column, ev, ed, er):
-    """Shared tail of the LIST/MAP unwrap: aggregate element slots into
-    per-slot lists at `node`'s granularity with null-wrapper detection."""
-    return _aggregate_entries(
-        node.max_rep, mid.max_def, node.max_def, ev, ed, er, node.path_str
-    )
-
-
-def _subtree_covered(node: Column, chunks) -> bool:
-    if node.is_leaf:
-        return node.path in chunks
-    return any(_subtree_covered(c, chunks) for c in node.children)
-
-
-def vector_row_columns(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
-    """General level-vectorized assembly for arbitrary nesting, in the same
-    column-oriented form as fast_row_columns (so callers window-materialize
-    identically). Returns (names, columns, n_rows), or None when the walk
-    meets a shape it cannot prove (the RecordAssembler then decides — and
-    raises its precise error if the data really is inconsistent)."""
-    try:
-        names = []
-        cols = []
-        n_rows = None
-        for top in schema.root.children:
-            if not _subtree_covered(top, chunks):
-                continue
-            if top.repetition == FieldRepetitionType.REPEATED:
-                v, _d, _r = _aggregated_child(schema.root, top, chunks, raw)
-            else:
-                v, _d, _r = _node_values(top, chunks, raw)
-            if n_rows is None:
-                n_rows = len(v)
-            elif len(v) != n_rows:
-                return None
-            names.append(top.name)
-            cols.append(v)
-        if n_rows is None:
-            return [], [], 0
-        return names, cols, n_rows
-    except _ShapeMismatch:
-        return None
-
-
-def vector_rows(schema: Schema, chunks: dict[tuple, ChunkData], raw: bool):
-    """Row-list form of vector_row_columns (None on unprovable shapes)."""
-    rc = vector_row_columns(schema, chunks, raw)
-    if rc is None:
-        return None
-    names, cols, _n = rc
-    if not names:
-        return []
-    return _zip_dict_rows(names, cols)
 
 
 def logical_kind(node: Column):
@@ -859,21 +152,46 @@ def logical_kind(node: Column):
 
 
 class RecordAssembler:
-    """Assembles rows from the leaf chunks of one row group."""
+    """Assembles rows from the leaf chunks of one row group.
 
-    def __init__(self, schema: Schema, chunks: dict[tuple, ChunkData], raw: bool = False):
+    `engine` selects how iteration assembles:
+      "auto"    (default) the vectorized engine (core/assembly_vec.py) when
+                PQT_VEC_ASSEMBLY != 0 and the level scans can prove the
+                shape; the scalar cursor walk otherwise
+      "vec"     force the vectorized engine (raises AssemblyError when the
+                scans cannot prove the shape)
+      "scalar"  force the cursor walk — the differential-test oracle
+    """
+
+    def __init__(
+        self,
+        schema: Schema,
+        chunks: dict[tuple, ChunkData],
+        raw: bool = False,
+        engine: str = "auto",
+    ):
         self.schema = schema
         self.raw = raw
-        self.cursors: dict[tuple, _LeafCursor] = {
-            path: _LeafCursor(c) for path, c in chunks.items()
-        }
-        # Static per-node caches (hot path: consulted per field per row).
+        self.engine = engine
+        self.chunks = chunks
+        # Cursor construction is LAZY: each _LeafCursor tolist()s the full
+        # level arrays, which the default (vectorized) iteration path never
+        # touches — only the scalar walk pays for its own state.
+        self.cursors: dict[tuple, _LeafCursor] | None = None
         self._covered_cache: dict[tuple, bool] = {}
         self._first_leaf_cache: dict[tuple, _LeafCursor] = {}
-        self._build_caches(schema.root)
+        self.selected_roots: list[Column] | None = None
+
+    def _ensure_cursors(self) -> None:
+        """Build the per-leaf cursors and the static per-node caches (hot
+        path: consulted per field per row) on first scalar use."""
+        if self.cursors is not None:
+            return
+        self.cursors = {path: _LeafCursor(c) for path, c in self.chunks.items()}
+        self._build_caches(self.schema.root)
         # Only assemble the subtree covered by the provided chunks (projection).
         self.selected_roots = [
-            child for child in schema.root.children if self._covered(child)
+            child for child in self.schema.root.children if self._covered(child)
         ]
 
     def _build_caches(self, node: Column) -> None:
@@ -910,6 +228,37 @@ class RecordAssembler:
     # -- row iteration ---------------------------------------------------------
 
     def __iter__(self):
+        if self.engine != "scalar":
+            from . import assembly_vec
+
+            if self.engine == "vec" or assembly_vec.vec_enabled():
+                rc = assembly_vec.assemble_row_columns(
+                    self.schema, self.chunks, self.raw
+                )
+                if rc is not None:
+                    # materialize in bounded windows (the scalar walk's
+                    # constant-memory streaming contract: only one window
+                    # of row dicts is forced live at a time — the column
+                    # values themselves are already materialized either way)
+                    names, columns, n = rc
+                    if not names:
+                        return
+                    step = 1 << 16
+                    for s in range(0, n, step):
+                        e = min(s + step, n)
+                        yield from assembly_vec._zip_dict_rows(
+                            names,
+                            [assembly_vec.slice_column(c, s, e) for c in columns],
+                        )
+                    return
+                if self.engine == "vec":
+                    raise AssemblyError(
+                        "assembly: vectorized engine cannot prove this shape"
+                    )
+        yield from self._iter_scalar()
+
+    def _iter_scalar(self):
+        self._ensure_cursors()
         while True:
             lead = None
             for child in self.selected_roots:
@@ -920,6 +269,7 @@ class RecordAssembler:
             yield self.assemble_row()
 
     def assemble_row(self) -> dict:
+        self._ensure_cursors()
         row = {}
         for child in self.selected_roots:
             value = self._read_field(child)

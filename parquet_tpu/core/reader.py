@@ -31,18 +31,19 @@ from ..io.source import SourceFile, open_source
 from ..meta.file_meta import ParquetFileError, read_file_metadata
 from ..meta.parquet_types import FileMetaData, RowGroup
 from .alloc import AllocTracker
-from .assembly import (
-    RecordAssembler,
+from .assembly import RecordAssembler
+from .assembly_vec import (
     _zip_dict_rows,
-    fast_row_columns,
+    assemble_row_columns,
     slice_column,
-    vector_row_columns,
+    vec_enabled,
 )
 from .chunk import ChunkData, ChunkError, read_chunk
 from .page import PageError
 from .schema import Schema
 from ..meta.thrift import ThriftError
-from ..utils.trace import bump, span, stage, traced_submit
+from ..utils import metrics as _metrics
+from ..utils.trace import bump, span, stage, timed_stage, traced_submit
 
 __all__ = ["FileReader", "PARQUET_ERRORS"]
 
@@ -120,18 +121,30 @@ def _dispatch_pool() -> ThreadPoolExecutor:
 
 
 def _timed_rows(assembler):
-    """Stream rows from the recursive assembler, billing per-row time to the
-    'assemble' stage without materializing the row group. record_span=False:
-    one sub-microsecond span PER ROW would flood the trace's event budget
-    and crowd out the chunk/page hierarchy — the aggregate stays exact."""
+    """Stream rows from the scalar cursor walk, billing per-row time to the
+    'assembly.rows' stage without materializing the row group.
+    record_span=False: one sub-microsecond span PER ROW would flood the
+    trace's event budget and crowd out the chunk/page hierarchy — the
+    aggregate stays exact. Row count and wall time also feed the always-on
+    assembly_rows_total{engine="scalar"} / assembly_seconds families."""
     it = iter(assembler)
-    while True:
-        with stage("assemble", record_span=False):
-            try:
-                row = next(it)
-            except StopIteration:
-                return
-        yield row
+    n = 0
+    seconds = 0.0
+    try:
+        while True:
+            with timed_stage("assembly.rows", record_span=False) as el:
+                try:
+                    row = next(it)
+                except StopIteration:
+                    break
+            n += 1
+            seconds += el.seconds
+            yield row
+    finally:
+        # also runs when the consumer abandons the generator: delivered
+        # rows still count
+        _metrics.inc("assembly_rows_total", n, engine="scalar")
+        _metrics.observe("assembly_seconds", seconds)
 
 
 def _scatter_byte_offsets(valid: np.ndarray, offsets) -> np.ndarray:
@@ -1370,21 +1383,23 @@ class FileReader:
             chunks = self._read_row_group(i, columns, pack=False)
         if not chunks:
             return []  # quarantined group (on_error='skip'), or empty selection
-        with stage("assemble"):
-            with _gc_paused():
-                rc = fast_row_columns(self.schema, chunks, raw)
-                if rc is not None:
-                    bump("assemble_canonical")
-                else:
-                    # arbitrary nesting: the general level-vectorized walk
-                    rc = vector_row_columns(self.schema, chunks, raw)
-                    if rc is not None:
-                        bump("assemble_vectorized")
+        rc = None
+        if vec_enabled():
+            # the vectorized engine: level prefix scans -> offsets/validity
+            # columns (core/assembly_vec.py). None when the scans cannot
+            # prove the shape — or always when PQT_VEC_ASSEMBLY=0.
+            with stage("assemble"):
+                with _gc_paused():
+                    rc = assemble_row_columns(self.schema, chunks, raw)
+            if rc is not None:
+                bump("assemble_vec")
         if rc is None:
             # per-row Dremel fallback: streams one row at a time (constant
             # memory) and raises precise errors on inconsistent level data
             bump("assemble_cursor")
-            return _timed_rows(RecordAssembler(self.schema, chunks, raw=raw))
+            return _timed_rows(
+                RecordAssembler(self.schema, chunks, raw=raw, engine="scalar")
+            )
         names, columns, n = rc
         if not names or n == 0:
             return []
@@ -1392,8 +1407,11 @@ class FileReader:
             # full decode happened: restrict materialization to the ranges
             return self._ranged_rows(names, columns, ranges)
         if n <= _ASSEMBLE_WINDOW:
-            with stage("assemble"), _gc_paused():
-                return _zip_dict_rows(names, columns)
+            with timed_stage("assembly.rows") as el, _gc_paused():
+                rows = _zip_dict_rows(names, columns)
+            _metrics.inc("assembly_rows_total", n, engine="vec")
+            _metrics.observe("assembly_seconds", el.seconds)
+            return rows
         return self._ranged_rows(names, columns, [(0, n)])
 
     def _read_group_ranges(
@@ -1458,10 +1476,12 @@ class FileReader:
                     # consumer must run with GC enabled and off the stage
                     # timer (a yield inside `with` would hold both open
                     # across arbitrary consumer code)
-                    with stage("assemble"), _gc_paused():
+                    with timed_stage("assembly.rows") as el, _gc_paused():
                         rows = _zip_dict_rows(
                             names, [slice_column(c, s, e) for c in columns]
                         )
+                    _metrics.inc("assembly_rows_total", e - s, engine="vec")
+                    _metrics.observe("assembly_seconds", el.seconds)
                     yield rows
 
         return itertools.chain.from_iterable(windows())

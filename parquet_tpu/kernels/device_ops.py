@@ -74,6 +74,8 @@ __all__ = [
     "expand_hybrid_device",
     "delta_packed_decode_device",
     "dict_gather_device",
+    "list_layout_device",
+    "record_starts_device",
 ]
 
 # Largest bit offset representable in the int32 position math (host drivers
@@ -246,6 +248,68 @@ def bss_transpose_device(streams: jnp.ndarray, num_values: int) -> jnp.ndarray:
 def dict_gather_device(dictionary: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
     """Dictionary expansion: one gather (reference: type_dict.go lookup loop)."""
     return dictionary[indices]
+
+
+@jax.jit
+def record_starts_device(rep: jnp.ndarray):
+    """Record assembly scan 1: which record each level entry belongs to.
+
+    The device formulation of ops/levels.rows_from_rep / slot_ids at the
+    root: an entry starts a record iff rep == 0, so row_of = inclusive
+    prefix count of starts, minus one. Returns (row_of int32[n], n_rows
+    int32 scalar) — both stay on device for downstream ragged-batch math."""
+    starts = (rep == 0).astype(jnp.int32)
+    row_of = jnp.cumsum(starts) - 1
+    return row_of, jnp.sum(starts)
+
+
+@jax.jit
+def list_layout_device(
+    rep: jnp.ndarray,  # int32[n]: repetition levels of one leaf
+    dfl: jnp.ndarray,  # int32[n]: definition levels of the same leaf
+    parent_rep,  # int32 scalar: the expanded node's PARENT repetition depth
+    elem_def,  # int32 scalar: def threshold at which an element exists
+) -> tuple:
+    """One nesting depth's offsets/validity from device-resident level
+    streams — the jittable twin of ops/levels.list_layout composed with
+    slot_ids, so level streams decoded (or delivered) on device assemble
+    into an Arrow-style layout WITHOUT a host round-trip (the host analogue
+    walks these same arrays in core/assembly_vec.py).
+
+    An entry opens a slot iff rep <= parent_rep; it starts an element of
+    this depth iff additionally-or-independently rep <= parent_rep + 1 AND
+    dfl >= elem_def (below elem_def the entry is the placeholder of an
+    empty or null list). All prefix sums are jnp.cumsum; the per-slot
+    element counts are one scatter-add — the shapes XLA executes well
+    (SURVEY §7.2 M3).
+
+    Returns (offsets, first_def, n_slots):
+      offsets    int32[n + 1]  element-count prefix sums; entries past
+                               n_slots repeat the total (padding)
+      first_def  int32[n]      each slot's first entry's def level (feed
+                               `first_def < null_def` for the node's null
+                               mask); entries past n_slots are 0
+      n_slots    int32 scalar  true slot count
+    """
+    n = rep.shape[0]
+    boundary = rep <= parent_rep
+    slot_of = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    exists = dfl >= elem_def
+    elem_start = (rep <= parent_rep + 1) & exists
+    counts = (
+        jnp.zeros(n, dtype=jnp.int32)
+        .at[jnp.clip(slot_of, 0, n - 1)]
+        .add(elem_start.astype(jnp.int32))
+    )
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(counts)]
+    )
+    first_def = (
+        jnp.zeros(n, dtype=jnp.int32)
+        .at[jnp.clip(slot_of, 0, n - 1)]
+        .add(jnp.where(boundary, dfl, 0).astype(jnp.int32))
+    )
+    return offsets, first_def, jnp.sum(boundary.astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("rows_pad",))

@@ -484,6 +484,44 @@ class DeviceColumn:
     dict_offsets: jnp.ndarray | None = None
     def_levels: "np.ndarray | PackedLevels | None" = None
     rep_levels: "np.ndarray | PackedLevels | None" = None
+    # memoized device copies of the level streams (one upload, shared by
+    # every list_layout() depth)
+    _dev_rep: "jnp.ndarray | None" = None
+    _dev_def: "jnp.ndarray | None" = None
+
+    def list_layout(self, parent_rep: int, elem_def: int):
+        """Arrow-style offsets/validity of one repeated depth, computed ON
+        DEVICE from this column's level streams (device_ops.
+        list_layout_device): the levels upload once (memoized) and the
+        offsets/first-def arrays stay in HBM, so a JAX consumer building
+        ragged batches from a device-decoded column never round-trips
+        record-assembly structure through the host.
+
+        Returns (offsets int32[n+1], first_def int32[n], n_slots int32
+        scalar device array); entries past n_slots are padding. Feed
+        `first_def < node.max_def` for the depth's null mask."""
+        from .device_ops import list_layout_device
+
+        if self.rep_levels is None:
+            raise ValueError("list_layout: column has no repetition levels")
+        if self._dev_rep is None:
+            self._dev_rep = jnp.asarray(
+                np.asarray(self.rep_levels), dtype=jnp.int32
+            )
+        if self._dev_def is None:
+            dl = self.def_levels
+            if dl is None:
+                # a missing def stream means every entry FULLY defined (the
+                # host engine's convention, assembly_vec._Stream): saturate
+                # so any elem_def threshold passes and no slot reads null
+                self._dev_def = jnp.full(
+                    self.num_values, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+                )
+            else:
+                self._dev_def = jnp.asarray(np.asarray(dl), dtype=jnp.int32)
+        return list_layout_device(
+            self._dev_rep, self._dev_def, parent_rep, elem_def
+        )
 
 
 class _ChunkPlan:

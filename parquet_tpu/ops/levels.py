@@ -22,6 +22,10 @@ __all__ = [
     "encode_levels_v1",
     "encode_levels_v2",
     "LevelError",
+    "rows_from_rep",
+    "slot_ids",
+    "list_layout",
+    "validity_from_def",
 ]
 
 
@@ -116,3 +120,77 @@ def _check(levels: np.ndarray, max_level: int) -> None:
         raise LevelError(
             f"levels: value {int(levels.max())} exceeds max level {max_level}"
         )
+
+
+# -- assembly prefix scans ------------------------------------------------------
+#
+# The data-parallel formulation of Dremel record assembly (PAPER.md; reference
+# schema.go:216-312 walks these streams entry by entry): every structural fact
+# a cursor walk discovers one `int(levels[pos])` at a time is a whole-column
+# scan over the rep/def arrays. These four primitives are the complete set —
+# core/assembly_vec.py composes them per nesting depth, and
+# kernels/device_ops.list_layout_device is the same math in jittable JAX so
+# device-resident level streams never round-trip to the host.
+
+
+def rows_from_rep(rep, n: int | None = None) -> np.ndarray:
+    """Positions where a record starts (rep == 0), as int64 indices.
+
+    `rep is None` means the column has no repetition dimension: every entry
+    starts a record, so the starts are 0..n-1 (`n` required then)."""
+    if rep is None:
+        if n is None:
+            raise ValueError("rows_from_rep: n required when rep is None")
+        return np.arange(n, dtype=np.int64)
+    return np.flatnonzero(np.asarray(rep) == 0)
+
+
+def slot_ids(rep, parent_rep: int) -> np.ndarray:
+    """Which slot (instance at nesting depth `parent_rep`) each level entry
+    belongs to: the inclusive prefix count of boundary entries, minus one.
+    An entry opens a new slot iff its rep level <= parent_rep (reference
+    data_store.go:294-308: the loop-until-rep-drops cursor walk, as one
+    cumsum)."""
+    return np.cumsum(np.asarray(rep) <= parent_rep, dtype=np.int64) - 1
+
+
+def list_layout(rep, dfl, slot_of, n_slots: int, elem_rep: int, elem_def: int):
+    """One repeated node's Arrow-style layout over the current entry stream.
+
+    rep/dfl are the stream's level arrays, slot_of the slot each entry
+    belongs to at the PARENT's granularity (from slot_ids, int64,
+    non-decreasing over n_slots slots). An entry STARTS an element of this
+    depth iff its rep level <= elem_rep AND its def level >= elem_def (below
+    elem_def the entry is the placeholder of an empty/null list and
+    contributes no element); entries with rep > elem_rep extend the open
+    element's subtree.
+
+    Returns (offsets, elem_start, exists):
+      offsets     int64[n_slots+1]  element-count prefix sums — slot i's
+                                    elements sit at [offsets[i], offsets[i+1])
+      elem_start  bool[n]           entry opens an element of this depth
+      exists      bool[n]           entry belongs to SOME element of this
+                                    depth (the child stream's keep mask)
+    """
+    rep = np.asarray(rep)
+    dfl = np.asarray(dfl)
+    exists = dfl >= elem_def
+    elem_start = (rep <= elem_rep) & exists
+    counts = np.bincount(slot_of[elem_start], minlength=n_slots)
+    offsets = np.zeros(n_slots + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return offsets, elem_start, exists
+
+
+def validity_from_def(first_def, null_def: int):
+    """Null mask (uint8[n_slots], 1 = null) from each slot's first def
+    level: the slot's node is absent where that level sits below `null_def`.
+    None when every slot is present (callers skip mask work entirely then —
+    the overwhelmingly common all-present case stays one vectorized
+    compare)."""
+    if null_def <= 0:
+        return None
+    first_def = np.asarray(first_def)
+    if bool((first_def >= null_def).all()):
+        return None
+    return (first_def < null_def).astype(np.uint8)

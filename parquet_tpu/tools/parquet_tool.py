@@ -628,7 +628,11 @@ def cmd_profile(args) -> int:
     the fused native walk's internal clocks, and the dispatch thread.
     --host profiles the pure host decode instead (no jax touched);
     --cpu forces jax onto the CPU platform first (profiling decode on a
-    machine whose accelerator tunnel should stay untouched)."""
+    machine whose accelerator tunnel should stay untouched); --rows
+    profiles an ASSEMBLED read (iter_rows) instead of the column decode —
+    the assemble / assembly.rows stages then show where record assembly
+    spends its time, and the metrics delta carries
+    assembly_rows_total{engine=} / assembly_seconds."""
     from ..utils import metrics
     from ..utils.trace import decode_trace, span
 
@@ -638,15 +642,19 @@ def cmd_profile(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    backend = "host" if args.host else "tpu_roundtrip"
+    backend = "host" if (args.host or args.rows) else "tpu_roundtrip"
     cols = args.columns.split(",") if args.columns else None
     snap0 = metrics.snapshot()
     with FileReader(args.file, columns=cols, backend=backend) as r:
         rows = r.num_rows
         with decode_trace() as t:
             with span("file", {"path": str(args.file), "backend": backend}):
-                for i in range(r.num_row_groups):
-                    r.read_row_group(i)
+                if args.rows:
+                    for _row in r.iter_rows():
+                        pass
+                else:
+                    for i in range(r.num_row_groups):
+                        r.read_row_group(i)
     doc = t.to_chrome_trace()
     # computed once: the registry is live process state, so a re-read could
     # disagree with what the file artifact recorded
@@ -869,6 +877,13 @@ def main(argv=None) -> int:
         "--metrics",
         action="store_true",
         help="also print the process metrics delta + summary for the run",
+    )
+    pf.add_argument(
+        "--rows",
+        action="store_true",
+        help="profile an assembled read (iter_rows) instead of the column "
+        "decode: the assemble/assembly.rows stages show where record "
+        "assembly spends its time (host path)",
     )
     pf.add_argument(
         "--host",

@@ -44,6 +44,16 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   sink_bytes_written_total          bytes actually written to byte sinks
   sink_write_calls_total            sink write calls (BufferedSink's
                                     write-combining shrinks it)
+  assembly_rows_total{engine=}      rows materialized by record assembly,
+                                    per engine: "vec" = the vectorized
+                                    level-scan engine (core/assembly_vec),
+                                    "scalar" = the cursor-walk fallback
+                                    (PQT_VEC_ASSEMBLY=0 or unprovable
+                                    shapes)
+  assembly_seconds                  histogram of row-materialization wall
+                                    time (one observation per assembly
+                                    window / scalar group; same clock as
+                                    the assembly.rows trace stage)
 
 Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as

@@ -1,0 +1,425 @@
+"""The vectorized assembly engine (core/assembly_vec) vs the scalar oracle.
+
+Differential matrix: every nested shape the engine claims — optional
+scalars, LIST-of-LIST, MAP, struct-in-list, empty lists, all-null rows,
+page boundaries mid-row — must produce byte-identical rows to the scalar
+cursor walk (RecordAssembler engine="scalar"), in BOTH ergonomic and raw
+modes. Corrupt inputs must fail with the same typed errors under either
+engine. The level prefix scans (ops/levels) and their jittable device twin
+(kernels/device_ops.list_layout_device) are pinned against each other.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.assembly import AssemblyError, RecordAssembler
+from parquet_tpu.core import assembly_vec as av
+from parquet_tpu.core.reader import PARQUET_ERRORS, FileReader
+from parquet_tpu.ops.levels import (
+    list_layout,
+    rows_from_rep,
+    slot_ids,
+    validity_from_def,
+)
+from parquet_tpu.utils import metrics
+from parquet_tpu.utils.trace import decode_trace
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "data", "corrupt")
+
+
+def _nested_tables():
+    """The differential-matrix shapes (name -> pyarrow table)."""
+    rng = np.random.default_rng(11)
+    n = 400
+
+    def some(i, v):
+        return None if i % 11 == 0 else v
+
+    t = {}
+    t["optional_scalars"] = pa.table(
+        {
+            "i": pa.array([some(i, i) for i in range(n)], pa.int64()),
+            "s": pa.array([some(i + 1, f"v{i % 13}") for i in range(n)]),
+        }
+    )
+    t["list_int"] = pa.table(
+        {
+            "v": pa.array(
+                [
+                    some(i, [None if j % 5 == 0 else int(rng.integers(0, 99))
+                             for j in range(i % 6)])
+                    for i in range(n)
+                ],
+                pa.list_(pa.int32()),
+            )
+        }
+    )
+    t["list_of_list"] = pa.table(
+        {
+            "ll": pa.array(
+                [
+                    some(i, [some(j, list(range(j % 4))) for j in range(i % 4)])
+                    for i in range(n)
+                ],
+                pa.list_(pa.list_(pa.int64())),
+            )
+        }
+    )
+    t["map"] = pa.table(
+        {
+            "m": pa.array(
+                [
+                    some(i, [(f"k{j}", some(j, j * i)) for j in range(i % 4)])
+                    for i in range(n)
+                ],
+                pa.map_(pa.string(), pa.int64()),
+            )
+        }
+    )
+    t["struct_in_list"] = pa.table(
+        {
+            "pts": pa.array(
+                [
+                    some(i, [some(j + 1, {"x": float(j), "y": some(j, j)})
+                             for j in range(i % 5)])
+                    for i in range(n)
+                ],
+                pa.list_(pa.struct([("x", pa.float64()), ("y", pa.int64())])),
+            )
+        }
+    )
+    t["struct_of_list"] = pa.table(
+        {
+            "s": pa.array(
+                [
+                    some(i, {"l": some(i + 1, list(range(i % 3))), "y": some(i + 2, i)})
+                    for i in range(n)
+                ],
+                pa.struct([("l", pa.list_(pa.int64())), ("y", pa.int32())]),
+            )
+        }
+    )
+    t["empty_lists"] = pa.table(
+        {"v": pa.array([[] for _ in range(n)], pa.list_(pa.int64()))}
+    )
+    t["all_null_rows"] = pa.table(
+        {
+            "v": pa.array([None] * n, pa.list_(pa.int32())),
+            "m": pa.array([None] * n, pa.map_(pa.string(), pa.int32())),
+        }
+    )
+    return t
+
+
+def _engine_rows(path, raw, scalar):
+    env = {"PQT_VEC_ASSEMBLY": "0"} if scalar else {}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        with FileReader(path) as r:
+            return list(r.iter_rows(raw=raw))
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class TestDifferentialMatrix:
+    @pytest.mark.parametrize("name", sorted(_nested_tables()))
+    @pytest.mark.parametrize("raw", [False, True])
+    def test_vec_matches_scalar(self, tmp_path, name, raw):
+        table = _nested_tables()[name]
+        path = str(tmp_path / f"{name}.parquet")
+        pq.write_table(table, path, compression="snappy")
+        with FileReader(path) as r:
+            chunks = r.read_row_group(0)
+            vec = av.assemble_rows(r.schema, chunks, raw)
+            scalar = list(
+                RecordAssembler(r.schema, chunks, raw=raw, engine="scalar")
+            )
+        assert vec is not None, f"{name}: engine declined a claimed shape"
+        assert vec == scalar
+
+    @pytest.mark.parametrize("raw", [False, True])
+    def test_page_boundary_mid_row(self, tmp_path, raw):
+        """Multi-entry rows split across pages (tiny data_page_size): the
+        whole-chunk level scan must stitch them identically to the walk."""
+        rows = [
+            None if i % 17 == 0 else [int(x) for x in range(i % 9)]
+            for i in range(4000)
+        ]
+        t = pa.table({"v": pa.array(rows, pa.list_(pa.int64()))})
+        path = str(tmp_path / "paged.parquet")
+        pq.write_table(
+            t, path, data_page_size=512, write_batch_size=64, compression="none"
+        )
+        with FileReader(path) as r:
+            chunks = r.read_row_group(0)
+            vec = av.assemble_rows(r.schema, chunks, raw)
+            scalar = list(
+                RecordAssembler(r.schema, chunks, raw=raw, engine="scalar")
+            )
+        assert vec is not None and vec == scalar
+        if not raw:
+            assert [x["v"] for x in vec] == rows
+
+    def test_projection_matches_scalar(self, tmp_path):
+        """Partial leaf selection (struct member / map value projected)."""
+        t = _nested_tables()["struct_in_list"]
+        path = str(tmp_path / "proj.parquet")
+        pq.write_table(t, path)
+        with FileReader(path, columns=["pts.list.element.x"]) as r:
+            chunks = r.read_row_group(0)
+            vec = av.assemble_rows(r.schema, chunks, False)
+            scalar = list(
+                RecordAssembler(r.schema, chunks, raw=False, engine="scalar")
+            )
+        assert vec is not None and vec == scalar
+
+
+class TestEngineSelection:
+    def test_env_knob_forces_scalar(self, tmp_path, monkeypatch):
+        t = _nested_tables()["list_int"]
+        path = str(tmp_path / "knob.parquet")
+        pq.write_table(t, path)
+        monkeypatch.setenv("PQT_VEC_ASSEMBLY", "0")
+        with decode_trace() as tr:
+            with FileReader(path) as r:
+                rows_scalar = list(r.iter_rows())
+        assert tr.counters().get("assemble_cursor", 0) >= 1
+        assert tr.counters().get("assemble_vec", 0) == 0
+        monkeypatch.delenv("PQT_VEC_ASSEMBLY")
+        with decode_trace() as tr:
+            with FileReader(path) as r:
+                rows_vec = list(r.iter_rows())
+        assert tr.counters().get("assemble_vec", 0) >= 1
+        assert tr.counters().get("assemble_cursor", 0) == 0
+        assert rows_scalar == rows_vec
+
+    def test_record_assembler_engine_param(self, tmp_path):
+        t = _nested_tables()["list_of_list"]
+        path = str(tmp_path / "eng.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            chunks = r.read_row_group(0)
+            auto = list(RecordAssembler(r.schema, chunks))
+            vec = list(RecordAssembler(r.schema, chunks, engine="vec"))
+            scalar = list(RecordAssembler(r.schema, chunks, engine="scalar"))
+        assert auto == vec == scalar
+
+    def test_metrics_and_trace_stage(self, tmp_path):
+        t = _nested_tables()["list_int"]
+        path = str(tmp_path / "met.parquet")
+        pq.write_table(t, path)
+        before = metrics.snapshot()
+        with decode_trace() as tr:
+            with FileReader(path) as r:
+                n = sum(1 for _ in r.iter_rows())
+        d = metrics.delta(before)
+        assert d.get('assembly_rows_total{engine="vec"}', 0) == n
+        assert d.get("assembly_seconds_count", 0) >= 1
+        assert "assembly.rows" in tr.stages
+        # scalar engine reports under its own label
+        before = metrics.snapshot()
+        _engine_rows(path, False, scalar=True)
+        d = metrics.delta(before)
+        assert d.get('assembly_rows_total{engine="scalar"}', 0) == n
+
+
+class TestTypedErrors:
+    """Corrupt input must fail IDENTICALLY typed under either engine."""
+
+    def _outcome(self, path, scalar):
+        try:
+            return ("rows", _engine_rows(path, False, scalar))
+        except PARQUET_ERRORS + (AssemblyError,) as e:
+            return ("error", type(e).__name__)
+
+    def test_corrupt_corpus_parity(self):
+        names = sorted(
+            f for f in os.listdir(CORPUS_DIR) if f.endswith(".parquet")
+        )
+        assert names, "corrupt corpus missing"
+        for name in names:
+            path = os.path.join(CORPUS_DIR, name)
+            vec = self._outcome(path, scalar=False)
+            scl = self._outcome(path, scalar=True)
+            assert vec[0] == scl[0], (name, vec, scl)
+            if vec[0] == "rows":
+                assert vec[1] == scl[1], name
+
+    def test_injected_level_faults_parity(self, tmp_path):
+        """Seeded level-stream corruption on a NESTED table: both engines
+        deliver identical rows or raise the same typed error family."""
+        from parquet_tpu.testing.faults import iter_fault_cases
+
+        t = pa.table(
+            {
+                "v": pa.array(
+                    [[1, 2], None, [], [3, None, 4]] * 40, pa.list_(pa.int64())
+                )
+            }
+        )
+        base = str(tmp_path / "base.parquet")
+        pq.write_table(t, base, compression="none")
+        data = open(base, "rb").read()
+        ran = 0
+        for case in iter_fault_cases(data, seed=5):
+            if "level" not in case.name:
+                continue
+            ran += 1
+            p = str(tmp_path / f"{case.name}.parquet")
+            with open(p, "wb") as f:
+                f.write(case.data)
+            vec = self._outcome(p, scalar=False)
+            scl = self._outcome(p, scalar=True)
+            assert vec[0] == scl[0], (case.name, vec, scl)
+            if vec[0] == "rows":
+                assert vec[1] == scl[1], case.name
+        assert ran >= 1
+
+    def test_value_count_mismatch_raises_assembly_error(self, tmp_path):
+        """A chunk whose values disagree with its def levels raises the
+        typed AssemblyError from the vec engine too (not a silent wrong
+        answer, not an internal numpy error)."""
+        t = pa.table({"v": pa.array([[1, 2], [3]], pa.list_(pa.int64()))})
+        path = str(tmp_path / "vc.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            chunks = r.read_row_group(0)
+            (path_key,) = chunks
+            cd = chunks[path_key]
+            cd.values = np.asarray(cd.values)[:-1]  # drop one value
+            with pytest.raises(AssemblyError):
+                av.assemble_rows(r.schema, chunks, False)
+            with pytest.raises(AssemblyError):
+                list(RecordAssembler(r.schema, chunks, raw=False, engine="scalar"))
+
+
+class TestLevelScans:
+    def test_rows_from_rep(self):
+        rep = np.array([0, 1, 1, 0, 0, 1], np.uint16)
+        assert rows_from_rep(rep).tolist() == [0, 3, 4]
+        assert rows_from_rep(None, 4).tolist() == [0, 1, 2, 3]
+        with pytest.raises(ValueError):
+            rows_from_rep(None)
+
+    def test_slot_ids(self):
+        rep = np.array([0, 1, 2, 1, 0], np.uint16)
+        assert slot_ids(rep, 0).tolist() == [0, 0, 0, 0, 1]
+        assert slot_ids(rep, 1).tolist() == [0, 1, 1, 2, 3]
+
+    def test_list_layout(self):
+        # two rows: [a, b] and [] (placeholder entry def<elem_def)
+        rep = np.array([0, 1, 0], np.uint16)
+        dfl = np.array([3, 3, 0], np.uint16)
+        so = slot_ids(rep, 0)
+        offs, elem_start, exists = list_layout(rep, dfl, so, 2, 1, 1)
+        assert offs.tolist() == [0, 2, 2]
+        assert elem_start.tolist() == [True, True, False]
+        assert exists.tolist() == [True, True, False]
+
+    def test_validity_from_def(self):
+        assert validity_from_def(np.array([2, 2]), 0) is None
+        assert validity_from_def(np.array([2, 2]), 1) is None
+        assert validity_from_def(np.array([2, 0, 1]), 2).tolist() == [0, 1, 1]
+
+
+class TestDeviceKernel:
+    def test_matches_host_scan_random(self):
+        import jax.numpy as jnp
+
+        from parquet_tpu.kernels.device_ops import (
+            list_layout_device,
+            record_starts_device,
+        )
+
+        rng = np.random.default_rng(3)
+        for trial in range(4):
+            n = int(rng.integers(10, 3000))
+            rep = np.zeros(n, np.int32)
+            rep[rng.random(n) < 0.6] = 1
+            rep[0] = 0
+            dfl = rng.integers(0, 4, n).astype(np.int32)
+            dfl[rep == 1] = np.maximum(dfl[rep == 1], 2)
+            so = slot_ids(rep, 0)
+            n_slots = len(rows_from_rep(rep))
+            offs, _es, _ex = list_layout(rep, dfl, so, n_slots, 1, 2)
+            d_offs, d_first, d_n = list_layout_device(
+                jnp.asarray(rep), jnp.asarray(dfl), 0, 2
+            )
+            assert int(d_n) == n_slots
+            assert np.array_equal(np.asarray(d_offs)[: n_slots + 1], offs)
+            starts = np.flatnonzero(rep == 0)
+            assert np.array_equal(np.asarray(d_first)[:n_slots], dfl[starts])
+            row_of, n_rows = record_starts_device(jnp.asarray(rep))
+            assert int(n_rows) == n_slots
+            assert np.array_equal(np.asarray(row_of), so)
+
+    def test_device_column_list_layout(self, tmp_path):
+        """Device-decoded level streams assemble on device: the offsets the
+        kernel computes in HBM equal the host engine's for the same chunk."""
+        t = pa.table(
+            {
+                "v": pa.array(
+                    [[1, 2, 3], [], None, [4]] * 100, pa.list_(pa.int32())
+                )
+            }
+        )
+        path = str(tmp_path / "dev.parquet")
+        pq.write_table(t, path, compression="none")
+        with FileReader(path, backend="tpu") as r:
+            cols = r.read_row_group_device(0)
+            (dc,) = cols.values()
+            leaf = [c for c in r.schema.leaves][0]
+            offs, first_def, n_slots = dc.list_layout(0, leaf.max_def - 1)
+        with FileReader(path) as r:
+            chunks = r.read_row_group(0)
+            (cd,) = chunks.values()
+            so = slot_ids(np.asarray(cd.rep_levels), 0)
+            n_host = len(rows_from_rep(np.asarray(cd.rep_levels)))
+            h_offs, _es, _ex = list_layout(
+                np.asarray(cd.rep_levels),
+                np.asarray(cd.def_levels),
+                so,
+                n_host,
+                1,
+                leaf.max_def - 1,
+            )
+        assert int(n_slots) == n_host
+        assert np.array_equal(np.asarray(offs)[: n_host + 1], h_offs)
+
+
+class TestArrowHandoff:
+    def test_nested_to_arrow_rides_engine(self, tmp_path):
+        """to_arrow's nested path consumes the engine's IR and still
+        matches pyarrow exactly."""
+        for name in ("list_of_list", "struct_in_list", "struct_of_list", "map"):
+            t = _nested_tables()[name]
+            path = str(tmp_path / f"{name}.parquet")
+            pq.write_table(t, path)
+            with FileReader(path) as r:
+                out = r.to_arrow()
+            want = pq.read_table(path)
+            for c in want.column_names:
+                assert out.column(c).to_pylist() == want.column(c).to_pylist(), name
+
+    def test_build_field_vec_arrow_mode(self, tmp_path):
+        t = _nested_tables()["list_int"]
+        path = str(tmp_path / "ir.parquet")
+        pq.write_table(t, path)
+        with FileReader(path) as r:
+            chunks = r.read_row_group(0)
+            vec, n = av.build_field_vec(r.schema, "v", chunks, mode="arrow")
+        assert isinstance(vec, av.ListVec)
+        assert n == t.num_rows
+        assert len(vec.offsets) == n + 1
+        assert int(vec.offsets[-1]) == sum(
+            len(x) for x in t.column("v").to_pylist() if x
+        )

@@ -21,12 +21,12 @@ from parquet_tpu.meta.parquet_types import Type
 
 @contextlib.contextmanager
 def _no_native(monkeypatch):
-    from parquet_tpu.core import arrays, assembly, column_store, compress
+    from parquet_tpu.core import arrays, assembly_vec, column_store, compress
     from parquet_tpu.utils import native as nat
 
     monkeypatch.setattr(nat, "_cached", None)
     monkeypatch.setattr(nat, "_probed", True)
-    for mod in (arrays, assembly, column_store):
+    for mod in (arrays, assembly_vec, column_store):
         monkeypatch.setattr(mod, "_ext", None)
     saved = dict(compress._REGISTRY)
     compress._REGISTRY.clear()

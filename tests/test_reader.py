@@ -303,13 +303,17 @@ class TestFastNestedAssembly:
     def _roundtrip_both(self, table, tmp_path):
         import pyarrow.parquet as pq
 
-        from parquet_tpu.core.assembly import RecordAssembler, fast_rows
+        from parquet_tpu.core.assembly import RecordAssembler
+        from parquet_tpu.core.assembly_vec import assemble_rows
 
         path = str(tmp_path / "f.parquet")
         pq.write_table(table, path, compression="snappy")
         with FileReader(path) as r:
-            fast = fast_rows(r.schema, r.read_row_group(0), False)
-            slow = list(RecordAssembler(r.schema, r.read_row_group(0), raw=False))
+            fast = assemble_rows(r.schema, r.read_row_group(0), False)
+            slow = list(
+                RecordAssembler(r.schema, r.read_row_group(0), raw=False,
+                                engine="scalar")
+            )
         return fast, slow
 
     def test_list_all_shapes(self, tmp_path):
@@ -354,9 +358,9 @@ class TestFastNestedAssembly:
         assert fast[0]["r"] is None and fast[1]["r"] == {"a": 1, "b": "s1"}
 
     def test_deep_nesting_takes_vector_path(self, tmp_path):
-        """Shapes past the canonical fast paths land on the general
-        level-vectorized walk (vector_rows), not the per-row assembler."""
-        from parquet_tpu.core.assembly import fast_rows, vector_rows
+        """Shapes past the old canonical fast paths (struct-of-list) ride
+        the unified engine, not the per-row assembler."""
+        from parquet_tpu.core.assembly_vec import assemble_rows
 
         t = pa.table(
             {
@@ -372,8 +376,7 @@ class TestFastNestedAssembly:
         pq.write_table(t, path)
         with FileReader(path) as r:
             chunks = r.read_row_group(0)
-            assert fast_rows(r.schema, chunks, False) is None
-            assert vector_rows(r.schema, chunks, False) is not None
+            assert assemble_rows(r.schema, chunks, False) is not None
             rows = list(r.iter_rows())
         assert rows[0]["r"] == {"xs": [1, 2]}
 
@@ -402,28 +405,23 @@ class TestFastNestedAssembly:
 
 
 class TestVectorAssembly:
-    """The general level-vectorized assembler (vector_rows) must match the
-    per-row Dremel walk exactly on ARBITRARY nesting — list-of-list,
-    struct-of-list, map-of-struct, 3-level list<struct<list>> — in both
-    ergonomic and raw modes. The canonical fast paths must decline these
-    shapes so the coverage claim is real."""
+    """The vectorized engine (assembly_vec) must match the per-row Dremel
+    walk exactly on ARBITRARY nesting — list-of-list, struct-of-list,
+    map-of-struct, 3-level list<struct<list>> — in both ergonomic and raw
+    modes."""
 
     def _both(self, table, tmp_path, raw=False):
         import pyarrow.parquet as pq
 
-        from parquet_tpu.core.assembly import (
-            RecordAssembler,
-            fast_row_columns,
-            vector_rows,
-        )
+        from parquet_tpu.core.assembly import RecordAssembler
+        from parquet_tpu.core.assembly_vec import assemble_rows
 
         path = str(tmp_path / "v.parquet")
         pq.write_table(table, path, compression="snappy")
         with FileReader(path) as r:
             chunks = r.read_row_group(0)
-            assert fast_row_columns(r.schema, chunks, raw) is None
-            vec = vector_rows(r.schema, chunks, raw)
-            slow = list(RecordAssembler(r.schema, chunks, raw=raw))
+            vec = assemble_rows(r.schema, chunks, raw)
+            slow = list(RecordAssembler(r.schema, chunks, raw=raw, engine="scalar"))
         assert vec is not None
         assert vec == slow
         return vec

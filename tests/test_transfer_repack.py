@@ -243,8 +243,8 @@ class TestStagingBufferPool:
 
 class TestAssemblyPathCounters:
     """The decode-trace counters distinguish which assembly engine served a
-    read: canonical fast path, general vectorized walk, or per-row cursor.
-    A 3-level list must be served VECTORIZED, not by the fallback."""
+    read: the vectorized engine or the per-row cursor fallback. A 3-level
+    list must be served VECTORIZED, not by the fallback."""
 
     def test_three_level_list_served_vectorized(self, tmp_path):
         t = pa.table({
@@ -258,7 +258,7 @@ class TestAssemblyPathCounters:
         with decode_trace() as tr:
             with FileReader(p) as r:
                 rows = list(r.iter_rows())
-        assert _calls(tr, "assemble_vectorized") >= 1, tr.stages
+        assert _calls(tr, "assemble_vec") >= 1, tr.stages
         assert _calls(tr, "assemble_cursor") == 0, tr.stages
         assert rows[:4] == [
             {"lll": [[[1, 2], []], None]},
@@ -274,7 +274,7 @@ class TestAssemblyPathCounters:
         with decode_trace() as tr:
             with FileReader(p) as r:
                 rows = list(r.iter_rows())
-        assert _calls(tr, "assemble_canonical") >= 1, tr.stages
+        assert _calls(tr, "assemble_vec") >= 1, tr.stages
         assert _calls(tr, "assemble_cursor") == 0
         assert rows == [{"v": [1, 2]}, {"v": None}, {"v": []}]
 
