@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
 check: native lint
@@ -45,6 +45,12 @@ bench-io: native
 # sweep (pool 1/4/8 x 8/16 row groups, byte-identical to serial); host-only
 bench-write: native
 	python bench.py --write
+
+# scan-service bench: requests/s + p50/p99 latency at client concurrency
+# 1/4/16 against a warm in-process daemon over real HTTP, plus the
+# cold-vs-warm /v1/plan latency ratio; host-only, no accelerator
+bench-serve: native
+	python bench.py --serve
 
 # record-assembly bench: vectorized level-scan engine vs scalar cursor walk
 # vs pyarrow to_pylist on flat/1-level/2-level tables (rows asserted

@@ -67,6 +67,15 @@ is depth>=2 beating depth 0, and `loader_rows_s` records the step-free pure
 decode+rebatch rate. Host-only (jax forced to CPU); the result rides the
 --json artifact under "dataset".
 
+`--serve` benchmarks the scan/query daemon (parquet_tpu.serve) over real
+HTTP against an in-process `ScanServer` on an ephemeral port: requests/s
+and p50/p99 request latency at client concurrency 1/4/16 (each request a
+full jsonl shard scan, round-robin over a PQT_SERVE_FILES-file corpus of
+PQT_SERVE_ROWS total rows, PQT_SERVE_REQUESTS per level) against a WARM
+daemon, plus the cold-vs-warm /v1/plan latency ratio the footer/block
+caches buy. PQT_BENCH_SERVE=0 skips it in a full run; the result rides
+the --json artifact under "serve".
+
 `--json out.json` (or PQT_BENCH_JSON=out.json) additionally writes the
 final structured result — headline + per-stage prepare breakdown + matrix —
 to a file, so the BENCH_* trajectory artifacts are produced by the harness
@@ -1145,6 +1154,170 @@ def _phase_io() -> None:
     _emit(out)
 
 
+# -- the scan-service benchmark (--serve / phase "serve") ----------------------
+
+SERVE_ROWS = int(os.environ.get("PQT_SERVE_ROWS", 160_000))
+SERVE_FILES = int(os.environ.get("PQT_SERVE_FILES", 8))
+SERVE_REQUESTS = int(os.environ.get("PQT_SERVE_REQUESTS", 32))
+
+
+def _serve_dir() -> Path:
+    """A cached multi-file corpus for the daemon: SERVE_ROWS int64+float64
+    rows over SERVE_FILES files of a few row groups each, so one request
+    decodes a few units and concurrent requests spread across files."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = Path(f"/tmp/pqt_serve_{SERVE_ROWS}_{SERVE_FILES}")
+    if d.exists():
+        return d
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(17)
+    per = SERVE_ROWS // SERVE_FILES
+    log(f"bench: generating {SERVE_FILES}x{per:,}-row serve corpus at {d}")
+    for i in range(SERVE_FILES):
+        t = pa.table(
+            {
+                "id": pa.array(
+                    np.arange(i * per, (i + 1) * per, dtype=np.int64)
+                ),
+                "v": pa.array(rng.standard_normal(per)),
+            }
+        )
+        pq.write_table(
+            t, str(d / f"shard-{i:03d}.parquet"),
+            compression="snappy", row_group_size=1 << 14,
+        )
+    return d
+
+
+def _phase_serve() -> None:
+    """Scan-service benchmark (`bench.py --serve` / `make bench-serve`).
+
+    Drives a real in-process daemon (parquet_tpu.serve, ephemeral port)
+    over HTTP, the way clients will: requests/s and p50/p99 request
+    latency at client concurrency 1/4/16 against a WARM daemon (each
+    request a full jsonl scan of one shard, round-robin across the
+    corpus), plus the cold-vs-warm /v1/plan latency ratio — the number
+    the footer/block caches exist to move (a warm plan is pure in-memory
+    metadata work; a cold one parses every footer). Host-only; the result
+    rides the --json artifact as "serve"."""
+    import http.client
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import threading
+
+    from parquet_tpu.serve import ScanServer, ServeConfig
+
+    d = _serve_dir()
+
+    def one_request(host, port, body):
+        t0 = time.perf_counter()
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        try:
+            conn.request("POST", "/v1/scan", body=body)
+            resp = conn.getresponse()
+            payload = resp.read()
+            assert resp.status == 200, payload[:200]
+            return time.perf_counter() - t0, len(payload)
+        finally:
+            conn.close()
+
+    def plan_latency(host, port):
+        t0 = time.perf_counter()
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        try:
+            conn.request("GET", "/v1/plan?paths=shard-*.parquet")
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()[:200]
+            resp.read()
+            return time.perf_counter() - t0
+        finally:
+            conn.close()
+
+    # cold plan: a FRESH daemon's first /v1/plan parses every footer; one
+    # sample per daemon, so take a few daemons and keep the median
+    cold = []
+    for _ in range(3):
+        with ScanServer(ServeConfig(port=0, root=str(d))) as srv:
+            srv.start_background()
+            cold.append(plan_latency(srv.host, srv.port))
+    cold_ms = float(np.median(cold) * 1e3)
+
+    out = {
+        "config": "serve",
+        "rows_per_file": SERVE_ROWS // SERVE_FILES,
+        "files": SERVE_FILES,
+        "requests_per_level": SERVE_REQUESTS,
+        "stat": "median",
+    }
+    bodies = [
+        json.dumps({"paths": f"shard-{i % SERVE_FILES:03d}.parquet"}).encode()
+        for i in range(SERVE_REQUESTS)
+    ]
+    # caps above the sweep's widest concurrency: this measures throughput,
+    # not admission control (tests pin the 429 behavior)
+    with ScanServer(
+        ServeConfig(
+            port=0, root=str(d), cache_mb=256,
+            max_inflight=64, tenant_concurrent=64,
+        )
+    ) as srv:
+        srv.start_background()
+        host, port = srv.host, srv.port
+        warm = [plan_latency(host, port) for _ in range(20)][5:]
+        warm_ms = float(np.median(warm) * 1e3)
+        # warm the daemon's caches end to end before timing the sweep
+        for i in range(SERVE_FILES):
+            one_request(host, port, bodies[i])
+        sweep = {}
+        for conc in (1, 4, 16):
+            lat: list = []
+            lock = threading.Lock()
+            idx = iter(range(SERVE_REQUESTS))
+
+            def worker():
+                while True:
+                    with lock:
+                        i = next(idx, None)
+                    if i is None:
+                        return
+                    t, _n = one_request(host, port, bodies[i])
+                    with lock:
+                        lat.append(t)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker) for _ in range(conc)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            assert len(lat) == SERVE_REQUESTS
+            sweep[str(conc)] = {
+                "rps": round(SERVE_REQUESTS / wall, 2),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+                "wall_s": round(wall, 4),
+            }
+            log(
+                f"bench: serve conc={conc}: {sweep[str(conc)]['rps']} req/s, "
+                f"p50 {sweep[str(conc)]['p50_ms']} ms, "
+                f"p99 {sweep[str(conc)]['p99_ms']} ms"
+            )
+    out["concurrency_sweep"] = sweep
+    out["plan_cold_ms"] = round(cold_ms, 3)
+    out["plan_warm_ms"] = round(warm_ms, 3)
+    out["plan_cold_vs_warm"] = round(cold_ms / warm_ms, 2) if warm_ms else None
+    log(
+        f"bench: serve plan cold {out['plan_cold_ms']} ms vs warm "
+        f"{out['plan_warm_ms']} ms = {out['plan_cold_vs_warm']}x"
+    )
+    _emit(out)
+
+
 # -- the streaming-loader benchmark (--dataset / phase "dataset") -------------
 
 DATASET_ROWS = int(os.environ.get("PQT_DATASET_ROWS", 2_000_000))
@@ -1410,6 +1583,19 @@ def main() -> None:
                 f"({r_io['gap_speedup']:.2f}x over gap 0)"
             )
 
+    # scan-service sweep (PQT_BENCH_SERVE=0 to skip): requests/s + p50/p99
+    # at client concurrency 1/4/16 against a warm daemon, cold-vs-warm plan
+    r_serve = None
+    if os.environ.get("PQT_BENCH_SERVE", "1") != "0":
+        r_serve = _run_phase("serve")
+        if r_serve:
+            c16 = r_serve["concurrency_sweep"]["16"]
+            log(
+                f"bench: serve {c16['rps']} req/s at conc 16 "
+                f"(p50 {c16['p50_ms']} ms, p99 {c16['p99_ms']} ms), "
+                f"warm plan {r_serve['plan_cold_vs_warm']}x faster than cold"
+            )
+
     # BASELINE.md 5-config matrix (per-config JSON on stderr + BENCH_MATRIX.json)
     results = None
     if os.environ.get("PQT_BENCH_MATRIX", "1") != "0":
@@ -1493,6 +1679,8 @@ def main() -> None:
         artifact["dataset"] = r_ds
     if r_io:
         artifact["io"] = r_io
+    if r_serve:
+        artifact["serve"] = r_serve
     if r_asm:
         artifact["assembly"] = r_asm
     if results is not None:
@@ -1543,6 +1731,8 @@ if __name__ == "__main__":
         _phase_io()
     elif argv and argv[0] == "--write":
         _phase_write()
+    elif argv and argv[0] == "--serve":
+        _phase_serve()
     elif len(argv) >= 2 and argv[0] == "--phase":
         name = argv[1]
         if name.startswith("matrix"):
@@ -1557,6 +1747,8 @@ if __name__ == "__main__":
             _phase_dataset()
         elif name == "io":
             _phase_io()
+        elif name == "serve":
+            _phase_serve()
         elif name == "assembly":
             _phase_assembly()
         else:

@@ -37,6 +37,8 @@ Layout:
              encode pipeline on the pqt-encode pool
   data/      streaming dataset: sharded/shuffled multi-file plans, bounded
              prefetch, fixed-size rebatching, mid-epoch checkpoint/resume
+  serve/     the scan/query daemon: typed HTTP protocol, warm-cache
+             planning, streaming push-down execution, admission control
   schema/    textual schema DSL (parser/printer/validator) + builder API
   floor/     high-level record marshal/unmarshal + dataclass autoschema
   parallel/  shard_map/mesh scale-out over pages, columns, and row groups
@@ -105,5 +107,13 @@ def __getattr__(name):
 
         module = importlib.import_module(".parallel", __name__)
         globals()["parallel"] = module
+        return module
+    if name == "serve":
+        # the daemon layer is stdlib-only but pulls http.server machinery
+        # nothing but `parquet-tool serve`/embedders need — keep it lazy
+        import importlib
+
+        module = importlib.import_module(".serve", __name__)
+        globals()["serve"] = module
         return module
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

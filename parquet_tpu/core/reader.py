@@ -45,12 +45,35 @@ from ..meta.thrift import ThriftError
 from ..utils import metrics as _metrics
 from ..utils.trace import bump, span, stage, timed_stage, traced_submit
 
-__all__ = ["FileReader", "PARQUET_ERRORS"]
+__all__ = ["FileReader", "PARQUET_ERRORS", "resolve_column_prefixes"]
 
 # The typed malformed-file error family: everything a corrupt or lying file
 # can legally raise out of a read. Anything else escaping a decode is a bug
 # the fault-injection harness (parquet_tpu.testing.faults) hunts for.
 PARQUET_ERRORS = (ParquetFileError, ChunkError, PageError, ThriftError)
+
+
+def resolve_column_prefixes(schema: Schema, columns):
+    """Resolve a column projection against a parsed schema: each entry is a
+    dotted (or tuple) path prefix selecting every leaf under it — the
+    reference's SetSelectedColumns convention. Returns the selected leaf
+    path set (None = all), raising the typed error for unknown prefixes.
+    Module-level so metadata-only callers (serve planning) validate with
+    the exact semantics FileReader applies, without opening the file."""
+    if columns is None:
+        return None
+    selected = set()
+    for c in columns:
+        path = tuple(c.split(".")) if isinstance(c, str) else tuple(c)
+        hits = [
+            leaf.path
+            for leaf in schema.leaves
+            if leaf.path[: len(path)] == path
+        ]
+        if not hits:
+            raise ParquetFileError(f"parquet: selected column {c!r} not in schema")
+        selected.update(hits)
+    return selected
 
 
 class _GroupQuarantined(Exception):
@@ -434,21 +457,7 @@ class FileReader:
     # -- column selection (reference: file_reader.go SetSelectedColumns, schema.go:347-367)
 
     def _resolve_columns(self, columns):
-        if columns is None:
-            return None
-        selected = set()
-        for c in columns:
-            path = tuple(c.split(".")) if isinstance(c, str) else tuple(c)
-            # select all leaves under the prefix
-            hits = [
-                leaf.path
-                for leaf in self.schema.leaves
-                if leaf.path[: len(path)] == path
-            ]
-            if not hits:
-                raise ParquetFileError(f"parquet: selected column {c!r} not in schema")
-            selected.update(hits)
-        return selected
+        return resolve_column_prefixes(self.schema, columns)
 
     def set_selected_columns(self, *columns) -> None:
         self._selected = self._resolve_columns(columns if columns else None)
@@ -1111,9 +1120,40 @@ class FileReader:
         groups provably excluded by written min/max/null-count never load
         (statistics-driven pruning; the reference writes stats but never
         consumes them, README.md:47)."""
-        from .filter import normalize_dnf
+        return self.prune_row_groups_counted(filters)[0]
 
-        return self._prune_groups_normalized(normalize_dnf(self.schema, filters))
+    def prune_row_groups_counted(self, filters) -> tuple:
+        """`(admitted_indices, stats_pruned, bloom_pruned)` — the same
+        pruning walk as prune_row_groups, attributing each excluded group
+        to the rung that excluded it (statistics first, then bloom). The
+        plan layer's pruning summary (`ScanPlan.pruning_summary()`) is fed
+        from here so the semantics live in ONE place."""
+        from .filter import normalize_dnf, row_group_may_match
+
+        dnf = normalize_dnf(self.schema, filters)
+        admitted: list[int] = []
+        stats_pruned = bloom_pruned = 0
+        for i in range(self.num_row_groups):
+            # one walk per (group, conjunction): dnf_group_may_match's OR
+            # semantics, unrolled so each stats evaluation happens once and
+            # the excluding rung is known without a second pass
+            rg = self.row_group(i)
+            stats_ok = survives = False
+            for conj in dnf:
+                if not row_group_may_match(rg, conj):
+                    continue
+                stats_ok = True
+                if self._bloom_excludes(i, conj):
+                    continue
+                survives = True
+                break
+            if survives:
+                admitted.append(i)
+            elif stats_ok:
+                bloom_pruned += 1
+            else:
+                stats_pruned += 1
+        return admitted, stats_pruned, bloom_pruned
 
     def _prune_groups_normalized(self, dnf) -> list[int]:
         from .filter import dnf_group_may_match
@@ -1136,13 +1176,20 @@ class FileReader:
         for path, cc, _col in self._selected_chunks(i, columns):
             ci = oi = None
             try:
+                # _fetch_chunk, not _pread: with a block cache attached the
+                # index ranges persist across readers, so warm re-planning
+                # (the serve daemon's repeat requests) reads zero bytes
                 if cc.column_index_offset and cc.column_index_length:
                     ci = ColumnIndex.loads(
-                        self._pread(cc.column_index_offset, cc.column_index_length)
+                        self._fetch_chunk(
+                            cc.column_index_offset, cc.column_index_length
+                        )
                     )
                 if cc.offset_index_offset and cc.offset_index_length:
                     oi = OffsetIndex.loads(
-                        self._pread(cc.offset_index_offset, cc.offset_index_length)
+                        self._fetch_chunk(
+                            cc.offset_index_offset, cc.offset_index_length
+                        )
                     )
             except ThriftError as e:
                 raise ParquetFileError(
@@ -1176,7 +1223,8 @@ class FileReader:
             if not length or length <= 0:
                 # header precedes the bitset; peek enough for the header,
                 # parse numBytes, then take exactly header+bitset
-                peek = self._pread(off, 64)
+                # (cache-routed so warm re-pruning repeats it from memory)
+                peek = self._fetch_chunk(off, 64)
                 from ..meta.parquet_types import BloomFilterHeader
                 from ..meta.thrift import CompactReader, ThriftError
 
@@ -1189,7 +1237,7 @@ class FileReader:
                     ) from e
                 length = r.pos + (h.numBytes or 0)
             try:
-                bf = BloomFilter.from_buffer(self._pread(off, length))
+                bf = BloomFilter.from_buffer(self._fetch_chunk(off, length))
             except ValueError as e:
                 raise ParquetFileError(
                     f"parquet: corrupt bloom filter for {'.'.join(path)}: {e}"

@@ -33,6 +33,7 @@ from typing import NamedTuple
 import numpy as np
 
 from ..core.reader import PARQUET_ERRORS, FileReader
+from ..utils import metrics as _metrics
 from ..utils.trace import bump
 
 __all__ = ["Unit", "ScanPlan", "expand_paths", "build_plan"]
@@ -80,6 +81,10 @@ class ScanPlan:
         metas: list,
         units: list[Unit],
         skipped_files: list[tuple[str, str]],
+        *,
+        units_total: int | None = None,
+        units_pruned_stats: int = 0,
+        units_pruned_bloom: int = 0,
     ):
         self.files = files
         # per-file FileMetaData (None for skipped files): per-unit readers
@@ -87,10 +92,27 @@ class ScanPlan:
         self.metas = metas
         self.units = units
         self.skipped_files = skipped_files
+        # The per-plan pruning summary: how many row groups the readable
+        # files held, and how many the filters excluded at plan time by
+        # chunk statistics vs bloom filters. Carried ON the plan so
+        # `GET /v1/plan` and `parquet-tool scan --json` report it without
+        # a trace attached (units_total - pruned_stats - pruned_bloom ==
+        # len(units)).
+        self.units_total = len(units) if units_total is None else units_total
+        self.units_pruned_stats = units_pruned_stats
+        self.units_pruned_bloom = units_pruned_bloom
 
     @property
     def num_units(self) -> int:
         return len(self.units)
+
+    def pruning_summary(self) -> dict:
+        return {
+            "units_total": self.units_total,
+            "units_pruned_stats": self.units_pruned_stats,
+            "units_pruned_bloom": self.units_pruned_bloom,
+            "units_admitted": len(self.units),
+        }
 
     @property
     def total_rows(self) -> int:
@@ -151,20 +173,27 @@ def build_plan(
     filters=None,
     on_error: str = "raise",
     footer_cache=None,
+    block_cache=None,
 ) -> ScanPlan:
     """Parse every file's footer and lay out the unit list.
 
     `filters` (the (column, op, value) DNF convention shared with
     FileReader) prunes row groups through the statistics/bloom path —
-    pruned groups never become units. With on_error != "raise" a file whose
-    footer (or schema/filter resolution) fails is skipped with a counter
-    instead of killing the scan. `footer_cache` (io.cache.FooterCache)
-    makes re-planning the same files — new epochs, new dataset objects,
-    open_many callers — parse each footer once per file generation."""
+    pruned groups never become units, and the per-plan pruning summary
+    (units_total / units_pruned_stats / units_pruned_bloom) rides the
+    returned ScanPlan. With on_error != "raise" a file whose footer (or
+    schema/filter resolution) fails is skipped with a counter instead of
+    killing the scan. `footer_cache` (io.cache.FooterCache) makes
+    re-planning the same files — new epochs, new dataset objects,
+    open_many callers — parse each footer once per file generation;
+    `block_cache` (io.cache.BlockCache) does the same for the bloom-filter
+    pages pruning consults, so a warm repeated plan performs ZERO source
+    reads even with bloom-equipped filters."""
     files = expand_paths(paths_or_glob)
     metas: list = []
     units: list[Unit] = []
     skipped: list[tuple[str, str]] = []
+    units_total = pruned_stats = pruned_bloom = 0
     filters_checked = filters is None
     for fi, path in enumerate(files):
         try:
@@ -186,14 +215,22 @@ def build_plan(
 
             normalize_dnf(Schema.from_thrift(meta.schema), filters)
             filters_checked = True
+        groups = meta.row_groups or []
+        # per-file tallies commit only after the file planned cleanly, so
+        # a mid-prune failure under the skip policy cannot skew the summary
+        f_stats = f_bloom = 0
         try:
             if filters is not None:
                 # statistics/bloom pruning needs a live reader (bloom pages
                 # read from the file); footer-only cost when no blooms exist
-                with FileReader(path, metadata=meta) as r:
-                    admitted = r.prune_row_groups(filters)
+                with FileReader(
+                    path, metadata=meta, block_cache=block_cache
+                ) as r:
+                    admitted, f_stats, f_bloom = r.prune_row_groups_counted(
+                        filters
+                    )
             else:
-                admitted = range(len(meta.row_groups or []))
+                admitted = range(len(groups))
         except PARQUET_ERRORS + (OSError,) as e:
             # OSError: the file vanished (or lost read permission) between
             # the glob and the open — same degradation policy as corruption
@@ -204,7 +241,21 @@ def build_plan(
             skipped.append((path, f"{type(e).__name__}: {e}"))
             continue
         metas.append(meta)
-        groups = meta.row_groups or []
+        units_total += len(groups)
+        pruned_stats += f_stats
+        pruned_bloom += f_bloom
         for gi in admitted:
             units.append(Unit(fi, path, gi, int(groups[gi].num_rows or 0)))
-    return ScanPlan(files, metas, units, skipped)
+    if pruned_stats:
+        _metrics.event("plan_units_pruned_stats", pruned_stats)
+    if pruned_bloom:
+        _metrics.event("plan_units_pruned_bloom", pruned_bloom)
+    return ScanPlan(
+        files,
+        metas,
+        units,
+        skipped,
+        units_total=units_total,
+        units_pruned_stats=pruned_stats,
+        units_pruned_bloom=pruned_bloom,
+    )

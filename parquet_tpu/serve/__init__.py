@@ -1,0 +1,38 @@
+"""parquet_tpu.serve — the concurrent scan/query service.
+
+The long-running request layer over everything the library already does:
+warm-cache planning (io.cache), projection/predicate push-down
+(data.plan + core.filter), bounded streaming execution (executor on the
+pqt-serve pool), admission control (queue depth, per-tenant budgets,
+deadlines, graceful drain), all behind a stdlib HTTP daemon
+(`parquet-tool serve`). See each module's docstring.
+"""
+
+from .admission import AdmissionController, Deadline  # noqa: F401
+from .executor import execute_stream, serve_pool  # noqa: F401
+from .protocol import (  # noqa: F401
+    ScanRequest,
+    ServeError,
+    filters_from_spec,
+    json_default,
+    parse_scan_request,
+)
+from .server import ScanServer, ScanService, ServeConfig  # noqa: F401
+from .session import PlannedScan, ScanSession  # noqa: F401
+
+__all__ = [
+    "ServeError",
+    "ScanRequest",
+    "parse_scan_request",
+    "filters_from_spec",
+    "json_default",
+    "ScanSession",
+    "PlannedScan",
+    "AdmissionController",
+    "Deadline",
+    "execute_stream",
+    "serve_pool",
+    "ServeConfig",
+    "ScanService",
+    "ScanServer",
+]

@@ -1,0 +1,367 @@
+"""Push-down execution of planned scans on the dedicated pqt-serve pool.
+
+The executor turns a PlannedScan into an incremental byte stream:
+
+  * every unit (one row group of one file) decodes as an independent task
+    on the process-wide bounded `pqt-serve` pool (PQT_SERVE_THREADS) —
+    separate from the chunk-prepare / pqt-data / pqt-io pools, so serve
+    traffic can never deadlock a dataset loader (and vice versa);
+  * results stream back IN PLAN ORDER with a bounded lookahead `window`:
+    at most `window` units are in flight or buffered per request, and the
+    generator only advances when the consumer (the chunked HTTP write)
+    drains — backpressure is the pull itself, nothing buffers the whole
+    result;
+  * predicate push-down continues below the plan's group pruning: each
+    unit reads through the reader's page-index pruning + exact residual
+    filtering (core/filter.py), with the projection applied at the source
+    (only selected chunks' byte ranges are fetched, through the shared
+    BlockCache);
+  * cancellation is cooperative: the deadline and the abort flag are
+    checked between units and every few thousand rows inside one, and
+    result waits are bounded by the deadline — an expired or disconnected
+    request frees its slot promptly instead of scanning to the end.
+
+Output formats: "jsonl" (rows exactly as `parquet-tool cat` prints them,
+one chunk per unit) and "arrow-ipc" (one Arrow IPC stream; each unit's
+table appended as record batches, EOS on completion).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutTimeout
+
+from ..core.reader import PARQUET_ERRORS, FileReader
+from ..utils import metrics as _metrics
+from ..utils.trace import stage, traced_submit
+from .protocol import ServeError, json_default
+
+__all__ = ["serve_pool", "execute_stream"]
+
+_ROW_CHECK_EVERY = 4096  # rows between cooperative cancellation checks
+_WAIT_SLICE_S = 0.1  # result-wait poll granularity (bounds deadline latency)
+
+_pool = None
+_pool_lock = threading.Lock()
+
+
+def serve_pool() -> ThreadPoolExecutor:
+    """The process-wide scan-execution pool. Sized by PQT_SERVE_THREADS
+    (default: min(8, cpus)); dedicated so nested pools (chunk prepare,
+    pqt-io readahead) can never self-deadlock against serve traffic."""
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            n = int(
+                os.environ.get("PQT_SERVE_THREADS", min(8, os.cpu_count() or 4))
+            )
+            _pool = ThreadPoolExecutor(
+                max_workers=max(1, n), thread_name_prefix="pqt-serve"
+            )
+        return _pool
+
+
+class _Check:
+    """The cooperative cancellation point: deadline + abort flag in one
+    callable, shared by the request generator and its unit tasks."""
+
+    __slots__ = ("deadline", "abort")
+
+    def __init__(self, deadline=None):
+        self.deadline = deadline
+        self.abort = threading.Event()
+
+    def __call__(self) -> None:
+        if self.abort.is_set():
+            raise ServeError(
+                499, "cancelled", "request cancelled (client gone or drained)"
+            )
+        if self.deadline is not None:
+            self.deadline.check()
+
+    def wait_slice(self) -> float:
+        if self.deadline is None:
+            return _WAIT_SLICE_S
+        rem = self.deadline.remaining()
+        if rem is None:
+            return _WAIT_SLICE_S
+        return max(0.0, min(_WAIT_SLICE_S, rem))
+
+
+def _open_reader(session, planned, unit) -> FileReader:
+    meta = planned.plan.metas[unit.file_index]
+    return FileReader(
+        session.open_source(unit.path),
+        columns=planned.request.columns,
+        metadata=meta,
+        block_cache=session.block_cache,
+    )
+
+
+def _close_unit_reader(session, reader) -> None:
+    # factory-built sources (chaos/remote seam) are caller-owned per the
+    # ByteSource contract: the reader won't close them, so we must
+    reader.close()
+    if session.source_factory is not None:
+        reader._source.close()
+
+
+def _run_jsonl_unit(session, planned, unit, max_rows, check):
+    """Decode + serialize one unit; returns (payload bytes, rows)."""
+    check()
+    with stage("serve.execute"):
+        reader = _open_reader(session, planned, unit)
+        try:
+            lines = []
+            n = 0
+            for row in reader.iter_rows(
+                row_groups=[unit.row_group], filters=planned.request.filters
+            ):
+                lines.append(json.dumps(row, default=json_default))
+                n += 1
+                if n % _ROW_CHECK_EVERY == 0:
+                    check()
+                if max_rows is not None and n >= max_rows:
+                    break
+            payload = ("\n".join(lines) + "\n").encode() if lines else b""
+            return payload, n
+        finally:
+            _close_unit_reader(session, reader)
+
+
+def _run_arrow_unit(session, planned, unit, max_rows, check):
+    """Decode one unit to a pyarrow Table (serialized by the stream side,
+    which owns the single IPC writer)."""
+    check()
+    with stage("serve.execute"):
+        reader = _open_reader(session, planned, unit)
+        try:
+            t = reader.to_arrow(
+                row_groups=[unit.row_group], filters=planned.request.filters
+            )
+            if max_rows is not None and t.num_rows > max_rows:
+                t = t.slice(0, max_rows)
+            return t
+        finally:
+            _close_unit_reader(session, reader)
+
+
+def _pipelined(units, run_one, window: int, check: "_Check"):
+    """Bounded in-order unit pipeline: submit up to `window` ahead, yield
+    results in plan order. Result waits poll in deadline-bounded slices so
+    an expired request raises its typed 504 even while a unit is stuck."""
+    pending: deque = deque()
+    idx = 0
+    try:
+        while pending or idx < len(units):
+            while idx < len(units) and len(pending) < window:
+                u = units[idx]
+                pending.append(traced_submit(serve_pool(), run_one, u))
+                idx += 1
+            fut = pending.popleft()
+            while True:
+                check()
+                try:
+                    result = fut.result(timeout=check.wait_slice())
+                    break
+                except _FutTimeout:
+                    continue
+            yield result
+    finally:
+        # abort first so already-running tasks exit at their next check,
+        # then drop anything still queued
+        check.abort.set()
+        for f in pending:
+            f.cancel()
+
+
+def _wrap_decode_errors(gen):
+    """Typed-error discipline at the execution boundary: a corrupt file
+    surfaces as a ServeError (422) the server renders structurally, never
+    a raw decode exception unwinding the handler."""
+    try:
+        yield from gen
+    except ServeError:
+        raise
+    except PARQUET_ERRORS as e:
+        raise ServeError(
+            422, "unreadable_file", f"{type(e).__name__}: {e}"
+        ) from None
+
+
+def _count_bytes(payload: bytes) -> None:
+    _metrics.inc("serve_scan_bytes_total", len(payload))
+
+
+def _stream_jsonl(planned, session, check, window):
+    remaining = planned.request.limit
+    units = planned.units
+
+    def run(u, cap=None):
+        return _run_jsonl_unit(session, planned, u, cap, check)
+
+    if remaining is None:
+        for payload, _n in _pipelined(units, run, window, check):
+            if payload:
+                _count_bytes(payload)
+                yield payload
+        return
+    # limited scans run sequentially: each unit's cap is what's left, and
+    # lookahead past a satisfied limit would be wasted decode work
+    for u in units:
+        if remaining <= 0:
+            break
+        check()
+        fut = traced_submit(serve_pool(), run, u, remaining)
+        while True:
+            check()
+            try:
+                payload, n = fut.result(timeout=check.wait_slice())
+                break
+            except _FutTimeout:
+                continue
+        remaining -= n
+        if payload:
+            _count_bytes(payload)
+            yield payload
+
+
+class _ChunkSink:
+    """A file-like the single Arrow IPC writer writes into; `take()` hands
+    the bytes accumulated since the last take to the HTTP stream."""
+
+    closed = False  # pyarrow's IPC writer checks the file-like protocol
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    def write(self, data) -> int:
+        b = bytes(data)
+        self._parts.append(b)
+        return len(b)
+
+    def flush(self) -> None:
+        pass
+
+    def take(self) -> bytes:
+        out = b"".join(self._parts)
+        self._parts.clear()
+        return out
+
+
+def _empty_table(planned, session):
+    """A zero-row table carrying the scan's schema (so an empty result is
+    still a VALID Arrow IPC stream: schema header + EOS)."""
+    for fi, meta in enumerate(planned.plan.metas):
+        if meta is None:
+            continue
+        reader = FileReader(
+            session.open_source(planned.plan.files[fi]),
+            columns=planned.request.columns,
+            metadata=meta,
+        )
+        try:
+            return reader.to_arrow(row_groups=[])
+        finally:
+            _close_unit_reader(session, reader)
+    raise ServeError(422, "unreadable_file", "no readable file to derive a schema")
+
+
+def _stream_arrow(planned, session, check, window):
+    import pyarrow as pa
+
+    sink = _ChunkSink()
+    writer = None
+    remaining = planned.request.limit
+    units = planned.units
+
+    def run(u):
+        return _run_arrow_unit(session, planned, u, None, check)
+
+    def limited():
+        # limited scans run sequentially, each unit capped at what the
+        # limit STILL needs (`remaining` shrinks as the loop consumes) —
+        # lookahead past a satisfied limit would be wasted decode work
+        for u in units:
+            if remaining <= 0:
+                return
+            check()
+            fut = traced_submit(
+                serve_pool(), _run_arrow_unit, session, planned, u,
+                remaining, check,
+            )
+            while True:
+                check()
+                try:
+                    yield fut.result(timeout=check.wait_slice())
+                    break
+                except _FutTimeout:
+                    continue
+
+    try:
+        source = (
+            _pipelined(units, run, window, check)
+            if remaining is None
+            else limited()
+        )
+        for table in source:
+            if remaining is not None:
+                table = table.slice(0, remaining)
+                remaining -= table.num_rows
+            if writer is None:
+                writer = pa.ipc.new_stream(sink, table.schema)
+            try:
+                writer.write_table(table)
+            except pa.ArrowInvalid as e:
+                raise ServeError(
+                    422, "schema_mismatch",
+                    f"files in one scan must share a schema: {e}",
+                ) from None
+            payload = sink.take()
+            if payload:
+                _count_bytes(payload)
+                yield payload
+            if remaining is not None and remaining <= 0:
+                break
+        if writer is None:
+            writer = pa.ipc.new_stream(sink, _empty_table(planned, session).schema)
+        writer.close()
+        payload = sink.take()
+        if payload:
+            _count_bytes(payload)
+            yield payload
+    finally:
+        check.abort.set()
+
+
+def execute_stream(planned, session, *, deadline=None, window: int = 2):
+    """The request's payload-chunk generator. Pull-driven: nothing decodes
+    beyond `window` units ahead of what the consumer has taken, and closing
+    the generator (client disconnect) aborts in-flight unit tasks at their
+    next cooperative check. Raises ServeError (typed) for every failure
+    mode — deadline, cancellation, corrupt data, schema drift."""
+    check = _Check(deadline)
+    if window < 1:
+        raise ValueError("executor: window must be >= 1")
+    if planned.request.format == "arrow-ipc":
+        gen = _stream_arrow(planned, session, check, window)
+    else:
+        gen = _stream_jsonl(planned, session, check, window)
+
+    def outer():
+        try:
+            for payload in _wrap_decode_errors(gen):
+                # the stage brackets the YIELD: its wall time is how long
+                # the consumer (the chunked HTTP write) took to drain this
+                # chunk — the backpressure/writeback measurement
+                with stage("serve.stream", nbytes=len(payload)):
+                    yield payload
+        finally:
+            check.abort.set()
+            gen.close()
+
+    return outer()

@@ -1,0 +1,487 @@
+"""The `parquet-tool serve` daemon: a concurrent scan/query HTTP service.
+
+stdlib-only (ThreadingHTTPServer — one thread per connection, scan work on
+the bounded pqt-serve pool), four endpoints:
+
+  POST /v1/scan     {"paths": ..., "columns": ..., "filters": ..., "limit":
+                    ..., "format": "jsonl"|"arrow-ipc", "shard": [i, n]}
+                    → chunked-transfer stream of results. Headers:
+                    `X-Tenant` (budget accounting key), `X-Timeout-Ms`
+                    (deadline override).
+  GET  /v1/plan     dry-run of the same request (query params or POSTed
+                    body): pruned vs total row groups, estimated bytes —
+                    zero source reads when the footer cache is warm.
+  GET  /metrics     Prometheus text exposition of the process registry.
+  GET  /healthz     {"status": "ok"|"draining", "in_flight": n}; 503 while
+                    draining so load balancers stop routing here.
+
+Error discipline: EVERY failure renders as a structured JSON body
+({"error": {code, message, status}}) — never a traceback. Failures after
+the 200 header is sent (the stream already started) emit a terminal
+`{"error": ...}` line (jsonl) and abort the chunked encoding WITHOUT the
+terminating 0-chunk, so clients always detect the torn transfer instead
+of mistaking a prefix for the full result.
+
+Shutdown: SIGTERM/SIGINT (install_signal_handlers, the `parquet-tool
+serve` path) or drain() begin a graceful drain — new requests get typed
+503s while in-flight ones run to completion — then the listener stops.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from ..io.cache import BlockCache
+from ..utils import metrics as _metrics
+from .admission import AdmissionController
+from .executor import execute_stream
+from .protocol import ServeError, parse_scan_request, scan_request_from_query
+from .session import ScanSession
+
+__all__ = ["ServeConfig", "ScanService", "ScanServer"]
+
+
+@dataclass
+class ServeConfig:
+    """Everything a daemon instance is allowed to do, in one place."""
+
+    host: str = "127.0.0.1"
+    port: int = 8080
+    root: str | None = None  # confine requested paths to this directory
+    cache_mb: int = 64  # shared block cache (0 disables)
+    max_inflight: int = 32
+    tenant_concurrent: int = 8
+    tenant_budget_mb: int | None = None  # scanned-byte budget per window
+    budget_window_s: float = 60.0
+    default_timeout_s: float | None = 30.0
+    max_timeout_s: float = 300.0
+    window: int = 2  # per-request unit lookahead (backpressure bound)
+    # request bodies are small JSON specs; a client-declared Content-Length
+    # is rejected with a typed 413 past this, BEFORE any bytes are buffered
+    max_body_bytes: int = 1 << 20
+    # per-socket-op timeout: a client that stalls (stops sending its body,
+    # or accepts the 200 and stops reading) would otherwise pin its handler
+    # thread AND its admission ticket forever — the cooperative deadline
+    # can't fire while the thread is blocked in a socket call
+    socket_timeout_s: float = 60.0
+    shard: tuple | None = None  # this daemon's (index, count) corpus stripe
+    source_factory: object = None  # chaos/remote seam: path -> ByteSource
+
+    def __post_init__(self):
+        if self.window < 1:
+            raise ValueError("serve: window must be >= 1")
+        if self.cache_mb < 0:
+            raise ValueError("serve: cache_mb must be >= 0")
+        if self.socket_timeout_s is not None and self.socket_timeout_s <= 0:
+            raise ValueError("serve: socket_timeout_s must be positive")
+        if self.max_body_bytes < 1:
+            raise ValueError("serve: max_body_bytes must be >= 1")
+        if self.default_timeout_s is not None and self.default_timeout_s <= 0:
+            raise ValueError(
+                "serve: default_timeout_s must be positive (None disables)"
+            )
+        if self.max_timeout_s <= 0:
+            raise ValueError("serve: max_timeout_s must be positive")
+
+
+class ScanService:
+    """The daemon's request brain, HTTP-free so tests and embedders drive
+    it directly: session (shared caches + confinement) + admission."""
+
+    def __init__(self, config: ServeConfig):
+        self.config = config
+        self.session = ScanSession(
+            root=config.root,
+            block_cache=(
+                BlockCache(config.cache_mb << 20) if config.cache_mb else None
+            ),
+            source_factory=config.source_factory,
+            shard=config.shard,
+        )
+        self.admission = AdmissionController(
+            max_inflight=config.max_inflight,
+            tenant_concurrent=config.tenant_concurrent,
+            tenant_budget_bytes=(
+                config.tenant_budget_mb << 20
+                if config.tenant_budget_mb is not None
+                else None
+            ),
+            budget_window_s=config.budget_window_s,
+            default_timeout_s=config.default_timeout_s,
+            max_timeout_s=config.max_timeout_s,
+        )
+
+    # -- request entry points (raise ServeError; HTTP layer renders) -----------
+
+    def plan(self, request) -> dict:
+        """The /v1/plan dry-run body (no admission: planning is cheap and
+        cached; hammering /v1/plan cannot starve scans of pool threads)."""
+        return self.session.plan(request).summary()
+
+    def scan(self, request, tenant: str, timeout_ms=None):
+        """Admit, plan, charge, and open the result stream. Returns
+        (ticket, content_type, chunk iterator); the caller MUST close the
+        iterator and release the ticket (both context-manage safely)."""
+        deadline = self.admission.deadline_for(
+            timeout_ms if timeout_ms is not None else request.timeout_ms
+        )
+        ticket = self.admission.admit(tenant)
+        try:
+            planned = self.session.plan(request)
+            # ticket.tenant is the RESOLVED accounting key (it may have
+            # collapsed to the overflow bucket under tenant-table pressure)
+            self.admission.charge(ticket.tenant, planned.estimated_bytes)
+            deadline.check()
+            chunks = execute_stream(
+                planned,
+                self.session,
+                deadline=deadline,
+                window=self.config.window,
+            )
+        except BaseException:
+            ticket.release()
+            raise
+        content_type = (
+            "application/vnd.apache.arrow.stream"
+            if request.format == "arrow-ipc"
+            else "application/x-ndjson"
+        )
+        return ticket, content_type, chunks
+
+    def healthz(self) -> tuple[int, dict]:
+        draining = self.admission.draining
+        body = {
+            "status": "draining" if draining else "ok",
+            "in_flight": self.admission.in_flight,
+        }
+        return (503 if draining else 200), body
+
+
+def _finish_request(tenant: str, status: int, t0: float) -> None:
+    _metrics.inc("serve_requests_total", status=str(status), tenant=tenant)
+    _metrics.observe("serve_request_seconds", time.perf_counter() - t0)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "parquet-tpu-serve"
+
+    # -- plumbing --------------------------------------------------------------
+
+    def setup(self):
+        # StreamRequestHandler applies self.timeout to the connection; a
+        # stalled read/write then raises TimeoutError (handled as a gone
+        # client) instead of pinning the thread + admission slot forever
+        self.timeout = getattr(self.server, "socket_timeout", 60.0)
+        super().setup()
+
+    def log_message(self, fmt, *args):  # noqa: A003 - BaseHTTPRequestHandler API
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    @property
+    def service(self) -> ScanService:
+        return self.server.service
+
+    def _tenant(self) -> str:
+        # resolved through admission so a flood of distinct X-Tenant values
+        # cannot grow per-tenant state or the metrics label set unbounded
+        return self.service.admission.resolve_tenant(
+            self.headers.get("X-Tenant")
+        )
+
+    def _timeout_ms(self):
+        return self.headers.get("X-Timeout-Ms")
+
+    def _read_body(self) -> bytes:
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            raise ServeError(400, "bad_request", "bad Content-Length") from None
+        cap = getattr(self.server, "max_body_bytes", 1 << 20)
+        if n > cap:
+            # reject on the DECLARED length, before buffering a byte — one
+            # request must not be able to exhaust daemon memory ahead of
+            # admission (the unread body closes the connection in _drain_body)
+            raise ServeError(
+                413, "body_too_large",
+                f"request body {n} bytes exceeds the {cap}-byte limit",
+            )
+        body = self.rfile.read(n) if n > 0 else b""
+        self._body_read = True
+        return body
+
+    def _drain_body(self) -> None:
+        """Consume a request body the route never read, so the next
+        keep-alive request isn't parsed out of leftover body bytes; bodies
+        too large (or unreadable) to drain close the connection instead."""
+        if getattr(self, "_body_read", False):
+            return
+        self._body_read = True
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            self.close_connection = True
+            return
+        if n <= 0:
+            return
+        if n > getattr(self.server, "max_body_bytes", 1 << 20):
+            self.close_connection = True
+            return
+        try:
+            self.rfile.read(n)
+        except OSError:
+            self.close_connection = True
+
+    def _send_json(self, status: int, body: dict, *, retry_after=None) -> None:
+        self._drain_body()
+        payload = (json.dumps(body) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_error_body(self, e: ServeError) -> None:
+        # absorb a client that hung up before reading its error: an escape
+        # from THIS send would bubble past the route's except clauses into
+        # socketserver's traceback dump (TimeoutError is an OSError)
+        try:
+            self._send_json(e.status, e.to_body(), retry_after=e.retry_after_s)
+        except OSError:
+            self.close_connection = True
+
+    # -- chunked streaming -----------------------------------------------------
+
+    def _write_chunk(self, payload: bytes) -> None:
+        self.wfile.write(b"%x\r\n" % len(payload) + payload + b"\r\n")
+
+    def _stream(self, chunks, content_type: str, tenant: str, t0: float) -> None:
+        """Send a 200 + chunked body. The FIRST chunk is pulled before the
+        status line goes out, so planning/admission/decode errors that
+        surface lazily still produce a clean typed error response."""
+        started = False
+        status = 200
+        try:
+            it = iter(chunks)
+            try:
+                first = next(it)
+            except StopIteration:
+                first = None
+            self.send_response(200)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            started = True
+            if first:
+                self._write_chunk(first)
+            for payload in it:
+                if payload:
+                    self._write_chunk(payload)
+            self._write_chunk(b"")  # terminating 0-chunk: complete transfer
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            status = 499  # client gone or stalled; executor aborts via gen.close()
+            self.close_connection = True
+        except Exception as exc:  # noqa: BLE001 - the no-traceback contract
+            # EVERY failure is absorbed here (a stray one escaping would be
+            # double-counted by the route handler — and, once the 200 went
+            # out, its 500 response line would corrupt the open chunked
+            # stream). Non-ServeError = a bug, rendered as the typed 500.
+            e = (
+                exc
+                if isinstance(exc, ServeError)
+                else ServeError(500, "internal", f"{type(exc).__name__}: {exc}")
+            )
+            status = e.status
+            if not started:
+                self._send_error_body(e)
+            else:
+                # mid-stream failure: typed terminal record, then ABORT the
+                # chunked encoding (no 0-chunk) so the client cannot
+                # mistake the prefix for a complete result
+                _metrics.event("serve_stream_aborted")
+                if content_type == "application/x-ndjson":
+                    try:
+                        self._write_chunk(
+                            (json.dumps(e.to_body()) + "\n").encode()
+                        )
+                    except OSError:
+                        pass
+                self.close_connection = True
+        finally:
+            chunks.close()
+            _finish_request(tenant, status, t0)
+
+    # -- routes ----------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        split = urlsplit(self.path)
+        route = split.path
+        t0 = time.perf_counter()
+        self._body_read = False  # per-request: the handler serves many
+        tenant = self._tenant()
+        try:
+            if route == "/healthz":
+                status, body = self.service.healthz()
+                self._send_json(status, body)
+                return
+            if route == "/metrics":
+                self._drain_body()
+                payload = _metrics.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            if route == "/v1/plan":
+                request = scan_request_from_query(parse_qs(split.query))
+                self._send_json(200, self.service.plan(request))
+                _finish_request(tenant, 200, t0)
+                return
+            raise ServeError(404, "no_such_route", f"unknown path {route!r}")
+        except ServeError as e:
+            self._send_error_body(e)
+            if route == "/v1/plan":
+                _finish_request(tenant, e.status, t0)
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            self.close_connection = True  # scraper/LB hung up or stalled
+        except Exception as e:  # noqa: BLE001 - the no-traceback contract
+            self._send_internal_error(e)
+
+    def _send_internal_error(self, e) -> None:
+        """Best-effort typed 500: never let a dead socket turn a handler
+        bug into a socketserver traceback dump."""
+        try:
+            self._send_error_body(
+                ServeError(500, "internal", f"{type(e).__name__}: {e}")
+            )
+        except OSError:
+            self.close_connection = True
+
+    def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        route = urlsplit(self.path).path
+        t0 = time.perf_counter()
+        self._body_read = False  # per-request: the handler serves many
+        tenant = self._tenant()
+        try:
+            if route == "/v1/scan":
+                request = parse_scan_request(self._read_body())
+                ticket, content_type, chunks = self.service.scan(
+                    request, tenant, timeout_ms=self._timeout_ms()
+                )
+                with ticket:
+                    self._stream(chunks, content_type, tenant, t0)
+                return
+            if route == "/v1/plan":
+                request = parse_scan_request(self._read_body())
+                self._send_json(200, self.service.plan(request))
+                _finish_request(tenant, 200, t0)
+                return
+            raise ServeError(404, "no_such_route", f"unknown path {route!r}")
+        except ServeError as e:
+            self._send_error_body(e)
+            _finish_request(tenant, e.status, t0)
+        except (BrokenPipeError, ConnectionResetError, TimeoutError):
+            self.close_connection = True
+            _finish_request(tenant, 499, t0)
+        except Exception as e:  # noqa: BLE001 - the no-traceback contract
+            self._send_internal_error(e)
+            _finish_request(tenant, 500, t0)
+
+
+class ScanServer:
+    """Lifecycle wrapper: bind, serve (foreground or background thread),
+    drain, stop. `port=0` binds an ephemeral port (tests/bench)."""
+
+    def __init__(self, config: ServeConfig, *, verbose: bool = False):
+        self.config = config
+        self.service = ScanService(config)
+        self._httpd = ThreadingHTTPServer(
+            (config.host, config.port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self._httpd.service = self.service
+        self._httpd.verbose = verbose
+        self._httpd.socket_timeout = config.socket_timeout_s
+        self._httpd.max_body_bytes = config.max_body_bytes
+        self._thread: threading.Thread | None = None
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- run -------------------------------------------------------------------
+
+    def serve_forever(self) -> None:
+        self._httpd.serve_forever(poll_interval=0.1)
+
+    def start_background(self) -> "ScanServer":
+        self._thread = threading.Thread(
+            target=self.serve_forever, name="pqt-serve-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    # -- stop ------------------------------------------------------------------
+
+    def drain(self, timeout: float | None = 30.0) -> bool:
+        """Graceful shutdown, the SIGTERM semantics: stop admitting (new
+        scans get typed 503s), let in-flight requests complete (bounded by
+        `timeout`), then stop the listener. True iff fully drained."""
+        self.service.admission.begin_drain()
+        drained = self.service.admission.wait_drained(timeout=timeout)
+        self.shutdown()
+        return drained
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def close(self) -> None:
+        try:
+            self.shutdown()
+        finally:
+            self._httpd.server_close()
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain then stop (main thread only —
+        the `parquet-tool serve` foreground path)."""
+        import signal
+
+        def _on_term(signum, frame):
+            # the handler must not block the main loop: drain on a thread,
+            # which shuts the listener down when the last request leaves
+            threading.Thread(
+                target=self.drain, name="pqt-serve-drain", daemon=True
+            ).start()
+
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -51,6 +51,9 @@ class FlakySource:
                  violation real transports commit; RetryingSource re-reads)
     latency_s    fixed sleep added to every read (the range-GET shape);
                  latency_jitter_s adds a uniform extra draw on top
+    spike_rate   probability a read stalls an EXTRA spike_s on top of the
+                 base latency — the hot-shard / GC-pause / tail-latency
+                 shape (see the latency_spike preset)
     permanent    every read fails with EIO — the budget-exhaustion case
     fault_window (offset, length) confining faults to reads that overlap
                  the window (None = everywhere)
@@ -65,6 +68,8 @@ class FlakySource:
         short_rate: float = 0.0,
         latency_s: float = 0.0,
         latency_jitter_s: float = 0.0,
+        spike_rate: float = 0.0,
+        spike_s: float = 0.0,
         permanent: bool = False,
         fault_window: tuple[int, int] | None = None,
         sleep=time.sleep,
@@ -75,11 +80,23 @@ class FlakySource:
         self.short_rate = float(short_rate)
         self.latency_s = float(latency_s)
         self.latency_jitter_s = float(latency_jitter_s)
+        self.spike_rate = float(spike_rate)
+        self.spike_s = float(spike_s)
         self.permanent = bool(permanent)
         self.fault_window = fault_window
         self._sleep = sleep
         self.faults_injected = 0
         self.reads = 0
+        self.spikes_injected = 0
+
+    @classmethod
+    def latency_spike(cls, inner, *, seed: int = 0, p: float = 0.05, ms: float = 50.0, **kw):
+        """Preset: a source whose reads occasionally STALL — each read has
+        probability `p` of an extra `ms`-millisecond spike (seeded, so a
+        failing chaos run replays exactly). The serving-layer adversary: a
+        latency-spiked source must produce slow responses or typed
+        timeouts, never a hung worker or a torn response body."""
+        return cls(inner, seed=seed, spike_rate=p, spike_s=ms / 1e3, **kw)
 
     @property
     def source_id(self) -> str:
@@ -103,6 +120,11 @@ class FlakySource:
                 else 0.0
             )
             self._sleep(self.latency_s + extra)
+        # spikes draw only when enabled so existing seeds' fault streams
+        # are unchanged by the knob's existence
+        if self.spike_rate and float(self._rng.random()) < self.spike_rate:
+            self.spikes_injected += 1
+            self._sleep(self.spike_s)
         if self._in_window(offset, n):
             if self.permanent:
                 self.faults_injected += 1

@@ -1,4 +1,4 @@
-"""parquet-tool: cat / head / meta / schema / rowcount / split / verify / salvage / profile / scan.
+"""parquet-tool: cat / head / meta / schema / rowcount / split / verify / salvage / profile / scan / serve.
 
 Equivalent of the reference's cobra CLI (reference: cmd/parquet-tool/cmds —
 cat.go:14, head.go:17, meta.go:14, schema.go:16, rowcount.go:16, split.go:31),
@@ -24,6 +24,13 @@ the whole file under the span tracer and writes Chrome trace-event JSON
 reports end-to-end loader throughput: rows/s, batches, and the wait-time
 share (how much of the wall the consumer spent starved for the next unit —
 the number prefetch depth tuning moves).
+
+`serve` runs the long-running scan/query daemon (parquet_tpu.serve): POST
+/v1/scan streams filtered, projected rows as jsonl or Arrow IPC with
+warm-cache planning and admission control; GET /v1/plan dry-runs the same
+request; /metrics and /healthz feed scrapers and load balancers.
+
+    python -m parquet_tpu.tools.parquet_tool serve --root /data --port 8080
 """
 
 from __future__ import annotations
@@ -41,9 +48,13 @@ __all__ = ["main"]
 
 
 def _json_default(v):
-    if isinstance(v, bytes):
-        return v.decode("utf-8", errors="replace")
-    return str(v)
+    # THE definition lives in serve/protocol.py (shared so daemon bytes
+    # match cat/head bytes); imported lazily per call — only reached for
+    # non-JSON-native values — so `parquet-tool cat` never pays the serve
+    # package import
+    from ..serve.protocol import json_default
+
+    return json_default(v)
 
 
 def _coerce(raw: str):
@@ -705,11 +716,27 @@ def cmd_scan(args) -> int:
     from ..utils import metrics
 
     cols = args.columns.split(",") if args.columns else None
+    if args.filters and args.filter:
+        raise ValueError(
+            "use either --filter (repeatable 'col OP value') or --filters "
+            "(one JSON spec), not both"
+        )
+    if args.filters:
+        # the same spec language POST /v1/scan accepts, via the same parser
+        from ..serve.protocol import filters_from_spec
+
+        try:
+            spec = json.loads(args.filters)
+        except ValueError as e:
+            raise ValueError(f"--filters is not valid JSON: {e}") from None
+        filters = filters_from_spec(spec)
+    else:
+        filters = _parse_filters(args.filter)
     ds = ParquetDataset(
         args.glob,
         batch_size=args.batch_size,
         columns=cols,
-        filters=_parse_filters(args.filter),
+        filters=filters,
         shuffle=args.shuffle,
         seed=args.seed,
         num_epochs=args.epochs,
@@ -727,6 +754,13 @@ def cmd_scan(args) -> int:
         f"{plan.total_rows:,} rows planned (shard "
         f"{ds.shard_index}/{ds.shard_count}, prefetch {ds.prefetch})"
     )
+    if filters is not None:
+        ps = plan.pruning_summary()
+        print(
+            f"scan: pruning {ps['units_admitted']}/{ps['units_total']} row "
+            f"groups admitted ({ps['units_pruned_stats']} pruned by stats, "
+            f"{ps['units_pruned_bloom']} by bloom)"
+        )
     snap0 = metrics.snapshot()
     rows = batches = 0
     t0 = time.perf_counter()
@@ -785,9 +819,47 @@ def cmd_scan(args) -> int:
                     "io_cache_hit_rate": (
                         round(hit_rate, 4) if hit_rate is not None else None
                     ),
+                    "pruning": plan.pruning_summary(),
                 }
             )
         )
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Run the scan/query daemon (parquet_tpu.serve) in the foreground.
+
+    SIGTERM/SIGINT drain gracefully: in-flight requests complete, new ones
+    get typed 503s, then the listener stops."""
+    from ..serve import ScanServer, ServeConfig
+    from ..serve.protocol import _parse_shard
+
+    config = ServeConfig(
+        host=args.host,
+        port=args.port,
+        root=args.root,
+        cache_mb=args.cache_mb,
+        max_inflight=args.max_inflight,
+        tenant_concurrent=args.tenant_concurrent,
+        tenant_budget_mb=args.tenant_budget_mb,
+        budget_window_s=args.budget_window_s,
+        default_timeout_s=(None if args.timeout_s == 0 else args.timeout_s),
+        max_timeout_s=args.max_timeout_s,
+        window=args.window,
+        socket_timeout_s=args.socket_timeout_s,
+        shard=_parse_shard(args.shard),
+    )
+    server = ScanServer(config, verbose=args.verbose)
+    server.install_signal_handlers()
+    # the exact line tests/scripts parse for the ephemeral --port 0 case
+    print(f"serve: listening on {server.url}", flush=True)
+    if server.config.root:
+        print(f"serve: root {server.config.root}", flush=True)
+    try:
+        server.serve_forever()
+    finally:
+        server.close()
+    print("serve: drained, bye", flush=True)
     return 0
 
 
@@ -907,6 +979,12 @@ def main(argv=None) -> int:
     pn.add_argument("glob", help="glob pattern or single file")
     pn.add_argument("--columns", help="comma-separated column projection")
     pn.add_argument("--filter", action="append", help=filter_help)
+    pn.add_argument(
+        "--filters",
+        help="JSON filter spec — a list of [column, op, value] triples "
+        "(ANDed) or a list of such lists (ORed), exactly what POST "
+        "/v1/scan accepts; mutually exclusive with --filter",
+    )
     pn.add_argument("--batch-size", type=int, default=8192)
     pn.add_argument("--prefetch", type=int, default=2, help="units decoded ahead")
     pn.add_argument(
@@ -937,6 +1015,83 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="also print a JSON result line"
     )
     pn.set_defaults(fn=cmd_scan)
+
+    pe = sub.add_parser(
+        "serve",
+        help="run the concurrent scan/query daemon (POST /v1/scan, "
+        "GET /v1/plan, /metrics, /healthz); SIGTERM drains gracefully",
+    )
+    pe.add_argument("--host", default="127.0.0.1")
+    pe.add_argument(
+        "--port", type=int, default=8080, help="0 binds an ephemeral port"
+    )
+    pe.add_argument(
+        "--root",
+        help="confine requested paths to this directory (strongly "
+        "recommended; escapes get typed 403s)",
+    )
+    pe.add_argument(
+        "--cache-mb",
+        type=int,
+        default=64,
+        help="shared block-cache budget in MiB (0 = off); footers always "
+        "cache, so warm repeat plans do zero source reads",
+    )
+    pe.add_argument(
+        "--max-inflight",
+        type=int,
+        default=32,
+        help="global concurrent-request cap (excess gets typed 429s)",
+    )
+    pe.add_argument(
+        "--tenant-concurrent",
+        type=int,
+        default=8,
+        help="per-tenant concurrent-request cap (X-Tenant header)",
+    )
+    pe.add_argument(
+        "--tenant-budget-mb",
+        type=int,
+        default=None,
+        help="per-tenant scanned-byte budget per window (charged with the "
+        "plan estimate; exhaustion gets typed 429s with Retry-After)",
+    )
+    pe.add_argument(
+        "--budget-window-s",
+        type=float,
+        default=60.0,
+        help="token-bucket refill window for --tenant-budget-mb",
+    )
+    pe.add_argument(
+        "--timeout-s",
+        type=float,
+        default=30.0,
+        help="default per-request deadline (0 = none; X-Timeout-Ms / "
+        "body timeout_ms override, clamped to --max-timeout-s)",
+    )
+    pe.add_argument("--max-timeout-s", type=float, default=300.0)
+    pe.add_argument(
+        "--socket-timeout-s",
+        type=float,
+        default=60.0,
+        help="per-socket-op timeout: a stalled client (stops sending or "
+        "stops reading) frees its thread and admission slot after this",
+    )
+    pe.add_argument(
+        "--window",
+        type=int,
+        default=2,
+        help="per-request unit decode lookahead (the backpressure bound)",
+    )
+    pe.add_argument(
+        "--shard",
+        help="this daemon's corpus stripe as 'i/n' — run n daemons with "
+        "i=0..n-1 over the same files to split one logical corpus",
+    )
+    pe.add_argument(
+        "--verbose", action="store_true", help="log every request line"
+    )
+    pe.set_defaults(fn=cmd_serve)
 
     pp = sub.add_parser("split", help="split into parts by rows or file size")
     pp.add_argument("-n", type=int, help="rows per part")

@@ -54,6 +54,21 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
                                     time (one observation per assembly
                                     window / scalar group; same clock as
                                     the assembly.rows trace stage)
+  serve_requests_total{status=,tenant=}  scan-service requests finished,
+                                    by HTTP status and X-Tenant key (499 =
+                                    client disconnected mid-stream)
+  serve_queue_depth                 gauge: requests currently admitted and
+                                    in flight in the serve daemon
+  serve_request_seconds             histogram of request wall time, entry
+                                    to last byte (plan + queue + execute +
+                                    stream)
+  serve_scan_bytes_total            response payload bytes streamed back
+                                    by /v1/scan (jsonl or arrow-ipc)
+  events_total{event="serve_stream_aborted"}  responses torn mid-stream
+                                    (typed terminal record, no 0-chunk)
+  events_total{event="plan_units_pruned_stats"|"plan_units_pruned_bloom"}
+                                    row groups excluded at plan time, also
+                                    on every ScanPlan.pruning_summary()
 
 Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as
