@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-compare dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
 check: native lint
@@ -57,6 +57,13 @@ bench-serve: native
 # identical before timing); host-only, no accelerator
 bench-assembly: native
 	python bench.py --assembly
+
+# regression gate over two --json artifacts: every tracked metric's
+# new/old ratio, non-zero exit on a >THRESHOLD regression — how future
+# PRs hold the BENCH_r0x trajectory. Usage:
+#   make bench-compare OLD=BENCH_r05.json NEW=/tmp/bench_now.json
+bench-compare:
+	python bench.py --compare $(OLD) $(NEW) --threshold $(or $(THRESHOLD),0.10)
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

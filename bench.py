@@ -81,6 +81,17 @@ final structured result — headline + per-stage prepare breakdown + matrix —
 to a file, so the BENCH_* trajectory artifacts are produced by the harness
 itself instead of by hand. Works in phase mode too
 (`bench.py --phase prepare --json out.json` writes that phase's object).
+
+`--compare old.json new.json [--threshold 0.10]` diffs two --json artifacts
+section by section: every tracked metric (throughputs like rows_s/req_s and
+the headline `value` are higher-better; latencies/walls like *_ms, p50_ms,
+`t` are lower-better) prints a new/old ratio, and the run exits non-zero
+when any tracked metric REGRESSES beyond the threshold (default 10%) — the
+`make bench-compare OLD=... NEW=...` gate future PRs hold the BENCH_r0x
+trajectory against. Untracked leaves (counts, depths, config echoes) are
+reported as changed/unchanged but never gate; two artifacts with NO
+tracked metric in common also exit non-zero (a gate that compared
+nothing must not read as green).
 """
 
 from __future__ import annotations
@@ -1715,6 +1726,117 @@ def _verify_host_paths(host, tpu) -> None:
     log("bench: byte-identical host vs tpu decode (values + levels) ✓")
 
 
+def _metric_direction(key: str) -> int:
+    """+1: higher is better (throughputs, speedups). -1: lower is better
+    (latencies, walls). 0: untracked (counts, depths, config echoes) —
+    reported but never gating. Keyed on the LEAF name only, so the rule
+    set survives new sections without a registry."""
+    k = key.lower()
+    if k.endswith("_ms") or "ms_per" in k or k in ("t", "wall_s", "wait_s"):
+        return -1
+    if (
+        "rows_s" in k
+        or "req_s" in k
+        or "speedup" in k
+        or k.startswith("vs_")
+        or k.endswith("_ratio")
+        or k == "value"
+    ):
+        return +1
+    return 0
+
+
+def _numeric_leaves(obj, prefix=""):
+    """Flatten nested dicts AND lists to {dotted.path: float} (bools
+    excluded). Lists index positionally (`matrix.0.t`) — the artifact's
+    matrix section is ordered by config, so position is identity; skipping
+    lists would silently exempt the whole matrix from the gate."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_numeric_leaves(v, f"{prefix}{k}."))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(_numeric_leaves(v, f"{prefix}{i}."))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def _phase_compare(old_path: str, new_path: str, threshold: float) -> None:
+    """Diff two --json artifacts; exit 1 when a tracked metric regresses
+    past `threshold` (fractional, default 0.10)."""
+    old = json.loads(Path(old_path).read_text())
+    new = json.loads(Path(new_path).read_text())
+    ol, nl = _numeric_leaves(old), _numeric_leaves(new)
+    shared = sorted(set(ol) & set(nl))
+    only_old = sorted(set(ol) - set(nl))
+    only_new = sorted(set(nl) - set(ol))
+    regressions = []
+    compared = 0
+    last_section = None
+    print(f"bench compare: {old_path} -> {new_path} (threshold {threshold:.0%})")
+    for path in shared:
+        section = path.split(".", 1)[0] if "." in path else "(headline)"
+        leaf = path.rsplit(".", 1)[-1]
+        direction = _metric_direction(leaf)
+        a, b = ol[path], nl[path]
+        if direction == 0:
+            continue  # tracked table first; untracked summarized below
+        compared += 1
+        if section != last_section:
+            print(f"  [{section}]")
+            last_section = section
+        ratio = (b / a) if a else float("inf")
+        # the regression sign follows the metric's direction: a throughput
+        # regresses by FALLING, a latency by RISING
+        delta = (b - a) / a if a else 0.0
+        regressed = (
+            (direction > 0 and delta < -threshold)
+            or (direction < 0 and delta > threshold)
+        )
+        better = "lower" if direction < 0 else "higher"
+        flag = "  REGRESSED" if regressed else ""
+        print(
+            f"    {path}: {a:g} -> {b:g}  x{ratio:.3f} "
+            f"({better}-is-better){flag}"
+        )
+        if regressed:
+            regressions.append((path, a, b))
+    changed = sum(
+        1
+        for p in shared
+        if _metric_direction(p.rsplit(".", 1)[-1]) == 0 and ol[p] != nl[p]
+    )
+    print(
+        f"bench compare: {len(shared)} shared leaves "
+        f"({changed} untracked changed), "
+        f"{len(only_old)} only in old, {len(only_new)} only in new"
+    )
+    if only_new:
+        print(f"bench compare: new sections/leaves: {', '.join(only_new[:8])}"
+              + (" ..." if len(only_new) > 8 else ""))
+    # a tracked metric that VANISHED can't gate numerically, but silence
+    # would read as "held" — name it so the reader decides
+    lost = [
+        p for p in only_old if _metric_direction(p.rsplit(".", 1)[-1]) != 0
+    ]
+    for p in lost:
+        print(f"bench compare: WARNING tracked metric only in old: {p}")
+    if regressions:
+        for path, a, b in regressions:
+            print(f"bench compare: REGRESSION {path}: {a:g} -> {b:g}")
+        raise SystemExit(1)
+    if compared == 0:
+        # disjoint artifacts (different phases, a crashed run): exiting 0
+        # here would green a CI gate that compared NOTHING
+        raise SystemExit(
+            "bench compare: no tracked metrics in common — nothing was "
+            "compared (are these artifacts from the same bench phase?)"
+        )
+    print(f"bench compare: no tracked regressions in {compared} metrics ✓")
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
     if "--json" in argv:
@@ -1723,7 +1845,28 @@ if __name__ == "__main__":
             raise SystemExit("bench: --json needs a path")
         _JSON_OUT = argv[k + 1]
         del argv[k : k + 2]
-    if argv and argv[0] == "--dataset":
+    if argv and argv[0] == "--compare":
+        rest = argv[1:]
+        thr = 0.10
+        if "--threshold" in rest:
+            k = rest.index("--threshold")
+            if k + 1 >= len(rest):
+                raise SystemExit("bench: --threshold needs a value")
+            try:
+                thr = float(rest[k + 1])
+            except ValueError:
+                raise SystemExit(
+                    f"bench: --threshold needs a number, got {rest[k + 1]!r}"
+                ) from None
+            del rest[k : k + 2]
+        paths = [a for a in rest if not a.startswith("--")]
+        if len(paths) != 2 or len(paths) != len(rest):
+            raise SystemExit(
+                "bench: --compare needs OLD.json NEW.json "
+                "[--threshold FRACTION]"
+            )
+        _phase_compare(paths[0], paths[1], thr)
+    elif argv and argv[0] == "--dataset":
         _phase_dataset()
     elif argv and argv[0] == "--assembly":
         _phase_assembly()

@@ -42,6 +42,7 @@ from .chunk import ChunkData, ChunkError, read_chunk
 from .page import PageError
 from .schema import Schema
 from ..meta.thrift import ThriftError
+from ..obs.log import log_event as _log_event
 from ..utils import metrics as _metrics
 from ..utils.trace import bump, span, stage, timed_stage, traced_submit
 
@@ -532,6 +533,11 @@ class FileReader:
                     if self.on_error == "raise":
                         raise
                     bump("chunks_quarantined")
+                    _log_event(
+                        "chunk_quarantined", level="warning",
+                        source=self._source.source_id, group=i,
+                        error=f"{type(e).__name__}: {e}",
+                    )
                     raise _GroupQuarantined() from e
             else:
                 out = {}
@@ -554,6 +560,12 @@ class FileReader:
                         if self.on_error == "raise":
                             raise
                         bump("chunks_quarantined")
+                        _log_event(
+                            "chunk_quarantined", level="warning",
+                            source=self._source.source_id, group=i,
+                            column=".".join(path),
+                            error=f"{type(e).__name__}: {e}",
+                        )
                         if self.on_error == "null":
                             nc = self._null_chunk(i, column)
                             if nc is not None:
@@ -563,6 +575,10 @@ class FileReader:
                         raise _GroupQuarantined() from e
         except _GroupQuarantined:
             bump("row_groups_quarantined")
+            _log_event(
+                "row_group_quarantined", level="warning",
+                source=self._source.source_id, group=i,
+            )
             return {}
         if pack and self.compact_levels:
             for path, cd in out.items():

@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
@@ -56,8 +57,11 @@ import numpy as np
 from ..core.arrays import ByteArrayData
 from ..core.reader import PARQUET_ERRORS, FileReader
 from ..meta.file_meta import ParquetFileError
+from ..obs.log import log_event
+from ..obs.pool import instrumented_submit
+from ..obs.recorder import recorder as _recorder
 from ..utils import metrics as _metrics
-from ..utils.trace import bump, span, timed_stage, traced_submit
+from ..utils.trace import bump, span, timed_stage
 from .plan import ScanPlan, build_plan
 
 __all__ = ["ParquetDataset", "DatasetIterator"]
@@ -621,8 +625,9 @@ class DatasetIterator:
             while nxt < len(order) and len(pending) < depth:
                 off = start_off if nxt == start_pos else 0
                 pending.append(
-                    (nxt, off, traced_submit(pool, self._load_unit,
-                                             units[order[nxt]], off))
+                    (nxt, off, instrumented_submit(pool, self._load_unit,
+                                                   units[order[nxt]], off,
+                                                   pool="pqt-data"))
                 )
                 nxt += 1
                 added += 1
@@ -652,9 +657,28 @@ class DatasetIterator:
     def _load_unit(self, unit, row_offset: int):
         """Decode one (file, row group) into batchable column arrays,
         sliced from `row_offset`. Runs on pqt-data worker threads (the trace
-        context arrives via traced_submit). Returns (None, 0) for a unit the
-        on_error policy dropped."""
+        and log context arrive via instrumented_submit). Returns (None, 0)
+        for a unit the on_error policy dropped."""
         ds = self._ds
+        t0 = time.perf_counter()
+
+        def _skipped(reason: str):
+            # the noteworthy event (rate-limited) + the flight record: one
+            # /v1/debug listing shows the skipped unit next to the serve
+            # traffic that may have been racing it
+            bump("dataset_units_skipped")
+            log_event(
+                "unit_quarantined", level="warning",
+                file=unit.path, group=unit.row_group, reason=reason,
+            )
+            _recorder().record(
+                "dataset.unit", status="skipped",
+                duration_s=time.perf_counter() - t0,
+                detail={"file": unit.path, "group": unit.row_group,
+                        "reason": reason},
+            )
+            return None, 0
+
         with span(
             "dataset.unit", {"file": unit.path, "group": unit.row_group}
         ):
@@ -671,14 +695,12 @@ class DatasetIterator:
             except PARQUET_ERRORS + (OSError,):
                 if ds.on_error == "raise":
                     raise
-                bump("dataset_units_skipped")
-                return None, 0
+                return _skipped("open_failed")
             try:
                 chunks = reader._read_row_group(unit.row_group, None, pack=False)
                 if not chunks:
                     # quarantined by on_error (or empty selection)
-                    bump("dataset_units_skipped")
-                    return None, 0
+                    return _skipped("quarantined")
                 cols = {
                     p: self._batch_array(p, cd, reader.schema.column(p))
                     for p, cd in chunks.items()
@@ -697,6 +719,12 @@ class DatasetIterator:
                 return None, 0
             cols = {p: a[row_offset:] for p, a in cols.items()}
             n -= row_offset
+        _recorder().record(
+            "dataset.unit",
+            duration_s=time.perf_counter() - t0,
+            nbytes=sum(int(a.nbytes) for a in cols.values()),
+            detail={"file": unit.path, "group": unit.row_group, "rows": n},
+        )
         return cols, n
 
     def _batch_array(self, path, cd, leaf) -> np.ndarray:

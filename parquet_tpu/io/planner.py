@@ -36,7 +36,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from ..utils import metrics as _metrics
-from ..utils.trace import stage, traced_submit
+from ..obs.pool import instrumented_submit
+from ..utils.trace import stage
 
 __all__ = [
     "DEFAULT_COALESCE_GAP",
@@ -251,8 +252,8 @@ class Readahead:
             self._inflight += total
             self._futures = [f for f in self._futures if not f.done()]
             self._futures.append(
-                traced_submit(io_pool(), self._fetch, source_or_path,
-                              list(ranges), total)
+                instrumented_submit(io_pool(), self._fetch, source_or_path,
+                                    list(ranges), total, pool="pqt-io")
             )
         return True
 

@@ -41,6 +41,7 @@ import threading
 import time
 from pathlib import Path
 
+from ..obs.log import log_event as _log_event
 from ..utils import metrics as _metrics
 
 __all__ = [
@@ -348,6 +349,13 @@ class RetryingSource(ByteSource):
                 )
                 reason = "short_read"
             _metrics.inc("io_retries_total", reason=reason)
+            # structured mirror of the counter: rate-limited per event key,
+            # so a retry storm costs counters (exact) not disk (sampled)
+            _log_event(
+                "source_retry", level="warning", reason=reason,
+                attempt=attempt + 1, offset=offset, nbytes=n,
+                source=self.inner.source_id,
+            )
             if attempt + 1 >= self.attempts:
                 break
             delay = min(self.max_delay_s, self.base_delay_s * (2**attempt))

@@ -1693,6 +1693,12 @@ def prepare_chunk_plan(
     if fault is not None:
         # the native walk aborted but the staged walk decoded cleanly
         _trace.bump("prepare_fallback_recovered")
+        from ..obs.log import log_event as _log_event
+
+        _log_event(
+            "prepare_fallback_recovered", level="warning",
+            column=".".join(column.path), fault=str(fault),
+        )
     return plan
 
 

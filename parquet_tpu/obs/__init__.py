@@ -1,0 +1,57 @@
+"""parquet_tpu.obs — the operator-facing observability layer.
+
+PR 3 built the substrate (per-read span tracing, the always-on metrics
+registry); this package turns it into something an operator of a
+long-running daemon can actually use at 14:02 when request X was slow:
+
+  recorder  request-correlated flight recorder: a bounded ring of
+            per-request records (id, tenant, status, plan summary, bytes,
+            queue-wait, stage rollup, sampled span trees), process-wide —
+            the serve daemon, ParquetDataset units and EncodePipeline
+            groups all record into the same ring. Served at
+            /v1/debug/requests by `parquet-tool serve`, queried by
+            `parquet-tool debug`.
+  log       structured JSON-lines logging (stdlib logging underneath):
+            request-id/tenant context injection, token-bucket rate
+            limiting per event key, silent until configure_logging().
+  pool      the one instrumented submit all four pqt-* pools route
+            through: queue-depth/active gauges + queue-wait/task-time
+            histograms per pool.
+
+See each module's docstring for the contracts and bounds.
+"""
+
+from .log import (  # noqa: F401
+    JsonLinesFormatter,
+    TokenBucketLimiter,
+    configure_logging,
+    log_context,
+    log_event,
+)
+from .pool import instrumented_submit, pool_depths  # noqa: F401
+from .recorder import (  # noqa: F401
+    RECORDER,
+    FlightRecorder,
+    ObsConfig,
+    RequestRecord,
+    configure,
+    recorder,
+    sanitize_request_id,
+)
+
+__all__ = [
+    "ObsConfig",
+    "FlightRecorder",
+    "RequestRecord",
+    "RECORDER",
+    "recorder",
+    "configure",
+    "sanitize_request_id",
+    "log_event",
+    "log_context",
+    "configure_logging",
+    "JsonLinesFormatter",
+    "TokenBucketLimiter",
+    "instrumented_submit",
+    "pool_depths",
+]

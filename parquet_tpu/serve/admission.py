@@ -29,8 +29,20 @@ from __future__ import annotations
 import threading
 import time
 
+from ..obs.log import log_event as _log_event
 from ..utils import metrics as _metrics
 from .protocol import ServeError
+
+
+def _rejected(code: str, tenant: str) -> None:
+    # the structured-log mirror of every typed admission rejection. The
+    # code rides the EVENT KEY so the limiter buckets per code: a
+    # queue_full flood can't absorb the one draining line at SIGTERM time
+    # (codes are code-controlled, so the key set stays bounded)
+    _log_event(
+        f"admission_rejected:{code}", level="warning",
+        code=code, tenant=tenant,
+    )
 
 __all__ = ["AdmissionController", "Deadline", "Ticket"]
 
@@ -191,28 +203,33 @@ class AdmissionController:
 
     def admit(self, tenant: str) -> Ticket:
         """Claim a slot for `tenant` or raise the typed rejection."""
-        with self._lock:
-            if self._draining:
-                raise ServeError(
-                    503, "draining", "daemon is draining; retry another replica"
-                )
-            if self._inflight >= self.max_inflight:
-                raise ServeError(
-                    429, "queue_full",
-                    f"daemon at max in-flight requests ({self.max_inflight})",
-                    retry_after_s=1,
-                )
-            tenant, st = self._tenant_state(tenant)
-            if st.concurrent >= self.tenant_concurrent:
-                raise ServeError(
-                    429, "tenant_concurrency",
-                    f"tenant {tenant!r} at max concurrent requests "
-                    f"({self.tenant_concurrent})",
-                    retry_after_s=1,
-                )
-            st.concurrent += 1
-            self._inflight += 1
-            _metrics.set_gauge("serve_queue_depth", self._inflight)
+        try:
+            with self._lock:
+                if self._draining:
+                    raise ServeError(
+                        503, "draining",
+                        "daemon is draining; retry another replica",
+                    )
+                if self._inflight >= self.max_inflight:
+                    raise ServeError(
+                        429, "queue_full",
+                        f"daemon at max in-flight requests ({self.max_inflight})",
+                        retry_after_s=1,
+                    )
+                tenant, st = self._tenant_state(tenant)
+                if st.concurrent >= self.tenant_concurrent:
+                    raise ServeError(
+                        429, "tenant_concurrency",
+                        f"tenant {tenant!r} at max concurrent requests "
+                        f"({self.tenant_concurrent})",
+                        retry_after_s=1,
+                    )
+                st.concurrent += 1
+                self._inflight += 1
+                _metrics.set_gauge("serve_queue_depth", self._inflight)
+        except ServeError as e:
+            _rejected(e.code, tenant)  # outside the lock: logging IO must
+            raise  # never serialize admissions
         return Ticket(self, tenant)
 
     def _release(self, tenant: str) -> None:
@@ -282,12 +299,14 @@ class AdmissionController:
             retry = min(
                 self.budget_window_s, deficit * self.budget_window_s / cap
             )
-            raise ServeError(
+            err = ServeError(
                 429, "tenant_over_budget",
                 f"tenant {tenant!r} scanned-byte budget exhausted "
                 f"(needs {nbytes:,} B, {int(st.tokens):,} B available)",
                 retry_after_s=max(1, int(retry)),
             )
+        _rejected(err.code, tenant)  # outside the lock (see admit)
+        raise err
 
     # -- drain -----------------------------------------------------------------
 

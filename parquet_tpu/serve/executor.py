@@ -36,8 +36,9 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FutTimeout
 
 from ..core.reader import PARQUET_ERRORS, FileReader
+from ..obs.pool import instrumented_submit
 from ..utils import metrics as _metrics
-from ..utils.trace import stage, traced_submit
+from ..utils.trace import stage
 from .protocol import ServeError, json_default
 
 __all__ = ["serve_pool", "execute_stream"]
@@ -160,7 +161,9 @@ def _pipelined(units, run_one, window: int, check: "_Check"):
         while pending or idx < len(units):
             while idx < len(units) and len(pending) < window:
                 u = units[idx]
-                pending.append(traced_submit(serve_pool(), run_one, u))
+                pending.append(
+                    instrumented_submit(serve_pool(), run_one, u, pool="pqt-serve")
+                )
                 idx += 1
             fut = pending.popleft()
             while True:
@@ -216,7 +219,9 @@ def _stream_jsonl(planned, session, check, window):
         if remaining <= 0:
             break
         check()
-        fut = traced_submit(serve_pool(), run, u, remaining)
+        fut = instrumented_submit(
+            serve_pool(), run, u, remaining, pool="pqt-serve"
+        )
         while True:
             check()
             try:
@@ -290,9 +295,9 @@ def _stream_arrow(planned, session, check, window):
             if remaining <= 0:
                 return
             check()
-            fut = traced_submit(
+            fut = instrumented_submit(
                 serve_pool(), _run_arrow_unit, session, planned, u,
-                remaining, check,
+                remaining, check, pool="pqt-serve",
             )
             while True:
                 check()

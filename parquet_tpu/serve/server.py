@@ -37,13 +37,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from ..io.cache import BlockCache
+from ..obs import log as _obslog
+from ..obs.recorder import ObsConfig as _ObsConfig
+from ..obs.recorder import configure as _obs_configure
+from ..obs.recorder import sanitize_request_id as _sanitize_request_id
 from ..utils import metrics as _metrics
+from ..utils.trace import decode_trace
 from .admission import AdmissionController
 from .executor import execute_stream
 from .protocol import ServeError, parse_scan_request, scan_request_from_query
 from .session import ScanSession
 
 __all__ = ["ServeConfig", "ScanService", "ScanServer"]
+
+# ObsConfig owns the observability knob defaults; ServeConfig mirrors them
+_OBS_DEFAULTS = _ObsConfig()
 
 
 @dataclass
@@ -71,6 +79,17 @@ class ServeConfig:
     socket_timeout_s: float = 60.0
     shard: tuple | None = None  # this daemon's (index, count) corpus stripe
     source_factory: object = None  # chaos/remote seam: path -> ByteSource
+    # observability (parquet_tpu.obs): every request runs under a
+    # request-scoped DecodeTrace whose stage rollup is ALWAYS retained in
+    # the flight-recorder ring; the full span tree is kept for a
+    # trace_sample_rate share of ok-and-fast requests and for EVERY
+    # request that errors or runs >= slow_ms. Defaults come from
+    # ObsConfig, the one place that owns the knobs — restated numbers
+    # here would silently drift.
+    trace_sample_rate: float = _OBS_DEFAULTS.trace_sample_rate
+    slow_ms: float = _OBS_DEFAULTS.slow_ms  # serve_slow_requests_total bar
+    debug_ring_size: int = _OBS_DEFAULTS.ring_size  # /v1/debug retention
+    debug_max_traces: int = _OBS_DEFAULTS.max_traces  # trees kept (~MBs each)
 
     def __post_init__(self):
         if self.window < 1:
@@ -87,6 +106,13 @@ class ServeConfig:
             )
         if self.max_timeout_s <= 0:
             raise ValueError("serve: max_timeout_s must be positive")
+        # delegate the obs-knob validation to the one place that owns it
+        _ObsConfig(
+            ring_size=self.debug_ring_size,
+            trace_sample_rate=self.trace_sample_rate,
+            slow_ms=self.slow_ms,
+            max_traces=self.debug_max_traces,
+        )
 
 
 class ScanService:
@@ -115,6 +141,18 @@ class ScanService:
             default_timeout_s=config.default_timeout_s,
             max_timeout_s=config.max_timeout_s,
         )
+        # the PROCESS-wide flight recorder, configured with this daemon's
+        # knobs: library records (dataset units, encode groups) land in
+        # the same recorder the debug endpoints serve (a sibling ring, so
+        # pipeline churn can't evict request evidence)
+        self.recorder = _obs_configure(
+            _ObsConfig(
+                ring_size=config.debug_ring_size,
+                trace_sample_rate=config.trace_sample_rate,
+                slow_ms=config.slow_ms,
+                max_traces=config.debug_max_traces,
+            )
+        )
 
     # -- request entry points (raise ServeError; HTTP layer renders) -----------
 
@@ -123,16 +161,20 @@ class ScanService:
         cached; hammering /v1/plan cannot starve scans of pool threads)."""
         return self.session.plan(request).summary()
 
-    def scan(self, request, tenant: str, timeout_ms=None):
+    def scan(self, request, tenant: str, timeout_ms=None, record=None):
         """Admit, plan, charge, and open the result stream. Returns
         (ticket, content_type, chunk iterator); the caller MUST close the
-        iterator and release the ticket (both context-manage safely)."""
+        iterator and release the ticket (both context-manage safely).
+        `record` (a flight-recorder RequestRecord) receives the plan's
+        pruning summary as soon as planning finishes."""
         deadline = self.admission.deadline_for(
             timeout_ms if timeout_ms is not None else request.timeout_ms
         )
         ticket = self.admission.admit(tenant)
         try:
             planned = self.session.plan(request)
+            if record is not None:
+                record.plan = planned.summary()
             # ticket.tenant is the RESOLVED accounting key (it may have
             # collapsed to the overflow bucket under tenant-table pressure)
             self.admission.charge(ticket.tenant, planned.estimated_bytes)
@@ -161,10 +203,62 @@ class ScanService:
         }
         return (503 if draining else 200), body
 
+    # -- the /v1/debug bodies (HTTP-free, like plan/scan) ----------------------
 
-def _finish_request(tenant: str, status: int, t0: float) -> None:
+    def debug_requests(
+        self, *, limit: int = 100, slow_only: bool = False, endpoint=None
+    ) -> dict:
+        """The /v1/debug/requests listing: newest-first record summaries."""
+        return {
+            "requests": self.recorder.list(
+                limit=limit, slow_only=slow_only, endpoint=endpoint
+            )
+        }
+
+    def debug_request(self, request_id) -> dict:
+        """One record in full (plan summary, stage rollup, queue-wait).
+        The id is sanitized before lookup — a hostile value can only miss."""
+        rec = self.recorder.get(request_id)
+        if rec is None:
+            raise ServeError(
+                404, "no_such_request",
+                f"request {str(request_id)[:64]!r} is not in the flight "
+                "recorder (never seen, or evicted from the ring)",
+            )
+        return rec.to_dict()
+
+    def debug_trace(self, request_id) -> dict:
+        """One record's Chrome-trace document (Perfetto-loadable)."""
+        rec = self.recorder.get(request_id)
+        if rec is None:
+            raise ServeError(
+                404, "no_such_request",
+                f"request {str(request_id)[:64]!r} is not in the flight "
+                "recorder (never seen, or evicted from the ring)",
+            )
+        doc = rec._trace
+        if doc is None:
+            if rec.trace_kind is not None:
+                # it QUALIFIED (error/slow/sampled) but newer qualifying
+                # requests pushed it past the trace budget — the knob to
+                # turn is max_traces, not the sampler
+                raise ServeError(
+                    404, "trace_evicted",
+                    f"request {rec.id!r} kept a span tree "
+                    f"({rec.trace_kind}) but it was evicted by newer "
+                    "traces (raise --debug-max-traces to retain more)",
+                )
+            raise ServeError(
+                404, "no_trace",
+                f"request {rec.id!r} kept no span tree (not sampled, not "
+                "slow, not errored — raise trace_sample_rate or lower "
+                "slow_ms to keep more)",
+            )
+        return doc
+
+
+def _count_request(tenant: str, status: int) -> None:
     _metrics.inc("serve_requests_total", status=str(status), tenant=tenant)
-    _metrics.observe("serve_request_seconds", time.perf_counter() - t0)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -244,6 +338,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
+        if getattr(self, "_rid", None):
+            self.send_header("X-Request-Id", self._rid)
         if retry_after is not None:
             self.send_header("Retry-After", str(retry_after))
         self.end_headers()
@@ -253,8 +349,13 @@ class _Handler(BaseHTTPRequestHandler):
         # absorb a client that hung up before reading its error: an escape
         # from THIS send would bubble past the route's except clauses into
         # socketserver's traceback dump (TimeoutError is an OSError)
+        body = e.to_body()
+        if getattr(self, "_rid", None):
+            # the correlation key rides the error body too, so a client
+            # that logs only bodies can still quote the id to an operator
+            body["error"]["request_id"] = self._rid
         try:
-            self._send_json(e.status, e.to_body(), retry_after=e.retry_after_s)
+            self._send_json(e.status, body, retry_after=e.retry_after_s)
         except OSError:
             self.close_connection = True
 
@@ -263,12 +364,16 @@ class _Handler(BaseHTTPRequestHandler):
     def _write_chunk(self, payload: bytes) -> None:
         self.wfile.write(b"%x\r\n" % len(payload) + payload + b"\r\n")
 
-    def _stream(self, chunks, content_type: str, tenant: str, t0: float) -> None:
+    def _stream(self, chunks, content_type: str):
         """Send a 200 + chunked body. The FIRST chunk is pulled before the
         status line goes out, so planning/admission/decode errors that
-        surface lazily still produce a clean typed error response."""
+        surface lazily still produce a clean typed error response.
+        Returns (status, payload bytes sent, error-or-None) for the route
+        wrapper to finish metrics + the flight record with."""
         started = False
         status = 200
+        nbytes = 0
+        err = None
         try:
             it = iter(chunks)
             try:
@@ -278,13 +383,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", content_type)
             self.send_header("Transfer-Encoding", "chunked")
+            if getattr(self, "_rid", None):
+                self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             started = True
             if first:
                 self._write_chunk(first)
+                nbytes += len(first)
             for payload in it:
                 if payload:
                     self._write_chunk(payload)
+                    nbytes += len(payload)
             self._write_chunk(b"")  # terminating 0-chunk: complete transfer
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, TimeoutError):
@@ -300,7 +409,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if isinstance(exc, ServeError)
                 else ServeError(500, "internal", f"{type(exc).__name__}: {exc}")
             )
-            status = e.status
+            status, err = e.status, e
             if not started:
                 self._send_error_body(e)
             else:
@@ -318,15 +427,132 @@ class _Handler(BaseHTTPRequestHandler):
                 self.close_connection = True
         finally:
             chunks.close()
-            _finish_request(tenant, status, t0)
+        return status, nbytes, err
+
+    # -- request finishing (metrics + flight record, one place) ----------------
+
+    def _finish(
+        self, *, endpoint, tenant, status, t0, rec=None, trace=None,
+        nbytes=0, error=None,
+    ) -> None:
+        dt = time.perf_counter() - t0
+        _count_request(tenant, status)
+        # endpoint labels are the matched-route constants, never the raw
+        # client path — a 404 probe flood cannot grow the label set
+        _metrics.observe("serve_request_seconds", dt, endpoint=endpoint)
+        if rec is None:
+            return
+        svc = self.service
+        svc.recorder.finish(
+            rec, status, nbytes=nbytes, error=error, trace=trace,
+            duration_s=dt,
+        )
+        if dt * 1e3 >= svc.config.slow_ms:
+            _metrics.inc("serve_slow_requests_total", endpoint=endpoint)
+            _obslog.log_event(
+                "slow_request", level="warning",
+                endpoint=endpoint, status=status,
+                duration_ms=round(dt * 1e3, 3), bytes=nbytes,
+            )
 
     # -- routes ----------------------------------------------------------------
+
+    def _recorded_request(self, endpoint: str, tenant: str, t0, run) -> None:
+        """One copy of the request discipline every recorded endpoint runs
+        under: open a flight record, bind the log context, run a
+        request-scoped trace, render failures through the typed-error
+        ladder, and finish metrics + record in one place. `run(rec)` does
+        the endpoint work and returns (status, payload bytes, error)."""
+        svc = self.service
+        rec = svc.recorder.begin(endpoint, tenant, request_id=self._rid)
+        self._rid = rec.id
+        status, nbytes, err, trace = 500, 0, None, None
+        with _obslog.log_context(request_id=rec.id, tenant=tenant):
+            try:
+                with decode_trace() as trace:
+                    try:
+                        status, nbytes, err = run(rec)
+                    except ServeError as e:
+                        self._send_error_body(e)
+                        status, err = e.status, e
+                    except (
+                        BrokenPipeError, ConnectionResetError, TimeoutError,
+                    ):
+                        self.close_connection = True
+                        status = 499
+                    except Exception as e:  # noqa: BLE001 - no-traceback contract
+                        self._send_internal_error(e)
+                        status, err = 500, e
+            finally:
+                self._finish(
+                    endpoint=endpoint, tenant=tenant, status=status, t0=t0,
+                    rec=rec, trace=trace, nbytes=nbytes, error=err,
+                )
+
+    def _scan_request(self, tenant: str, t0: float) -> None:
+        """POST /v1/scan under the record discipline."""
+
+        def run(rec):
+            request = parse_scan_request(self._read_body())
+            ticket, content_type, chunks = self.service.scan(
+                request, tenant, timeout_ms=self._timeout_ms(), record=rec
+            )
+            with ticket:
+                return self._stream(chunks, content_type)
+
+        self._recorded_request("/v1/scan", tenant, t0, run)
+
+    def _plan_request(self, tenant: str, t0: float, request_fn) -> None:
+        """GET/POST /v1/plan under the same record discipline."""
+
+        def run(rec):
+            body = self.service.plan(request_fn())
+            rec.plan = body
+            self._send_json(200, body)
+            return 200, 0, None
+
+        self._recorded_request("/v1/plan", tenant, t0, run)
+
+    _DEBUG_PREFIX = "/v1/debug/requests"
+
+    def _debug_request(self, route: str, qs: dict) -> None:
+        """GET /v1/debug/requests[/<id>[/trace]] — read-only views of the
+        flight recorder. No admission (cheap, in-memory), no record (the
+        debugger must not evict the evidence it is reading)."""
+        svc = self.service
+        if route == self._DEBUG_PREFIX:
+            raw = qs.get("limit", ["100"])[-1]
+            try:
+                limit = int(raw)
+            except ValueError:
+                raise ServeError(
+                    400, "bad_request", f"'limit' must be an integer, got {raw!r}"
+                ) from None
+            if not 1 <= limit <= 1000:
+                raise ServeError(400, "bad_request", "'limit' must be in [1, 1000]")
+            slow_only = qs.get("slow", ["0"])[-1] in ("1", "true", "yes")
+            endpoint = qs.get("endpoint", [None])[-1]
+            self._send_json(
+                200,
+                svc.debug_requests(
+                    limit=limit, slow_only=slow_only, endpoint=endpoint
+                ),
+            )
+            return
+        rest = route[len(self._DEBUG_PREFIX) + 1 :]
+        if rest.endswith("/trace"):
+            self._send_json(200, svc.debug_trace(rest[: -len("/trace")]))
+        elif "/" not in rest and rest:
+            self._send_json(200, svc.debug_request(rest))
+        else:
+            raise ServeError(404, "no_such_route", f"unknown path {route!r}")
 
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
         split = urlsplit(self.path)
         route = split.path
         t0 = time.perf_counter()
         self._body_read = False  # per-request: the handler serves many
+        self._rid = self._request_id()
         tenant = self._tenant()
         try:
             if route == "/healthz":
@@ -341,23 +567,35 @@ class _Handler(BaseHTTPRequestHandler):
                     "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
                 )
                 self.send_header("Content-Length", str(len(payload)))
+                if self._rid:
+                    self.send_header("X-Request-Id", self._rid)
                 self.end_headers()
                 self.wfile.write(payload)
                 return
             if route == "/v1/plan":
-                request = scan_request_from_query(parse_qs(split.query))
-                self._send_json(200, self.service.plan(request))
-                _finish_request(tenant, 200, t0)
+                self._plan_request(
+                    tenant, t0,
+                    lambda: scan_request_from_query(parse_qs(split.query)),
+                )
+                return
+            if route == self._DEBUG_PREFIX or route.startswith(
+                self._DEBUG_PREFIX + "/"
+            ):
+                self._debug_request(route, parse_qs(split.query))
                 return
             raise ServeError(404, "no_such_route", f"unknown path {route!r}")
         except ServeError as e:
             self._send_error_body(e)
-            if route == "/v1/plan":
-                _finish_request(tenant, e.status, t0)
         except (BrokenPipeError, ConnectionResetError, TimeoutError):
             self.close_connection = True  # scraper/LB hung up or stalled
         except Exception as e:  # noqa: BLE001 - the no-traceback contract
             self._send_internal_error(e)
+
+    def _request_id(self) -> str | None:
+        """The sanitized client-supplied X-Request-Id (None generates one
+        at record-begin time). Bounded exactly like tenant keys: a hostile
+        header cannot grow the ring, the index, or the debug JSON."""
+        return _sanitize_request_id(self.headers.get("X-Request-Id"))
 
     def _send_internal_error(self, e) -> None:
         """Best-effort typed 500: never let a dead socket turn a handler
@@ -373,31 +611,27 @@ class _Handler(BaseHTTPRequestHandler):
         route = urlsplit(self.path).path
         t0 = time.perf_counter()
         self._body_read = False  # per-request: the handler serves many
+        self._rid = self._request_id()
         tenant = self._tenant()
         try:
             if route == "/v1/scan":
-                request = parse_scan_request(self._read_body())
-                ticket, content_type, chunks = self.service.scan(
-                    request, tenant, timeout_ms=self._timeout_ms()
-                )
-                with ticket:
-                    self._stream(chunks, content_type, tenant, t0)
+                self._scan_request(tenant, t0)
                 return
             if route == "/v1/plan":
-                request = parse_scan_request(self._read_body())
-                self._send_json(200, self.service.plan(request))
-                _finish_request(tenant, 200, t0)
+                self._plan_request(
+                    tenant, t0, lambda: parse_scan_request(self._read_body())
+                )
                 return
             raise ServeError(404, "no_such_route", f"unknown path {route!r}")
         except ServeError as e:
             self._send_error_body(e)
-            _finish_request(tenant, e.status, t0)
+            _count_request(tenant, e.status)
         except (BrokenPipeError, ConnectionResetError, TimeoutError):
             self.close_connection = True
-            _finish_request(tenant, 499, t0)
+            _count_request(tenant, 499)
         except Exception as e:  # noqa: BLE001 - the no-traceback contract
             self._send_internal_error(e)
-            _finish_request(tenant, 500, t0)
+            _count_request(tenant, 500)
 
 
 class ScanServer:
@@ -447,8 +681,16 @@ class ScanServer:
         """Graceful shutdown, the SIGTERM semantics: stop admitting (new
         scans get typed 503s), let in-flight requests complete (bounded by
         `timeout`), then stop the listener. True iff fully drained."""
+        _obslog.log_event(
+            "drain_begin", in_flight=self.service.admission.in_flight
+        )
         self.service.admission.begin_drain()
         drained = self.service.admission.wait_drained(timeout=timeout)
+        _obslog.log_event(
+            "drain_complete",
+            level="info" if drained else "warning",
+            drained=drained,
+        )
         self.shutdown()
         return drained
 

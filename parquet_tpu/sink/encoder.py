@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -65,8 +66,11 @@ from ..meta.parquet_types import (
     PageType,
     RowGroup,
 )
+from ..obs.log import log_event as _log_event
+from ..obs.pool import instrumented_submit
+from ..obs.recorder import recorder as _recorder
 from ..utils import metrics as _metrics
-from ..utils.trace import stage, timed_stage, traced_submit
+from ..utils.trace import stage, timed_stage
 
 __all__ = [
     "EncoderConfig",
@@ -635,7 +639,9 @@ class EncodePipeline:
                 self._room.wait()
                 self._raise_pending()
         futs = [
-            traced_submit(self.pool, encode_chunk, self.cfg, b, kv)
+            instrumented_submit(
+                self.pool, encode_chunk, self.cfg, b, kv, pool="pqt-encode"
+            )
             for b, kv in zip(builders, kvs)
         ]
         with self._lock:
@@ -671,9 +677,11 @@ class EncodePipeline:
                 futs, n_rows, est = self._queue.popleft()
             try:
                 if self.error is None:
+                    t0 = time.perf_counter()
                     chunks = [f.result() for f in futs]
                     erg = assemble_group(self.cfg, chunks, n_rows)
-                    erg.row_group.ordinal = len(self.row_groups)
+                    ordinal = erg.row_group.ordinal = len(self.row_groups)
+                    pos0 = self.pos
                     self.pos = commit_group(
                         erg, self.sink, self.pos, self._codec_label
                     )
@@ -681,10 +689,28 @@ class EncodePipeline:
                     if self.cfg.write_page_index:
                         self.page_indexes.append(erg.indexes)
                     self.blooms.extend(erg.blooms)
+                    # the library side of the flight recorder: encode groups
+                    # land in the same ring the serve daemon's /v1/debug
+                    # lists, so one listing interleaves serving + pipeline
+                    _recorder().record(
+                        "encode.group",
+                        duration_s=time.perf_counter() - t0,
+                        nbytes=self.pos - pos0,
+                        detail={"group": ordinal, "rows": n_rows},
+                    )
                 else:
                     for f in futs:  # error set: drop, but don't leak workers
                         f.cancel()
             except BaseException as e:  # noqa: BLE001 — deferred to the writer
+                _log_event(
+                    "encode_group_failed", level="error",
+                    group=len(self.row_groups), error=f"{type(e).__name__}: {e}",
+                )
+                _recorder().record(
+                    "encode.group", status="error",
+                    detail={"group": len(self.row_groups), "rows": n_rows},
+                    error=e,
+                )
                 with self._lock:
                     if self.error is None:
                         self.error = e

@@ -1,4 +1,4 @@
-"""parquet-tool: cat / head / meta / schema / rowcount / split / verify / salvage / profile / scan / serve.
+"""parquet-tool: cat / head / meta / schema / rowcount / split / verify / salvage / profile / scan / serve / debug.
 
 Equivalent of the reference's cobra CLI (reference: cmd/parquet-tool/cmds —
 cat.go:14, head.go:17, meta.go:14, schema.go:16, rowcount.go:16, split.go:31),
@@ -31,6 +31,15 @@ warm-cache planning and admission control; GET /v1/plan dry-runs the same
 request; /metrics and /healthz feed scrapers and load balancers.
 
     python -m parquet_tpu.tools.parquet_tool serve --root /data --port 8080
+
+`debug` is the operator's client for the daemon's flight recorder: list
+recent requests (ids, status, duration, queue-wait), fetch one record in
+full, or export a sampled/slow/errored request's span tree as
+Perfetto-loadable Chrome-trace JSON.
+
+    python -m parquet_tpu.tools.parquet_tool debug http://127.0.0.1:8080 --slow
+    python -m parquet_tpu.tools.parquet_tool debug http://127.0.0.1:8080 \
+        --id demo --trace -o trace.json
 """
 
 from __future__ import annotations
@@ -831,9 +840,13 @@ def cmd_serve(args) -> int:
 
     SIGTERM/SIGINT drain gracefully: in-flight requests complete, new ones
     get typed 503s, then the listener stops."""
+    from ..obs.log import configure_logging
     from ..serve import ScanServer, ServeConfig
     from ..serve.protocol import _parse_shard
 
+    # the daemon is the one place the LIBRARY's silent-by-default logging
+    # opts in: structured JSON lines on stderr, request ids injected
+    configure_logging()
     config = ServeConfig(
         host=args.host,
         port=args.port,
@@ -848,6 +861,18 @@ def cmd_serve(args) -> int:
         window=args.window,
         socket_timeout_s=args.socket_timeout_s,
         shard=_parse_shard(args.shard),
+        # obs flags default to None so ObsConfig (via ServeConfig) stays
+        # the single owner of the numbers
+        **{
+            k: v
+            for k, v in {
+                "trace_sample_rate": args.trace_sample_rate,
+                "slow_ms": args.slow_ms,
+                "debug_ring_size": args.debug_ring,
+                "debug_max_traces": args.debug_max_traces,
+            }.items()
+            if v is not None
+        },
     )
     server = ScanServer(config, verbose=args.verbose)
     server.install_signal_handlers()
@@ -860,6 +885,85 @@ def cmd_serve(args) -> int:
     finally:
         server.close()
     print("serve: drained, bye", flush=True)
+    return 0
+
+
+def _debug_fetch(url: str):
+    """GET one debug endpoint; returns (status, parsed JSON). Typed error
+    bodies come back as JSON too — the caller renders, never a traceback."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except ValueError:
+            return e.code, {"error": {"code": "bad_response",
+                                      "message": f"HTTP {e.code}"}}
+
+
+def cmd_debug(args) -> int:
+    """Query a running daemon's flight recorder (/v1/debug/requests).
+
+    Without --id: list recent requests (newest first; --slow filters to
+    the ones at/over the daemon's slow_ms). With --id: one record in full.
+    With --id + --trace: the Perfetto-loadable Chrome-trace JSON, written
+    to -o (or stdout) for ui.perfetto.dev / chrome://tracing."""
+    base = args.url.rstrip("/")
+    if not base.startswith(("http://", "https://")):
+        base = "http://" + base
+    if args.trace and not args.id:
+        raise ValueError("debug: --trace requires --id REQUEST_ID")
+    if args.id:
+        path = f"{base}/v1/debug/requests/{args.id}"
+        if args.trace:
+            path += "/trace"
+        status, body = _debug_fetch(path)
+        if status != 200:
+            err = body.get("error", {})
+            print(
+                f"debug: {err.get('code', status)}: {err.get('message', '')}",
+                file=sys.stderr,
+            )
+            return 1
+        text = json.dumps(body, indent=None if args.trace else 2)
+        if args.trace and args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+            n = len(body.get("traceEvents", []))
+            print(f"debug: wrote {n} trace events to {args.output}")
+        else:
+            print(text)
+        return 0
+    qs = f"?limit={args.limit}" + ("&slow=1" if args.slow else "")
+    status, body = _debug_fetch(f"{base}/v1/debug/requests{qs}")
+    if status != 200:
+        err = body.get("error", {})
+        print(
+            f"debug: {err.get('code', status)}: {err.get('message', '')}",
+            file=sys.stderr,
+        )
+        return 1
+    reqs = body.get("requests", [])
+    if not reqs:
+        print("debug: no recorded requests" + (" at/over slow_ms" if args.slow else ""))
+        return 0
+    print(
+        f"{'ID':<18} {'ENDPOINT':<14} {'TENANT':<10} {'STATUS':<7} "
+        f"{'MS':>9} {'BYTES':>12} {'WAIT_MS':>8} TRACE"
+    )
+    for r in reqs:
+        dur = r.get("duration_ms")
+        print(
+            f"{r['id']:<18} {r['endpoint']:<14} {str(r['tenant']):<10} "
+            f"{str(r['status']):<7} "
+            f"{dur if dur is not None else '-':>9} {r['bytes']:>12} "
+            f"{r['queue_wait_ms']:>8} "
+            f"{r.get('trace_kind') or '-'}{' (open)' if r.get('open') else ''}"
+        )
     return 0
 
 
@@ -1091,7 +1195,63 @@ def main(argv=None) -> int:
     pe.add_argument(
         "--verbose", action="store_true", help="log every request line"
     )
+    pe.add_argument(
+        "--trace-sample-rate",
+        type=float,
+        default=None,
+        help="share of ok-and-fast requests whose full span tree the "
+        "flight recorder keeps (errored/slow requests always keep "
+        "theirs; default from ObsConfig: 1%%)",
+    )
+    pe.add_argument(
+        "--slow-ms",
+        type=float,
+        default=None,
+        help="requests at/over this wall time count as slow: "
+        "serve_slow_requests_total, a warning log line, and an "
+        "always-retained trace (default from ObsConfig: 1s)",
+    )
+    pe.add_argument(
+        "--debug-ring",
+        type=int,
+        default=None,
+        help="flight-recorder retention: how many recent requests "
+        "/v1/debug/requests can list (default from ObsConfig)",
+    )
+    pe.add_argument(
+        "--debug-max-traces",
+        type=int,
+        default=None,
+        help="how many full span trees the flight recorder retains "
+        "(each can be MBs; sampled/slow/errored requests compete for "
+        "these slots, newest win; default from ObsConfig)",
+    )
     pe.set_defaults(fn=cmd_serve)
+
+    pd = sub.add_parser(
+        "debug",
+        help="query a running daemon's flight recorder: list recent "
+        "requests, fetch one by id, or export its Perfetto trace",
+    )
+    pd.add_argument("url", help="daemon base URL, e.g. http://127.0.0.1:8080")
+    pd.add_argument("--id", help="one request id (the X-Request-Id echo)")
+    pd.add_argument(
+        "--trace",
+        action="store_true",
+        help="with --id: fetch the Chrome-trace JSON (ui.perfetto.dev)",
+    )
+    pd.add_argument(
+        "-o", "--output", help="with --trace: write the trace document here"
+    )
+    pd.add_argument(
+        "--slow",
+        action="store_true",
+        help="list only requests at/over the daemon's slow_ms",
+    )
+    pd.add_argument(
+        "--limit", type=int, default=100, help="max requests to list"
+    )
+    pd.set_defaults(fn=cmd_debug)
 
     pp = sub.add_parser("split", help="split into parts by rows or file size")
     pp.add_argument("-n", type=int, help="rows per part")

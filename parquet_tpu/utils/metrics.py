@@ -59,9 +59,9 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
                                     client disconnected mid-stream)
   serve_queue_depth                 gauge: requests currently admitted and
                                     in flight in the serve daemon
-  serve_request_seconds             histogram of request wall time, entry
+  serve_request_seconds{endpoint=}  histogram of request wall time, entry
                                     to last byte (plan + queue + execute +
-                                    stream)
+                                    stream), per endpoint
   serve_scan_bytes_total            response payload bytes streamed back
                                     by /v1/scan (jsonl or arrow-ipc)
   events_total{event="serve_stream_aborted"}  responses torn mid-stream
@@ -69,6 +69,37 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   events_total{event="plan_units_pruned_stats"|"plan_units_pruned_bloom"}
                                     row groups excluded at plan time, also
                                     on every ScanPlan.pruning_summary()
+  serve_slow_requests_total{endpoint=}  requests at/over the daemon's
+                                    slow_ms threshold (the flight
+                                    recorder always keeps their traces);
+                                    serve_request_seconds is labeled by
+                                    the same bounded endpoint set, so
+                                    /v1/plan and /v1/scan latencies are
+                                    separable
+  pool_queue_depth{pool=}           gauge: tasks submitted to a pqt-*
+                                    pool and not yet running
+  pool_active_workers{pool=}        gauge: tasks currently running on a
+                                    pqt-* pool
+  pool_queue_wait_seconds{pool=}    histogram: submit-to-start wait per
+                                    pool — the elastic-SLO controller's
+                                    primary input (also credited to the
+                                    submitting request's trace as the
+                                    pool.wait stage)
+  pool_task_seconds{pool=}          histogram: task wall time per pool
+  obs_requests_recorded_total{endpoint=}  flight-recorder records opened
+                                    (serve endpoints + dataset.unit /
+                                    encode.group library records)
+  obs_ring_evictions_total          records evicted from the bounded
+                                    flight-recorder ring
+  obs_traces_retained_total         span trees kept by the recorder
+                                    (sampled, slow or errored requests);
+                                    obs_ring_records is the occupancy
+                                    gauge
+  log_events_total{event=}          structured log events emitted by
+                                    obs.log (counted even with no
+                                    handler attached)
+  log_suppressed_total{event=}      events the per-key token-bucket rate
+                                    limiter absorbed
 
 Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as
@@ -112,11 +143,78 @@ _PREFIX = "parquet_tpu_"
 _DEFAULT_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
 
 
+def _escape_label_value(v) -> str:
+    # the Prometheus text-format escapes: backslash, double-quote, newline
+    # (in that order — escaping the escape character first)
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _key(name: str, labels: dict) -> str:
     if not labels:
         return name
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
     return f"{name}{{{inner}}}"
+
+
+def _format_le(le) -> str:
+    """A histogram bound as a plain decimal (never repr()'s scientific
+    notation): 0.0005 -> "0.0005", 1.0 -> "1" — what Prometheus tooling
+    and humans both read without surprises."""
+    s = f"{float(le):.12f}".rstrip("0").rstrip(".")
+    return s or "0"
+
+
+# one-line family descriptions, rendered as `# HELP` in the exposition —
+# the prose lives in the module docstring; this is the scrape-visible form
+_HELP = {
+    "pages_decoded_total": "pages decoded, per wire encoding",
+    "page_bytes_total": "uncompressed page bytes, per encoding",
+    "bytes_compressed_total": "wire bytes entering decompression, per codec",
+    "bytes_uncompressed_total": "bytes leaving decompression, per codec",
+    "chunk_decode_seconds": "per-chunk decode wall time",
+    "events_total": "every trace.bump() event, always-on",
+    "io_bytes_read_total": "bytes actually read from byte sources",
+    "io_read_calls_total": "source read calls (coalescing shrinks it)",
+    "io_retries_total": "failed source attempts absorbed by the retry ladder",
+    "io_cache_hits_total": "block-cache hits",
+    "io_cache_misses_total": "block-cache misses",
+    "io_cache_bytes": "block-cache resident bytes",
+    "io_footer_cache_hits_total": "footer/metadata cache hits",
+    "io_footer_cache_misses_total": "footer/metadata cache misses",
+    "pages_written_total": "pages encoded by the write side, per encoding",
+    "write_bytes_total": "encoded row-group bytes committed to sinks, per codec",
+    "encode_seconds": "per-chunk encode wall time",
+    "sink_bytes_written_total": "bytes actually written to byte sinks",
+    "sink_write_calls_total": "sink write calls",
+    "assembly_rows_total": "rows materialized by record assembly, per engine",
+    "assembly_seconds": "row-materialization wall time",
+    "dataset_batches_total": "batches delivered by ParquetDataset",
+    "dataset_rows_total": "rows delivered by ParquetDataset",
+    "dataset_wait_seconds": "consumer wait for the next decoded unit",
+    "dataset_prefetch_depth": "dataset units currently in flight",
+    "serve_requests_total": "scan-service requests finished, by status and tenant",
+    "serve_queue_depth": "requests admitted and in flight in the serve daemon",
+    "serve_request_seconds": "request wall time entry to last byte, per endpoint",
+    "serve_scan_bytes_total": "response payload bytes streamed by /v1/scan",
+    "serve_slow_requests_total": "requests at/over the slow_ms threshold, per endpoint",
+    "pool_queue_depth": "tasks submitted to a pqt-* pool and not yet running",
+    "pool_active_workers": "tasks currently running on a pqt-* pool",
+    "pool_queue_wait_seconds": "submit-to-start wait per pool",
+    "pool_task_seconds": "task wall time per pool",
+    "obs_requests_recorded_total": "flight-recorder records opened, per endpoint",
+    "obs_ring_evictions_total": "records evicted from the flight-recorder ring",
+    "obs_traces_retained_total": "span trees retained by the flight recorder",
+    "obs_ring_records": "flight-recorder ring occupancy",
+    "log_events_total": "structured log events emitted, per event key",
+    "log_suppressed_total": "log events absorbed by the rate limiter, per event key",
+}
 
 
 class _Hist:
@@ -230,26 +328,30 @@ class MetricsRegistry:
             gauges = sorted(self._gauges.items())
             hists = sorted(self._hists.items())
         seen_types = set()
+
+        def family_header(name: str, kind: str) -> None:
+            if name in seen_types:
+                return
+            seen_types.add(name)
+            doc = _HELP.get(name)
+            if doc:
+                lines.append(f"# HELP {_PREFIX}{name} {doc}")
+            lines.append(f"# TYPE {_PREFIX}{name} {kind}")
+
         for (name, labels), v in counters:
-            if name not in seen_types:
-                seen_types.add(name)
-                lines.append(f"# TYPE {_PREFIX}{name} counter")
+            family_header(name, "counter")
             lines.append(f"{_PREFIX}{_key(name, dict(labels))} {v}")
         for (name, labels), v in gauges:
-            if name not in seen_types:
-                seen_types.add(name)
-                lines.append(f"# TYPE {_PREFIX}{name} gauge")
+            family_header(name, "gauge")
             lines.append(f"{_PREFIX}{_key(name, dict(labels))} {v}")
         for (name, labels), h in hists:
-            if name not in seen_types:
-                seen_types.add(name)
-                lines.append(f"# TYPE {_PREFIX}{name} histogram")
+            family_header(name, "histogram")
             ld = dict(labels)
             # bucket_counts are cumulative already (observe() increments
             # every bucket whose bound admits the value)
             for le, c in zip(h.buckets, h.bucket_counts):
                 lines.append(
-                    f"{_PREFIX}{_key(name + '_bucket', {**ld, 'le': repr(le)})} {c}"
+                    f"{_PREFIX}{_key(name + '_bucket', {**ld, 'le': _format_le(le)})} {c}"
                 )
             lines.append(
                 f"{_PREFIX}{_key(name + '_bucket', {**ld, 'le': '+Inf'})} {h.count}"

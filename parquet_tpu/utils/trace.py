@@ -142,6 +142,18 @@ class DecodeTrace:
         with self._lock:
             return {name: s.calls for name, s in self.stages.items() if s.calls}
 
+    def stage_rollup(self) -> dict:
+        """The flat per-stage aggregates as plain JSON-shaped data:
+        {stage: {"seconds", "bytes", "calls"}} — what the flight recorder
+        stores per request (the span TREE is sampled; this rollup is kept
+        for every record, and its pool.wait entry is the record's
+        queue-wait)."""
+        with self._lock:
+            return {
+                n: {"seconds": s.seconds, "bytes": s.bytes, "calls": s.calls}
+                for n, s in self.stages.items()
+            }
+
     def report(self, sort: str = "time") -> str:
         """Per-stage table. sort="time" (default) lists the hottest stages
         first (wall seconds, descending); sort="name" is alphabetical.

@@ -142,6 +142,92 @@ class TestPrometheus:
         assert "parquet_tpu_pqt_test_buckets_count 2" in text
 
 
+class TestExpositionGolden:
+    """The exposition-correctness contract on a FRESH registry (the
+    process-wide one accumulates across the test run): label values are
+    escaped per the Prometheus text format, histogram `le` bounds render
+    as plain decimals, and documented families carry `# HELP` lines."""
+
+    def test_golden_document(self):
+        reg = metrics.MetricsRegistry()
+        reg.inc("io_retries_total", 2, reason='back\\slash"quote\nnewline')
+        reg.set("pool_queue_depth", 3, pool="pqt-io")
+        reg.observe("chunk_decode_seconds", 0.002)
+        reg.observe("chunk_decode_seconds", 2.0)
+        assert reg.render_prometheus() == (
+            '# HELP parquet_tpu_io_retries_total failed source attempts absorbed by the retry ladder\n'
+            '# TYPE parquet_tpu_io_retries_total counter\n'
+            'parquet_tpu_io_retries_total{reason="back\\\\slash\\"quote\\nnewline"} 2\n'
+            '# HELP parquet_tpu_pool_queue_depth tasks submitted to a pqt-* pool and not yet running\n'
+            '# TYPE parquet_tpu_pool_queue_depth gauge\n'
+            'parquet_tpu_pool_queue_depth{pool="pqt-io"} 3\n'
+            '# HELP parquet_tpu_chunk_decode_seconds per-chunk decode wall time\n'
+            '# TYPE parquet_tpu_chunk_decode_seconds histogram\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="0.0005"} 0\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="0.001"} 0\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="0.005"} 1\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="0.01"} 1\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="0.05"} 1\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="0.1"} 1\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="0.5"} 1\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="1"} 1\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="5"} 2\n'
+            'parquet_tpu_chunk_decode_seconds_bucket{le="+Inf"} 2\n'
+            'parquet_tpu_chunk_decode_seconds_sum 2.002\n'
+            'parquet_tpu_chunk_decode_seconds_count 2\n'
+        )
+
+    def test_label_escaping_round_trips(self):
+        """An escaped sample line still parses: unescaping recovers the
+        original value exactly (what a scraper's parser will do)."""
+        reg = metrics.MetricsRegistry()
+        hostile = 'a\\b"c\nd\\\\e""'
+        reg.inc("pqt_test_escape_total", 1, v=hostile)
+        [line] = [
+            ln for ln in reg.render_prometheus().splitlines()
+            if ln.startswith("parquet_tpu_pqt_test_escape_total")
+        ]
+        assert "\n" not in line  # the raw newline would split the sample
+        quoted = line[line.index('v="') + 3 : line.rindex('"')]
+        unescaped = (
+            quoted.replace("\\\\", "\x00")
+            .replace('\\"', '"')
+            .replace("\\n", "\n")
+            .replace("\x00", "\\")
+        )
+        assert unescaped == hostile
+
+    def test_le_bounds_never_scientific(self):
+        """repr() would render tight bounds as 5e-05; the exposition must
+        print plain decimals for every bound."""
+        h = metrics._Hist(buckets=(0.00005, 0.5, 1.0, 10.0))
+        reg = metrics.MetricsRegistry()
+        reg._hists[("pqt_test_le_seconds", ())] = h
+        text = reg.render_prometheus()
+        assert 'le="0.00005"' in text
+        assert 'le="1"' in text and 'le="1.0"' not in text
+        assert 'le="10"' in text
+        assert "e-" not in text.lower().replace('le="+inf"', "")
+
+    def test_help_precedes_type_once_per_family(self):
+        reg = metrics.MetricsRegistry()
+        reg.inc("io_retries_total", 1, reason="eio")
+        reg.inc("io_retries_total", 1, reason="short_read")
+        lines = reg.render_prometheus().splitlines()
+        help_ix = [i for i, ln in enumerate(lines) if ln.startswith("# HELP")]
+        assert len(help_ix) == 1  # one HELP per family, not per sample
+        assert lines[help_ix[0] + 1].startswith(
+            "# TYPE parquet_tpu_io_retries_total"
+        )
+
+    def test_undocumented_family_renders_without_help(self):
+        reg = metrics.MetricsRegistry()
+        reg.inc("pqt_test_undoc_total", 1)
+        text = reg.render_prometheus()
+        assert "# HELP" not in text
+        assert "# TYPE parquet_tpu_pqt_test_undoc_total counter" in text
+
+
 class TestGauges:
     def test_set_last_write_wins(self):
         metrics.set_gauge("pqt_test_gauge", 3)

@@ -1,0 +1,485 @@
+"""parquet_tpu.obs: the operator-facing observability layer's contracts.
+
+Pinned here:
+  * structured logging: silent-by-default library discipline, JSON-lines
+    shape, request-id/tenant context injection (including across pool
+    workers), per-event-key token-bucket rate limiting with an exact
+    `suppressed` carry, and the always-on log_events_total /
+    log_suppressed_total counters;
+  * pool visibility: instrumented_submit's queue-depth/active gauges
+    return to zero, its wait/task histograms observe per pool label, the
+    queue wait is credited to the submitting request's trace as the
+    pool.wait stage, and cancelled futures release their depth;
+  * flight recorder unit contracts: id sanitization, config validation,
+    ring/index/trace bounds, the deterministic accumulator sampler, and
+    always-kept traces for errored/slow requests.
+
+The HTTP-level debug endpoints and the eviction-under-hammer stress live
+in tests/test_serve.py next to the daemon they exercise.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from parquet_tpu.obs.log import (
+    LOGGER_NAME,
+    JsonLinesFormatter,
+    TokenBucketLimiter,
+    configure_logging,
+    log_context,
+    log_event,
+    set_limiter,
+)
+from parquet_tpu.obs.pool import instrumented_submit, pool_depths
+from parquet_tpu.obs.recorder import (
+    FlightRecorder,
+    ObsConfig,
+    sanitize_request_id,
+)
+from parquet_tpu.utils import metrics
+from parquet_tpu.utils.trace import decode_trace
+
+WATCHDOG_S = 30.0
+
+
+@pytest.fixture()
+def wide_open_limiter():
+    """A limiter that admits everything (tests that pin line content must
+    not race the process-wide bucket other tests drained)."""
+    prev = set_limiter(TokenBucketLimiter(rate=1e9, burst=10**6))
+    yield
+    set_limiter(prev)
+
+
+@pytest.fixture()
+def log_capture(wide_open_limiter):
+    """configure_logging into a StringIO; detach after."""
+    buf = io.StringIO()
+    handler = configure_logging(stream=buf)
+    yield buf
+    logging.getLogger(LOGGER_NAME).removeHandler(handler)
+
+
+# -- structured logging --------------------------------------------------------
+
+
+class TestSilentByDefault:
+    def test_library_logger_never_propagates(self):
+        logger = logging.getLogger(LOGGER_NAME)
+        assert logger.propagate is False
+        assert any(
+            isinstance(h, logging.NullHandler) for h in logger.handlers
+        )
+
+    def test_configure_twice_does_not_stack_handlers(self):
+        logger = logging.getLogger(LOGGER_NAME)
+        h1 = configure_logging(stream=io.StringIO())
+        h2 = configure_logging(stream=io.StringIO())
+        try:
+            obs = [
+                h for h in logger.handlers
+                if getattr(h, "_pqt_obs_handler", False)
+            ]
+            assert obs == [h2]  # h1 was replaced, not stacked
+        finally:
+            for h in (h1, h2):
+                logger.removeHandler(h)
+
+
+class TestJsonLines:
+    def test_line_shape_and_fields(self, log_capture):
+        admitted = log_event(
+            "pqt_test_shape", level="warning", file="a.parquet", group=3
+        )
+        assert admitted
+        doc = json.loads(log_capture.getvalue())
+        assert doc["event"] == "pqt_test_shape"
+        assert doc["level"] == "warning"
+        assert doc["file"] == "a.parquet" and doc["group"] == 3
+        assert doc["ts"].endswith("Z")
+        assert "request_id" not in doc  # no context bound
+
+    def test_context_injection(self, log_capture):
+        with log_context(request_id="r42", tenant="acme"):
+            log_event("pqt_test_ctx")
+        doc = json.loads(log_capture.getvalue())
+        assert doc["request_id"] == "r42" and doc["tenant"] == "acme"
+        # and the binding does not leak past the block
+        log_capture.truncate(0)
+        log_capture.seek(0)
+        log_event("pqt_test_ctx_after")
+        assert "request_id" not in json.loads(log_capture.getvalue())
+
+    def test_context_carries_into_pool_workers(self, log_capture):
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pqt-test-log"
+        ) as pool:
+            with log_context(request_id="r-pool", tenant="t"):
+                fut = instrumented_submit(
+                    pool, log_event, "pqt_test_pool_ctx", pool="pqt-test-log"
+                )
+            fut.result(timeout=WATCHDOG_S)
+        [line] = [
+            ln for ln in log_capture.getvalue().splitlines()
+            if "pqt_test_pool_ctx" in ln
+        ]
+        assert json.loads(line)["request_id"] == "r-pool"
+
+    def test_unserializable_field_renders_via_str(self, log_capture):
+        log_event("pqt_test_unser", blob=object())  # must not raise
+        doc = json.loads(log_capture.getvalue())
+        assert "object object at" in doc["blob"]
+
+    def test_reserved_keys_win_over_fields(self, log_capture):
+        log_event("pqt_test_reserved", ts="fake", extra=1)
+        doc = json.loads(log_capture.getvalue())
+        assert doc["ts"] != "fake" and doc["extra"] == 1
+
+    def test_formatter_without_obs_extras(self):
+        # a foreign record routed through the formatter still renders
+        rec = logging.LogRecord(
+            "x", logging.INFO, __file__, 1, "plain message", None, None
+        )
+        doc = json.loads(JsonLinesFormatter().format(rec))
+        assert doc["event"] == "plain message"
+
+
+class TestRateLimiting:
+    def test_token_bucket_admits_burst_then_suppresses(self):
+        t = [0.0]
+        lim = TokenBucketLimiter(rate=1.0, burst=3, clock=lambda: t[0])
+        assert [lim.admit("k")[0] for _ in range(5)] == [
+            True, True, True, False, False,
+        ]
+        # refill: one token per second; the admitted line carries the
+        # exact count the limiter absorbed
+        t[0] = 1.0
+        admitted, suppressed = lim.admit("k")
+        assert admitted and suppressed == 2
+
+    def test_keys_are_independent(self):
+        t = [0.0]
+        lim = TokenBucketLimiter(rate=1.0, burst=1, clock=lambda: t[0])
+        assert lim.admit("a") == (True, 0)
+        assert lim.admit("a") == (False, 1)
+        assert lim.admit("b") == (True, 0)
+
+    def test_bad_limiter_config_rejected(self):
+        with pytest.raises(ValueError):
+            TokenBucketLimiter(rate=0)
+        with pytest.raises(ValueError):
+            TokenBucketLimiter(burst=0)
+
+    def test_log_event_counts_both_ways(self):
+        t = [0.0]
+        prev = set_limiter(
+            TokenBucketLimiter(rate=1.0, burst=2, clock=lambda: t[0])
+        )
+        try:
+            e0 = metrics.get("log_events_total", event="pqt_test_rl")
+            s0 = metrics.get("log_suppressed_total", event="pqt_test_rl")
+            results = [log_event("pqt_test_rl") for _ in range(5)]
+            assert results == [True, True, False, False, False]
+            assert metrics.get("log_events_total", event="pqt_test_rl") == e0 + 2
+            assert (
+                metrics.get("log_suppressed_total", event="pqt_test_rl")
+                == s0 + 3
+            )
+        finally:
+            set_limiter(prev)
+
+    def test_suppressed_count_rides_next_admitted_line(self, log_capture):
+        t = [0.0]
+        prev = set_limiter(
+            TokenBucketLimiter(rate=1.0, burst=1, clock=lambda: t[0])
+        )
+        try:
+            for _ in range(4):
+                log_event("pqt_test_gap")
+            t[0] = 1.0
+            log_event("pqt_test_gap")
+        finally:
+            set_limiter(prev)
+        lines = [json.loads(ln) for ln in log_capture.getvalue().splitlines()]
+        assert len(lines) == 2  # burst line + the post-refill line
+        assert "suppressed" not in lines[0]
+        assert lines[1]["suppressed"] == 3
+
+
+# -- pool visibility -----------------------------------------------------------
+
+
+class TestPoolGauges:
+    def test_gauges_rise_and_return_to_zero(self):
+        gate = threading.Event()
+        started = threading.Event()
+
+        def task():
+            started.set()
+            assert gate.wait(WATCHDOG_S)
+            return 7
+
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pqt-testpool"
+        ) as pool:
+            futs = [
+                instrumented_submit(pool, task, pool="pqt-testpool")
+                for _ in range(3)
+            ]
+            assert started.wait(WATCHDOG_S)
+            d = pool_depths()["pqt-testpool"]
+            assert d["active"] == 1 and d["queued"] == 2
+            assert metrics.get("pool_active_workers", pool="pqt-testpool") == 1
+            assert metrics.get("pool_queue_depth", pool="pqt-testpool") == 2
+            gate.set()
+            assert [f.result(timeout=WATCHDOG_S) for f in futs] == [7, 7, 7]
+        d = pool_depths()["pqt-testpool"]
+        assert d == {"queued": 0, "active": 0}
+        assert metrics.get("pool_queue_depth", pool="pqt-testpool") == 0
+        assert metrics.get("pool_active_workers", pool="pqt-testpool") == 0
+
+    def test_wait_and_task_histograms_observe(self):
+        snap = metrics.snapshot()
+        with ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="pqt-testhist"
+        ) as pool:
+            futs = [
+                instrumented_submit(
+                    pool, time.sleep, 0.002, pool="pqt-testhist"
+                )
+                for _ in range(4)
+            ]
+            for f in futs:
+                f.result(timeout=WATCHDOG_S)
+        d = metrics.delta(snap)
+        assert d.get('pool_queue_wait_seconds_count{pool="pqt-testhist"}') == 4
+        assert d.get('pool_task_seconds_count{pool="pqt-testhist"}') == 4
+        assert d.get('pool_task_seconds_sum{pool="pqt-testhist"}') >= 0.008
+
+    def test_pool_label_defaults_to_thread_name_prefix(self):
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pqt-testauto"
+        ) as pool:
+            instrumented_submit(pool, int).result(timeout=WATCHDOG_S)
+        assert metrics.get(
+            "pool_queue_wait_seconds", pool="pqt-testauto"
+        ) == 0  # histograms aren't counters; presence shows via snapshot
+        assert (
+            'pool_queue_wait_seconds_count{pool="pqt-testauto"}'
+            in metrics.snapshot()
+        )
+
+    def test_queue_wait_credited_to_submitting_trace(self):
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pqt-testwait"
+        ) as pool:
+            with decode_trace() as tr:
+                futs = [
+                    instrumented_submit(
+                        pool, time.sleep, 0.001, pool="pqt-testwait"
+                    )
+                    for _ in range(3)
+                ]
+                for f in futs:
+                    f.result(timeout=WATCHDOG_S)
+            rollup = tr.stage_rollup()
+        assert rollup["pool.wait"]["calls"] == 3
+        assert rollup["pool.wait"]["seconds"] >= 0
+
+    def test_cancelled_future_releases_queue_depth(self):
+        gate = threading.Event()
+        started = threading.Event()
+
+        def blocker():
+            started.set()
+            assert gate.wait(WATCHDOG_S)
+
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pqt-testcancel"
+        ) as pool:
+            head = instrumented_submit(pool, blocker, pool="pqt-testcancel")
+            assert started.wait(WATCHDOG_S)
+            queued = instrumented_submit(pool, int, pool="pqt-testcancel")
+            assert pool_depths()["pqt-testcancel"]["queued"] == 1
+            assert queued.cancel()
+            gate.set()
+            head.result(timeout=WATCHDOG_S)
+        assert pool_depths()["pqt-testcancel"] == {"queued": 0, "active": 0}
+
+    def test_worker_exception_still_balances_gauges(self):
+        with ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="pqt-testboom"
+        ) as pool:
+            fut = instrumented_submit(
+                pool, [].pop, pool="pqt-testboom"  # IndexError in the worker
+            )
+            with pytest.raises(IndexError):
+                fut.result(timeout=WATCHDOG_S)
+        assert pool_depths()["pqt-testboom"] == {"queued": 0, "active": 0}
+
+
+# -- flight recorder unit contracts --------------------------------------------
+
+
+class TestSanitizeRequestId:
+    def test_passthrough_and_none(self):
+        assert sanitize_request_id("r-1.2:3_ok") == "r-1.2:3_ok"
+        assert sanitize_request_id(None) is None
+        assert sanitize_request_id("   ") is None
+        assert sanitize_request_id("") is None
+
+    def test_hostile_values_bounded_and_cleaned(self):
+        assert sanitize_request_id("a b{c}") == "a_b_c_"
+        assert len(sanitize_request_id("x" * 500)) == 64
+        assert sanitize_request_id('"\n\\') == "___"
+        assert sanitize_request_id(12345) == "12345"  # coerced, not crashed
+
+
+class TestObsConfigValidation:
+    def test_rejects_bad_knobs(self):
+        with pytest.raises(ValueError, match="ring_size"):
+            ObsConfig(ring_size=0)
+        with pytest.raises(ValueError, match="trace_sample_rate"):
+            ObsConfig(trace_sample_rate=1.5)
+        with pytest.raises(ValueError, match="slow_ms"):
+            ObsConfig(slow_ms=0)
+        with pytest.raises(ValueError, match="max_traces"):
+            ObsConfig(max_traces=-1)
+
+
+class TestRecorderBounds:
+    def test_ring_and_index_evict_together(self):
+        rec = FlightRecorder(ObsConfig(ring_size=4, trace_sample_rate=0.0))
+        for i in range(10):
+            r = rec.begin("/v1/scan", "t", request_id=f"r{i}")
+            rec.finish(r, 200)
+        st = rec.stats()
+        assert st["records"] == 4 and st["indexed"] == 4
+        assert rec.get("r0") is None and rec.get("r9") is not None
+        assert [r["id"] for r in rec.list()] == ["r9", "r8", "r7", "r6"]
+
+    def test_duplicate_id_newest_wins_lookup(self):
+        rec = FlightRecorder(ObsConfig(ring_size=8))
+        rec.finish(rec.begin("/v1/scan", "t", request_id="dup"), 200)
+        second = rec.begin("/v1/plan", "t", request_id="dup")
+        rec.finish(second, 404)
+        assert rec.get("dup") is second
+        # evicting the OLD duplicate must not drop the index entry that
+        # now points at the new record
+        for i in range(8):
+            rec.finish(rec.begin("/v1/scan", "t", request_id=f"f{i}"), 200)
+        assert rec.get("dup") is None  # both generations evicted by now
+
+    def test_trace_budget_drops_oldest_keeps_summary(self):
+        rec = FlightRecorder(
+            ObsConfig(ring_size=32, trace_sample_rate=1.0, max_traces=2)
+        )
+        records = []
+        for i in range(5):
+            with decode_trace() as tr:
+                pass
+            r = rec.begin("/v1/scan", "t", request_id=f"tr{i}")
+            rec.finish(r, 200, trace=tr)
+            records.append(r)
+        assert rec.stats()["traces"] == 2
+        assert records[0]._trace is None  # oldest trace dropped...
+        assert rec.get("tr0") is records[0]  # ...but the record remains
+        assert records[4]._trace is not None
+
+    def test_max_traces_zero_keeps_no_trees(self):
+        rec = FlightRecorder(
+            ObsConfig(ring_size=8, trace_sample_rate=1.0, max_traces=0)
+        )
+        with decode_trace() as tr:
+            pass
+        r = rec.finish(rec.begin("/v1/scan", "t"), 200, trace=tr)
+        assert r._trace is None and r.stages is not None
+
+    def test_shrinking_config_trims_immediately(self):
+        rec = FlightRecorder(ObsConfig(ring_size=16))
+        for i in range(16):
+            rec.finish(rec.begin("/v1/scan", "t", request_id=f"s{i}"), 200)
+        rec.configure(ObsConfig(ring_size=3))
+        st = rec.stats()
+        assert st["records"] == 3 and st["indexed"] == 3
+
+
+class TestTraceRetention:
+    def _finish_with_trace(self, rec, status, duration_s, rid):
+        with decode_trace() as tr:
+            pass
+        r = rec.begin("/v1/scan", "t", request_id=rid)
+        return rec.finish(r, status, trace=tr, duration_s=duration_s)
+
+    def test_error_and_slow_always_keep(self):
+        rec = FlightRecorder(
+            ObsConfig(ring_size=8, trace_sample_rate=0.0, slow_ms=100.0)
+        )
+        err = self._finish_with_trace(rec, 500, 0.001, "err")
+        slow = self._finish_with_trace(rec, 200, 0.5, "slow")
+        fast = self._finish_with_trace(rec, 200, 0.001, "fast")
+        assert err.trace_kind == "error" and err._trace is not None
+        assert slow.trace_kind == "slow" and slow._trace is not None
+        assert fast.trace_kind is None and fast._trace is None
+
+    def test_string_error_status_counts_as_error(self):
+        rec = FlightRecorder(ObsConfig(trace_sample_rate=0.0))
+        r = self._finish_with_trace(rec, "error", 0.001, "estr")
+        assert r.trace_kind == "error"
+
+    def test_accumulator_sampler_is_exact(self):
+        rec = FlightRecorder(
+            ObsConfig(ring_size=64, trace_sample_rate=0.25, slow_ms=1e9)
+        )
+        kinds = [
+            self._finish_with_trace(rec, 200, 0.0, f"a{i}").trace_kind
+            for i in range(8)
+        ]
+        assert kinds.count("sampled") == 2  # exactly rate * n, no PRNG
+
+    def test_error_message_truncates(self):
+        rec = FlightRecorder(ObsConfig())
+        r = rec.record("dataset.unit", status="error", error="x" * 10_000)
+        assert len(r.error) == 300
+
+    def test_one_shot_record_lands_in_ring(self):
+        rec = FlightRecorder(ObsConfig())
+        r = rec.record(
+            "encode.group", duration_s=0.25, nbytes=1024,
+            detail={"group": 3, "rows": 100},
+        )
+        assert r.open is False and r.duration_ms == 250.0
+        got = rec.get(r.id)
+        assert got is r
+        d = got.to_dict()
+        assert d["detail"] == {"group": 3, "rows": 100}
+        assert d["bytes"] == 1024
+
+
+class TestLibraryRingIsolation:
+    def test_pipeline_churn_cannot_evict_request_evidence(self):
+        """The 14:02 story survives a busy co-resident pipeline: hundreds
+        of dataset.unit one-shots evict only each other, never the serve
+        request records the debug endpoints exist to retain."""
+        rec = FlightRecorder(ObsConfig(ring_size=8, trace_sample_rate=0.0))
+        for i in range(4):
+            rec.finish(rec.begin("/v1/scan", "t", request_id=f"req{i}"), 200)
+        for i in range(500):
+            rec.record("dataset.unit", detail={"group": i})
+        st = rec.stats()
+        assert st["requests"] == 4 and st["library"] == 8
+        for i in range(4):
+            assert rec.get(f"req{i}") is not None  # all still retrievable
+        # one merged listing, newest first, both kinds present
+        ids = [r["endpoint"] for r in rec.list(limit=100)]
+        assert ids.count("dataset.unit") == 8
+        assert ids.count("/v1/scan") == 4
+        assert ids[0] == "dataset.unit"  # the newest record overall

@@ -136,7 +136,13 @@ def _expected_jsonl(corpus, names, columns=None, filters=None, limit=None):
 def _error_code(body: bytes) -> str:
     doc = json.loads(body)
     assert set(doc) == {"error"}, doc
-    assert set(doc["error"]) == {"code", "message", "status"}, doc
+    # request_id rides every error body produced inside a recorded request
+    # (the correlation key for /v1/debug/requests); pre-record errors
+    # (bad route, oversized body) legitimately lack it
+    assert set(doc["error"]) - {"request_id"} == {"code", "message", "status"}, doc
+    rid = doc["error"].get("request_id")
+    if rid is not None:
+        assert isinstance(rid, str) and 0 < len(rid) <= 64, doc
     return doc["error"]["code"]
 
 
@@ -1101,3 +1107,395 @@ class TestRequestHygiene:
         )
         err = capsys.readouterr().err
         assert rc == 1 and "default_timeout_s" in err
+
+
+# -- flight recorder + request correlation (parquet_tpu.obs over serve) --------
+
+
+class TestFlightRecorder:
+    """The PR-9 operator story: a client-supplied X-Request-Id is echoed,
+    sanitized, and retrievable at /v1/debug/requests/<id> with status,
+    tenant, pruning summary, queue-wait and stage rollup — plus a
+    Perfetto-loadable trace when sampled/slow/errored. The ring and its
+    trace retention stay bounded under a concurrent hammer."""
+
+    @pytest.fixture()
+    def sampled_server(self, corpus):
+        with ScanServer(
+            ServeConfig(
+                port=0, root=str(corpus), cache_mb=32, trace_sample_rate=1.0
+            )
+        ) as s:
+            s.start_background()
+            yield s
+
+    def test_request_id_roundtrip_record_and_trace(self, sampled_server):
+        server = sampled_server
+        body_spec = {"paths": "a.parquet", "columns": ["id"]}
+        status, headers, body = _scan(
+            server, body_spec, headers={"X-Request-Id": "demo"}
+        )
+        assert status == 200
+        assert headers.get("X-Request-Id") == "demo"  # echoed verbatim
+        # byte identity: the correlation header must not perturb the payload
+        status2, headers2, body2 = _scan(server, body_spec)
+        assert status2 == 200 and body2 == body
+        assert headers2.get("X-Request-Id")  # generated when not supplied
+
+        s, _h, b = _request(server, "GET", "/v1/debug/requests/demo")
+        assert s == 200
+        doc = json.loads(b)
+        assert doc["id"] == "demo"
+        assert doc["endpoint"] == "/v1/scan"
+        assert doc["tenant"] == "default"
+        assert doc["status"] == 200
+        assert doc["open"] is False
+        assert doc["bytes"] == len(body)  # payload bytes, chunked framing off
+        assert doc["duration_ms"] > 0
+        assert doc["queue_wait_ms"] >= 0
+        plan = doc["plan"]
+        assert plan["files"] == 1 and plan["units_admitted"] >= 1
+        assert "units_pruned_stats" in plan and "units_pruned_bloom" in plan
+        stages = doc["stages"]
+        assert stages and all(
+            set(v) == {"seconds", "bytes", "calls"} for v in stages.values()
+        )
+        assert "pool.wait" in stages  # the queue-wait rollup's source
+
+        # rate 1.0: the span tree was kept, and it is Perfetto-shaped
+        assert doc["has_trace"] and doc["trace_kind"] == "sampled"
+        s, _h, b = _request(server, "GET", "/v1/debug/requests/demo/trace")
+        assert s == 200
+        tr = json.loads(b)
+        assert tr["traceEvents"]
+        for ev in tr["traceEvents"]:
+            assert "ph" in ev and "name" in ev and "pid" in ev
+        assert tr["otherData"]["request"] == {
+            "id": "demo", "endpoint": "/v1/scan", "tenant": "default",
+        }
+
+        # the listing includes it, newest first
+        s, _h, b = _request(server, "GET", "/v1/debug/requests")
+        reqs = json.loads(b)["requests"]
+        assert any(r["id"] == "demo" for r in reqs)
+
+    def test_hostile_request_id_sanitized_everywhere(self, sampled_server):
+        server = sampled_server
+        raw = "e{vil}|id;" + "x" * 200
+        status, headers, _b = _scan(
+            server,
+            {"paths": "a.parquet", "columns": ["id"]},
+            headers={"X-Request-Id": raw},
+        )
+        assert status == 200
+        rid = headers["X-Request-Id"]
+        assert len(rid) <= 64
+        assert all(c.isalnum() or c in "._:-" for c in rid)
+        s, _h, b = _request(server, "GET", f"/v1/debug/requests/{rid}")
+        assert s == 200 and json.loads(b)["id"] == rid
+
+    def test_errored_request_always_keeps_trace(self, corpus):
+        with ScanServer(
+            ServeConfig(
+                port=0, root=str(corpus), cache_mb=32, trace_sample_rate=0.0
+            )
+        ) as server:
+            server.start_background()
+            status, _h, body = _scan(
+                server,
+                {"paths": "missing.parquet"},
+                headers={"X-Request-Id": "whoops"},
+            )
+            assert status == 404
+            assert json.loads(body)["error"]["request_id"] == "whoops"
+            s, _h, b = _request(server, "GET", "/v1/debug/requests/whoops")
+            doc = json.loads(b)
+            assert doc["status"] == 404
+            assert doc["error"]  # the truncated message, retrievable later
+            assert doc["has_trace"] and doc["trace_kind"] == "error"
+            s, _h, _b = _request(
+                server, "GET", "/v1/debug/requests/whoops/trace"
+            )
+            assert s == 200
+
+    def test_slow_request_counts_and_keeps_trace(self, corpus):
+        with ScanServer(
+            ServeConfig(
+                port=0, root=str(corpus), cache_mb=32,
+                trace_sample_rate=0.0, slow_ms=0.0001,
+            )
+        ) as server:
+            server.start_background()
+            snap = metrics.snapshot()
+            status, _h, _b = _scan(
+                server,
+                {"paths": "a.parquet", "columns": ["id"]},
+                headers={"X-Request-Id": "tortoise"},
+            )
+            assert status == 200
+            d = metrics.delta(snap)
+            assert d.get('serve_slow_requests_total{endpoint="/v1/scan"}', 0) >= 1
+            s, _h, b = _request(server, "GET", "/v1/debug/requests/tortoise")
+            doc = json.loads(b)
+            assert doc["trace_kind"] == "slow" and doc["has_trace"]
+
+    def test_unsampled_fast_request_has_no_trace(self, corpus):
+        with ScanServer(
+            ServeConfig(
+                port=0, root=str(corpus), cache_mb=32, trace_sample_rate=0.0
+            )
+        ) as server:
+            server.start_background()
+            status, _h, _b = _scan(
+                server,
+                {"paths": "a.parquet", "columns": ["id"]},
+                headers={"X-Request-Id": "quick"},
+            )
+            assert status == 200
+            s, _h, b = _request(server, "GET", "/v1/debug/requests/quick")
+            assert s == 200 and json.loads(b)["has_trace"] is False
+            s, _h, b = _request(
+                server, "GET", "/v1/debug/requests/quick/trace"
+            )
+            assert s == 404 and _error_code(b) == "no_trace"
+
+    def test_unknown_id_and_bad_limit_are_typed(self, server):
+        s, _h, b = _request(server, "GET", "/v1/debug/requests/never-seen")
+        assert s == 404 and _error_code(b) == "no_such_request"
+        s, _h, b = _request(server, "GET", "/v1/debug/requests?limit=banana")
+        assert s == 400 and _error_code(b) == "bad_request"
+        s, _h, b = _request(server, "GET", "/v1/debug/requests?limit=0")
+        assert s == 400 and _error_code(b) == "bad_request"
+        s, _h, b = _request(server, "GET", "/v1/debug/requests/a/b/c")
+        assert s == 404 and _error_code(b) == "no_such_route"
+
+    def test_plan_requests_are_recorded_per_endpoint(self, sampled_server):
+        server = sampled_server
+        snap = metrics.snapshot()
+        s, h, _b = _request(
+            server, "POST", "/v1/plan", {"paths": "a.parquet"},
+            {"X-Request-Id": "dry-run"},
+        )
+        assert s == 200 and h.get("X-Request-Id") == "dry-run"
+        s, _h, b = _request(server, "GET", "/v1/debug/requests/dry-run")
+        doc = json.loads(b)
+        assert doc["endpoint"] == "/v1/plan" and doc["plan"]["files"] == 1
+        d = metrics.delta(snap)
+        assert d.get('serve_request_seconds_count{endpoint="/v1/plan"}', 0) >= 1
+
+    def test_ring_stays_bounded_under_http_requests(self, corpus):
+        with ScanServer(
+            ServeConfig(
+                port=0, root=str(corpus), cache_mb=32, debug_ring_size=8,
+                trace_sample_rate=1.0,
+            )
+        ) as server:
+            server.start_background()
+            for i in range(20):
+                s, _h, _b = _request(
+                    server, "GET", "/v1/plan?paths=a.parquet",
+                    headers={"X-Request-Id": f"r{i:02d}"},
+                )
+                assert s == 200
+            # the PROCESS-wide recorder may hold library one-shots from
+            # other tests in this run; the REQUEST ring is what this
+            # daemon's 20 plans hammer, and both rings share the bound
+            stats = server.service.recorder.stats()
+            assert stats["requests"] <= 8
+            assert stats["library"] <= 8
+            assert stats["indexed"] <= stats["records"]
+            s, _h, b = _request(
+                server, "GET", "/v1/debug/requests?limit=1000&endpoint=/v1/plan"
+            )
+            reqs = json.loads(b)["requests"]
+            assert len(reqs) <= 8
+            assert reqs[0]["id"] == "r19"  # newest first
+            # evicted ids 404, retained ones resolve
+            s, _h, _b = _request(server, "GET", "/v1/debug/requests/r00")
+            assert s == 404
+            s, _h, _b = _request(server, "GET", "/v1/debug/requests/r19")
+            assert s == 200
+
+    def test_eviction_under_hammer_bounds_memory(self):
+        """8 writer threads churn a tiny ring (every record slow+traced, the
+        worst case for trace retention) while readers list/get — occupancy
+        never exceeds the configured bounds."""
+        from parquet_tpu.obs.recorder import FlightRecorder, ObsConfig
+        from parquet_tpu.utils.trace import decode_trace
+
+        rec = FlightRecorder(
+            ObsConfig(ring_size=16, trace_sample_rate=1.0, slow_ms=0.001,
+                      max_traces=4)
+        )
+        stop = threading.Event()
+        violations = []
+
+        def writer(k):
+            for i in range(200):
+                with decode_trace() as tr:
+                    pass
+                r = rec.begin("/v1/scan", f"t{k}", request_id=f"w{k}-{i}")
+                rec.finish(r, 200, nbytes=64, trace=tr, duration_s=0.01)
+
+        def reader():
+            while not stop.is_set():
+                rec.list(limit=50)
+                rec.get("w0-5")
+                st = rec.stats()
+                if st["records"] > 16 or st["traces"] > 4:
+                    violations.append(st)
+
+        threads = [
+            threading.Thread(target=writer, args=(k,)) for k in range(8)
+        ] + [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads[:8]:
+            t.join(WATCHDOG_S)
+        stop.set()
+        for t in threads[8:]:
+            t.join(WATCHDOG_S)
+        assert not any(t.is_alive() for t in threads)
+        assert not violations, violations[:3]
+        st = rec.stats()
+        assert st["records"] <= 16 and st["indexed"] <= 16
+        assert st["traces"] <= 4
+        # every retained record is one of the newest; the ring dropped
+        # ~1584 records without the index leaking any of them
+        assert len(rec.list(limit=100)) <= 16
+
+
+class TestDebugCli:
+    """`parquet-tool debug <url>` — the operator client for the daemon's
+    flight recorder (list / one record / Perfetto trace export)."""
+
+    @pytest.fixture()
+    def live(self, corpus):
+        with ScanServer(
+            ServeConfig(
+                port=0, root=str(corpus), cache_mb=32, trace_sample_rate=1.0
+            )
+        ) as s:
+            s.start_background()
+            _scan(
+                s, {"paths": "a.parquet", "columns": ["id"]},
+                headers={"X-Request-Id": "cli-demo"},
+            )
+            yield s, f"http://{s.host}:{s.port}"
+
+    def test_list_table(self, live, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        _server, url = live
+        assert tool_main(["debug", url]) == 0
+        out = capsys.readouterr().out
+        assert "ID" in out and "ENDPOINT" in out and "WAIT_MS" in out
+        assert "cli-demo" in out and "/v1/scan" in out and "sampled" in out
+
+    def test_one_record_json(self, live, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        _server, url = live
+        assert tool_main(["debug", url, "--id", "cli-demo"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["id"] == "cli-demo" and doc["status"] == 200
+        assert doc["plan"]["files"] == 1 and doc["stages"]
+
+    def test_trace_export_is_perfetto_loadable(self, live, tmp_path, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        _server, url = live
+        out_path = tmp_path / "trace.json"
+        assert tool_main(
+            ["debug", url, "--id", "cli-demo", "--trace", "-o", str(out_path)]
+        ) == 0
+        assert "trace events" in capsys.readouterr().out
+        doc = json.loads(out_path.read_text())
+        assert doc["traceEvents"]
+        assert doc["otherData"]["request"]["id"] == "cli-demo"
+
+    def test_slow_filter_and_scheme_default(self, live, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        server, _url = live
+        # bare host:port grows the http:// scheme; nothing is slow yet
+        assert tool_main(
+            ["debug", f"{server.host}:{server.port}", "--slow"]
+        ) == 0
+        assert "no recorded requests" in capsys.readouterr().out
+
+    def test_unknown_id_is_typed_failure(self, live, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        _server, url = live
+        assert tool_main(["debug", url, "--id", "nope"]) == 1
+        assert "no_such_request" in capsys.readouterr().err
+
+    def test_trace_without_id_rejected(self, live, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        _server, url = live
+        assert tool_main(["debug", url, "--trace"]) == 1
+        assert "--trace requires --id" in capsys.readouterr().err
+
+
+class TestTraceEviction:
+    def test_evicted_trace_404_names_the_right_knob(self, corpus):
+        """A record that QUALIFIED for a trace but lost it to max_traces
+        pressure must say so — not claim it was never sampled."""
+        with ScanServer(
+            ServeConfig(
+                port=0, root=str(corpus), cache_mb=32, trace_sample_rate=0.0
+            )
+        ) as server:
+            server.start_background()
+            # every error keeps a trace; default max_traces=16, so the
+            # 17th evicts the first record's tree (the record stays)
+            for i in range(17):
+                _scan(
+                    server, {"paths": "missing.parquet"},
+                    headers={"X-Request-Id": f"e{i:02d}"},
+                )
+            s, _h, b = _request(server, "GET", "/v1/debug/requests/e00")
+            doc = json.loads(b)
+            assert s == 200
+            assert doc["trace_kind"] == "error" and doc["has_trace"] is False
+            s, _h, b = _request(server, "GET", "/v1/debug/requests/e00/trace")
+            assert s == 404 and _error_code(b) == "trace_evicted"
+            assert "--debug-max-traces" in json.loads(b)["error"]["message"]
+            # the newest qualifier still serves its tree
+            s, _h, _b = _request(
+                server, "GET", "/v1/debug/requests/e16/trace"
+            )
+            assert s == 200
+
+
+class TestObsKnobOwnership:
+    def test_serve_defaults_mirror_obsconfig(self):
+        """ObsConfig owns the observability numbers; ServeConfig must not
+        restate them (restated copies drift silently)."""
+        from parquet_tpu.obs.recorder import ObsConfig
+
+        cfg, obs = ServeConfig(), ObsConfig()
+        assert cfg.trace_sample_rate == obs.trace_sample_rate
+        assert cfg.slow_ms == obs.slow_ms
+        assert cfg.debug_ring_size == obs.ring_size
+        assert cfg.debug_max_traces == obs.max_traces
+
+    def test_admission_rejections_rate_limit_per_code(self):
+        """A queue_full flood must not absorb the log line for a DIFFERENT
+        rejection code — the limiter keys on admission_rejected:<code>."""
+        adm = AdmissionController(max_inflight=1)
+        before = metrics.get(
+            "log_events_total", event="admission_rejected:queue_full"
+        ) + metrics.get(
+            "log_suppressed_total", event="admission_rejected:queue_full"
+        )
+        with adm.admit("t0"), pytest.raises(ServeError, match="max in-flight"):
+            adm.admit("t")
+        after = metrics.get(
+            "log_events_total", event="admission_rejected:queue_full"
+        ) + metrics.get(
+            "log_suppressed_total", event="admission_rejected:queue_full"
+        )
+        assert after == before + 1  # keyed per code, counted either way

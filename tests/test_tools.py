@@ -596,3 +596,93 @@ class TestBenchJson:
             x for x in proc.stdout.decode().splitlines() if x.strip().startswith("{")
         ][-1]
         assert json.loads(line) == doc
+
+
+class TestBenchCompare:
+    def _run_compare(self, tmp_path, old, new, extra=()):
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parent.parent
+        a, b = tmp_path / "old.json", tmp_path / "new.json"
+        a.write_text(json.dumps(old))
+        b.write_text(json.dumps(new))
+        return subprocess.run(
+            [sys.executable, str(root / "bench.py"), "--compare",
+             str(a), str(b), *extra],
+            cwd=str(root), capture_output=True, text=True, timeout=60,
+        )
+
+    def test_no_regression_exits_zero(self, tmp_path):
+        old = {"scan": {"rows_s": 1000.0, "p50_ms": 10.0, "rows": 500}}
+        new = {"scan": {"rows_s": 1050.0, "p50_ms": 9.0, "rows": 500}}
+        proc = self._run_compare(tmp_path, old, new)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "no tracked regressions" in proc.stdout
+
+    def test_throughput_drop_gates(self, tmp_path):
+        old = {"scan": {"rows_s": 1000.0}}
+        new = {"scan": {"rows_s": 800.0}}  # -20% past the 10% default
+        proc = self._run_compare(tmp_path, old, new)
+        assert proc.returncode == 1
+        assert "REGRESSION scan.rows_s" in proc.stdout
+
+    def test_latency_rise_gates_and_threshold_overrides(self, tmp_path):
+        old = {"serve": {"p50_ms": 10.0}}
+        new = {"serve": {"p50_ms": 11.5}}  # +15%
+        proc = self._run_compare(tmp_path, old, new)
+        assert proc.returncode == 1
+        proc = self._run_compare(tmp_path, old, new, ("--threshold", "0.2"))
+        assert proc.returncode == 0
+
+    def test_untracked_leaves_never_gate(self, tmp_path):
+        old = {"scan": {"rows_s": 100.0, "rows": 100, "prefetch": 2}}
+        new = {"scan": {"rows_s": 100.0, "rows": 9, "prefetch": 8}}
+        proc = self._run_compare(tmp_path, old, new)  # counts, not metrics
+        assert proc.returncode == 0
+        assert "untracked changed" in proc.stdout
+
+    def test_disjoint_artifacts_fail_instead_of_green(self, tmp_path):
+        # two artifacts with no tracked metric in common compared NOTHING;
+        # a CI gate must not pass on that
+        old = {"scan": {"rows_s": 100.0}}
+        new = {"prepare": {"stage_ms": 5.0, "rows": 3}}
+        proc = self._run_compare(tmp_path, old, new)
+        assert proc.returncode != 0
+        assert "no tracked metrics in common" in proc.stderr
+        assert "WARNING tracked metric only in old: scan.rows_s" in proc.stdout
+
+    def test_bad_usage_is_a_clean_message(self, tmp_path):
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parent.parent
+        a = tmp_path / "x.json"
+        a.write_text('{"scan": {"rows_s": 1.0}}')
+
+        def run(*args):
+            return subprocess.run(
+                [sys.executable, str(root / "bench.py"), "--compare", *args],
+                cwd=str(root), capture_output=True, text=True, timeout=60,
+            )
+
+        proc = run(str(a), str(a), "--threshold", "abc")
+        assert proc.returncode != 0
+        assert "Traceback" not in proc.stderr
+        assert "--threshold needs a number" in proc.stderr
+        # flags-before-paths ordering still resolves the two paths
+        proc = run("--threshold", "0.2", str(a), str(a))
+        assert proc.returncode == 0, proc.stderr
+        proc = run(str(a))
+        assert proc.returncode != 0 and "needs OLD.json NEW.json" in proc.stderr
+
+    def test_matrix_lists_are_gated(self, tmp_path):
+        # the full-run artifact stores the 5-config matrix as a LIST;
+        # positional flattening must keep it inside the gate
+        old = {"matrix": [{"t": 1.0, "vs_baseline": 2.0, "config": 1}]}
+        new = {"matrix": [{"t": 2.0, "vs_baseline": 2.0, "config": 1}]}
+        proc = self._run_compare(tmp_path, old, new)
+        assert proc.returncode == 1
+        assert "REGRESSION matrix.0.t" in proc.stdout
