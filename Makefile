@@ -1,10 +1,11 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-compare dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-chaos chaos-smoke bench-compare dryrun fuzz profile
 
-# tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them
-check: native lint
+# tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
+# chaos-smoke runs the scripted fault schedule end to end at smoke scale
+check: native lint chaos-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -51,6 +52,17 @@ bench-write: native
 # cold-vs-warm /v1/plan latency ratio; host-only, no accelerator
 bench-serve: native
 	python bench.py --serve
+
+# chaos bench: the scripted fault schedule (latency spike -> error burst ->
+# blackout -> recovery) against the SLO-controlled dataset pipeline vs
+# uncontrolled, breaker fast-fail vs the retry ladder, and the serve daemon
+# under brownout; "SLO held through the schedule" as a measured artifact
+bench-chaos: native
+	python bench.py --chaos
+
+# the make-check-sized chaos gate: same code paths, sub-second phases
+chaos-smoke: native
+	PQT_CHAOS_SMOKE=1 JAX_PLATFORMS=cpu python bench.py --chaos
 
 # record-assembly bench: vectorized level-scan engine vs scalar cursor walk
 # vs pyarrow to_pylist on flat/1-level/2-level tables (rows asserted

@@ -76,6 +76,18 @@ daemon, plus the cold-vs-warm /v1/plan latency ratio the footer/block
 caches buy. PQT_BENCH_SERVE=0 skips it in a full run; the result rides
 the --json artifact under "serve".
 
+`--chaos` benchmarks graceful degradation under the scripted fault schedule
+(testing/chaos.py: latency spike -> error burst -> blackout -> recovery,
+driven through every source the process opens): the SLO-controlled dataset
+pipeline vs the same pipeline uncontrolled (per-phase p50/p99 consumer
+waits; the pin is p99 within the SLO in the steady spike phase WITH the
+controller and over it WITHOUT), hedged-read win rate, the breakered vs
+un-breakered time-to-error on a blacked-out source (pin: < 10%), and the
+serve daemon under brownout (statuses, sheds, typed-responses-only pin).
+PQT_CHAOS_ROWS / PQT_CHAOS_FILES / PQT_CHAOS_PHASE_S size it;
+PQT_CHAOS_SMOKE=1 is the make-check-sized smoke; PQT_BENCH_CHAOS=0 skips
+it in a full run. The result rides the --json artifact under "chaos".
+
 `--json out.json` (or PQT_BENCH_JSON=out.json) additionally writes the
 final structured result — headline + per-stage prepare breakdown + matrix —
 to a file, so the BENCH_* trajectory artifacts are produced by the harness
@@ -1470,6 +1482,354 @@ def _phase_dataset() -> None:
     _emit(out)
 
 
+# -- the chaos benchmark (--chaos / phase "chaos") -----------------------------
+
+CHAOS_ROWS = int(os.environ.get("PQT_CHAOS_ROWS", 400_000))
+CHAOS_FILES = int(os.environ.get("PQT_CHAOS_FILES", 6))
+CHAOS_PHASE_S = float(os.environ.get("PQT_CHAOS_PHASE_S", 2.0))
+# PQT_CHAOS_SMOKE=1: the `make check` fast gate — tiny corpus, sub-second
+# phases, same code paths
+CHAOS_SMOKE = os.environ.get("PQT_CHAOS_SMOKE", "0") == "1"
+
+
+def _chaos_glob() -> str:
+    """A cached shard set for the chaos runs (its own corpus: the dataset
+    bench's files are sized for throughput, these for many quick units so
+    phases see plenty of reads)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rows = 60_000 if CHAOS_SMOKE else CHAOS_ROWS
+    files = 3 if CHAOS_SMOKE else CHAOS_FILES
+    d = Path(f"/tmp/pqt_chaos_{rows}_{files}")
+    marker = d / "DONE"
+    if not marker.exists():
+        d.mkdir(parents=True, exist_ok=True)
+        rng = np.random.default_rng(13)
+        per = rows // files
+        log(f"bench: generating {files} x {per:,}-row chaos shards in {d}")
+        for i in range(files):
+            t = pa.table(
+                {
+                    "id": pa.array(
+                        np.arange(i * per, (i + 1) * per, dtype=np.int64)
+                    ),
+                    "v": pa.array(
+                        rng.integers(0, 1 << 30, per).astype(np.int64)
+                    ),
+                }
+            )
+            pq.write_table(
+                t, d / f"shard-{i:03d}.parquet", compression="snappy",
+                row_group_size=1 << 13, use_dictionary=False,
+            )
+        marker.write_text("ok\n")
+    return str(d / "shard-*.parquet")
+
+
+def _chaos_schedule(phase_s: float, base: dict):
+    """The bench timeline: the standard acts, with the latency spike split
+    into a CONVERGE phase (the controller is still adapting) and a STEADY
+    phase (the acceptance pin reads this one: p99 within SLO once
+    converged)."""
+    from parquet_tpu.testing.chaos import FaultSchedule, Phase
+
+    spike = {**base, "spike_rate": 0.5, "spike_s": 0.15}
+    return FaultSchedule([
+        Phase("warmup", phase_s * 0.5, base),
+        Phase("spike_converge", phase_s, spike),
+        Phase("spike_steady", phase_s, spike),
+        Phase("error_burst", phase_s * 0.5, {**base, "error_rate": 0.3}),
+        Phase("blackout", phase_s * 0.5, {**base, "permanent": True}),
+        Phase("recovery", phase_s * 0.5, base),
+    ])
+
+
+def _chaos_dataset_run(pattern: str, *, slo_ms: float, phase_s: float,
+                       controlled: bool) -> dict:
+    """One dataset pass under the scripted schedule: breaker + retry (+
+    hedge when controlled) installed, controller attached per
+    `controlled`. Returns the run_dataset_chaos report."""
+    from parquet_tpu.data.controller import AIMDController
+    from parquet_tpu.testing.chaos import ChaosHarness, run_dataset_chaos
+
+    base = {"latency_s": 0.001}
+    schedule = _chaos_schedule(phase_s, base)
+    controller = (
+        AIMDController(
+            slo_wait_ms=slo_ms, initial_depth=1, max_depth=16,
+            window_s=max(0.2, phase_s / 8), violation_share=0.02,
+            increase_step=2, idle_windows=6,
+        )
+        if controlled
+        else None
+    )
+    with ChaosHarness(
+        schedule,
+        seed=17,
+        breaker=True,
+        retry=True,
+        hedge=controlled,
+        breaker_kw={"failure_threshold": 5, "open_s": phase_s / 4},
+        retry_kw={"attempts": 3, "base_delay_s": 0.002, "max_delay_s": 0.02,
+                  "sleep": time.sleep},
+        hedge_kw={"delay_quantile": 0.9, "min_delay_s": 0.005,
+                  "initial_delay_s": 0.02, "max_delay_s": 0.2},
+    ) as chaos:
+        return run_dataset_chaos(
+            pattern,
+            chaos=chaos,
+            batch_size=4096,
+            slo_wait_ms=slo_ms,
+            enable_controller=controlled,
+            controller=controller,
+            prefetch=1,
+            # a DEVICE-BOUND consumer (the block_until_ready shape): the
+            # controller's depth buys real overlap against it, and a spike
+            # that outruns depth-1 pipelining lands squarely on next()
+            step_s=0.02,
+        )
+
+
+def _chaos_breaker_probe(pattern: str) -> dict:
+    """Micro-measure of the blackout fast-fail: time-to-typed-error on a
+    permanently failing source through the retry ladder alone vs through
+    an OPEN breaker. The acceptance pin: breakered < 10% of un-breakered."""
+    import glob as _glob
+
+    from parquet_tpu.io import (
+        BreakerSource,
+        CircuitBreaker,
+        LocalFileSource,
+        RetryingSource,
+    )
+    from parquet_tpu.testing.flaky import FlakySource
+
+    path = sorted(_glob.glob(pattern))[0]
+
+    def t_read(src):
+        t0 = time.perf_counter()
+        try:
+            src.read_at(0, 64)
+        except OSError:
+            pass
+        return time.perf_counter() - t0
+
+    # the un-breakered shape: every read spins the full ladder (real
+    # backoff sleeps — that IS the cost being measured)
+    ladder = RetryingSource(
+        FlakySource(LocalFileSource(path), seed=5, permanent=True),
+        attempts=4, base_delay_s=0.02, max_delay_s=0.1, seed=5,
+    )
+    t_unbreakered = min(t_read(ladder) for _ in range(3))
+    ladder.close()
+    # the breakered shape: ladder under a breaker; trip it, then measure
+    # the steady-state fast-fail
+    breaker = CircuitBreaker("bench-blackout", failure_threshold=1, open_s=60.0)
+    gated = BreakerSource(
+        RetryingSource(
+            FlakySource(LocalFileSource(path), seed=5, permanent=True),
+            attempts=4, base_delay_s=0.02, max_delay_s=0.1, seed=5,
+        ),
+        breaker,
+    )
+    t_read(gated)  # trips the breaker (pays one full ladder)
+    t_breakered = min(t_read(gated) for _ in range(3))
+    gated.close()
+    return {
+        "time_to_error_ms": round(t_unbreakered * 1e3, 3),
+        "fast_fail_ms": round(t_breakered * 1e3, 3),
+        "fast_fail_ratio": round(t_breakered / t_unbreakered, 5),
+        "pin_under_10pct": t_breakered < 0.1 * t_unbreakered,
+    }
+
+
+def _chaos_serve_run(pattern: str, *, phase_s: float) -> dict:
+    """Hammer an in-process daemon while its sources run the fault
+    schedule: every response must be typed (2xx with a complete body, a
+    structured error body, or a torn stream ENDING in a typed terminator
+    record) — never a hang or a traceback. Brownout sheds and breaker
+    fast-fails are counted from the metrics delta."""
+    import glob as _glob
+    import http.client
+    import threading as _threading
+
+    from parquet_tpu.io import (
+        BreakerRegistry,
+        BreakerSource,
+        LocalFileSource,
+        RetryingSource,
+    )
+    from parquet_tpu.serve import ScanServer, ServeConfig
+    from parquet_tpu.testing.chaos import ChaosHarness, standard_schedule
+    from parquet_tpu.utils import metrics
+
+    files = sorted(_glob.glob(pattern))
+    root = str(Path(files[0]).parent)
+    names = [Path(f).name for f in files]
+    schedule = standard_schedule(
+        phase_s=phase_s * 0.5, spike_p=0.4, spike_ms=60.0, error_rate=0.4,
+        base={"latency_s": 0.001},
+    )
+    chaos = ChaosHarness(schedule, seed=23)
+    breakers = BreakerRegistry(failure_threshold=4, open_s=phase_s / 2)
+
+    def factory(p):
+        # the production resilience stack over the injected faults:
+        # breaker under a short retry ladder — the blackout phase trips
+        # the breaker, and the executor's fast-fail shows up as
+        # serve_shed_total{reason="breaker_open"} 503s
+        return RetryingSource(
+            BreakerSource(chaos.wrap(LocalFileSource(p)), registry=breakers),
+            attempts=2, base_delay_s=0.002, max_delay_s=0.01, seed=23,
+        )
+
+    config = ServeConfig(
+        port=0,
+        root=root,
+        cache_mb=0,  # chaos must hit the source, not the block cache
+        default_timeout_s=max(1.0, phase_s),
+        brownout_wait_ms=200.0,
+        brownout_window_s=max(0.25, phase_s / 4),
+        source_factory=factory,
+    )
+    statuses: dict = {}
+    anomalies = {"hang": 0, "untyped": 0, "torn_typed": 0}
+    lock = _threading.Lock()
+    snap0 = metrics.snapshot()
+    schedule.start(time.monotonic())
+    stop = time.monotonic() + schedule.total_s
+
+    def tally(key):
+        with lock:
+            statuses[key] = statuses.get(key, 0) + 1
+
+    def client(i: int):
+        body = json.dumps(
+            {"paths": [names[i % len(names)]], "format": "jsonl"}
+        )
+        while time.monotonic() < stop:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=max(10.0, 4 * phase_s)
+            )
+            try:
+                conn.request(
+                    "POST", "/v1/scan", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                try:
+                    payload = resp.read()
+                    complete = True
+                except http.client.IncompleteRead as e:
+                    payload, complete = e.partial, False
+                tally(str(resp.status))
+                if resp.status == 200 and not complete:
+                    # torn stream: acceptable ONLY with a typed terminator
+                    last = payload.rstrip(b"\n").rsplit(b"\n", 1)[-1]
+                    try:
+                        ok = "error" in json.loads(last)
+                    except ValueError:
+                        ok = False
+                    with lock:
+                        anomalies["torn_typed" if ok else "untyped"] += 1
+                elif resp.status != 200:
+                    try:
+                        json.loads(payload)["error"]["code"]
+                    except (ValueError, KeyError):
+                        with lock:
+                            anomalies["untyped"] += 1
+            except (TimeoutError, OSError):
+                with lock:
+                    anomalies["hang"] += 1
+            finally:
+                conn.close()
+
+    with ScanServer(config) as server:
+        server.start_background()
+        threads = [
+            _threading.Thread(target=client, args=(i,), daemon=True)
+            for i in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=schedule.total_s + 30.0)
+        hung_workers = sum(1 for t in threads if t.is_alive())
+    d = metrics.delta(snap0)
+    total = sum(statuses.values())
+    return {
+        "requests": total,
+        "statuses": statuses,
+        "torn_with_typed_terminator": anomalies["torn_typed"],
+        "untyped_responses": anomalies["untyped"],
+        "client_hangs": anomalies["hang"] + hung_workers,
+        "shed_queue_wait": d.get('serve_shed_total{reason="queue_wait"}', 0),
+        "shed_breaker_open": d.get('serve_shed_total{reason="breaker_open"}', 0),
+        "typed_only": anomalies["untyped"] == 0
+        and anomalies["hang"] + hung_workers == 0,
+    }
+
+
+def _phase_chaos() -> None:
+    """Graceful-degradation measurement: the scripted fault schedule
+    (latency spike -> error burst -> blackout -> recovery) against (a) the
+    SLO-controlled dataset pipeline vs the same pipeline uncontrolled,
+    (b) a breakered vs un-breakered blacked-out source, and (c) the serve
+    daemon under brownout. Emits the "chaos" --json section; the three
+    acceptance pins ride it as booleans. PQT_CHAOS_SMOKE=1 shrinks
+    everything to a make-check-sized smoke."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    pattern = _chaos_glob()
+    phase_s = 0.8 if CHAOS_SMOKE else CHAOS_PHASE_S
+    # the SLO sits between the healthy wait (~ms) and a raw 150 ms spike:
+    # absorbing a spike needs real depth/hedging, not luck
+    slo_ms = 100.0
+    controlled = _chaos_dataset_run(
+        pattern, slo_ms=slo_ms, phase_s=phase_s, controlled=True
+    )
+    uncontrolled = _chaos_dataset_run(
+        pattern, slo_ms=slo_ms, phase_s=phase_s, controlled=False
+    )
+    steady_c = controlled["phases"].get("spike_steady", {})
+    steady_u = uncontrolled["phases"].get("spike_steady", {})
+    hedges = controlled["hedge"]
+    launched = hedges.get("launched", 0)
+    breaker = _chaos_breaker_probe(pattern)
+    serve = _chaos_serve_run(pattern, phase_s=phase_s)
+    out = {
+        "config": "chaos",
+        "smoke": CHAOS_SMOKE,
+        "phase_s": phase_s,
+        "slo_ms": slo_ms,
+        "controlled": controlled,
+        "uncontrolled": uncontrolled,
+        "slo_held_controlled": (
+            steady_c.get("p99_ms") is not None
+            and steady_c["p99_ms"] <= slo_ms
+        ),
+        "slo_violated_uncontrolled": (
+            steady_u.get("p99_ms") is not None
+            and steady_u["p99_ms"] > slo_ms
+        ),
+        "hedge_win_rate": (
+            round(hedges.get("win_hedge", 0) / launched, 4) if launched else None
+        ),
+        "breaker": breaker,
+        "serve": serve,
+    }
+    log(
+        f"bench: chaos: spike-steady p99 {steady_c.get('p99_ms')} ms "
+        f"controlled vs {steady_u.get('p99_ms')} ms uncontrolled "
+        f"(slo {slo_ms} ms); breaker fast-fail "
+        f"{breaker['fast_fail_ratio']:.1%} of ladder; serve typed-only="
+        f"{serve['typed_only']} (shed {serve['shed_queue_wait']} brownout, "
+        f"{serve['shed_breaker_open']} breaker)"
+    )
+    _emit(out)
+
+
 _PHASE_FNS = {
     "host": decode_all_host,
     "tpu_host": decode_all_tpu_to_host,
@@ -1594,6 +1954,19 @@ def main() -> None:
                 f"({r_io['gap_speedup']:.2f}x over gap 0)"
             )
 
+    # chaos sweep (PQT_BENCH_CHAOS=0 to skip): the scripted fault schedule
+    # against the SLO-controlled pipeline, breaker fast-fail, serve brownout
+    r_chaos = None
+    if os.environ.get("PQT_BENCH_CHAOS", "1") != "0":
+        r_chaos = _run_phase("chaos")
+        if r_chaos:
+            log(
+                f"bench: chaos: slo held (controlled) = "
+                f"{r_chaos['slo_held_controlled']}, breaker fast-fail "
+                f"{r_chaos['breaker']['fast_fail_ratio']:.1%} of ladder, "
+                f"serve typed-only = {r_chaos['serve']['typed_only']}"
+            )
+
     # scan-service sweep (PQT_BENCH_SERVE=0 to skip): requests/s + p50/p99
     # at client concurrency 1/4/16 against a warm daemon, cold-vs-warm plan
     r_serve = None
@@ -1692,6 +2065,8 @@ def main() -> None:
         artifact["io"] = r_io
     if r_serve:
         artifact["serve"] = r_serve
+    if r_chaos:
+        artifact["chaos"] = r_chaos
     if r_asm:
         artifact["assembly"] = r_asm
     if results is not None:
@@ -1876,6 +2251,8 @@ if __name__ == "__main__":
         _phase_write()
     elif argv and argv[0] == "--serve":
         _phase_serve()
+    elif argv and argv[0] == "--chaos":
+        _phase_chaos()
     elif len(argv) >= 2 and argv[0] == "--phase":
         name = argv[1]
         if name.startswith("matrix"):
@@ -1892,6 +2269,8 @@ if __name__ == "__main__":
             _phase_io()
         elif name == "serve":
             _phase_serve()
+        elif name == "chaos":
+            _phase_chaos()
         elif name == "assembly":
             _phase_assembly()
         else:

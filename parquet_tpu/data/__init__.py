@@ -6,12 +6,14 @@ math) driven by a bounded prefetch-and-rebatch pipeline (dataset.py). See
 ParquetDataset for the full contract.
 """
 
+from .controller import AIMDController  # noqa: F401
 from .dataset import DatasetIterator, ParquetDataset  # noqa: F401
 from .plan import ScanPlan, Unit, build_plan, expand_paths  # noqa: F401
 
 __all__ = [
     "ParquetDataset",
     "DatasetIterator",
+    "AIMDController",
     "ScanPlan",
     "Unit",
     "build_plan",

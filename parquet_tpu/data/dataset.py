@@ -113,6 +113,8 @@ class ParquetDataset:
         device=None,
         cache_bytes: int = 0,
         readahead_bytes: int | None = None,
+        slo_wait_ms: float | None = None,
+        controller=None,
     ):
         if batch_size <= 0:
             raise ValueError("dataset: batch_size must be positive")
@@ -192,6 +194,24 @@ class ParquetDataset:
         # GROUP, and rebuilding the schema tree from thrift every unit is
         # pure waste when the footer is already cached on the plan
         self._schemas: dict[int, object] = {}
+        # elastic SLO: slo_wait_ms attaches an AIMD controller that scales
+        # prefetch depth / pqt-data workers / the readahead budget to keep
+        # consumer waits under the SLO. Advisory only — it never touches
+        # anything state_dict() depends on, so resume stays byte-identical.
+        # A pre-built AIMDController (controller=) wins, letting tests
+        # inject clocks and registries.
+        if controller is not None:
+            self._controller = controller
+        elif slo_wait_ms is not None:
+            from .controller import AIMDController
+
+            self._controller = AIMDController(
+                slo_wait_ms=slo_wait_ms,
+                initial_depth=max(1, self.prefetch),
+                max_depth=max(32, self.prefetch),
+            )
+        else:
+            self._controller = None
 
     @staticmethod
     def _resolve_split(spec, what: str) -> tuple[int, int]:
@@ -326,11 +346,34 @@ class ParquetDataset:
             if self._pool is None:
                 env = os.environ.get("PQT_DATA_THREADS")
                 cap = int(env) if env else (os.cpu_count() or 1)
-                workers = max(1, min(self.prefetch, cap))
+                if self._controller is not None:
+                    workers = self._controller.worker_target
+                else:
+                    workers = max(1, min(self.prefetch, cap))
                 self._pool = ThreadPoolExecutor(
                     max_workers=workers, thread_name_prefix="pqt-data"
                 )
             return self._pool
+
+    def _apply_controller_targets(self) -> None:
+        """Push the SLO controller's current targets onto the pool and the
+        readahead scheduler (called from the fetch loop after each control
+        tick). Worker growth takes effect on the next submit (the executor
+        spawns threads lazily up to _max_workers); shrink is lazy — extra
+        idle workers just park, and actual concurrency is already bounded
+        by the prefetch window."""
+        ctl = self._controller
+        if ctl is None:
+            return
+        pool = self._pool
+        if pool is not None:
+            w = ctl.worker_target
+            # _max_workers is the executor's documented-by-use sizing knob;
+            # there is no public resize API in the stdlib
+            if w != pool._max_workers:
+                pool._max_workers = w
+        if self._readahead is not None:
+            self._readahead.budget_bytes = ctl.readahead_budget
 
     def close(self) -> None:
         """Shut the prefetch pool down (idempotent). The dataset and its
@@ -585,7 +628,8 @@ class DatasetIterator:
         up to `prefetch` units ahead on the pqt-data pool."""
         ds = self._ds
         units = ds.plan.units
-        depth = ds.prefetch
+        ctl = ds._controller
+        depth = ds.prefetch if ctl is None else ctl.prefetch_target
         if depth <= 0:
             for k in range(start_pos, len(order)):
                 off = start_off if k == start_pos else 0
@@ -620,7 +664,12 @@ class DatasetIterator:
                     ds._readahead.schedule(unit.path, ranges)
 
         def fill():
-            nonlocal nxt
+            nonlocal nxt, depth
+            if ctl is not None:
+                # re-read the target each refill: the controller moves it
+                # between batches, and the window tracks it immediately —
+                # up (more submits now) or down (drain to the new bound)
+                depth = ctl.prefetch_target
             added = 0
             while nxt < len(order) and len(pending) < depth:
                 off = start_off if nxt == start_pos else 0
@@ -645,6 +694,8 @@ class DatasetIterator:
                 finally:
                     _inflight_add(-1)  # popped units always leave the gauge
                 _metrics.observe("dataset_wait_seconds", w.seconds)
+                if ctl is not None and ctl.tick():
+                    ds._apply_controller_targets()
                 fill()
                 if cols is not None and n > 0:
                     yield k, off, cols, n
@@ -705,6 +756,15 @@ class DatasetIterator:
                     p: self._batch_array(p, cd, reader.schema.column(p))
                     for p, cd in chunks.items()
                 }
+            except OSError:
+                # transport failure mid-decode (a retry ladder exhausted,
+                # a circuit breaker fast-failing a blacked-out source):
+                # under "skip"/"null" the unit quarantines exactly like a
+                # corrupt one — the stream degrades in typed, counted
+                # steps instead of killing the train loop
+                if ds.on_error == "raise":
+                    raise
+                return _skipped("io_failed")
             finally:
                 reader.close()
         lens = {a.shape[0] for a in cols.values()}

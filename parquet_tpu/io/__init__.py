@@ -8,6 +8,17 @@ and byte-budgeted block + footer caches. See each module's docstring.
 """
 
 from .cache import BlockCache, FooterCache, shared_footer_cache  # noqa: F401
+from .hedge import (  # noqa: F401
+    BreakerRegistry,
+    BreakerSource,
+    CircuitBreaker,
+    HedgedSource,
+    ResilienceConfig,
+    breaker_registry,
+    configure_resilience,
+    resilience_config,
+    wrap_resilient,
+)
 from .planner import (  # noqa: F401
     DEFAULT_COALESCE_GAP,
     Readahead,
@@ -45,4 +56,13 @@ __all__ = [
     "Readahead",
     "io_pool",
     "DEFAULT_COALESCE_GAP",
+    "HedgedSource",
+    "CircuitBreaker",
+    "BreakerRegistry",
+    "BreakerSource",
+    "breaker_registry",
+    "ResilienceConfig",
+    "configure_resilience",
+    "resilience_config",
+    "wrap_resilient",
 ]

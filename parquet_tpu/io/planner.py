@@ -258,13 +258,17 @@ class Readahead:
         return True
 
     def _fetch(self, source_or_path, ranges, total) -> None:
-        from .source import LocalFileSource
+        from .source import open_source
 
         try:
-            owned = isinstance(source_or_path, (str, os.PathLike))
-            src = (
-                LocalFileSource(source_or_path) if owned else source_or_path
-            )
+            # paths open through open_source so readahead reads inherit the
+            # same resilience policy (breaker/retry/hedge) decode does — a
+            # blacked-out source must not keep burning pqt-io on fetches
+            # decode would fast-fail
+            if isinstance(source_or_path, (str, os.PathLike)):
+                src, owned = open_source(os.fspath(source_or_path))
+            else:
+                src, owned = source_or_path, False
             try:
                 fetch_ranges(src, ranges, cache=self.cache, gap=self.gap)
                 _metrics.inc("io_readahead_fetched_total")

@@ -58,11 +58,18 @@ __all__ = [
 
 class SourceError(OSError):
     """Terminal IO failure of a byte source: the read is not satisfiable
-    (range past EOF, retry budget exhausted, source closed). An OSError
-    subclass so callers treating IO failures generically (the dataset
-    layer's skip policy) need no new clause — but typed, so tests can pin
-    that the retry ladder converted a transient fault storm into exactly
-    this, never a raw errno leak."""
+    (range past EOF, retry budget exhausted, source closed, circuit
+    breaker open). An OSError subclass so callers treating IO failures
+    generically (the dataset layer's skip policy) need no new clause — but
+    typed, so tests can pin that the retry ladder converted a transient
+    fault storm into exactly this, never a raw errno leak. `code` is an
+    optional stable discriminator ("breaker_open") layers above branch on
+    — the serve executor turns breaker fast-fails into 503s instead of
+    422s with it."""
+
+    def __init__(self, *args, code: str | None = None):
+        super().__init__(*args)
+        self.code = code
 
 
 def _count_read(nbytes: int) -> None:
@@ -367,7 +374,8 @@ class RetryingSource(ByteSource):
         raise SourceError(
             f"read of {n} bytes at {offset} failed after "
             f"{min(attempt + 1, self.attempts)} attempt(s) "
-            f"[last: {reason}]"
+            f"[last: {reason}]",
+            code="retry_exhausted",
         ) from last
 
     def read_ranges(self, ranges) -> list:
@@ -426,6 +434,16 @@ class SourceFile:
         pass
 
 
+def _wrap_policy(source: ByteSource) -> ByteSource:
+    """Apply the installed resilience policy (io.hedge: chaos wrapper,
+    circuit breaker, retry ladder, hedged reads) to a source open_source
+    just CONSTRUCTED. The default policy is all-off and this is the
+    identity; lazy import keeps source.py <-> hedge.py acyclic."""
+    from .hedge import wrap_resilient
+
+    return wrap_resilient(source)
+
+
 def open_source(obj) -> tuple[ByteSource, bool]:
     """Coerce `obj` into a (ByteSource, owns) pair — the FileReader
     constructor's one entry point for every accepted source shape.
@@ -435,16 +453,22 @@ def open_source(obj) -> tuple[ByteSource, bool]:
       io.BytesIO            -> MemorySource snapshot (owned)
       ByteSource            -> passed through        (caller keeps lifetime)
       seekable file-like    -> FileObjectSource      (caller keeps lifetime)
-    """
+
+    Sources this function CONSTRUCTS additionally pass through the
+    process resilience policy (io.hedge.configure_resilience): with a
+    policy installed, every reader/dataset/daemon open inherits breakers,
+    retries and hedging here, with no per-callsite wiring. Pre-built
+    ByteSource and file-like objects pass through untouched — an explicit
+    stack is the caller's to compose."""
     if isinstance(obj, ByteSource):
         return obj, False
     if isinstance(obj, (str, Path)):
-        return LocalFileSource(obj), True
+        return _wrap_policy(LocalFileSource(obj)), True
     if isinstance(obj, (bytes, bytearray, memoryview)):
-        return MemorySource(obj), True
+        return _wrap_policy(MemorySource(obj)), True
     if isinstance(obj, _io.BytesIO):
         # snapshot: decouples decode from later caller mutation of the BytesIO
-        return MemorySource(obj.getvalue()), True
+        return _wrap_policy(MemorySource(obj.getvalue())), True
     if hasattr(obj, "read_at") and hasattr(obj, "size"):
         return obj, False  # duck-typed source (custom remote implementations)
     if hasattr(obj, "read") and hasattr(obj, "seek"):

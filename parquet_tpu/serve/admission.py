@@ -16,12 +16,22 @@ a thread on them:
     body `timeout_ms` override) — cooperative cancellation points in the
     executor check it between units and every few thousand rows, so an
     expired request frees its slot instead of scanning to the end;
+  * brownout — when the pqt-serve pool's windowed mean queue wait crosses
+    `brownout_wait_s` (or its queue depth crosses `brownout_depth`), NEW
+    requests shed with a typed 503 `brownout` + Retry-After BEFORE they
+    join the pile-up. Shedding early is the point: without it every
+    admitted request queues until the deadline sweeps them all into 504s
+    at once — clients get no signal to back off until the worst moment.
+    Sheds count serve_shed_total{reason="queue_wait"};
   * graceful drain — `begin_drain()` (the SIGTERM path) rejects NEW
     requests with a typed 503 `draining` while in-flight ones run to
     completion; `wait_drained()` tells the server when the last one left.
 
 Everything here is clock-injectable (tests pin time) and updates the
-always-on registry: `serve_queue_depth` gauge tracks in-flight requests.
+always-on registry: `serve_queue_depth` gauge tracks in-flight requests;
+brownout reads the PR 9 pool_queue_wait_seconds{pool="pqt-serve"}
+histogram back OUT of the registry (windowed deltas) as its pressure
+signal.
 """
 
 from __future__ import annotations
@@ -122,7 +132,11 @@ class AdmissionController:
         default_timeout_s: float | None = 30.0,
         max_timeout_s: float = 300.0,
         max_tenants: int = 1024,
+        brownout_wait_s: float | None = None,
+        brownout_depth: int | None = None,
+        brownout_window_s: float = 2.0,
         clock=time.monotonic,
+        registry=None,
     ):
         if max_inflight <= 0:
             raise ValueError("admission: max_inflight must be positive")
@@ -132,6 +146,12 @@ class AdmissionController:
             raise ValueError("admission: budget_window_s must be positive")
         if max_tenants <= 0:
             raise ValueError("admission: max_tenants must be positive")
+        if brownout_wait_s is not None and brownout_wait_s <= 0:
+            raise ValueError("admission: brownout_wait_s must be positive")
+        if brownout_depth is not None and brownout_depth <= 0:
+            raise ValueError("admission: brownout_depth must be positive")
+        if brownout_window_s <= 0:
+            raise ValueError("admission: brownout_window_s must be positive")
         self.max_inflight = int(max_inflight)
         self.tenant_concurrent = int(tenant_concurrent)
         self.tenant_budget_bytes = tenant_budget_bytes
@@ -139,6 +159,14 @@ class AdmissionController:
         self.default_timeout_s = default_timeout_s
         self.max_timeout_s = float(max_timeout_s)
         self.max_tenants = int(max_tenants)
+        self.brownout_wait_s = brownout_wait_s
+        self.brownout_depth = brownout_depth
+        self.brownout_window_s = float(brownout_window_s)
+        self._registry = registry if registry is not None else _metrics.REGISTRY
+        # windowed brownout state: last pqt-serve queue-wait totals + when
+        # they were read, and the verdict cached between windows
+        self._bw_last: tuple[float, int, float] | None = None  # (t, count, sum)
+        self._bw_hot = False
         self._clock = clock
         self._lock = threading.Lock()
         self._drained = threading.Condition(self._lock)
@@ -201,6 +229,44 @@ class AdmissionController:
         with self._lock:
             return self._draining
 
+    # -- brownout --------------------------------------------------------------
+
+    def _brownout_hot(self) -> bool:
+        """Is the pqt-serve pool under brownout pressure right now?
+
+        Evaluated at most once per brownout_window_s (the verdict is cached
+        between windows — admissions must not pay a histogram read each):
+        hot when the windowed MEAN queue wait crosses brownout_wait_s, or
+        the instantaneous queue depth crosses brownout_depth (the wedged-
+        pool case, where no task starts so no new wait is ever observed).
+        Called with self._lock held."""
+        now = self._clock()
+        if self._bw_last is None:
+            h = self._registry.hist_stats(
+                "pool_queue_wait_seconds", pool="pqt-serve"
+            )
+            self._bw_last = (now, h["count"], h["sum"])
+            return False
+        t0, c0, s0 = self._bw_last
+        if now - t0 >= self.brownout_window_s:
+            h = self._registry.hist_stats(
+                "pool_queue_wait_seconds", pool="pqt-serve"
+            )
+            self._bw_last = (now, h["count"], h["sum"])
+            d_count = h["count"] - c0
+            d_sum = h["sum"] - s0
+            mean = (d_sum / d_count) if d_count else 0.0
+            self._bw_hot = (
+                self.brownout_wait_s is not None
+                and d_count > 0
+                and mean > self.brownout_wait_s
+            )
+        if not self._bw_hot and self.brownout_depth is not None:
+            depth = self._registry.get("pool_queue_depth", pool="pqt-serve")
+            if depth > self.brownout_depth:
+                return True
+        return self._bw_hot
+
     def admit(self, tenant: str) -> Ticket:
         """Claim a slot for `tenant` or raise the typed rejection."""
         try:
@@ -209,6 +275,17 @@ class AdmissionController:
                     raise ServeError(
                         503, "draining",
                         "daemon is draining; retry another replica",
+                    )
+                if (
+                    self.brownout_wait_s is not None
+                    or self.brownout_depth is not None
+                ) and self._brownout_hot():
+                    _metrics.inc("serve_shed_total", reason="queue_wait")
+                    raise ServeError(
+                        503, "brownout",
+                        "daemon is shedding load (scan queue wait over the "
+                        "brownout threshold); retry after backoff",
+                        retry_after_s=max(1, int(self.brownout_window_s)),
                     )
                 if self._inflight >= self.max_inflight:
                     raise ServeError(

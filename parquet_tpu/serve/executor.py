@@ -36,6 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as _FutTimeout
 
 from ..core.reader import PARQUET_ERRORS, FileReader
+from ..io.source import SourceError
 from ..obs.pool import instrumented_submit
 from ..utils import metrics as _metrics
 from ..utils.trace import stage
@@ -185,14 +186,46 @@ def _pipelined(units, run_one, window: int, check: "_Check"):
 def _wrap_decode_errors(gen):
     """Typed-error discipline at the execution boundary: a corrupt file
     surfaces as a ServeError (422) the server renders structurally, never
-    a raw decode exception unwinding the handler."""
+    a raw decode exception unwinding the handler. A circuit breaker's
+    fast-fail (SourceError code="breaker_open" — the source is KNOWN dark)
+    becomes a 503 with Retry-After instead: the file is fine, the
+    transport is down, and the client should back off rather than re-ask —
+    and the unit fails in microseconds instead of burning its deadline on
+    a retry ladder that cannot succeed. Counted
+    serve_shed_total{reason="breaker_open"}."""
     try:
         yield from gen
     except ServeError:
         raise
+    except SourceError as e:
+        code = getattr(e, "code", None)
+        if code == "breaker_open":
+            _metrics.inc("serve_shed_total", reason="breaker_open")
+            raise ServeError(
+                503, "source_unavailable",
+                f"source circuit breaker open: {e}", retry_after_s=1,
+            ) from None
+        if code == "retry_exhausted":
+            # the ladder gave up on a TRANSIENT fault storm: the file is
+            # not wrong, the transport is — same 503 + Retry-After shape
+            # the raw OSError below gets, not a permanent-looking 422
+            raise ServeError(
+                503, "source_error", f"{type(e).__name__}: {e}",
+                retry_after_s=1,
+            ) from None
+        raise ServeError(
+            422, "unreadable_file", f"{type(e).__name__}: {e}"
+        ) from None
     except PARQUET_ERRORS as e:
         raise ServeError(
             422, "unreadable_file", f"{type(e).__name__}: {e}"
+        ) from None
+    except OSError as e:
+        # a raw transport fault (EIO from a flaky store, a vanished mount)
+        # is the DAEMON's environment failing, not the request: 503 +
+        # Retry-After, not a 500 that reads as a server bug
+        raise ServeError(
+            503, "source_error", f"{type(e).__name__}: {e}", retry_after_s=1
         ) from None
 
 

@@ -68,6 +68,14 @@ class ServeConfig:
     budget_window_s: float = 60.0
     default_timeout_s: float | None = 30.0
     max_timeout_s: float = 300.0
+    # brownout: shed NEW scans with typed 503s + Retry-After once the
+    # pqt-serve pool's windowed mean queue wait crosses brownout_wait_ms
+    # (or its queue depth crosses brownout_depth) — degrade loudly and
+    # early instead of admitting work that will only 504 later. None
+    # disables (the default: an explicitly sized deployment opts in).
+    brownout_wait_ms: float | None = None
+    brownout_depth: int | None = None
+    brownout_window_s: float = 2.0
     window: int = 2  # per-request unit lookahead (backpressure bound)
     # request bodies are small JSON specs; a client-declared Content-Length
     # is rejected with a typed 413 past this, BEFORE any bytes are buffered
@@ -106,6 +114,16 @@ class ServeConfig:
             )
         if self.max_timeout_s <= 0:
             raise ValueError("serve: max_timeout_s must be positive")
+        if self.brownout_wait_ms is not None and self.brownout_wait_ms <= 0:
+            raise ValueError(
+                "serve: brownout_wait_ms must be positive (None disables)"
+            )
+        if self.brownout_depth is not None and self.brownout_depth <= 0:
+            raise ValueError(
+                "serve: brownout_depth must be positive (None disables)"
+            )
+        if self.brownout_window_s <= 0:
+            raise ValueError("serve: brownout_window_s must be positive")
         # delegate the obs-knob validation to the one place that owns it
         _ObsConfig(
             ring_size=self.debug_ring_size,
@@ -140,6 +158,13 @@ class ScanService:
             budget_window_s=config.budget_window_s,
             default_timeout_s=config.default_timeout_s,
             max_timeout_s=config.max_timeout_s,
+            brownout_wait_s=(
+                config.brownout_wait_ms / 1e3
+                if config.brownout_wait_ms is not None
+                else None
+            ),
+            brownout_depth=config.brownout_depth,
+            brownout_window_s=config.brownout_window_s,
         )
         # the PROCESS-wide flight recorder, configured with this daemon's
         # knobs: library records (dataset units, encode groups) land in

@@ -24,6 +24,8 @@ from ..core.reader import PARQUET_ERRORS, resolve_column_prefixes
 from ..core.schema import Schema
 from ..data.plan import ScanPlan, build_plan, expand_paths
 from ..io.cache import BlockCache, FooterCache
+from ..io.source import SourceError
+from ..utils import metrics as _metrics
 from ..utils.trace import span
 from .protocol import ScanRequest, ServeError
 
@@ -159,6 +161,27 @@ class ScanSession:
             except ServeError:
                 raise
             except PARQUET_ERRORS as e:
+                raise ServeError(
+                    422, "unreadable_file", f"{type(e).__name__}: {e}"
+                ) from None
+            except SourceError as e:
+                # a breaker fast-fail during (cold) footer reads: the file
+                # is not wrong, the transport is dark — 503 + Retry-After,
+                # and the plan failed in microseconds instead of spinning
+                # a retry ladder per footer
+                code = getattr(e, "code", None)
+                if code == "breaker_open":
+                    _metrics.inc("serve_shed_total", reason="breaker_open")
+                    raise ServeError(
+                        503, "source_unavailable",
+                        f"source circuit breaker open: {e}",
+                        retry_after_s=1,
+                    ) from None
+                if code == "retry_exhausted":
+                    raise ServeError(
+                        503, "source_error", f"{type(e).__name__}: {e}",
+                        retry_after_s=1,
+                    ) from None
                 raise ServeError(
                     422, "unreadable_file", f"{type(e).__name__}: {e}"
                 ) from None

@@ -3,9 +3,10 @@
 The fault-injection harness in testing/faults.py corrupts BYTES (what a
 rotten disk or lying writer produces); this module corrupts the TRANSPORT —
 what a loaded object store or flaky NFS mount produces: transient EIO,
-short reads, injected latency, and (optionally) permanent failure. Wrapped
-around any ByteSource and driven from an integer seed, it gives the retry
-ladder (io.source.RetryingSource) a deterministic adversary:
+short reads, injected latency, latency spikes, and (optionally) permanent
+failure. Wrapped around any ByteSource and driven from an integer seed, it
+gives the retry ladder (io.source.RetryingSource) a deterministic
+adversary:
 
     src = RetryingSource(
         FlakySource(LocalFileSource(path), seed=7, error_rate=0.3),
@@ -18,10 +19,20 @@ chance to succeed — the transient-fault shape. `fault_window` confines
 faults to a byte region (e.g. only the footer tail); `permanent=True` makes
 every read fail, the budget-exhaustion shape.
 
+`schedule=` accepts a testing.chaos.FaultSchedule (anything with a
+`params_at(t)` -> dict): each operation reads the schedule's CURRENT phase
+parameters at the injected `clock` and overlays them on the constructor
+knobs — the chaos harness drives a whole latency-spike -> error-burst ->
+blackout -> recovery timeline through one wrapper, deterministically under
+fake time (advance the fake clock, the phase changes; the rng stream stays
+one seeded sequence either way).
+
 FlakySink is the WRITE-side mirror: wrapped around any ByteSink it injects
-seeded write/flush/commit faults, the adversary for the FileWriter error
-path — flush failures must surface as typed WriterError and, because path
-sinks commit atomically, the destination must never hold a torn file:
+seeded write/flush/commit faults (including latency spikes, the same knobs
+and `latency_spike` preset as FlakySource), the adversary for the
+FileWriter error path — flush failures must surface as typed WriterError
+and, because path sinks commit atomically, the destination must never hold
+a torn file:
 
     sink = FlakySink(LocalFileSink(path), seed=7, error_rate=0.3)
     with pytest.raises(WriterError):
@@ -37,6 +48,18 @@ import time
 import numpy as np
 
 __all__ = ["FlakySource", "FlakySink"]
+
+# the knobs a FaultSchedule phase may override, shared by source and sink
+# (unknown keys in a phase are rejected by the schedule, not silently
+# ignored here — see testing.chaos.Phase)
+_SOURCE_KNOBS = (
+    "error_rate", "short_rate", "latency_s", "latency_jitter_s",
+    "spike_rate", "spike_s", "permanent",
+)
+_SINK_KNOBS = (
+    "error_rate", "flush_error_rate", "latency_s", "spike_rate", "spike_s",
+    "permanent",
+)
 
 
 class FlakySource:
@@ -57,6 +80,10 @@ class FlakySource:
     permanent    every read fails with EIO — the budget-exhaustion case
     fault_window (offset, length) confining faults to reads that overlap
                  the window (None = everywhere)
+    schedule     a FaultSchedule whose current phase overrides the knobs
+                 above per operation (chaos timelines)
+    clock        the schedule's time base (injectable: fake time makes
+                 chaos phases deterministic)
     """
 
     def __init__(
@@ -72,6 +99,8 @@ class FlakySource:
         spike_s: float = 0.0,
         permanent: bool = False,
         fault_window: tuple[int, int] | None = None,
+        schedule=None,
+        clock=time.monotonic,
         sleep=time.sleep,
     ):
         self.inner = inner
@@ -84,6 +113,8 @@ class FlakySource:
         self.spike_s = float(spike_s)
         self.permanent = bool(permanent)
         self.fault_window = fault_window
+        self.schedule = schedule
+        self._clock = clock
         self._sleep = sleep
         self.faults_injected = 0
         self.reads = 0
@@ -111,29 +142,42 @@ class FlakySource:
         w_off, w_len = self.fault_window
         return offset < w_off + w_len and offset + n > w_off
 
+    def _params(self) -> dict:
+        """The effective knobs for THIS operation: the constructor values,
+        overlaid with the schedule's current phase when one is attached."""
+        p = {k: getattr(self, k) for k in _SOURCE_KNOBS}
+        if self.schedule is not None:
+            p.update(
+                (k, v)
+                for k, v in self.schedule.params_at(self._clock()).items()
+                if k in p
+            )
+        return p
+
     def read_at(self, offset: int, n: int) -> bytes:
         self.reads += 1
-        if self.latency_s or self.latency_jitter_s:
+        p = self._params()
+        if p["latency_s"] or p["latency_jitter_s"]:
             extra = (
-                float(self._rng.uniform(0, self.latency_jitter_s))
-                if self.latency_jitter_s
+                float(self._rng.uniform(0, p["latency_jitter_s"]))
+                if p["latency_jitter_s"]
                 else 0.0
             )
-            self._sleep(self.latency_s + extra)
+            self._sleep(p["latency_s"] + extra)
         # spikes draw only when enabled so existing seeds' fault streams
         # are unchanged by the knob's existence
-        if self.spike_rate and float(self._rng.random()) < self.spike_rate:
+        if p["spike_rate"] and float(self._rng.random()) < p["spike_rate"]:
             self.spikes_injected += 1
-            self._sleep(self.spike_s)
+            self._sleep(p["spike_s"])
         if self._in_window(offset, n):
-            if self.permanent:
+            if p["permanent"]:
                 self.faults_injected += 1
                 raise OSError(_errno.EIO, f"injected permanent EIO at {offset}")
             roll = float(self._rng.random())
-            if roll < self.error_rate:
+            if roll < p["error_rate"]:
                 self.faults_injected += 1
                 raise OSError(_errno.EIO, f"injected transient EIO at {offset}")
-            if roll < self.error_rate + self.short_rate and n > 1:
+            if roll < p["error_rate"] + p["short_rate"] and n > 1:
                 self.faults_injected += 1
                 cut = int(self._rng.integers(0, n))
                 return self.inner.read_at(offset, cut)
@@ -170,7 +214,13 @@ class FlakySink:
     commit_error     close() (the commit) raises OSError(EIO) — the
                      rename-fails shape; abort stays clean
     latency_s        fixed sleep added to every write (the PUT shape)
+    spike_rate       probability a write stalls an EXTRA spike_s — the
+                     stalled-PUT / throttled-store shape (see the
+                     latency_spike preset, FlakySource parity)
     permanent        every write fails with EIO
+    schedule         a FaultSchedule whose current phase overrides the
+                     knobs above per operation (chaos timelines)
+    clock            the schedule's time base (injectable)
     """
 
     def __init__(
@@ -183,7 +233,11 @@ class FlakySink:
         flush_error_rate: float = 0.0,
         commit_error: bool = False,
         latency_s: float = 0.0,
+        spike_rate: float = 0.0,
+        spike_s: float = 0.0,
         permanent: bool = False,
+        schedule=None,
+        clock=time.monotonic,
         sleep=time.sleep,
     ):
         self.inner = inner
@@ -193,21 +247,47 @@ class FlakySink:
         self.flush_error_rate = float(flush_error_rate)
         self.commit_error = bool(commit_error)
         self.latency_s = float(latency_s)
+        self.spike_rate = float(spike_rate)
+        self.spike_s = float(spike_s)
         self.permanent = bool(permanent)
+        self.schedule = schedule
+        self._clock = clock
         self._sleep = sleep
         self.faults_injected = 0
         self.writes = 0
         self.bytes_written = 0
+        self.spikes_injected = 0
+
+    @classmethod
+    def latency_spike(cls, inner, *, seed: int = 0, p: float = 0.05, ms: float = 50.0, **kw):
+        """Preset: a sink whose writes occasionally STALL — each write has
+        probability `p` of an extra `ms`-millisecond spike (seeded). The
+        FlakySource.latency_spike mirror for the encode/flush pipeline."""
+        return cls(inner, seed=seed, spike_rate=p, spike_s=ms / 1e3, **kw)
 
     @property
     def sink_id(self) -> str:
         return self.inner.sink_id
 
+    def _params(self) -> dict:
+        p = {k: getattr(self, k) for k in _SINK_KNOBS}
+        if self.schedule is not None:
+            p.update(
+                (k, v)
+                for k, v in self.schedule.params_at(self._clock()).items()
+                if k in p
+            )
+        return p
+
     def write(self, data) -> int:
         self.writes += 1
-        if self.latency_s:
-            self._sleep(self.latency_s)
-        if self.permanent:
+        p = self._params()
+        if p["latency_s"]:
+            self._sleep(p["latency_s"])
+        if p["spike_rate"] and float(self._rng.random()) < p["spike_rate"]:
+            self.spikes_injected += 1
+            self._sleep(p["spike_s"])
+        if p["permanent"]:
             self.faults_injected += 1
             raise OSError(_errno.EIO, "injected permanent EIO on write")
         if (
@@ -219,7 +299,7 @@ class FlakySink:
                 _errno.ENOSPC,
                 f"injected write failure past {self.fail_after_bytes} bytes",
             )
-        if self.error_rate and float(self._rng.random()) < self.error_rate:
+        if p["error_rate"] and float(self._rng.random()) < p["error_rate"]:
             self.faults_injected += 1
             raise OSError(
                 _errno.EIO, f"injected transient EIO at write {self.writes}"
@@ -232,7 +312,8 @@ class FlakySink:
         return self.inner.tell()
 
     def flush(self) -> None:
-        if self.flush_error_rate and float(self._rng.random()) < self.flush_error_rate:
+        rate = self._params()["flush_error_rate"]
+        if rate and float(self._rng.random()) < rate:
             self.faults_injected += 1
             raise OSError(_errno.EIO, "injected EIO on flush")
         self.inner.flush()

@@ -754,6 +754,9 @@ def cmd_scan(args) -> int:
         on_error=args.on_error,
         nullable=args.nullable,
         cache_bytes=args.cache_mb << 20,
+        # --slo-ms doubles as the controller opt-in: the gate measures the
+        # ADAPTIVE pipeline, the same thing production would run
+        slo_wait_ms=args.slo_ms,
     )
     plan = ds.plan
     for path, why in plan.skipped_files:
@@ -772,9 +775,17 @@ def cmd_scan(args) -> int:
         )
     snap0 = metrics.snapshot()
     rows = batches = 0
+    waits = []  # per-batch next() wall: the --slo-ms gate's percentiles
     t0 = time.perf_counter()
     with ds:
-        for batch in ds:
+        it = iter(ds)
+        while True:
+            tb = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            waits.append(time.perf_counter() - tb)
             first = next(iter(batch.values()))
             rows += int(first.shape[0])
             batches += 1
@@ -809,6 +820,18 @@ def cmd_scan(args) -> int:
     if hit_rate is not None:
         io_line += f", cache hit rate {hit_rate:.1%}"
     print(io_line)
+    slo = None
+    if args.slo_ms is not None:
+        from ..testing.chaos import percentile
+
+        p50 = (percentile(waits, 0.50) or 0.0) * 1e3
+        p99 = (percentile(waits, 0.99) or 0.0) * 1e3
+        slo = {
+            "slo_ms": args.slo_ms,
+            "p50_wait_ms": round(p50, 3),
+            "p99_wait_ms": round(p99, 3),
+            "held": p99 <= args.slo_ms,
+        }
     if args.json:
         print(
             json.dumps(
@@ -829,9 +852,20 @@ def cmd_scan(args) -> int:
                         round(hit_rate, 4) if hit_rate is not None else None
                     ),
                     "pruning": plan.pruning_summary(),
+                    **({"slo": slo} if slo is not None else {}),
                 }
             )
         )
+    if slo is not None:
+        # the CI gate: ONE line either way, non-zero exit on a violation
+        verdict = "held" if slo["held"] else "VIOLATED"
+        print(
+            f"scan: slo {verdict}: p99 wait {slo['p99_wait_ms']:.2f} ms "
+            f"(p50 {slo['p50_wait_ms']:.2f} ms) vs slo {args.slo_ms:.2f} ms "
+            f"over {batches} batches"
+        )
+        if not slo["held"]:
+            return 1
     return 0
 
 
@@ -858,6 +892,8 @@ def cmd_serve(args) -> int:
         budget_window_s=args.budget_window_s,
         default_timeout_s=(None if args.timeout_s == 0 else args.timeout_s),
         max_timeout_s=args.max_timeout_s,
+        brownout_wait_ms=args.brownout_wait_ms,
+        brownout_depth=args.brownout_depth,
         window=args.window,
         socket_timeout_s=args.socket_timeout_s,
         shard=_parse_shard(args.shard),
@@ -1118,6 +1154,14 @@ def main(argv=None) -> int:
     pn.add_argument(
         "--json", action="store_true", help="also print a JSON result line"
     )
+    pn.add_argument(
+        "--slo-ms",
+        type=float,
+        default=None,
+        help="latency gate: attach the elastic-SLO controller, then exit "
+        "non-zero (one-line report) when the p99 per-batch consumer wait "
+        "exceeds this many milliseconds — CI-able",
+    )
     pn.set_defaults(fn=cmd_scan)
 
     pe = sub.add_parser(
@@ -1174,6 +1218,21 @@ def main(argv=None) -> int:
         "body timeout_ms override, clamped to --max-timeout-s)",
     )
     pe.add_argument("--max-timeout-s", type=float, default=300.0)
+    pe.add_argument(
+        "--brownout-wait-ms",
+        type=float,
+        default=None,
+        help="shed NEW scans with typed 503s (+Retry-After) once the scan "
+        "pool's windowed mean queue wait crosses this — degrade early and "
+        "loudly instead of mass-504ing later (default: disabled)",
+    )
+    pe.add_argument(
+        "--brownout-depth",
+        type=int,
+        default=None,
+        help="also shed when the scan pool's queue depth crosses this "
+        "(catches a fully wedged pool that produces no new wait samples)",
+    )
     pe.add_argument(
         "--socket-timeout-s",
         type=float,

@@ -100,6 +100,27 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
                                     handler attached)
   log_suppressed_total{event=}      events the per-key token-bucket rate
                                     limiter absorbed
+  dataset_prefetch_target           gauge: the elastic-SLO controller's
+                                    current prefetch-depth target (the
+                                    dataset_prefetch_depth gauge shows
+                                    what is actually in flight)
+  dataset_slo_violations_total      consumer-wait observations that
+                                    exceeded the dataset's configured
+                                    slo_wait_ms
+  io_hedges_total{outcome=}         hedged duplicate reads: "launched"
+                                    when a read outlives the latency-
+                                    quantile bar, then "win_primary" /
+                                    "win_hedge" / "failed" for how the
+                                    race resolved
+  io_breaker_state{source=}         gauge: circuit-breaker state per
+                                    source (0 closed, 1 open, 2 half-
+                                    open); the label set is bounded by
+                                    BreakerRegistry.max_sources
+  serve_shed_total{reason=}         requests the daemon shed before
+                                    spending execution on them
+                                    ("queue_wait" = brownout on pqt-serve
+                                    queue pressure, "breaker_open" = a
+                                    blacked-out source fast-failed)
 
 Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as
@@ -214,6 +235,11 @@ _HELP = {
     "obs_ring_records": "flight-recorder ring occupancy",
     "log_events_total": "structured log events emitted, per event key",
     "log_suppressed_total": "log events absorbed by the rate limiter, per event key",
+    "dataset_prefetch_target": "the SLO controller's current prefetch-depth target",
+    "dataset_slo_violations_total": "consumer waits that exceeded the configured SLO",
+    "io_hedges_total": "hedged-read outcomes (launched, win_primary, win_hedge, failed)",
+    "io_breaker_state": "circuit-breaker state per source (0 closed, 1 open, 2 half-open)",
+    "serve_shed_total": "requests shed before execution, per reason",
 }
 
 
@@ -273,6 +299,29 @@ class MetricsRegistry:
             if h is None:
                 h = self._hists[key] = _Hist()
             h.observe(value)
+
+    def hist_stats(self, name: str, **labels) -> dict:
+        """One histogram's running totals — {"count", "sum", "buckets",
+        "bucket_counts"} — without paying for a full snapshot(). The cheap
+        windowed-delta feed for feedback controllers (the SLO controller
+        polls this every control window); a never-observed histogram
+        returns zeros over the default buckets."""
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                return {
+                    "count": 0,
+                    "sum": 0.0,
+                    "buckets": tuple(_DEFAULT_BUCKETS),
+                    "bucket_counts": [0] * len(_DEFAULT_BUCKETS),
+                }
+            return {
+                "count": h.count,
+                "sum": h.total,
+                "buckets": tuple(h.buckets),
+                "bucket_counts": list(h.bucket_counts),
+            }
 
     # -- read side -------------------------------------------------------------
 

@@ -1,0 +1,318 @@
+"""parquet_tpu.data.controller: the elastic-SLO AIMD controller.
+
+Pinned here:
+  * the fake-clock step response: a wait spike grows the prefetch target
+    within k control windows (additive), a sustained idle decays it
+    (multiplicative), mixed traffic holds;
+  * no traffic = no movement (windowed deltas of zero observations);
+  * dataset_slo_violations_total counts over-SLO observations;
+  * dataset wiring: slo_wait_ms attaches a controller, targets reach the
+    pool and the fill loop, and — the stream contract — the delivered
+    batch stream and checkpoint/resume stay BYTE-IDENTICAL with the
+    controller on, off, or mid-adaptation;
+  * parquet-tool scan --slo-ms: the CI gate passes on a generous SLO and
+    exits non-zero (one-line report) on an impossible one.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.data import AIMDController, ParquetDataset
+from parquet_tpu.utils import metrics
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_controller(**kw):
+    clock = FakeClock()
+    reg = metrics.MetricsRegistry()
+    kw.setdefault("slo_wait_ms", 10.0)
+    kw.setdefault("window_s", 1.0)
+    kw.setdefault("initial_depth", 2)
+    kw.setdefault("max_workers", 8)
+    ctl = AIMDController(clock=clock, registry=reg, **kw)
+    return ctl, clock, reg
+
+
+def tick_window(ctl, clock, step=1.0):
+    clock.t += step
+    return ctl.tick()
+
+
+class TestControlLaw:
+    def test_arms_then_evaluates_per_window(self):
+        ctl, clock, reg = make_controller()
+        assert ctl.tick() is False  # arming
+        assert ctl.tick() is False  # same window
+        clock.t += 0.5
+        assert ctl.tick() is False  # window not elapsed
+        clock.t += 0.6
+        assert ctl.tick() is True
+        assert ctl.ticks == 1
+
+    def test_spike_grows_depth_within_k_ticks(self):
+        ctl, clock, reg = make_controller(initial_depth=1, increase_step=1)
+        ctl.tick()  # arm
+        for k in range(4):
+            # every window: waits far over the 10 ms SLO
+            for _ in range(20):
+                reg.observe("dataset_wait_seconds", 0.05)
+            tick_window(ctl, clock)
+        assert ctl.prefetch_target == 1 + 4  # additive, one step per window
+        assert ctl.increases == 4
+
+    def test_depth_caps_at_max(self):
+        ctl, clock, reg = make_controller(initial_depth=1, max_depth=3)
+        ctl.tick()
+        for _ in range(6):
+            for _ in range(10):
+                reg.observe("dataset_wait_seconds", 0.05)
+            tick_window(ctl, clock)
+        assert ctl.prefetch_target == 3
+
+    def test_idle_decays_multiplicatively_after_streak(self):
+        ctl, clock, reg = make_controller(
+            initial_depth=8, idle_windows=3, decrease_factor=0.5
+        )
+        ctl.tick()
+        for k in range(3):
+            for _ in range(20):
+                reg.observe("dataset_wait_seconds", 0.0001)  # far under SLO
+            tick_window(ctl, clock)
+        assert ctl.prefetch_target == 4  # 8 * 0.5 after the 3-window streak
+        assert ctl.decreases == 1
+
+    def test_depth_floors_at_min(self):
+        ctl, clock, reg = make_controller(
+            initial_depth=2, min_depth=1, idle_windows=1
+        )
+        ctl.tick()
+        for _ in range(6):
+            for _ in range(5):
+                reg.observe("dataset_wait_seconds", 0.0001)
+            tick_window(ctl, clock)
+        assert ctl.prefetch_target == 1
+
+    def test_slo_below_smallest_bucket_drives_on_mean_only(self):
+        # an SLO under every histogram bound has no bucket witness: healthy
+        # waits must NOT all count as violations (depth would climb to max
+        # on a perfectly fine source) — the mean-wait signal drives alone
+        ctl, clock, reg = make_controller(
+            slo_wait_ms=0.4, initial_depth=2, idle_windows=1
+        )
+        ctl.tick()
+        for _ in range(3):
+            for _ in range(20):
+                reg.observe("dataset_wait_seconds", 0.00001)  # healthy
+            tick_window(ctl, clock)
+        assert ctl.increases == 0  # never pressured
+        assert reg.get("dataset_slo_violations_total") == 0
+        # mean wait over the SLO still pressures
+        for _ in range(20):
+            reg.observe("dataset_wait_seconds", 0.005)
+        tick_window(ctl, clock)
+        assert ctl.increases == 1
+
+    def test_no_traffic_holds(self):
+        ctl, clock, reg = make_controller(initial_depth=4, idle_windows=1)
+        ctl.tick()
+        for _ in range(5):
+            tick_window(ctl, clock)  # zero observations in every window
+        assert ctl.prefetch_target == 4
+        assert ctl.increases == 0 and ctl.decreases == 0
+
+    def test_moderate_traffic_holds_and_resets_idle_streak(self):
+        ctl, clock, reg = make_controller(
+            initial_depth=4, idle_windows=2, idle_fraction=0.1
+        )
+        ctl.tick()
+        for _ in range(4):
+            # mean wait between idle_fraction*SLO and SLO: neither signal
+            for _ in range(10):
+                reg.observe("dataset_wait_seconds", 0.005)
+            tick_window(ctl, clock)
+        assert ctl.prefetch_target == 4
+
+    def test_violations_counter(self):
+        # reads AND writes go through the injected registry: a test (or a
+        # second dataset) with its own registry is fully isolated
+        ctl, clock, reg = make_controller()
+        before = metrics.get("dataset_slo_violations_total")
+        ctl.tick()
+        for _ in range(7):
+            reg.observe("dataset_wait_seconds", 0.05)  # > 10 ms SLO
+        for _ in range(3):
+            reg.observe("dataset_wait_seconds", 0.0001)
+        tick_window(ctl, clock)
+        assert reg.get("dataset_slo_violations_total") == 7
+        assert metrics.get("dataset_slo_violations_total") == before
+
+    def test_worker_target_tracks_depth_clamped(self):
+        ctl, clock, reg = make_controller(initial_depth=2, max_workers=4)
+        assert ctl.worker_target == 2
+        ctl.tick()
+        for _ in range(8):
+            for _ in range(10):
+                reg.observe("dataset_wait_seconds", 0.05)
+            tick_window(ctl, clock)
+        assert ctl.prefetch_target == 10
+        assert ctl.worker_target == 4  # clamped
+
+    def test_readahead_budget_scales_with_depth(self):
+        ctl, clock, reg = make_controller(
+            initial_depth=3, readahead_unit_bytes=1 << 20
+        )
+        assert ctl.readahead_budget == 3 << 20
+
+    def test_prefetch_target_gauge(self):
+        ctl, clock, reg = make_controller(initial_depth=5)
+        assert reg.get("dataset_prefetch_target") == 5
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AIMDController(slo_wait_ms=0)
+        with pytest.raises(ValueError):
+            AIMDController(slo_wait_ms=5, min_depth=0)
+        with pytest.raises(ValueError):
+            AIMDController(slo_wait_ms=5, decrease_factor=1.5)
+        with pytest.raises(ValueError):
+            AIMDController(slo_wait_ms=5, window_s=0)
+
+
+# -- dataset wiring -------------------------------------------------------------
+
+N_FILES = 4
+ROWS = 900
+ROW_GROUP = 150
+
+
+@pytest.fixture(scope="module")
+def pattern(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ctl_shards")
+    rng = np.random.default_rng(3)
+    for i in range(N_FILES):
+        t = pa.table(
+            {
+                "x": pa.array(rng.integers(0, 1 << 40, ROWS).astype(np.int64)),
+            }
+        )
+        pq.write_table(
+            t, str(d / f"s-{i:02d}.parquet"), row_group_size=ROW_GROUP
+        )
+    return str(d / "s-*.parquet")
+
+
+def _drain(it):
+    return [{k: np.asarray(v) for k, v in b.items()} for b in it]
+
+
+def _batches_equal(a, b):
+    assert len(a) == len(b)
+    for ba, bb in zip(a, b):
+        assert ba.keys() == bb.keys()
+        for k in ba:
+            assert np.array_equal(ba[k], bb[k]), k
+
+
+class TestDatasetWiring:
+    def test_slo_attaches_controller(self, pattern):
+        ds = ParquetDataset(pattern, batch_size=128, slo_wait_ms=5.0)
+        assert ds._controller is not None
+        assert ds._controller.slo_wait_ms == 5.0
+        ds2 = ParquetDataset(pattern, batch_size=128)
+        assert ds2._controller is None
+
+    def test_stream_identical_with_and_without_controller(self, pattern):
+        """THE advisory contract: the controller changes speed, never the
+        stream."""
+        kw = dict(batch_size=128, shuffle=True, seed=11, remainder="keep")
+        with ParquetDataset(pattern, **kw) as plain:
+            ref = _drain(iter(plain))
+        with ParquetDataset(pattern, slo_wait_ms=1.0, **kw) as elastic:
+            got = _drain(iter(elastic))
+        _batches_equal(ref, got)
+
+    def test_resume_byte_identical_with_controller_mid_adaptation(self, pattern):
+        """Checkpoint mid-epoch while the controller is live (and has
+        moved the depth), resume on a dataset with DIFFERENT controller
+        settings: the remaining stream must be byte-identical — controller
+        state is advisory and absent from state_dict."""
+        kw = dict(batch_size=100, shuffle=True, seed=7, remainder="keep")
+        clock = FakeClock()
+        ctl = AIMDController(
+            slo_wait_ms=0.001, initial_depth=1, window_s=0.001, clock=clock
+        )
+        with ParquetDataset(pattern, controller=ctl, **kw) as ds:
+            it = iter(ds)
+            first = [next(it) for _ in range(9)]
+            clock.t += 10  # force control windows to elapse between waits
+            state = it.state_dict()
+            rest_live = _drain(it)
+        assert "controller" not in state and "prefetch" not in state
+        # resume WITHOUT a controller
+        with ParquetDataset(pattern, **kw) as ds2:
+            it2 = ds2.iterator(state)
+            rest_resumed = _drain(it2)
+        _batches_equal(rest_live, rest_resumed)
+        # and the full stream from scratch agrees
+        with ParquetDataset(pattern, slo_wait_ms=5000.0, **kw) as ds3:
+            full = _drain(iter(ds3))
+        _batches_equal(first + rest_live, full)
+
+    def test_pool_grows_with_target(self, pattern):
+        clock = FakeClock()
+        reg = metrics.MetricsRegistry()
+        ctl = AIMDController(
+            slo_wait_ms=10.0, initial_depth=1, window_s=1.0,
+            max_workers=4, clock=clock, registry=reg,
+        )
+        ds = ParquetDataset(pattern, batch_size=128, controller=ctl)
+        with ds:
+            pool = ds._worker_pool()
+            assert pool._max_workers == 1
+            ctl.tick()
+            for _ in range(3):
+                for _ in range(10):
+                    reg.observe("dataset_wait_seconds", 0.05)
+                clock.t += 1.0
+                ctl.tick()
+            ds._apply_controller_targets()
+            assert pool._max_workers == ctl.worker_target > 1
+
+
+class TestScanSloGate:
+    def test_generous_slo_passes(self, pattern, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        rc = tool_main(["scan", pattern, "--slo-ms", "60000"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "slo held" in out
+
+    def test_impossible_slo_fails_with_one_line_report(self, pattern, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        rc = tool_main(
+            ["scan", pattern, "--slo-ms", "0.000001", "--json"]
+        )
+        out = capsys.readouterr().out
+        assert rc != 0
+        [line] = [ln for ln in out.splitlines() if "slo VIOLATED" in ln]
+        assert "p99 wait" in line
+        # the --json artifact carries the same verdict
+        import json as _json
+
+        blob = next(
+            _json.loads(ln) for ln in out.splitlines() if ln.startswith("{")
+        )
+        assert blob["slo"]["held"] is False

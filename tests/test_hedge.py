@@ -1,0 +1,442 @@
+"""parquet_tpu.io.hedge: hedged reads, circuit breakers, resilience wiring.
+
+Pinned here:
+  * HedgedSource: a fast primary never hedges; a stalled primary races a
+    duplicate and the first success wins (either side); both failing
+    re-raises the primary's error; every outcome lands in
+    io_hedges_total{outcome=};
+  * CircuitBreaker: the closed -> open -> half-open machine under a fake
+    clock — threshold trips, typed fast-fail (SourceError code
+    "breaker_open"), exactly ONE half-open probe, success closes, failure
+    re-arms;
+  * BreakerRegistry: bounded like every externally-keyed table (LRU-evict
+    closed breakers, overflow when everything is open);
+  * composition: the breaker's fast-fail is TERMINAL to RetryingSource
+    (no pointless backoff on a known-dark source), in both stack orders;
+  * open_source wiring: configure_resilience() makes every constructed
+    source come back wrapped (and the default policy is the identity).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from parquet_tpu.io.hedge import (
+    BreakerRegistry,
+    BreakerSource,
+    CircuitBreaker,
+    HedgedSource,
+    ResilienceConfig,
+    configure_resilience,
+    resilience_config,
+    wrap_resilient,
+)
+from parquet_tpu.io.source import (
+    ByteSource,
+    LocalFileSource,
+    MemorySource,
+    RetryingSource,
+    SourceError,
+    open_source,
+)
+from parquet_tpu.utils import metrics
+
+
+class ScriptedSource(ByteSource):
+    """A source whose successive read_at calls follow a script: each entry
+    is bytes (return), an Exception (raise), or a threading.Event (block
+    until set, then return). Deterministic concurrency for hedge races."""
+
+    def __init__(self, script, data=b"x" * 64):
+        self._script = list(script)
+        self._data = data
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def read_at(self, offset: int, n: int) -> bytes:
+        with self._lock:
+            idx = self.calls
+            self.calls += 1
+            step = (
+                self._script[idx] if idx < len(self._script) else self._data
+            )
+        if isinstance(step, threading.Event):
+            assert step.wait(10.0), "scripted block never released"
+            return self._data[offset : offset + n]
+        if isinstance(step, Exception):
+            raise step
+        return step[offset : offset + n]
+
+
+def _hedge_outcomes(before):
+    d = metrics.delta(before)
+    return {
+        k.split('"')[1]: v for k, v in d.items()
+        if k.startswith("io_hedges_total")
+    }
+
+
+class TestHedgedSource:
+    def test_fast_primary_never_hedges(self):
+        src = ScriptedSource([])
+        h = HedgedSource(src, min_delay_s=0.05, initial_delay_s=0.05)
+        before = metrics.snapshot()
+        for _ in range(4):
+            assert h.read_at(0, 8) == b"x" * 8
+        assert src.calls == 4
+        assert h.hedges_launched == 0
+        assert _hedge_outcomes(before) == {}
+
+    def test_hedge_wins_when_primary_stalls(self):
+        gate = threading.Event()
+        src = ScriptedSource([gate])  # call 1 blocks; call 2 returns fast
+        h = HedgedSource(src, min_delay_s=0.01, initial_delay_s=0.01)
+        before = metrics.snapshot()
+        try:
+            assert h.read_at(0, 8) == b"x" * 8
+        finally:
+            gate.set()  # release the absorbed loser
+        assert src.calls == 2
+        assert h.hedges_launched == 1 and h.hedges_won == 1
+        out = _hedge_outcomes(before)
+        assert out.get("launched") == 1 and out.get("win_hedge") == 1
+
+    def test_primary_wins_when_hedge_is_slower(self):
+        g1, g2 = threading.Event(), threading.Event()
+        src = ScriptedSource([g1, g2])
+        h = HedgedSource(src, min_delay_s=0.01, initial_delay_s=0.01)
+        before = metrics.snapshot()
+
+        # release the primary shortly after the hedge launches
+        def release():
+            time.sleep(0.05)
+            g1.set()
+
+        t = threading.Thread(target=release, daemon=True)
+        t.start()
+        try:
+            assert h.read_at(0, 8) == b"x" * 8
+        finally:
+            g1.set()
+            g2.set()
+        t.join()
+        assert _hedge_outcomes(before).get("win_primary") == 1
+
+    def test_hedge_failure_waits_for_primary(self):
+        gate = threading.Event()
+        src = ScriptedSource([gate, OSError(5, "hedge fails")])
+        h = HedgedSource(src, min_delay_s=0.01, initial_delay_s=0.01)
+
+        def release():
+            time.sleep(0.05)
+            gate.set()
+
+        t = threading.Thread(target=release, daemon=True)
+        t.start()
+        assert h.read_at(0, 8) == b"x" * 8
+        t.join()
+
+    def test_both_failing_raises_primary_error(self):
+        """A hedged read where the PRIMARY stalls past the bar and then
+        both copies fail re-raises the primary's error (the hedge's is the
+        same fault again, not new information)."""
+
+        class _SlowThenFail(ScriptedSource):
+            # primary: stall past the hedge bar, then fail; hedge: fail fast
+            def read_at(self, offset, n):
+                with self._lock:
+                    idx = self.calls
+                    self.calls += 1
+                if idx == 0:
+                    time.sleep(0.05)
+                    raise OSError(5, "primary fault")
+                raise OSError(5, "hedge fault")
+
+        h = HedgedSource(
+            _SlowThenFail([]), min_delay_s=0.01, initial_delay_s=0.01
+        )
+        before = metrics.snapshot()
+        with pytest.raises(OSError, match="primary fault"):
+            h.read_at(0, 8)
+        assert _hedge_outcomes(before).get("failed") == 1
+
+    def test_fast_failure_propagates_without_hedge(self):
+        src = ScriptedSource([OSError(5, "boom")])
+        h = HedgedSource(src, min_delay_s=0.5, initial_delay_s=0.5)
+        before = metrics.snapshot()
+        with pytest.raises(OSError, match="boom"):
+            h.read_at(0, 8)
+        assert src.calls == 1
+        assert _hedge_outcomes(before) == {}
+
+    def test_delay_tracks_latency_quantile(self):
+        src = ScriptedSource([])
+        h = HedgedSource(
+            src, min_delay_s=0.001, max_delay_s=10.0, initial_delay_s=0.25
+        )
+        assert h.hedge_delay() == 0.25  # no samples yet
+        for _ in range(16):
+            h._window.record(0.002)
+        assert h.hedge_delay() == pytest.approx(0.002)
+        # the clamp floors it
+        h2 = HedgedSource(src, min_delay_s=0.05)
+        for _ in range(16):
+            h2._window.record(0.001)
+        assert h2.hedge_delay() == 0.05
+
+    def test_validation(self):
+        src = ScriptedSource([])
+        with pytest.raises(ValueError):
+            HedgedSource(src, delay_quantile=1.5)
+        with pytest.raises(ValueError):
+            HedgedSource(src, min_delay_s=0.5, max_delay_s=0.1)
+
+
+class TestCircuitBreaker:
+    def test_trip_fast_fail_and_recover(self):
+        t = [0.0]
+        b = CircuitBreaker("s", failure_threshold=3, open_s=5.0,
+                           clock=lambda: t[0])
+        assert b.state == "closed"
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed"  # below threshold
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(SourceError) as ei:
+            b.before_read()
+        assert ei.value.code == "breaker_open"
+        # time passes -> half-open, ONE probe admitted
+        t[0] = 5.0
+        assert b.state == "half_open"
+        b.before_read()  # the probe slot
+        with pytest.raises(SourceError):
+            b.before_read()  # concurrent readers keep fast-failing
+        b.record_success()
+        assert b.state == "closed"
+        b.before_read()  # closed again
+
+    def test_probe_failure_rearms(self):
+        t = [0.0]
+        b = CircuitBreaker("s", failure_threshold=1, open_s=2.0,
+                           clock=lambda: t[0])
+        b.record_failure()
+        assert b.state == "open"
+        t[0] = 2.0
+        b.before_read()  # probe
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(SourceError):
+            b.before_read()  # timer re-armed from t=2
+        t[0] = 3.9
+        with pytest.raises(SourceError):
+            b.before_read()
+        t[0] = 4.0
+        b.before_read()  # next probe window
+
+    def test_valueerror_probe_releases_slot(self):
+        # a ValueError (caller bug) during the half-open probe must not
+        # latch _probing: the NEXT read still gets a probe slot
+        t = [0.0]
+        b = CircuitBreaker("s", failure_threshold=1, open_s=2.0,
+                           clock=lambda: t[0])
+        src = BreakerSource(
+            ScriptedSource([OSError("boom"), ValueError("bad range")]), b
+        )
+        with pytest.raises(OSError):
+            src.read_at(0, 8)  # trips the breaker
+        t[0] = 2.0
+        assert b.state == "half_open"
+        with pytest.raises(ValueError):
+            src.read_at(0, 8)  # probe dies pre-flight: slot released
+        assert src.read_at(0, 8) == b"x" * 8  # next read IS the probe
+        assert b.state == "closed"
+
+    def test_success_resets_failure_streak(self):
+        b = CircuitBreaker("s", failure_threshold=3)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"  # never 3 CONSECUTIVE
+
+    def test_state_gauge(self):
+        b = CircuitBreaker("gauge-pin", failure_threshold=1, label="gauge-pin")
+        assert metrics.get("io_breaker_state", source="gauge-pin") == 0
+        b.record_failure()
+        assert metrics.get("io_breaker_state", source="gauge-pin") == 1
+
+
+class TestBreakerRegistry:
+    def test_shared_per_source_id(self):
+        reg = BreakerRegistry()
+        assert reg.breaker_for("a") is reg.breaker_for("a")
+        assert reg.breaker_for("a") is not reg.breaker_for("b")
+
+    def test_bounded_evicts_closed(self):
+        reg = BreakerRegistry(max_sources=2)
+        reg.breaker_for("a")
+        reg.breaker_for("b")
+        reg.breaker_for("c")  # evicts a closed breaker
+        assert len(reg.states()) == 2
+
+    def test_overflow_when_all_open(self):
+        reg = BreakerRegistry(max_sources=2, failure_threshold=1)
+        for sid in ("a", "b"):
+            reg.breaker_for(sid).record_failure()
+        b = reg.breaker_for("c")
+        assert reg.breaker_for("d") is b  # both land in the overflow slot
+        assert BreakerRegistry.OVERFLOW in reg.states()
+
+    def test_reset(self):
+        reg = BreakerRegistry(failure_threshold=1)
+        reg.breaker_for("a").record_failure()
+        reg.reset()
+        assert reg.states() == {}
+
+
+class _AlwaysFails(ByteSource):
+    def __init__(self):
+        self.calls = 0
+
+    def size(self) -> int:
+        return 64
+
+    @property
+    def source_id(self) -> str:
+        return "always-fails"
+
+    def read_at(self, offset: int, n: int) -> bytes:
+        self.calls += 1
+        raise OSError(5, "injected")
+
+
+class TestComposition:
+    def test_breaker_under_retry_is_terminal(self):
+        """Retrying(Breaker(src)): once the breaker opens mid-ladder, the
+        typed fast-fail aborts the remaining attempts — no backoff is
+        spent on a source the breaker already called dark."""
+        inner = _AlwaysFails()
+        b = CircuitBreaker("c1", failure_threshold=2, open_s=60.0)
+        src = RetryingSource(
+            BreakerSource(inner, b), attempts=10, base_delay_s=0.0001,
+            sleep=lambda s: None,
+        )
+        with pytest.raises(SourceError) as ei:
+            src.read_at(0, 8)
+        assert ei.value.code == "breaker_open"
+        assert inner.calls == 2  # threshold, not attempts
+
+    def test_breaker_over_retry_counts_exhaustion(self):
+        """Breaker(Retrying(src)): the breaker sees one failure per
+        EXHAUSTED ladder, so it trips after threshold x attempts raw
+        faults."""
+        inner = _AlwaysFails()
+        b = CircuitBreaker("c2", failure_threshold=2, open_s=60.0)
+        src = BreakerSource(
+            RetryingSource(inner, attempts=3, base_delay_s=0.0001,
+                           sleep=lambda s: None),
+            b,
+        )
+        for _ in range(2):
+            with pytest.raises(SourceError):
+                src.read_at(0, 8)
+        assert b.state == "open"
+        assert inner.calls == 6
+        calls_before = inner.calls
+        with pytest.raises(SourceError) as ei:
+            src.read_at(0, 8)
+        assert ei.value.code == "breaker_open"
+        assert inner.calls == calls_before  # fast fail: no transport touch
+
+    def test_value_error_never_counts(self):
+        b = CircuitBreaker("c3", failure_threshold=1)
+        src = BreakerSource(MemorySource(b"abc"), b)
+        with pytest.raises(ValueError):
+            src.read_at(-1, 2)
+        assert b.state == "closed"
+
+
+class TestResilienceWiring:
+    def teardown_method(self):
+        configure_resilience(None)
+
+    def test_default_policy_is_identity(self):
+        assert not resilience_config().active
+        src = MemorySource(b"abc")
+        assert wrap_resilient(src) is src
+
+    def test_open_source_applies_policy(self, tmp_path):
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"hello world")
+        reg = BreakerRegistry(failure_threshold=1)
+        configure_resilience(
+            ResilienceConfig(breaker=True, retry=True, hedge=True,
+                             registry=reg,
+                             retry_kw={"sleep": lambda s: None})
+        )
+        src, owns = open_source(str(p))
+        assert owns
+        # outermost hedge, then retry, then breaker, then the local source
+        assert isinstance(src, HedgedSource)
+        assert isinstance(src.inner, RetryingSource)
+        assert isinstance(src.inner.inner, BreakerSource)
+        assert isinstance(src.inner.inner.inner, LocalFileSource)
+        assert src.read_at(0, 5) == b"hello"
+        src.close()
+
+    def test_passed_through_sources_stay_unwrapped(self, tmp_path):
+        configure_resilience(ResilienceConfig(retry=True))
+        src = MemorySource(b"abc")
+        got, owns = open_source(src)
+        assert got is src and not owns
+
+    def test_configure_returns_previous(self):
+        prev = configure_resilience(ResilienceConfig(retry=True))
+        assert not prev.active
+        back = configure_resilience(prev)
+        assert back.active
+        assert not resilience_config().active
+
+    def test_chaos_wrapper_is_innermost(self):
+        wrapped = []
+
+        def chaos(s):
+            wrapped.append(s)
+            return s
+
+        configure_resilience(
+            ResilienceConfig(retry=True, chaos_wrapper=chaos,
+                             retry_kw={"sleep": lambda s: None})
+        )
+        src = wrap_resilient(MemorySource(b"abc"))
+        assert isinstance(src, RetryingSource)
+        assert isinstance(wrapped[0], MemorySource)
+
+    def test_reader_reads_through_policy(self, tmp_path):
+        """The whole point of the choke-point wiring: a FileReader opened
+        by PATH picks the policy up with no per-callsite code."""
+        import numpy as np
+
+        from parquet_tpu.core.writer import FileWriter
+        from parquet_tpu.schema.dsl import parse_schema
+
+        p = str(tmp_path / "t.parquet")
+        w = FileWriter(p, parse_schema("message m { required int64 x; }"))
+        w.write_column("x", np.arange(100, dtype=np.int64))
+        w.close()
+        seen = []
+        configure_resilience(ResilienceConfig(chaos_wrapper=lambda s: (seen.append(s) or s)))
+        from parquet_tpu.core.reader import FileReader
+
+        with FileReader(p) as r:
+            cols = r.read_row_group(0)
+        assert next(iter(cols.values())).num_values == 100
+        assert seen  # the policy saw the open
