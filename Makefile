@@ -1,11 +1,13 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-chaos chaos-smoke bench-compare dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
-# chaos-smoke runs the scripted fault schedule end to end at smoke scale
-check: native lint chaos-smoke
+# chaos-smoke runs the scripted fault schedule end to end at smoke scale;
+# obs-smoke validates the bench trend store's schema and pins the
+# sampling profiler's overhead on a decode loop
+check: native lint chaos-smoke obs-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -73,9 +75,33 @@ bench-assembly: native
 # regression gate over two --json artifacts: every tracked metric's
 # new/old ratio, non-zero exit on a >THRESHOLD regression — how future
 # PRs hold the BENCH_r0x trajectory. Usage:
-#   make bench-compare OLD=BENCH_r05.json NEW=/tmp/bench_now.json
+#   make bench-compare OLD=BENCH_r06.json NEW=/tmp/bench_now.json
+# (omit OLD to diff against the latest round in BENCH_history.jsonl)
 bench-compare:
 	python bench.py --compare $(OLD) $(NEW) --threshold $(or $(THRESHOLD),0.10)
+
+# capture a full bench round AND append it to the persistent trend store
+# (BENCH_history.jsonl: artifact + git rev + config fingerprint). LABEL
+# names the round (default rNN); the trend renders with `make bench-trend`
+bench-record: native
+	python bench.py --json /tmp/pqt_bench_now.json
+	python bench.py --record /tmp/pqt_bench_now.json $(if $(LABEL),--label $(LABEL))
+
+# every tracked metric across the recorded rounds, last-vs-first ratio
+bench-trend:
+	python bench.py --trend $(if $(SECTION),--section $(SECTION))
+
+# the make-check-sized observability gate: the trend store's schema must
+# parse (a malformed BENCH_history.jsonl exits non-zero) and the sampling
+# profiler's measured overhead on a decode loop must hold its <5% pin
+obs-smoke: native
+	python bench.py --trend > /dev/null
+	JAX_PLATFORMS=cpu python -m pytest tests/test_prof.py -q -k overhead
+
+# live-profile a RUNNING daemon (flamegraph-compatible collapsed stacks,
+# lane-attributed to the pqt-* pools): make profile-live URL=host:port
+profile-live:
+	python -m parquet_tpu.tools.parquet_tool profile --live $(or $(URL),http://127.0.0.1:8080) --seconds $(or $(SECONDS),2)
 
 dryrun:
 	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"

@@ -103,13 +103,26 @@ when any tracked metric REGRESSES beyond the threshold (default 10%) — the
 trajectory against. Untracked leaves (counts, depths, config echoes) are
 reported as changed/unchanged but never gate; two artifacts with NO
 tracked metric in common also exit non-zero (a gate that compared
-nothing must not read as green).
+nothing must not read as green). With ONE path, the old side defaults to
+the LATEST round recorded in BENCH_history.jsonl — `bench.py --compare
+/tmp/now.json` is the whole regression check.
+
+`--record artifact.json [--label rNN] [--history PATH]` appends the
+artifact to the persistent trend store BENCH_history.jsonl together with
+its provenance (git rev, a fingerprint of the PQT_* config env, python/
+platform, timestamp) — the per-PR trajectory record the BENCH_r0x files
+used to be by hand. `--trend [--history PATH] [--section S]` renders
+every tracked metric's value across the recorded rounds with the
+last-vs-first ratio, newest round on the right; it also validates the
+store's schema (a malformed entry exits non-zero), which is what the
+`make check` trend smoke asserts.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import time
 from pathlib import Path
@@ -132,8 +145,13 @@ def log(*a):
 
 def _write_artifact(obj) -> None:
     """Write the structured result to the --json/PQT_BENCH_JSON path (no-op
-    when unset)."""
+    when unset). The artifact carries the config fingerprint of the env
+    the benchmark ACTUALLY ran under, so a later `--record` from a
+    different shell cannot stamp the wrong provenance (string leaves:
+    invisible to the --compare gate)."""
     if _JSON_OUT:
+        digest, basis = _config_fingerprint()
+        obj = {**obj, "bench_config": {"fingerprint": digest, "basis": basis}}
         try:
             Path(_JSON_OUT).write_text(json.dumps(obj, indent=1) + "\n")
         except OSError as e:  # pragma: no cover
@@ -2112,6 +2130,7 @@ def _metric_direction(key: str) -> int:
     if (
         "rows_s" in k
         or "req_s" in k
+        or k == "rps"  # the serve sweep's requests/s headline
         or "speedup" in k
         or k.startswith("vs_")
         or k.endswith("_ratio")
@@ -2138,10 +2157,214 @@ def _numeric_leaves(obj, prefix=""):
     return out
 
 
-def _phase_compare(old_path: str, new_path: str, threshold: float) -> None:
+# -- the persistent bench trend store ------------------------------------------
+
+_HISTORY_DEFAULT = Path(__file__).resolve().parent / "BENCH_history.jsonl"
+
+
+def _git_rev() -> str:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            cwd=str(Path(__file__).resolve().parent),
+            timeout=10,
+        )
+        if out.returncode == 0:
+            rev = out.stdout.decode().strip()
+            if rev:
+                return rev
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def _config_fingerprint() -> tuple:
+    """(digest, basis): a short stable hash of everything that shapes a
+    bench round's numbers besides the code — the PQT_* size knobs, the jax
+    platform selection, python and machine — so the trend view can tell a
+    real regression from a config change."""
+    import hashlib
+    import platform
+
+    basis = {
+        "env": {
+            k: v
+            for k, v in sorted(os.environ.items())
+            if k.startswith("PQT_") or k == "JAX_PLATFORMS"
+        },
+        "python": platform.python_version(),
+        "machine": platform.machine(),
+    }
+    digest = hashlib.sha256(
+        json.dumps(basis, sort_keys=True).encode()
+    ).hexdigest()[:12]
+    return digest, basis
+
+
+def _read_history(path) -> list:
+    """Parse + schema-validate the trend store. Every entry must carry
+    label/recorded_at/git_rev/config/artifact — a malformed line is a
+    hard exit, not a skip: silently dropping rounds would make the trend
+    LIE about the trajectory."""
+    entries = []
+    for i, line in enumerate(Path(path).read_text().splitlines()):
+        if not line.strip():
+            continue
+        try:
+            e = json.loads(line)
+        except ValueError:
+            raise SystemExit(
+                f"bench history: {path} line {i + 1} is not valid JSON"
+            ) from None
+        if not isinstance(e, dict):
+            raise SystemExit(f"bench history: {path} line {i + 1} is not an object")
+        for k in ("label", "recorded_at", "git_rev", "config", "artifact"):
+            if k not in e:
+                raise SystemExit(
+                    f"bench history: {path} line {i + 1} missing {k!r}"
+                )
+        if not isinstance(e["artifact"], dict):
+            raise SystemExit(
+                f"bench history: {path} line {i + 1} artifact is not an object"
+            )
+        entries.append(e)
+    return entries
+
+
+def _phase_record(artifact_path: str, history_path, label) -> None:
+    """Append one --json artifact to the trend store with its provenance."""
+    from datetime import datetime, timezone
+
+    art = json.loads(Path(artifact_path).read_text())
+    if not isinstance(art, dict):
+        raise SystemExit(f"bench record: {artifact_path} is not a JSON object")
+    history = Path(history_path)
+    entries = _read_history(history) if history.exists() else []
+    if label is None:
+        # continue the rNN sequence from the HIGHEST recorded round (the
+        # store ships seeded at r06; plain len+1 would collide with it)
+        ns = [
+            int(e["label"][1:])
+            for e in entries
+            if re.fullmatch(r"r\d+", e["label"])
+        ]
+        label = f"r{(max(ns) if ns else len(entries)) + 1:02d}"
+    if any(e["label"] == label for e in entries):
+        raise SystemExit(
+            f"bench record: label {label!r} already recorded in {history} "
+            "(pass --label to name this round)"
+        )
+    # provenance preference: the fingerprint the artifact captured at RUN
+    # time (bench_config, stamped by _write_artifact) — the env of this
+    # --record invocation may differ from the env the numbers ran under
+    embedded = art.get("bench_config")
+    if isinstance(embedded, dict) and embedded.get("fingerprint"):
+        digest = embedded["fingerprint"]
+        basis = embedded.get("basis", {})
+    else:
+        digest, basis = _config_fingerprint()
+    entry = {
+        "label": label,
+        "recorded_at": datetime.now(timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        "git_rev": _git_rev(),
+        "config": digest,
+        "config_basis": basis,
+        "artifact": art,
+    }
+    with open(history, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    n_tracked = sum(
+        1
+        for k in _numeric_leaves(art)
+        if _metric_direction(k.rsplit(".", 1)[-1]) != 0
+    )
+    print(
+        f"bench record: {label} <- {artifact_path} "
+        f"(git {entry['git_rev']}, cfg {digest}, {n_tracked} tracked "
+        f"metrics) -> {history} ({len(entries) + 1} rounds)"
+    )
+
+
+def _phase_trend(history_path, section=None) -> None:
+    """Render every tracked metric across the recorded rounds (newest on
+    the right) with the last-vs-first ratio, direction-aware."""
+    history = Path(history_path)
+    if not history.exists():
+        raise SystemExit(
+            f"bench trend: no trend store at {history} "
+            "(record a round first: bench.py --record artifact.json)"
+        )
+    entries = _read_history(history)
+    if not entries:
+        raise SystemExit(f"bench trend: {history} is empty")
+    labels = [e["label"] for e in entries]
+    leaves = [_numeric_leaves(e["artifact"]) for e in entries]
+    keys = []  # tracked leaves, in first-seen order across rounds
+    seen = set()
+    for lv in leaves:
+        for k in lv:
+            if k in seen or _metric_direction(k.rsplit(".", 1)[-1]) == 0:
+                continue
+            seen.add(k)
+            keys.append(k)
+    if section is not None:
+        keys = [
+            k
+            for k in keys
+            if (k.split(".", 1)[0] if "." in k else "(headline)") == section
+        ]
+    configs = {e["config"] for e in entries}
+    rounds = ", ".join(
+        "{}@{}".format(e["label"], e["git_rev"][:7]) for e in entries
+    )
+    print(f"bench trend: {len(entries)} rounds in {history} ({rounds})")
+    if len(configs) > 1:
+        print(
+            "bench trend: NOTE rounds span "
+            f"{len(configs)} config fingerprints — deltas may reflect "
+            "config changes, not code"
+        )
+    last_section = None
+    width = max((len(k) for k in keys), default=10)
+    for k in keys:
+        sec = k.split(".", 1)[0] if "." in k else "(headline)"
+        if sec != last_section:
+            print(f"  [{sec}]")
+            last_section = sec
+        vals = [lv.get(k) for lv in leaves]
+        cells = " -> ".join("-" if v is None else f"{v:g}" for v in vals)
+        present = [v for v in vals if v is not None]
+        tail = ""
+        if len(present) >= 2 and present[0]:
+            ratio = present[-1] / present[0]
+            direction = _metric_direction(k.rsplit(".", 1)[-1])
+            better = (ratio > 1) if direction > 0 else (ratio < 1)
+            verdict = "improved" if better else "regressed"
+            if 0.98 <= ratio <= 1.02:
+                verdict = "held"
+            tail = f"  x{ratio:.3f} {verdict}"
+        print(f"    {k:<{width}}  {cells}{tail}")
+    print(
+        f"bench trend: {len(keys)} tracked metrics across "
+        f"{len(labels)} rounds ✓"
+    )
+
+
+def _phase_compare(old_path, new_path: str, threshold: float) -> None:
     """Diff two --json artifacts; exit 1 when a tracked metric regresses
-    past `threshold` (fractional, default 0.10)."""
-    old = json.loads(Path(old_path).read_text())
+    past `threshold` (fractional, default 0.10). `old_path` may be a
+    (name, dict) pair — how the single-path form passes the latest
+    recorded history round in."""
+    if isinstance(old_path, tuple):
+        old_path, old = old_path
+    else:
+        old = json.loads(Path(old_path).read_text())
     new = json.loads(Path(new_path).read_text())
     ol, nl = _numeric_leaves(old), _numeric_leaves(new)
     shared = sorted(set(ol) & set(nl))
@@ -2212,6 +2435,20 @@ def _phase_compare(old_path: str, new_path: str, threshold: float) -> None:
     print(f"bench compare: no tracked regressions in {compared} metrics ✓")
 
 
+def _pop_opt(args: list, name: str):
+    """Pop `NAME VALUE` out of args (mutating); None when absent, clean
+    SystemExit when the value is missing — the one copy of the edge case
+    every hand-rolled flag below shares."""
+    if name not in args:
+        return None
+    k = args.index(name)
+    if k + 1 >= len(args):
+        raise SystemExit(f"bench: {name} needs a value")
+    val = args[k + 1]
+    del args[k : k + 2]
+    return val
+
+
 if __name__ == "__main__":
     argv = sys.argv[1:]
     if "--json" in argv:
@@ -2222,25 +2459,66 @@ if __name__ == "__main__":
         del argv[k : k + 2]
     if argv and argv[0] == "--compare":
         rest = argv[1:]
-        thr = 0.10
-        if "--threshold" in rest:
-            k = rest.index("--threshold")
-            if k + 1 >= len(rest):
-                raise SystemExit("bench: --threshold needs a value")
+        raw_thr = _pop_opt(rest, "--threshold")
+        if raw_thr is None:
+            thr = 0.10
+        else:
             try:
-                thr = float(rest[k + 1])
+                thr = float(raw_thr)
             except ValueError:
                 raise SystemExit(
-                    f"bench: --threshold needs a number, got {rest[k + 1]!r}"
+                    f"bench: --threshold needs a number, got {raw_thr!r}"
                 ) from None
-            del rest[k : k + 2]
+        history = _pop_opt(rest, "--history") or _HISTORY_DEFAULT
         paths = [a for a in rest if not a.startswith("--")]
-        if len(paths) != 2 or len(paths) != len(rest):
+        if len(paths) not in (1, 2) or len(paths) != len(rest):
             raise SystemExit(
-                "bench: --compare needs OLD.json NEW.json "
-                "[--threshold FRACTION]"
+                "bench: --compare needs [OLD.json] NEW.json "
+                "[--threshold FRACTION] [--history PATH] — with one path "
+                "the old side is the latest round in BENCH_history.jsonl"
             )
-        _phase_compare(paths[0], paths[1], thr)
+        if len(paths) == 1:
+            # old side defaults to the LATEST recorded round: the one-arg
+            # form IS the trajectory gate against the trend store
+            if not Path(history).exists():
+                raise SystemExit(
+                    f"bench compare: no trend store at {history} to "
+                    "compare against (record a round first, or pass "
+                    "OLD.json explicitly)"
+                )
+            entries = _read_history(history)
+            if not entries:
+                raise SystemExit(f"bench compare: {history} is empty")
+            latest = entries[-1]
+            old_side = (
+                f"{history}[{latest['label']}]",
+                latest["artifact"],
+            )
+            _phase_compare(old_side, paths[0], thr)
+        else:
+            _phase_compare(paths[0], paths[1], thr)
+    elif argv and argv[0] == "--record":
+        rest = argv[1:]
+        history = _pop_opt(rest, "--history") or _HISTORY_DEFAULT
+        label = _pop_opt(rest, "--label")
+        paths = [a for a in rest if not a.startswith("--")]
+        if not paths and _JSON_OUT:
+            paths = [_JSON_OUT]  # record the artifact --json just named
+        if len(paths) != 1 or [a for a in rest if a.startswith("--")]:
+            raise SystemExit(
+                "bench: --record needs ARTIFACT.json "
+                "[--label NAME] [--history PATH]"
+            )
+        _phase_record(paths[0], history, label)
+    elif argv and argv[0] == "--trend":
+        rest = argv[1:]
+        history = _pop_opt(rest, "--history") or _HISTORY_DEFAULT
+        section = _pop_opt(rest, "--section")
+        if rest:
+            raise SystemExit(
+                "bench: --trend takes [--history PATH] [--section NAME]"
+            )
+        _phase_trend(history, section)
     elif argv and argv[0] == "--dataset":
         _phase_dataset()
     elif argv and argv[0] == "--assembly":

@@ -21,6 +21,7 @@ import zlib
 from ..meta.file_meta import ParquetFileError
 from ..meta.parquet_types import CompressionCodec
 from ..utils import metrics as _metrics
+from ..utils.trace import add_bytes as _trace_add_bytes
 
 __all__ = [
     "compress_block",
@@ -279,8 +280,13 @@ def decompress_block(data: bytes, codec, uncompressed_size: int) -> bytes:
         )
     # every staged decode path funnels through here, making this the one
     # choke point for the always-on byte counters (the fused native walk
-    # bypasses it and reports its own totals in kernels/pipeline.py)
+    # bypasses it and reports its own totals in kernels/pipeline.py).
+    # The same output-byte count rides the ACTIVE trace as the
+    # `decode.bytes` account, so a request-scoped trace's decoded-byte
+    # total reconciles EXACTLY with the process bytes_uncompressed_total
+    # delta — what the serve cost ledger charges per tenant.
     _metrics.io_bytes(len(data), len(out), impl.name)
+    _trace_add_bytes("decode.bytes", len(out))
     return out
 
 

@@ -27,6 +27,7 @@ import threading
 from collections import OrderedDict
 
 from ..utils import metrics as _metrics
+from ..utils.trace import count as _trace_count
 
 __all__ = ["BlockCache", "FooterCache", "shared_footer_cache"]
 
@@ -50,8 +51,15 @@ class BlockCache:
             if buf is not None:
                 self._blocks.move_to_end(key)
                 _metrics.inc("io_cache_hits_total")
+                # trace-only count (the registry line above already owns
+                # the always-on counter): a request-scoped trace carries
+                # its own hit/miss split — how the serve cost ledger
+                # attributes cache outcomes per tenant. Costs one
+                # contextvar read when no trace is active.
+                _trace_count("io_cache_hit")
                 return buf
         _metrics.inc("io_cache_misses_total")
+        _trace_count("io_cache_miss")
         return None
 
     def put(self, source_id: str, offset: int, length: int, data) -> None:

@@ -1102,6 +1102,10 @@ def _native_prepare_impl(f, chunk, column, validate_crc, alloc, stats):
     # value-stream length (levels excluded).
     _metrics.observe("chunk_decode_seconds", t_walk)
     _metrics.io_bytes(len(buf), int(md.total_uncompressed_size or 0), codec)
+    # mirror decompress_block's per-trace decoded-byte account (the fused
+    # walk bypasses that choke point), so cost attribution stays exact on
+    # the native lane too
+    _trace.add_bytes("decode.bytes", int(md.total_uncompressed_size or 0))
     pages_arr = res["pages"]
     if len(pages_arr):
         for e in np.unique(pages_arr[:, _PC_ENC]):

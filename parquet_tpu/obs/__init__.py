@@ -17,10 +17,26 @@ long-running daemon can actually use at 14:02 when request X was slow:
   pool      the one instrumented submit all four pqt-* pools route
             through: queue-depth/active gauges + queue-wait/task-time
             histograms per pool.
+  prof      continuous sampling profiler over sys._current_frames():
+            bounded, lane-attributed (the named pqt-* pools), rendered
+            as flamegraph-compatible collapsed stacks and a top-N
+            self-time table. Served live at /v1/debug/profile, fetched
+            by `parquet-tool profile --live`.
+  cost      per-tenant cost accounting: CPU seconds (thread-time deltas
+            around executor units), decoded/source bytes and cache
+            outcomes (from the request trace), charged to the
+            admission-resolved tenant. Served at /v1/debug/tenants.
 
 See each module's docstring for the contracts and bounds.
 """
 
+from .cost import (  # noqa: F401
+    LEDGER,
+    CostLedger,
+    charged_tenant,
+    cost_context,
+    unit_clock,
+)
 from .log import (  # noqa: F401
     JsonLinesFormatter,
     TokenBucketLimiter,
@@ -29,6 +45,12 @@ from .log import (  # noqa: F401
     log_event,
 )
 from .pool import instrumented_submit, pool_depths  # noqa: F401
+from .prof import (  # noqa: F401
+    ProfilerBusy,
+    SamplingProfiler,
+    capture,
+    lane_of,
+)
 from .recorder import (  # noqa: F401
     RECORDER,
     FlightRecorder,
@@ -54,4 +76,13 @@ __all__ = [
     "TokenBucketLimiter",
     "instrumented_submit",
     "pool_depths",
+    "SamplingProfiler",
+    "ProfilerBusy",
+    "capture",
+    "lane_of",
+    "CostLedger",
+    "LEDGER",
+    "cost_context",
+    "charged_tenant",
+    "unit_clock",
 ]

@@ -37,6 +37,7 @@ from concurrent.futures import TimeoutError as _FutTimeout
 
 from ..core.reader import PARQUET_ERRORS, FileReader
 from ..io.source import SourceError
+from ..obs.cost import unit_clock
 from ..obs.pool import instrumented_submit
 from ..utils import metrics as _metrics
 from ..utils.trace import stage
@@ -113,9 +114,11 @@ def _close_unit_reader(session, reader) -> None:
 
 
 def _run_jsonl_unit(session, planned, unit, max_rows, check):
-    """Decode + serialize one unit; returns (payload bytes, rows)."""
+    """Decode + serialize one unit; returns (payload bytes, rows).
+    unit_clock bills the unit's thread-time (exact per-thread CPU) to the
+    request's tenant through the cost contextvar the submit carried."""
     check()
-    with stage("serve.execute"):
+    with unit_clock(), stage("serve.execute"):
         reader = _open_reader(session, planned, unit)
         try:
             lines = []
@@ -137,9 +140,9 @@ def _run_jsonl_unit(session, planned, unit, max_rows, check):
 
 def _run_arrow_unit(session, planned, unit, max_rows, check):
     """Decode one unit to a pyarrow Table (serialized by the stream side,
-    which owns the single IPC writer)."""
+    which owns the single IPC writer). unit_clock: see _run_jsonl_unit."""
     check()
-    with stage("serve.execute"):
+    with unit_clock(), stage("serve.execute"):
         reader = _open_reader(session, planned, unit)
         try:
             t = reader.to_arrow(

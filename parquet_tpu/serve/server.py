@@ -11,9 +11,20 @@ the bounded pqt-serve pool), four endpoints:
   GET  /v1/plan     dry-run of the same request (query params or POSTed
                     body): pruned vs total row groups, estimated bytes —
                     zero source reads when the footer cache is warm.
-  GET  /metrics     Prometheus text exposition of the process registry.
+  GET  /metrics     Prometheus text exposition of the process registry
+                    (`Accept: application/openmetrics-text` negotiates the
+                    OpenMetrics variant whose serve_request_seconds
+                    buckets carry request-id EXEMPLARS).
   GET  /healthz     {"status": "ok"|"draining", "in_flight": n}; 503 while
                     draining so load balancers stop routing here.
+  GET  /v1/debug/requests[/<id>[/trace]]  the flight recorder (PR 9).
+  GET  /v1/debug/profile?seconds=N  live sampling profile of the process
+                    (collapsed flamegraph text / top table / json),
+                    lane-attributed to the pqt-* pools.
+  GET  /v1/debug/tenants  per-tenant cost table (CPU seconds, decoded/
+                    source bytes, cache outcomes) + cross-tenant totals.
+  GET  /v1/debug/vars  process snapshot: uptime, pid, version, pool
+                    sizes, resilience policy, cache/admission budgets.
 
 Error discipline: EVERY failure renders as a structured JSON body
 ({"error": {code, message, status}}) — never a traceback. Failures after
@@ -37,7 +48,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from ..io.cache import BlockCache
+from ..obs import cost as _cost
 from ..obs import log as _obslog
+from ..obs import prof as _prof
 from ..obs.recorder import ObsConfig as _ObsConfig
 from ..obs.recorder import configure as _obs_configure
 from ..obs.recorder import sanitize_request_id as _sanitize_request_id
@@ -178,6 +191,10 @@ class ScanService:
                 max_traces=config.debug_max_traces,
             )
         )
+        # the process-wide tenant cost ledger (same lifetime discipline as
+        # the recorder) and the daemon's start instant for /v1/debug/vars
+        self.ledger = _cost.LEDGER
+        self.started_at = time.time()
 
     # -- request entry points (raise ServeError; HTTP layer renders) -----------
 
@@ -280,6 +297,101 @@ class ScanService:
                 "slow_ms to keep more)",
             )
         return doc
+
+    def debug_tenants(self) -> dict:
+        """The /v1/debug/tenants usage table: per-tenant CPU seconds,
+        decoded/source/payload bytes, cache outcomes, request and unit
+        counts — hottest CPU first, plus the cross-tenant totals. This is
+        how a hot tenant is identified BEFORE its byte-budget 429s fire."""
+        return {
+            "tenants": self.ledger.table(),
+            "totals": self.ledger.totals(),
+        }
+
+    def debug_vars(self) -> dict:
+        """The /v1/debug/vars process snapshot: uptime, pid, version, the
+        effective pool sizes, resilience policy, cache/admission budgets
+        and obs knobs — everything `parquet-tool debug` needs to know
+        about a daemon's configuration without scraping its flags."""
+        import os
+
+        from .. import __version__ as _version
+        from ..io.hedge import resilience_config
+        from ..obs.pool import pool_depths
+
+        cfg = self.config
+        res = resilience_config()
+        # service-relative uptime in the BODY only: the
+        # process_uptime_seconds gauge is owned by the exposition render
+        # (one writer, one epoch — process start)
+        uptime = round(time.time() - self.started_at, 3)
+        return {
+            "pid": os.getpid(),
+            "version": _version,
+            "uptime_s": uptime,
+            "started_at": self.started_at,
+            "pools": {
+                "env": {
+                    k: os.environ[k]
+                    for k in (
+                        "PQT_SERVE_THREADS",
+                        "PQT_IO_THREADS",
+                        "PQT_DATA_THREADS",
+                        "PQT_ENCODE_THREADS",
+                    )
+                    if k in os.environ
+                },
+                "depths": pool_depths(),
+            },
+            "serve": {
+                "root": cfg.root,
+                "cache_mb": cfg.cache_mb,
+                "max_inflight": cfg.max_inflight,
+                "tenant_concurrent": cfg.tenant_concurrent,
+                "tenant_budget_mb": cfg.tenant_budget_mb,
+                "budget_window_s": cfg.budget_window_s,
+                "default_timeout_s": cfg.default_timeout_s,
+                "max_timeout_s": cfg.max_timeout_s,
+                "brownout_wait_ms": cfg.brownout_wait_ms,
+                "brownout_depth": cfg.brownout_depth,
+                "window": cfg.window,
+                "max_body_bytes": cfg.max_body_bytes,
+                "socket_timeout_s": cfg.socket_timeout_s,
+                "shard": list(cfg.shard) if cfg.shard else None,
+            },
+            "obs": {
+                "trace_sample_rate": cfg.trace_sample_rate,
+                "slow_ms": cfg.slow_ms,
+                "debug_ring_size": cfg.debug_ring_size,
+                "debug_max_traces": cfg.debug_max_traces,
+            },
+            "resilience": {
+                "breaker": res.breaker,
+                "retry": res.retry,
+                "hedge": res.hedge,
+            },
+        }
+
+    def debug_profile(
+        self, seconds: float, interval_ms: float = 10.0
+    ) -> _prof.SamplingProfiler:
+        """Run one live capture window (the /v1/debug/profile body; the
+        HTTP layer renders collapsed/top/json). Bounded: at most 60 s and
+        at least 1 ms interval; a concurrent window is a typed 409."""
+        if not 0 < seconds <= 60:
+            raise ServeError(
+                400, "bad_request", "'seconds' must be in (0, 60]"
+            )
+        if not 1.0 <= interval_ms <= 1000.0:
+            raise ServeError(
+                400, "bad_request", "'interval_ms' must be in [1, 1000]"
+            )
+        try:
+            return _prof.capture(seconds, interval_ms / 1e3)
+        except _prof.ProfilerBusy as e:
+            raise ServeError(
+                409, "profile_in_progress", str(e), retry_after_s=1
+            ) from None
 
 
 def _count_request(tenant: str, status: int) -> None:
@@ -463,14 +575,28 @@ class _Handler(BaseHTTPRequestHandler):
         dt = time.perf_counter() - t0
         _count_request(tenant, status)
         # endpoint labels are the matched-route constants, never the raw
-        # client path — a 404 probe flood cannot grow the label set
-        _metrics.observe("serve_request_seconds", dt, endpoint=endpoint)
+        # client path — a 404 probe flood cannot grow the label set. The
+        # request id rides the histogram bucket as an OpenMetrics exemplar
+        # (visible only to scrapers that negotiate that format): a latency
+        # spike on a dashboard names the exact /v1/debug/requests record.
+        _metrics.observe(
+            "serve_request_seconds",
+            dt,
+            exemplar=({"request_id": rec.id} if rec is not None else None),
+            endpoint=endpoint,
+        )
         if rec is None:
             return
         svc = self.service
         svc.recorder.finish(
             rec, status, nbytes=nbytes, error=error, trace=trace,
             duration_s=dt,
+        )
+        # the request's byte/cache usage, charged to its tenant out of the
+        # same trace rollup the flight record stores (CPU was already
+        # charged per unit by the executor's thread-time clock)
+        _cost.charge_request_from_trace(
+            tenant, trace, nbytes=nbytes, ledger=svc.ledger
         )
         if dt * 1e3 >= svc.config.slow_ms:
             _metrics.inc("serve_slow_requests_total", endpoint=endpoint)
@@ -492,7 +618,8 @@ class _Handler(BaseHTTPRequestHandler):
         rec = svc.recorder.begin(endpoint, tenant, request_id=self._rid)
         self._rid = rec.id
         status, nbytes, err, trace = 500, 0, None, None
-        with _obslog.log_context(request_id=rec.id, tenant=tenant):
+        with _obslog.log_context(request_id=rec.id, tenant=tenant), \
+                _cost.cost_context(tenant):
             try:
                 with decode_trace() as trace:
                     try:
@@ -572,6 +699,49 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             raise ServeError(404, "no_such_route", f"unknown path {route!r}")
 
+    def _profile_request(self, qs: dict) -> None:
+        """GET /v1/debug/profile?seconds=N[&interval_ms=M][&format=F] —
+        run one live capture window on THIS handler thread (connection
+        threads are cheap; scan work never runs on them) and return it as
+        `collapsed` flamegraph text (default), a `top` self-time table,
+        or the full `json` snapshot. No admission: the window is bounded
+        at 60 s and a concurrent capture is a typed 409."""
+
+        def num(name, default):
+            raw = qs.get(name, [None])[-1]
+            if raw is None:
+                return default
+            try:
+                return float(raw)
+            except ValueError:
+                raise ServeError(
+                    400, "bad_request",
+                    f"{name!r} must be a number, got {raw!r}",
+                ) from None
+
+        seconds = num("seconds", 2.0)
+        interval_ms = num("interval_ms", 10.0)
+        fmt = qs.get("format", ["collapsed"])[-1]
+        if fmt not in ("collapsed", "top", "json"):
+            raise ServeError(
+                400, "bad_request",
+                "'format' must be collapsed, top or json",
+            )
+        prof = self.service.debug_profile(seconds, interval_ms)
+        if fmt == "json":
+            self._send_json(200, prof.snapshot())
+            return
+        text = prof.collapsed() if fmt == "collapsed" else prof.render_top(30)
+        payload = text.encode()
+        self._drain_body()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(payload)))
+        if self._rid:
+            self.send_header("X-Request-Id", self._rid)
+        self.end_headers()
+        self.wfile.write(payload)
+
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
         split = urlsplit(self.path)
         route = split.path
@@ -586,11 +756,22 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if route == "/metrics":
                 self._drain_body()
-                payload = _metrics.render_prometheus().encode()
+                # content negotiation: a scraper asking for OpenMetrics
+                # gets the exemplar-carrying variant (+ the # EOF
+                # terminator); everyone else sees the classic text format
+                # byte-for-byte unchanged
+                accept = self.headers.get("Accept") or ""
+                if "application/openmetrics-text" in accept:
+                    payload = _metrics.render_openmetrics().encode()
+                    ctype = (
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8"
+                    )
+                else:
+                    payload = _metrics.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
-                )
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 if self._rid:
                     self.send_header("X-Request-Id", self._rid)
@@ -607,6 +788,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._DEBUG_PREFIX + "/"
             ):
                 self._debug_request(route, parse_qs(split.query))
+                return
+            if route == "/v1/debug/tenants":
+                self._send_json(200, self.service.debug_tenants())
+                return
+            if route == "/v1/debug/vars":
+                self._send_json(200, self.service.debug_vars())
+                return
+            if route == "/v1/debug/profile":
+                self._profile_request(parse_qs(split.query))
                 return
             raise ServeError(404, "no_such_route", f"unknown path {route!r}")
         except ServeError as e:

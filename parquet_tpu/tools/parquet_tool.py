@@ -652,12 +652,53 @@ def cmd_profile(args) -> int:
     profiles an ASSEMBLED read (iter_rows) instead of the column decode —
     the assemble / assembly.rows stages then show where record assembly
     spends its time, and the metrics delta carries
-    assembly_rows_total{engine=} / assembly_seconds."""
+    assembly_rows_total{engine=} / assembly_seconds.
+
+    --live <url> profiles a RUNNING daemon instead of a file: it fetches
+    GET /v1/debug/profile (the continuous sampling profiler, lane-
+    attributed to the named pqt-* pools) for --seconds and prints the
+    collapsed flamegraph text (or the --top self-time table); -o writes
+    the text for flamegraph.pl / speedscope."""
     from ..utils import metrics
     from ..utils.trace import decode_trace, span
 
     import os
 
+    if args.live:
+        # flags that shape the FILE decode have no meaning against a
+        # remote daemon — refuse rather than silently drop them
+        ignored = [
+            name
+            for name, v in (
+                ("--columns", args.columns),
+                ("--rows", args.rows),
+                ("--host", args.host),
+                ("--cpu", args.cpu),
+                ("--metrics", args.metrics),
+            )
+            if v
+        ]
+        if ignored or args.file:
+            what = ", ".join(ignored + (["FILE"] if args.file else []))
+            print(
+                f"profile: {what} applies to file mode, not --live",
+                file=sys.stderr,
+            )
+            return 2
+        return _profile_live(args)
+    if args.top or args.seconds != 2.0 or args.interval_ms != 10.0:
+        print(
+            "profile: --top/--seconds/--interval-ms apply to --live mode "
+            "only",
+            file=sys.stderr,
+        )
+        return 2
+    if not args.file or not args.out:
+        print(
+            "profile: FILE and -o are required (or use --live URL)",
+            file=sys.stderr,
+        )
+        return 2
     if args.cpu:
         import jax
 
@@ -707,6 +748,50 @@ def cmd_profile(args) -> int:
             print(f"  {k} = {v}")
         print()
         print(metrics.report())
+    return 0
+
+
+def _profile_live(args) -> int:
+    """The `profile --live <url>` body: one /v1/debug/profile window."""
+    import urllib.error
+    import urllib.request
+
+    base = args.live.rstrip("/")
+    if not base.startswith(("http://", "https://")):
+        base = "http://" + base
+    fmt = "top" if args.top else "collapsed"
+    url = (
+        f"{base}/v1/debug/profile?seconds={args.seconds:g}"
+        f"&interval_ms={args.interval_ms:g}&format={fmt}"
+    )
+    try:
+        with urllib.request.urlopen(url, timeout=args.seconds + 30) as resp:
+            text = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        try:
+            err = json.loads(e.read()).get("error", {})
+            msg = f"{err.get('code', e.code)}: {err.get('message', '')}"
+        except ValueError:
+            msg = f"HTTP {e.code}"
+        print(f"profile: {msg}", file=sys.stderr)
+        return 1
+    except OSError as e:
+        print(f"profile: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        n = len(text.splitlines())
+        print(
+            f"profile: wrote {n} {fmt} lines to {args.out}"
+            + (
+                " (feed to flamegraph.pl / speedscope)"
+                if fmt == "collapsed"
+                else ""
+            )
+        )
+    else:
+        sys.stdout.write(text)
     return 0
 
 
@@ -947,12 +1032,58 @@ def cmd_debug(args) -> int:
     Without --id: list recent requests (newest first; --slow filters to
     the ones at/over the daemon's slow_ms). With --id: one record in full.
     With --id + --trace: the Perfetto-loadable Chrome-trace JSON, written
-    to -o (or stdout) for ui.perfetto.dev / chrome://tracing."""
+    to -o (or stdout) for ui.perfetto.dev / chrome://tracing. --vars
+    snapshots the daemon's configuration (/v1/debug/vars); --tenants
+    prints the per-tenant cost table (/v1/debug/tenants)."""
     base = args.url.rstrip("/")
     if not base.startswith(("http://", "https://")):
         base = "http://" + base
     if args.trace and not args.id:
         raise ValueError("debug: --trace requires --id REQUEST_ID")
+    if args.vars:
+        status, body = _debug_fetch(f"{base}/v1/debug/vars")
+        if status != 200:
+            err = body.get("error", {})
+            print(
+                f"debug: {err.get('code', status)}: {err.get('message', '')}",
+                file=sys.stderr,
+            )
+            return 1
+        print(json.dumps(body, indent=2))
+        return 0
+    if args.tenants:
+        status, body = _debug_fetch(f"{base}/v1/debug/tenants")
+        if status != 200:
+            err = body.get("error", {})
+            print(
+                f"debug: {err.get('code', status)}: {err.get('message', '')}",
+                file=sys.stderr,
+            )
+            return 1
+        rows = body.get("tenants", [])
+        if not rows:
+            print("debug: no tenant usage recorded")
+            return 0
+        print(
+            f"{'TENANT':<18} {'CPU_S':>9} {'DECODED_B':>13} {'SOURCE_B':>12} "
+            f"{'PAYLOAD_B':>12} {'HIT':>6} {'MISS':>6} {'REQS':>6} {'UNITS':>6}"
+        )
+        for r in rows:
+            print(
+                f"{r['tenant']:<18} {r['cpu_seconds']:>9.3f} "
+                f"{r['decoded_bytes']:>13,} {r['source_bytes']:>12,} "
+                f"{r['payload_bytes']:>12,} {r['cache_hits']:>6} "
+                f"{r['cache_misses']:>6} {r['requests']:>6} {r['units']:>6}"
+            )
+        t = body.get("totals")
+        if t:
+            print(
+                f"{'TOTAL':<18} {t['cpu_seconds']:>9.3f} "
+                f"{t['decoded_bytes']:>13,} {t['source_bytes']:>12,} "
+                f"{t['payload_bytes']:>12,} {t['cache_hits']:>6} "
+                f"{t['cache_misses']:>6} {t['requests']:>6} {t['units']:>6}"
+            )
+        return 0
     if args.id:
         path = f"{base}/v1/debug/requests/{args.id}"
         if args.trace:
@@ -1078,8 +1209,12 @@ def main(argv=None) -> int:
         help="decode the file under the span tracer; write Chrome "
         "trace-event JSON (Perfetto/chrome://tracing) + per-stage report",
     )
-    pf.add_argument("file")
-    pf.add_argument("-o", "--out", required=True, help="trace JSON output path")
+    pf.add_argument("file", nargs="?", help="file to profile (omit with --live)")
+    pf.add_argument(
+        "-o", "--out",
+        help="trace JSON output path (file mode, required there); "
+        "collapsed/top text output path (--live mode, optional)",
+    )
     pf.add_argument(
         "--columns",
         help="comma-separated column projection (the io line then shows the "
@@ -1108,6 +1243,31 @@ def main(argv=None) -> int:
         action="store_true",
         help="force jax onto the CPU platform before profiling (keeps the "
         "accelerator tunnel untouched)",
+    )
+    pf.add_argument(
+        "--live",
+        metavar="URL",
+        help="profile a RUNNING daemon via GET /v1/debug/profile instead "
+        "of decoding a file: prints flamegraph-compatible collapsed "
+        "stacks attributed to the pqt-* pool lanes",
+    )
+    pf.add_argument(
+        "--seconds",
+        type=float,
+        default=2.0,
+        help="live capture window length (default 2)",
+    )
+    pf.add_argument(
+        "--interval-ms",
+        type=float,
+        default=10.0,
+        help="live sampling interval (default 10 ms)",
+    )
+    pf.add_argument(
+        "--top",
+        action="store_true",
+        help="with --live: print the top self-time table instead of "
+        "collapsed stacks",
     )
     pf.set_defaults(fn=cmd_profile)
 
@@ -1309,6 +1469,18 @@ def main(argv=None) -> int:
     )
     pd.add_argument(
         "--limit", type=int, default=100, help="max requests to list"
+    )
+    pd.add_argument(
+        "--vars",
+        action="store_true",
+        help="snapshot the daemon's /v1/debug/vars (uptime, pid, version, "
+        "pool sizes, resilience policy, cache/admission budgets)",
+    )
+    pd.add_argument(
+        "--tenants",
+        action="store_true",
+        help="print the per-tenant cost table (/v1/debug/tenants): CPU "
+        "seconds, decoded/source bytes, cache outcomes, hottest first",
     )
     pd.set_defaults(fn=cmd_debug)
 

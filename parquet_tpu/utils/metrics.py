@@ -121,6 +121,37 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
                                     ("queue_wait" = brownout on pqt-serve
                                     queue pressure, "breaker_open" = a
                                     blacked-out source fast-failed)
+  process_uptime_seconds            gauge: seconds since process start
+                                    (refreshed at every exposition
+                                    render; /v1/debug/vars reports its
+                                    own service-relative uptime_s)
+  serve_tenant_cpu_seconds_total{tenant=}  executor CPU seconds (thread-
+                                    time deltas around row-group units)
+                                    charged to the admission-resolved
+                                    tenant key — the "who is spending the
+                                    machine" counter; bounded by the same
+                                    sanitized-tenant table as
+                                    serve_requests_total
+  serve_tenant_decoded_bytes_total{tenant=}  uncompressed bytes decoded
+                                    on behalf of each tenant (charged
+                                    from the request's trace rollup at
+                                    finish); /v1/debug/tenants carries
+                                    the full usage table (source-read
+                                    bytes, cache hits/misses, payload)
+  obs_profile_samples_total{lane=}  continuous-profiler stack samples
+                                    per pool lane (pqt-io/pqt-data/
+                                    pqt-serve/pqt-encode/pqt-hedge/
+                                    pqt-dispatch/other) —
+                                    obs_profile_windows_total counts
+                                    completed capture windows
+
+Exposition variants: render_prometheus() is the classic text format every
+scraper understands; render_openmetrics() is the content-negotiated
+OpenMetrics 1.0 document (`Accept: application/openmetrics-text` on
+GET /metrics) that additionally carries EXEMPLARS — request-ids attached
+to serve_request_seconds buckets via observe(exemplar=...) — and ends
+with `# EOF`. The classic output is byte-for-byte unaffected by
+exemplars.
 
 Snapshot keys are flat strings in Prometheus sample syntax without the
 prefix: `pages_decoded_total{encoding="PLAIN"}`. Histograms snapshot as
@@ -138,6 +169,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 
 __all__ = [
     "MetricsRegistry",
@@ -149,6 +181,7 @@ __all__ = [
     "snapshot",
     "delta",
     "render_prometheus",
+    "render_openmetrics",
     "report",
     "event",
     "page_decoded",
@@ -240,11 +273,19 @@ _HELP = {
     "io_hedges_total": "hedged-read outcomes (launched, win_primary, win_hedge, failed)",
     "io_breaker_state": "circuit-breaker state per source (0 closed, 1 open, 2 half-open)",
     "serve_shed_total": "requests shed before execution, per reason",
+    "process_uptime_seconds": "seconds since process start, refreshed at each exposition render",
+    "serve_tenant_cpu_seconds_total": "executor CPU seconds charged per tenant",
+    "serve_tenant_decoded_bytes_total": "decoded (uncompressed) bytes charged per tenant",
+    "obs_profile_samples_total": "sampling-profiler stack samples, per pool lane",
+    "obs_profile_windows_total": "sampling-profiler capture windows completed",
 }
 
 
 class _Hist:
-    __slots__ = ("count", "total", "vmin", "vmax", "buckets", "bucket_counts")
+    __slots__ = (
+        "count", "total", "vmin", "vmax", "buckets", "bucket_counts",
+        "exemplars",
+    )
 
     def __init__(self, buckets=_DEFAULT_BUCKETS):
         self.count = 0
@@ -253,15 +294,28 @@ class _Hist:
         self.vmax = float("-inf")
         self.buckets = buckets
         self.bucket_counts = [0] * len(buckets)
+        # per-bucket last exemplar (index len(buckets) = the +Inf bucket):
+        # (labels dict, observed value, unix ts) — allocated on first use
+        # so histograms nobody attaches exemplars to pay one None
+        self.exemplars: list | None = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: dict | None = None) -> None:
         self.count += 1
         self.total += v
         self.vmin = min(self.vmin, v)
         self.vmax = max(self.vmax, v)
+        slot = len(self.buckets)  # +Inf unless a finite bound admits v
         for i, le in enumerate(self.buckets):
             if v <= le:
                 self.bucket_counts[i] += 1
+                slot = min(slot, i)
+        if exemplar is not None:
+            # last-write-wins in the value's CANONICAL (first admitting)
+            # bucket: one recent trace reference per latency band, bounded
+            # by the bucket count — never by traffic
+            if self.exemplars is None:
+                self.exemplars = [None] * (len(self.buckets) + 1)
+            self.exemplars[slot] = (dict(exemplar), v, time.time())
 
 
 class MetricsRegistry:
@@ -292,13 +346,19 @@ class MetricsRegistry:
             self._gauges[key] = value
             self._gauge_names.add(name)
 
-    def observe(self, name: str, value: float, **labels) -> None:
+    def observe(
+        self, name: str, value: float, exemplar: dict | None = None, **labels
+    ) -> None:
+        """Record one histogram observation. `exemplar` (a small dict such
+        as {"request_id": ...}) attaches a metric→trace reference to the
+        value's bucket, rendered only by the OpenMetrics exposition — the
+        classic text format ignores it entirely."""
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             h = self._hists.get(key)
             if h is None:
                 h = self._hists[key] = _Hist()
-            h.observe(value)
+            h.observe(value, exemplar)
 
     def hist_stats(self, name: str, **labels) -> dict:
         """One histogram's running totals — {"count", "sum", "buckets",
@@ -409,6 +469,79 @@ class MetricsRegistry:
             lines.append(f"{_PREFIX}{_key(name + '_count', ld)} {h.count}")
         return "\n".join(lines) + "\n"
 
+    def render_openmetrics(self) -> str:
+        """OpenMetrics 1.0 text exposition — the content-negotiated
+        variant of render_prometheus() (Accept: application/openmetrics-
+        text). Differences from the classic format, per the spec:
+
+          * counter FAMILIES drop their `_total` suffix in # TYPE/# HELP
+            while samples keep it (`# TYPE ..._requests counter` +
+            `..._requests_total{...} 3`);
+          * histogram bucket samples may carry an EXEMPLAR — ` # {labels}
+            value timestamp` — here the request-id attached via
+            observe(exemplar=...), which is the dashboard→flight-recorder
+            link: a latency bucket names the exact request an operator can
+            fetch from /v1/debug/requests/<id>;
+          * the document terminates with `# EOF`.
+
+        Scrapers that never ask for OpenMetrics see the classic format
+        unchanged (exemplars are invisible there)."""
+        lines = []
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = [
+                (k, h, list(h.exemplars) if h.exemplars else None)
+                for k, h in sorted(self._hists.items())
+            ]
+        seen_types = set()
+
+        def family_header(name: str, kind: str, family=None) -> None:
+            if name in seen_types:
+                return
+            seen_types.add(name)
+            fam = family if family is not None else name
+            doc = _HELP.get(name)
+            lines.append(f"# TYPE {_PREFIX}{fam} {kind}")
+            if doc:
+                lines.append(f"# HELP {_PREFIX}{fam} {doc}")
+
+        def exemplar_suffix(ex) -> str:
+            if ex is None:
+                return ""
+            labels, value, ts = ex
+            inner = ",".join(
+                f'{k}="{_escape_label_value(v)}"'
+                for k, v in sorted(labels.items())
+            )
+            return f" # {{{inner}}} {value:g} {ts:.3f}"
+
+        for (name, labels), v in counters:
+            fam = name[: -len("_total")] if name.endswith("_total") else name
+            family_header(name, "counter", family=fam)
+            lines.append(f"{_PREFIX}{_key(name, dict(labels))} {v}")
+        for (name, labels), v in gauges:
+            family_header(name, "gauge")
+            lines.append(f"{_PREFIX}{_key(name, dict(labels))} {v}")
+        for ((name, labels), h, exemplars) in hists:
+            family_header(name, "histogram")
+            ld = dict(labels)
+            for i, (le, c) in enumerate(zip(h.buckets, h.bucket_counts)):
+                ex = exemplars[i] if exemplars else None
+                lines.append(
+                    f"{_PREFIX}{_key(name + '_bucket', {**ld, 'le': _format_le(le)})}"
+                    f" {c}{exemplar_suffix(ex)}"
+                )
+            ex = exemplars[len(h.buckets)] if exemplars else None
+            lines.append(
+                f"{_PREFIX}{_key(name + '_bucket', {**ld, 'le': '+Inf'})}"
+                f" {h.count}{exemplar_suffix(ex)}"
+            )
+            lines.append(f"{_PREFIX}{_key(name + '_sum', ld)} {h.total}")
+            lines.append(f"{_PREFIX}{_key(name + '_count', ld)} {h.count}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
     def reset(self) -> None:
         """Drop every metric (tests only — production counters are
         monotonic for the life of the process)."""
@@ -421,6 +554,17 @@ class MetricsRegistry:
 
 REGISTRY = MetricsRegistry()
 
+# Process start, for the process_uptime_seconds gauge the expositions
+# refresh on every render (a scrape always sees current uptime).
+_PROCESS_START = time.time()
+
+
+def _refresh_uptime(registry: MetricsRegistry) -> None:
+    registry.set(
+        "process_uptime_seconds", round(time.time() - _PROCESS_START, 3)
+    )
+
+
 # -- module-level convenience (the registry everyone means) --------------------
 
 
@@ -428,8 +572,10 @@ def inc(name: str, n=1, **labels) -> None:
     REGISTRY.inc(name, n, **labels)
 
 
-def observe(name: str, value: float, **labels) -> None:
-    REGISTRY.observe(name, value, **labels)
+def observe(
+    name: str, value: float, exemplar: dict | None = None, **labels
+) -> None:
+    REGISTRY.observe(name, value, exemplar, **labels)
 
 
 def set_gauge(name: str, value, **labels) -> None:
@@ -449,7 +595,13 @@ def delta(previous: dict) -> dict:
 
 
 def render_prometheus() -> str:
+    _refresh_uptime(REGISTRY)
     return REGISTRY.render_prometheus()
+
+
+def render_openmetrics() -> str:
+    _refresh_uptime(REGISTRY)
+    return REGISTRY.render_openmetrics()
 
 
 # -- the decode plumbing's vocabulary ------------------------------------------

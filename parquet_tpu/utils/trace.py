@@ -51,6 +51,7 @@ __all__ = [
     "add_seconds",
     "add_seconds_batch",
     "bump",
+    "count",
     "active",
     "current",
     "traced_submit",
@@ -60,6 +61,16 @@ __all__ = [
 ]
 
 _active_var: ContextVar = ContextVar("pqt_decode_trace", default=None)
+
+# Depth of stage() / timed_stage() aggregates currently OPEN in this
+# context. Seconds committed while an enclosing stage aggregate is open
+# (an inner decode stage under serve.execute, a native sub-clock inside a
+# measured parent) are already part of that parent's wall time — they are
+# marked "nested" on their own StageStats so rollups and the report TOTAL
+# can count them EXACTLY once. The contextvar rides the same
+# copy_context() carry as the trace itself, so nesting detected inside a
+# pool worker attributes against the stage open on that worker.
+_stage_depth_var: ContextVar = ContextVar("pqt_stage_depth", default=0)
 
 # Process-wide count of span-event allocations: the zero-overhead oracle.
 # A read with no trace active must leave it untouched — tests assert that by
@@ -79,6 +90,10 @@ class StageStats:
     seconds: float = 0.0
     bytes: int = 0
     calls: int = 0
+    # the share of `seconds` that elapsed INSIDE another open stage
+    # aggregate (a sub-clock): already billed to the enclosing stage, so
+    # exclusive rollups subtract it — TOTAL counts wall time once
+    nested_seconds: float = 0.0
 
 
 class DecodeTrace:
@@ -112,6 +127,7 @@ class DecodeTrace:
         start_ns: int | None = None,
         dur_ns: int = 0,
         args: dict | None = None,
+        nested: bool = False,
     ) -> None:
         global _span_allocs
         with self._lock:
@@ -120,6 +136,8 @@ class DecodeTrace:
                 s.seconds += seconds
                 s.bytes += nbytes
                 s.calls += calls
+                if nested:
+                    s.nested_seconds += seconds
             if start_ns is not None:
                 tid = threading.get_ident()
                 if tid not in self._threads:
@@ -147,42 +165,76 @@ class DecodeTrace:
         {stage: {"seconds", "bytes", "calls"}} — what the flight recorder
         stores per request (the span TREE is sampled; this rollup is kept
         for every record, and its pool.wait entry is the record's
-        queue-wait)."""
+        queue-wait). Stages whose time elapsed inside another measured
+        stage (sub-clocks: the native prepare.* split, an inner decode
+        stage under serve.execute) additionally carry "nested_seconds" —
+        the share already billed to their parent — so a consumer summing
+        `seconds - nested_seconds` counts wall time exactly once."""
         with self._lock:
-            return {
-                n: {"seconds": s.seconds, "bytes": s.bytes, "calls": s.calls}
-                for n, s in self.stages.items()
-            }
+            out = {}
+            for n, s in self.stages.items():
+                d = {"seconds": s.seconds, "bytes": s.bytes, "calls": s.calls}
+                if s.nested_seconds:
+                    d["nested_seconds"] = s.nested_seconds
+                out[n] = d
+            return out
+
+    def exclusive_seconds(self) -> float:
+        """Wall seconds across all stages with sub-clock time counted
+        ONCE: sum of per-stage (seconds - nested_seconds) — the same
+        quantity the report() TOTAL footer shows (computed there inline,
+        atomically with its per-stage listing); exposed as API for
+        embedders and tests."""
+        with self._lock:
+            return sum(
+                s.seconds - s.nested_seconds for s in self.stages.values()
+            )
 
     def report(self, sort: str = "time") -> str:
         """Per-stage table. sort="time" (default) lists the hottest stages
         first (wall seconds, descending); sort="name" is alphabetical.
-        A TOTAL footer sums seconds/bytes/calls across stages."""
+        A TOTAL footer sums seconds/bytes/calls across stages; sub-clock
+        seconds (time a stage spent inside another measured stage — the
+        native prepare.* split under its parent, inner decode stages under
+        serve.execute) count toward the TOTAL exactly once, and stages
+        that are partly or wholly sub-clocks are marked with a trailing
+        `*` (their own line still shows inclusive seconds)."""
         if sort not in ("time", "name"):
             raise ValueError(f'report sort must be "time" or "name", got {sort!r}')
         with self._lock:
-            items = list(self.stages.items())
+            items = [
+                (n, s.seconds, s.bytes, s.calls, s.nested_seconds)
+                for n, s in self.stages.items()
+            ]
         if sort == "name":
             items.sort(key=lambda kv: kv[0])
         else:
-            items.sort(key=lambda kv: (-kv[1].seconds, kv[0]))
+            items.sort(key=lambda kv: (-kv[1], kv[0]))
 
-        def line(name, seconds, nbytes, calls):
+        def line(name, seconds, nbytes, calls, mark=""):
             rate = f" ({nbytes / seconds / 1e6:.0f} MB/s)" if seconds > 0 and nbytes else ""
             return (
                 f"{name:12s} {seconds * 1000:8.1f} ms  {nbytes:>12,} B  "
-                f"{calls:>6} calls{rate}"
+                f"{calls:>6} calls{rate}{mark}"
             )
 
-        lines = [line(n, s.seconds, s.bytes, s.calls) for n, s in items]
+        lines = [
+            line(n, sec, b, c, "  *" if nested else "")
+            for n, sec, b, c, nested in items
+        ]
         lines.append(
             line(
                 "TOTAL",
-                sum(s.seconds for _, s in items),
-                sum(s.bytes for _, s in items),
-                sum(s.calls for _, s in items),
+                sum(sec - nested for _, sec, _b, _c, nested in items),
+                sum(b for _, _s, b, _c, _n in items),
+                sum(c for _, _s, _b, c, _n in items),
             )
         )
+        if any(nested for *_rest, nested in items):
+            lines.append(
+                "(* partly sub-clocked: time also inside an enclosing "
+                "stage; TOTAL counts it once)"
+            )
         return "\n".join(lines)
 
     # -- Chrome trace-event export ---------------------------------------------
@@ -199,7 +251,16 @@ class DecodeTrace:
             events = list(self._events)
             threads = dict(self._threads)
             stages = {
-                n: {"seconds": s.seconds, "bytes": s.bytes, "calls": s.calls}
+                n: {
+                    "seconds": s.seconds,
+                    "bytes": s.bytes,
+                    "calls": s.calls,
+                    **(
+                        {"nested_seconds": s.nested_seconds}
+                        if s.nested_seconds
+                        else {}
+                    ),
+                }
                 for n, s in self.stages.items()
             }
             dropped = self.events_dropped
@@ -263,22 +324,46 @@ def decode_trace():
         )
 
 
+def _enter_stage() -> tuple:
+    """Open a stage aggregate in this context: returns (reset token,
+    was-nested). The depth rides the same contextvar carry as the trace,
+    so sub-clocks committed inside a pool task see the stage their
+    submitter (or the task itself) holds open."""
+    depth = _stage_depth_var.get()
+    return _stage_depth_var.set(depth + 1), depth > 0
+
+
+def _exit_stage(token) -> None:
+    try:
+        _stage_depth_var.reset(token)
+    except ValueError:  # pragma: no cover - exotic cross-context consumer
+        # a generator suspended inside the stage was resumed from another
+        # context: losing the reset mis-tags later commits there as
+        # nested at worst — never break the decode over bookkeeping
+        pass
+
+
 @contextmanager
 def stage(name: str, nbytes: int = 0, record_span: bool = True):
     """Time a pipeline stage: aggregates into stages[name] AND records a
     span (no-op without an active trace). record_span=False keeps the
     aggregate but skips the span event — for per-ROW micro-stages (the
     assembled-rows loop) that would otherwise flood the event budget with
-    sub-microsecond spans and crowd out the meaningful hierarchy."""
+    sub-microsecond spans and crowd out the meaningful hierarchy. A stage
+    opened while another stage aggregate is already open commits its
+    seconds as nested (sub-clocked): its wall time is part of the parent's
+    and rollup TOTALs count it once."""
     t = _active_var.get()
     if t is None:
         yield
         return
+    token, nested = _enter_stage()
     t0 = time.perf_counter_ns()
     try:
         yield
     finally:
         dt = time.perf_counter_ns() - t0
+        _exit_stage(token)
         t._commit(
             name,
             dt / 1e9,
@@ -286,6 +371,7 @@ def stage(name: str, nbytes: int = 0, record_span: bool = True):
             1,
             start_ns=t0 if record_span else None,
             dur_ns=dt,
+            nested=nested,
         )
 
 
@@ -307,6 +393,7 @@ def timed_stage(name: str, nbytes: int = 0, record_span: bool = True):
     two consumers, no skew between what the trace and the registry report."""
     t = _active_var.get()
     out = _Elapsed()
+    token, nested = (None, False) if t is None else _enter_stage()
     t0 = time.perf_counter_ns()
     try:
         yield out
@@ -314,6 +401,7 @@ def timed_stage(name: str, nbytes: int = 0, record_span: bool = True):
         dt = time.perf_counter_ns() - t0
         out.seconds = dt / 1e9
         if t is not None:
+            _exit_stage(token)
             t._commit(
                 name,
                 out.seconds,
@@ -321,6 +409,7 @@ def timed_stage(name: str, nbytes: int = 0, record_span: bool = True):
                 1,
                 start_ns=t0 if record_span else None,
                 dur_ns=dt,
+                nested=nested,
             )
 
 
@@ -369,7 +458,9 @@ def add_bytes(name: str, nbytes: int) -> None:
 
 def add_seconds(name: str, seconds: float, nbytes: int = 0) -> None:
     """Credit externally-measured wall time to a stage. The span is placed
-    ending 'now' (the measurement must have just finished)."""
+    ending 'now' (the measurement must have just finished). When a stage
+    aggregate is open in this context, the credited time is part of that
+    stage's wall and commits as nested (counted once in TOTALs)."""
     t = _active_var.get()
     if t is not None:
         dur = int(seconds * 1e9)
@@ -380,6 +471,7 @@ def add_seconds(name: str, seconds: float, nbytes: int = 0) -> None:
             1,
             start_ns=time.perf_counter_ns() - dur,
             dur_ns=dur,
+            nested=_stage_depth_var.get() > 0,
         )
 
 
@@ -388,15 +480,18 @@ def add_seconds_batch(pairs) -> None:
     finished (how the fused native chunk walk reports its internal
     decompress/levels/prescan/copy/crc split). Spans are laid back-to-back
     ENDING now, so they nest inside the enclosing span (their sum never
-    exceeds the native call's wall time)."""
+    exceeds the native call's wall time). Like add_seconds, the batch
+    commits as nested when an enclosing stage aggregate is open — the
+    sub-clocks are a BREAKDOWN of their parent, not additional wall."""
     t = _active_var.get()
     if t is None:
         return
+    nested = _stage_depth_var.get() > 0
     pairs = [(n, s) for n, s in pairs if s > 0]
     cursor = time.perf_counter_ns() - sum(int(s * 1e9) for _, s in pairs)
     for name, sec in pairs:
         dur = int(sec * 1e9)
-        t._commit(name, sec, 0, 1, start_ns=cursor, dur_ns=dur)
+        t._commit(name, sec, 0, 1, start_ns=cursor, dur_ns=dur, nested=nested)
         cursor += dur
 
 
@@ -409,6 +504,17 @@ def bump(name: str, nbytes: int = 0) -> None:
     t = _active_var.get()
     if t is not None:
         t._commit(name, 0.0, nbytes, 1)
+
+
+def count(name: str, n: int = 1) -> None:
+    """Count an event under the active trace ONLY — no registry write.
+    For call sites that already feed a dedicated always-on counter (the
+    block cache's io_cache_hits_total) and need just the per-request
+    attribution: one contextvar read when no trace is active, no extra
+    lock traffic on hot paths."""
+    t = _active_var.get()
+    if t is not None:
+        t._commit(name, 0.0, 0, n)
 
 
 def span_allocations() -> int:

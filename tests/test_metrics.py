@@ -228,6 +228,98 @@ class TestExpositionGolden:
         assert "# TYPE parquet_tpu_pqt_test_undoc_total counter" in text
 
 
+class TestOpenMetricsGolden:
+    """The content-negotiated OpenMetrics variant: counter families drop
+    their _total suffix in # TYPE while samples keep it, histogram bucket
+    samples carry exemplars in the spec's ` # {labels} value ts` syntax,
+    the document terminates with # EOF — and the CLASSIC exposition stays
+    byte-for-byte unchanged for existing scrapers."""
+
+    def _reg(self):
+        reg = metrics.MetricsRegistry()
+        reg.inc("io_retries_total", 2, reason="eio")
+        reg.set("pool_queue_depth", 3, pool="pqt-io")
+        reg.observe(
+            "serve_request_seconds",
+            0.26,
+            exemplar={"request_id": "abc123"},
+            endpoint="/v1/scan",
+        )
+        return reg
+
+    def test_counter_family_drops_total_suffix(self):
+        om = self._reg().render_openmetrics()
+        assert "# TYPE parquet_tpu_io_retries counter" in om
+        assert 'parquet_tpu_io_retries_total{reason="eio"} 2' in om
+        # the classic format keeps the full name in TYPE
+        classic = self._reg().render_prometheus()
+        assert "# TYPE parquet_tpu_io_retries_total counter" in classic
+
+    def test_document_terminates_with_eof(self):
+        om = self._reg().render_openmetrics()
+        assert om.endswith("# EOF\n")
+        assert om.count("# EOF") == 1
+
+    def test_exemplar_rides_the_canonical_bucket_only(self):
+        om = self._reg().render_openmetrics()
+        ex_lines = [ln for ln in om.splitlines() if " # {" in ln]
+        assert len(ex_lines) == 1
+        [line] = ex_lines
+        # 0.26 lands in the le="0.5" bucket (its first admitting bound)
+        assert 'le="0.5"' in line
+        sample, _, exemplar = line.partition(" # ")
+        assert sample.endswith(" 1")
+        labels, _, rest = exemplar.partition("} ")
+        assert labels == '{request_id="abc123"'
+        value, ts = rest.split(" ")
+        assert float(value) == 0.26
+        assert float(ts) > 0  # unix timestamp, spec-optional but emitted
+
+    def test_exemplar_label_values_escape(self):
+        reg = metrics.MetricsRegistry()
+        reg.observe(
+            "serve_request_seconds",
+            0.002,
+            exemplar={"request_id": 'a"b\\c\nd'},
+            endpoint="/v1/plan",
+        )
+        om = reg.render_openmetrics()
+        [line] = [ln for ln in om.splitlines() if " # {" in ln]
+        assert '{request_id="a\\"b\\\\c\\nd"}' in line
+        assert "\n" not in line  # the raw newline would split the sample
+
+    def test_classic_format_is_unchanged_by_exemplars(self):
+        """An existing scraper must see identical bytes whether or not
+        exemplars were ever attached."""
+        with_ex = self._reg()
+        without = metrics.MetricsRegistry()
+        without.inc("io_retries_total", 2, reason="eio")
+        without.set("pool_queue_depth", 3, pool="pqt-io")
+        without.observe("serve_request_seconds", 0.26, endpoint="/v1/scan")
+        assert with_ex.render_prometheus() == without.render_prometheus()
+        classic = with_ex.render_prometheus()
+        assert "# EOF" not in classic and " # {" not in classic
+
+    def test_histograms_and_gauges_render_in_openmetrics(self):
+        om = self._reg().render_openmetrics()
+        assert "# TYPE parquet_tpu_pool_queue_depth gauge" in om
+        assert "# TYPE parquet_tpu_serve_request_seconds histogram" in om
+        assert (
+            'parquet_tpu_serve_request_seconds_bucket{endpoint="/v1/scan",le="+Inf"} 1'
+            in om
+        )
+        assert 'parquet_tpu_serve_request_seconds_count{endpoint="/v1/scan"} 1' in om
+
+    def test_module_render_refreshes_uptime_gauge(self):
+        text = metrics.render_prometheus()
+        assert "parquet_tpu_process_uptime_seconds" in text
+        assert "# TYPE parquet_tpu_process_uptime_seconds gauge" in text
+        up = metrics.get("process_uptime_seconds")
+        assert up >= 0
+        om = metrics.render_openmetrics()
+        assert "parquet_tpu_process_uptime_seconds" in om
+
+
 class TestGauges:
     def test_set_last_write_wins(self):
         metrics.set_gauge("pqt_test_gauge", 3)

@@ -591,11 +591,16 @@ class TestBenchJson:
             doc = json.load(f)
         assert "prepare_serial_s" in doc
         assert "stage_ms" in doc
-        # stdout keeps the one-line JSON contract too
+        # stdout keeps the one-line JSON contract too; the FILE artifact
+        # additionally carries the run-time config fingerprint the trend
+        # store records (bench_config — provenance, not a metric)
+        assert doc["bench_config"]["fingerprint"]
         line = [
             x for x in proc.stdout.decode().splitlines() if x.strip().startswith("{")
         ][-1]
-        assert json.loads(line) == doc
+        assert json.loads(line) == {
+            k: v for k, v in doc.items() if k != "bench_config"
+        }
 
 
 class TestBenchCompare:
@@ -675,8 +680,12 @@ class TestBenchCompare:
         # flags-before-paths ordering still resolves the two paths
         proc = run("--threshold", "0.2", str(a), str(a))
         assert proc.returncode == 0, proc.stderr
-        proc = run(str(a))
-        assert proc.returncode != 0 and "needs OLD.json NEW.json" in proc.stderr
+        # ONE path is now the trend-store form: the old side defaults to
+        # the latest recorded round — with no store, a clean typed message
+        proc = run(str(a), "--history", str(tmp_path / "missing.jsonl"))
+        assert proc.returncode != 0 and "no trend store" in proc.stderr
+        proc = run()
+        assert proc.returncode != 0 and "needs" in proc.stderr
 
     def test_matrix_lists_are_gated(self, tmp_path):
         # the full-run artifact stores the 5-config matrix as a LIST;

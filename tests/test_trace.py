@@ -177,6 +177,107 @@ class TestReport:
             t.report(sort="bytes")
 
 
+class TestExclusiveRollup:
+    """Sub-clock seconds count ONCE in rollups: a stage (or an
+    add_seconds/add_seconds_batch credit) committed inside another open
+    stage aggregate is part of that parent's wall time — before this fix
+    the report TOTAL and the flight-recorder rollup double-counted the
+    native prepare.* split against its measured parent, and every inner
+    decode stage against serve.execute."""
+
+    def test_golden_subclock_total(self):
+        """The golden pin: deterministic sub-clock credits inside a
+        measured parent leave TOTAL == exclusive wall, exactly."""
+        with decode_trace() as t:
+            add_seconds("standalone", 0.1)  # no parent open: exclusive
+            with stage("parent"):
+                add_seconds_batch(
+                    [("prepare.decompress", 0.04), ("prepare.levels", 0.01)]
+                )
+                add_seconds("prepare.crc", 0.02)
+        rollup = t.stage_rollup()
+        # the sub-clocks carry their nested share; the exclusive stages
+        # carry none
+        assert rollup["prepare.decompress"]["nested_seconds"] == 0.04
+        assert rollup["prepare.levels"]["nested_seconds"] == 0.01
+        assert rollup["prepare.crc"]["nested_seconds"] == 0.02
+        assert "nested_seconds" not in rollup["standalone"]
+        assert "nested_seconds" not in rollup["parent"]
+        expect = 0.1 + rollup["parent"]["seconds"]
+        assert abs(t.exclusive_seconds() - expect) < 1e-9
+        # the report TOTAL footer is the exclusive sum, not the inflated
+        # inclusive one (which would be expect + 0.07)
+        total_line = [
+            ln for ln in t.report().splitlines() if ln.startswith("TOTAL")
+        ][0]
+        total_ms = float(total_line.split()[1])
+        assert total_ms == pytest.approx(expect * 1e3, abs=0.05)
+        # sub-clocked stages are marked; the parent is not
+        rep = t.report()
+        assert any(
+            ln.startswith("prepare.decompress") and ln.endswith("*")
+            for ln in rep.splitlines()
+        )
+        assert "(* partly sub-clocked" in rep
+
+    def test_nested_stage_counts_once(self):
+        """The serve shape: inner decode stages under serve.execute."""
+        with decode_trace() as t:
+            with stage("serve.execute"):
+                with stage("decompress"):
+                    pass
+                with stage("decode"):
+                    pass
+        r = t.stage_rollup()
+        assert r["decompress"]["nested_seconds"] == r["decompress"]["seconds"]
+        assert r["decode"]["nested_seconds"] == r["decode"]["seconds"]
+        assert "nested_seconds" not in r["serve.execute"]
+        assert t.exclusive_seconds() == pytest.approx(
+            r["serve.execute"]["seconds"], abs=1e-9
+        )
+
+    def test_same_stage_nested_and_free_splits(self):
+        """One name used both inside and outside a parent: only the
+        nested share is excluded from the exclusive total."""
+        with decode_trace() as t:
+            add_seconds("io", 0.05)  # free-standing
+            with stage("serve.execute"):
+                add_seconds("io", 0.03)  # nested
+        r = t.stage_rollup()
+        assert r["io"]["seconds"] == pytest.approx(0.08)
+        assert r["io"]["nested_seconds"] == pytest.approx(0.03)
+        assert t.exclusive_seconds() == pytest.approx(
+            0.05 + r["serve.execute"]["seconds"], abs=1e-9
+        )
+
+    def test_span_is_not_a_parent(self):
+        """A pure hierarchy span bills no seconds, so sub-clocks inside
+        it (the fused native walk under the chunk.prepare span) must stay
+        EXCLUSIVE — excluding them would undercount the total."""
+        with decode_trace() as t:
+            with span("chunk.prepare"):
+                add_seconds_batch([("prepare.decompress", 0.04)])
+        r = t.stage_rollup()
+        assert "nested_seconds" not in r["prepare.decompress"]
+        assert t.exclusive_seconds() == pytest.approx(0.04)
+
+    def test_nesting_carries_into_pool_workers(self):
+        """instrumented_submit/traced_submit carry the open-stage depth
+        with the context: work a stage submits bills as nested on the
+        worker."""
+        pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="pqt-test")
+        try:
+            with decode_trace() as t:
+                with stage("serve.execute"):
+                    traced_submit(
+                        pool, lambda: add_seconds("io", 0.02)
+                    ).result(timeout=10)
+        finally:
+            pool.shutdown(wait=True)
+        r = t.stage_rollup()
+        assert r["io"]["nested_seconds"] == pytest.approx(0.02)
+
+
 def _check_event_schema(events):
     assert events, "no trace events"
     for ev in events:
