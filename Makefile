@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-query bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
@@ -54,6 +54,12 @@ bench-write: native
 # cold-vs-warm /v1/plan latency ratio; host-only, no accelerator
 bench-serve: native
 	python bench.py --serve
+
+# query push-down bench: vectorized vs scalar residual filtering on a
+# 1M-row numeric predicate, and filtered-AGGREGATE req/s (POST /v1/query)
+# vs row-streaming req/s of the same predicate; host-only, no accelerator
+bench-query: native
+	python bench.py --query
 
 # chaos bench: the scripted fault schedule (latency spike -> error burst ->
 # blackout -> recovery) against the SLO-controlled dataset pipeline vs

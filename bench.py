@@ -1202,21 +1202,25 @@ SERVE_FILES = int(os.environ.get("PQT_SERVE_FILES", 8))
 SERVE_REQUESTS = int(os.environ.get("PQT_SERVE_REQUESTS", 32))
 
 
-def _serve_dir() -> Path:
-    """A cached multi-file corpus for the daemon: SERVE_ROWS int64+float64
-    rows over SERVE_FILES files of a few row groups each, so one request
+def _serve_dir(
+    rows: int | None = None, files: int | None = None, row_group: int = 1 << 14
+) -> Path:
+    """A cached multi-file corpus for the daemon: `rows` int64+float64
+    rows over `files` files of `row_group`-row groups, so one request
     decodes a few units and concurrent requests spread across files."""
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    d = Path(f"/tmp/pqt_serve_{SERVE_ROWS}_{SERVE_FILES}")
+    rows = SERVE_ROWS if rows is None else rows
+    files = SERVE_FILES if files is None else files
+    d = Path(f"/tmp/pqt_serve_{rows}_{files}_{row_group}")
     if d.exists():
         return d
     d.mkdir(parents=True)
     rng = np.random.default_rng(17)
-    per = SERVE_ROWS // SERVE_FILES
-    log(f"bench: generating {SERVE_FILES}x{per:,}-row serve corpus at {d}")
-    for i in range(SERVE_FILES):
+    per = rows // files
+    log(f"bench: generating {files}x{per:,}-row serve corpus at {d}")
+    for i in range(files):
         t = pa.table(
             {
                 "id": pa.array(
@@ -1227,7 +1231,7 @@ def _serve_dir() -> Path:
         )
         pq.write_table(
             t, str(d / f"shard-{i:03d}.parquet"),
-            compression="snappy", row_group_size=1 << 14,
+            compression="snappy", row_group_size=row_group,
         )
     return d
 
@@ -1355,6 +1359,194 @@ def _phase_serve() -> None:
     log(
         f"bench: serve plan cold {out['plan_cold_ms']} ms vs warm "
         f"{out['plan_warm_ms']} ms = {out['plan_cold_vs_warm']}x"
+    )
+    _emit(out)
+
+
+# -- the query push-down benchmark (--query / phase "query") ------------------
+
+QUERY_ROWS = int(os.environ.get("PQT_QUERY_ROWS", 1_000_000))
+QUERY_REQUESTS = int(os.environ.get("PQT_QUERY_REQUESTS", 24))
+
+
+def _query_file() -> Path:
+    """A cached 1M-row numeric file for the vec-vs-scalar residual-filter
+    sweep (int64 id + float64 v, several row groups)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    p = Path(f"/tmp/pqt_query_{QUERY_ROWS}.parquet")
+    if p.exists():
+        return p
+    rng = np.random.default_rng(23)
+    t = pa.table(
+        {
+            "id": pa.array(np.arange(QUERY_ROWS, dtype=np.int64)),
+            "v": pa.array(rng.standard_normal(QUERY_ROWS)),
+        }
+    )
+    pq.write_table(t, str(p), compression="snappy", row_group_size=1 << 17)
+    return p
+
+
+def _phase_query() -> None:
+    """Query push-down benchmark (`bench.py --query` / `make bench-query`).
+
+    Two ceilings, measured head-on:
+      * residual filtering: rows/s of a filtered iter_rows over a 1M-row
+        numeric predicate, vectorized mask pipeline (core/filter_vec) vs
+        the scalar row_matches walk (PQT_VEC_FILTER=0) — outputs asserted
+        identical before timing;
+      * the serialization plateau: req/s of a filtered AGGREGATE query
+        (POST /v1/query — kilobyte bodies) vs the row-streaming jsonl scan
+        of the same predicate (POST /v1/scan) against a warm daemon.
+    Host-only; the result rides the --json artifact as "query"."""
+    import http.client
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from parquet_tpu.core.reader import FileReader
+    from parquet_tpu.serve import ScanServer, ServeConfig
+
+    out = {"config": "query", "stat": "median"}
+
+    # -- vec vs scalar residual filtering ------------------------------------
+    path = _query_file()
+    predicate = [["v", ">", 2.0]]  # ~2.3% selectivity: the dashboard shape
+
+    def filtered_rows() -> int:
+        with FileReader(str(path)) as r:
+            return sum(1 for _ in r.iter_rows(filters=predicate))
+
+    # restore the caller's engine choice afterwards: the serve comparison
+    # below (and any later phase) must run whatever the round configured
+    prior = os.environ.get("PQT_VEC_FILTER")
+    try:
+        os.environ["PQT_VEC_FILTER"] = "1"
+        k_vec = filtered_rows()  # warm + correctness reference
+        t_vec = timed_stats(
+            filtered_rows, REPEATS, "filter-vec", rows=QUERY_ROWS
+        )
+        os.environ["PQT_VEC_FILTER"] = "0"
+        k_scalar = filtered_rows()
+        assert k_scalar == k_vec, f"engines disagree: {k_vec} vs {k_scalar}"
+        t_scalar = timed_stats(
+            filtered_rows, max(1, REPEATS // 2), "filter-scalar",
+            rows=QUERY_ROWS,
+        )
+    finally:
+        if prior is None:
+            os.environ.pop("PQT_VEC_FILTER", None)
+        else:
+            os.environ["PQT_VEC_FILTER"] = prior
+    out["filter"] = {
+        "rows": QUERY_ROWS,
+        "predicate": "v > 2.0",
+        "rows_matched": k_vec,
+        "rows_s_vec": round(QUERY_ROWS / t_vec["t"], 1),
+        "rows_s_scalar": round(QUERY_ROWS / t_scalar["t"], 1),
+        "vec_vs_scalar": round(t_scalar["t"] / t_vec["t"], 2),
+    }
+    log(
+        f"bench: query filter 1M-row predicate: vec "
+        f"{out['filter']['rows_s_vec'] / 1e6:.2f} M rows/s vs scalar "
+        f"{out['filter']['rows_s_scalar'] / 1e6:.2f} M rows/s = "
+        f"{out['filter']['vec_vs_scalar']}x"
+    )
+
+    # -- filtered aggregate vs row streaming on the serve corpus --------------
+    # a production-shaped corpus: analytics files carry LARGE row groups
+    # (64Ki rows here vs the serve bench's concurrency-shaped 16Ki), and
+    # the aggregate's response is near-constant in result size while row
+    # streaming pays per matching row — the contrast push-down exists for
+    q_rows = int(os.environ.get("PQT_QUERY_SERVE_ROWS", 4 * SERVE_ROWS))
+    d = _serve_dir(q_rows, SERVE_FILES, row_group=1 << 16)
+    filt = [["v", ">", 0.0]]  # ~half the corpus survives: streaming hurts
+    scan_body = json.dumps(
+        {"paths": "shard-*.parquet", "filters": filt}
+    ).encode()
+    query_body = json.dumps(
+        {
+            "paths": "shard-*.parquet",
+            "filters": filt,
+            "aggregates": ["count", ["sum", "v"], ["min", "id"], ["max", "id"]],
+        }
+    ).encode()
+
+    def one(host, port, route, body):
+        conn = http.client.HTTPConnection(host, port, timeout=300)
+        try:
+            conn.request("POST", route, body=body)
+            resp = conn.getresponse()
+            payload = resp.read()
+            assert resp.status == 200, payload[:200]
+            return payload
+        finally:
+            conn.close()
+
+    def hammer(host, port, route, body, n, conc=4):
+        """Throughput at client concurrency `conc` — the production shape
+        (and the serve bench's): req/s is what the ratio pin is about."""
+        import threading
+
+        lat: list = []
+        sizes: list = []
+        lock = threading.Lock()
+        idx = iter(range(n))
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                t1 = time.perf_counter()
+                payload = one(host, port, route, body)
+                with lock:
+                    lat.append(time.perf_counter() - t1)
+                    sizes.append(len(payload))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker) for _ in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert len(lat) == n
+        return {
+            "rps": round(n / wall, 2),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+        }, sizes[-1]
+
+    with ScanServer(
+        ServeConfig(port=0, root=str(d), cache_mb=256, max_inflight=64)
+    ) as srv:
+        srv.start_background()
+        host, port = srv.host, srv.port
+        # warm caches end to end on both routes before timing
+        hammer(host, port, "/v1/query", query_body, 2, conc=2)
+        hammer(host, port, "/v1/scan", scan_body, 1, conc=1)
+        agg, agg_bytes = hammer(
+            host, port, "/v1/query", query_body, QUERY_REQUESTS
+        )
+        stream, stream_bytes = hammer(
+            host, port, "/v1/scan", scan_body, max(4, QUERY_REQUESTS // 4)
+        )
+    out["serve"] = {
+        "requests": QUERY_REQUESTS,
+        "rows": q_rows,
+        "files": SERVE_FILES,
+        "aggregate": agg,
+        "stream": stream,
+        "aggregate_bytes": agg_bytes,
+        "stream_bytes": stream_bytes,
+        "aggregate_vs_stream": round(agg["rps"] / stream["rps"], 2),
+    }
+    log(
+        f"bench: query serve: aggregate {agg['rps']} req/s "
+        f"({agg_bytes} B/resp) vs row-stream {stream['rps']} req/s "
+        f"({stream_bytes} B/resp) = {out['serve']['aggregate_vs_stream']}x"
     )
     _emit(out)
 
@@ -1998,6 +2190,19 @@ def main() -> None:
                 f"warm plan {r_serve['plan_cold_vs_warm']}x faster than cold"
             )
 
+    # query push-down sweep (PQT_BENCH_QUERY=0 to skip): vec-vs-scalar
+    # residual filtering + filtered-aggregate vs row-streaming req/s
+    r_query = None
+    if os.environ.get("PQT_BENCH_QUERY", "1") != "0":
+        r_query = _run_phase("query")
+        if r_query:
+            log(
+                f"bench: query filter vec "
+                f"{r_query['filter']['vec_vs_scalar']}x over scalar; "
+                f"aggregate {r_query['serve']['aggregate_vs_stream']}x "
+                "req/s over row streaming"
+            )
+
     # BASELINE.md 5-config matrix (per-config JSON on stderr + BENCH_MATRIX.json)
     results = None
     if os.environ.get("PQT_BENCH_MATRIX", "1") != "0":
@@ -2083,6 +2288,8 @@ def main() -> None:
         artifact["io"] = r_io
     if r_serve:
         artifact["serve"] = r_serve
+    if r_query:
+        artifact["query"] = r_query
     if r_chaos:
         artifact["chaos"] = r_chaos
     if r_asm:
@@ -2529,6 +2736,8 @@ if __name__ == "__main__":
         _phase_write()
     elif argv and argv[0] == "--serve":
         _phase_serve()
+    elif argv and argv[0] == "--query":
+        _phase_query()
     elif argv and argv[0] == "--chaos":
         _phase_chaos()
     elif len(argv) >= 2 and argv[0] == "--phase":
@@ -2547,6 +2756,8 @@ if __name__ == "__main__":
             _phase_io()
         elif name == "serve":
             _phase_serve()
+        elif name == "query":
+            _phase_query()
         elif name == "chaos":
             _phase_chaos()
         elif name == "assembly":

@@ -40,7 +40,10 @@ __all__ = [
     "dnf_page_ranges",
 ]
 
-_OPS = ("==", "!=", "<", "<=", ">", ">=", "is_null", "not_null", "in", "not_in")
+_OPS = (
+    "==", "!=", "<", "<=", ">", ">=", "is_null", "not_null", "in", "not_in",
+    "contains",
+)
 
 _EPOCH_DATE = dt.date(1970, 1, 1)
 _EPOCH_UTC = dt.datetime(1970, 1, 1, tzinfo=dt.timezone.utc)
@@ -93,10 +96,26 @@ def normalize_filters(schema: Schema, filters) -> list:
             leaf = schema.column(path)
         except Exception as e:
             raise FilterError(f"filter: unknown column {name!r}") from e
+        if op == "contains":
+            # list membership: the named field must resolve (through an
+            # annotated LIST wrapper, or directly for a legacy repeated
+            # leaf) to ONE single-level repeated element leaf. The row
+            # domain is the top-level field (rows hold the unwrapped list),
+            # so only top-level names are addressable.
+            if len(path) != 1:
+                raise FilterError(
+                    f"filter: contains on {name!r}: only top-level LIST "
+                    "columns can be tested for membership"
+                )
+            leaf = _contains_leaf(name, leaf)
+            row_value, stat_lo, stat_hi = _coerce_value(leaf, value)
+            out.append((leaf.path, leaf, op, row_value, stat_lo, stat_hi))
+            continue
         if not leaf.is_leaf or leaf.max_rep > 0:
             raise FilterError(
                 f"filter: {name!r} is not a flat leaf column (repeated/nested "
-                "columns cannot be pruned by chunk statistics)"
+                "columns cannot be pruned by chunk statistics; use "
+                "'contains' for LIST membership)"
             )
         if op in ("is_null", "not_null"):
             if value is not None:
@@ -127,6 +146,26 @@ def normalize_filters(schema: Schema, filters) -> list:
         row_value, stat_lo, stat_hi = _coerce_value(leaf, value)
         out.append((path, leaf, op, row_value, stat_lo, stat_hi))
     return out
+
+
+def _contains_leaf(name, node):
+    """Resolve a top-level field to its single LIST element leaf for a
+    'contains' predicate: a legacy repeated leaf IS the element; an
+    annotated LIST wrapper descends its single-child chain. Anything else
+    (struct elements, multi-level lists, flat leaves) is refused typed."""
+    while not node.is_leaf:
+        if len(node.children) != 1:
+            raise FilterError(
+                f"filter: contains on {name!r}: list elements must be a "
+                "single leaf column (struct elements cannot be compared)"
+            )
+        node = node.children[0]
+    if node.max_rep != 1:
+        raise FilterError(
+            f"filter: contains on {name!r}: expected a single-level LIST "
+            f"column (element repetition depth is {node.max_rep})"
+        )
+    return node
 
 
 def _unify_members(rows: list) -> list:
@@ -320,6 +359,10 @@ def _bounds_admit(op, vlo, vhi, lo, hi, null_count) -> bool:
     [vlo, vhi] brackets the filter value in the stat domain; vlo != vhi
     means the value falls between representable stored values, so each
     comparison uses the end that keeps pruning conservative."""
+    if op == "contains":
+        # a list can only contain the value if some ELEMENT equals it, and
+        # the stats bracket the element values — equality semantics
+        op = "=="
     if op == "in":
         # admits iff ANY member could be present ([] provably matches nothing)
         return any(
@@ -568,6 +611,18 @@ def row_matches(row: dict, normalized) -> bool:
             continue
         if op == "not_null":
             if v is None:
+                return False
+            continue
+        if op == "contains":
+            # rows hold the unwrapped list under the TOP name (the leaf
+            # path addresses the element for stats; normalize_filters pins
+            # len-1 user paths, so path[0] is the top field)
+            v = row.get(path[0])
+            if not isinstance(v, list):
+                return False  # null list, or not the expected shape
+            if not any(
+                e is not None and _lift_row_value(e, value) == value for e in v
+            ):
                 return False
             continue
         if v is None:

@@ -1400,35 +1400,126 @@ class FileReader:
                 indexes = None
             if ranges is not None and not ranges:
                 continue
-            if read_cols is not None:
-                for row in self._iter_group_rows(i, raw, ranges, indexes, read_cols):
-                    if dnf_row_matches(row, dnf):
-                        for parents, key in strips:
-                            d = row
-                            for part in parents:
-                                d = d.get(part) if isinstance(d, dict) else None
-                                if d is None:
-                                    break
-                            if isinstance(d, dict):
-                                d.pop(key, None)
-                        yield row
-            else:
-                for row in self._iter_group_rows(i, raw, ranges, indexes):
-                    if dnf_row_matches(row, dnf):
-                        yield row
+            yield from self._filtered_group_rows(
+                i, raw, dnf, ranges, indexes, read_cols, strips
+            )
 
-    def _iter_group_rows(
-        self, i: int, raw: bool, ranges=None, indexes=None, columns=None
+    def _filtered_group_rows(
+        self, i: int, raw: bool, dnf, ranges, indexes, read_cols, strips
     ):
-        """One row group's rows: a LIST for small vectorized shapes (callers
-        iterate without an extra generator frame per row), a window-batched
-        generator for large ones (bounds the live tracked-object count so
-        cyclic GC passes stay cheap), or the streaming Dremel fallback.
-        `ranges` (sorted disjoint [(start, stop)), from the page index)
-        limits which rows materialize; when every selected column is flat
-        and indexed, only the pages covering the ranges are even READ and
-        decoded (selective page decode). The Dremel fallback ignores ranges
-        (the caller's exact predicate check keeps the result correct)."""
+        """One row group's rows surviving the residual predicate.
+
+        The vectorized path: the decoded chunks compile into ONE boolean
+        row mask (core/filter_vec.dnf_mask — per-leaf masks over the
+        columnar buffers, AND within conjunctions, OR across them) and only
+        matching rows ever materialize, windowed over the mask's True-runs.
+        Shapes or value domains the mask pipeline cannot prove raise the
+        typed VecFilterError and this falls back to the scalar per-row
+        `row_matches` walk — identical output, the engine-ladder contract
+        of assembly_vec (PQT_VEC_FILTER=0 forces the scalar oracle)."""
+        from .filter import dnf_row_matches
+        from .filter_vec import (
+            VecFilterError,
+            dnf_mask,
+            group_row_count,
+            masked_flat_columns,
+            vec_filter_enabled,
+        )
+
+        chunks, sliced = self._decode_group_chunks(i, ranges, indexes, read_cols)
+        if not chunks:
+            return  # quarantined group (on_error='skip'), or empty selection
+        mask = None
+        if vec_filter_enabled() and vec_enabled():
+            try:
+                with timed_stage("assembly.filter") as el:
+                    mask = dnf_mask(chunks, dnf, group_row_count(chunks))
+                _metrics.observe("filter_mask_seconds", el.seconds)
+            except VecFilterError:
+                mask = None
+        if mask is not None:
+            kept = int(mask.sum())
+            if kept:
+                # rows assemble from the PROJECTION only: filter-only leaf
+                # chunks never build row values, so the strip pass the
+                # scalar path needs does not exist here
+                row_chunks = (
+                    chunks
+                    if self._selected is None
+                    else {p: cd for p, cd in chunks.items() if p in self._selected}
+                )
+                # flat schemas gather ONLY the kept rows (value boxing and
+                # logical conversion scale with matches, not group size)
+                flat = None
+                try:
+                    with stage("assemble"):
+                        flat = masked_flat_columns(row_chunks, raw, mask)
+                except VecFilterError:
+                    flat = None
+                if flat is not None:
+                    bump("assemble_vec")
+                    _metrics.inc(
+                        "query_rows_filtered_total",
+                        len(mask) - kept,
+                        engine="vec",
+                    )
+                    names, columns, k = flat
+                    if names and k:
+                        yield from self._column_rows(names, columns, k)
+                    return
+                rc = None
+                with stage("assemble"):
+                    with _gc_paused():
+                        rc = assemble_row_columns(self.schema, row_chunks, raw)
+                if rc is not None and rc[2] == len(mask):
+                    bump("assemble_vec")
+                    _metrics.inc(
+                        "query_rows_filtered_total",
+                        len(mask) - kept,
+                        engine="vec",
+                    )
+                    names, columns, _n = rc
+                    if names:
+                        yield from self._masked_rows(names, columns, mask)
+                    return
+            else:
+                # the mask alone proved the group empty of matches: no rows
+                # assemble under either engine, the filtering was vec's
+                _metrics.inc(
+                    "query_rows_filtered_total", len(mask), engine="vec"
+                )
+                return
+            # row assembly couldn't prove the shape: the scalar walk below
+            # decides (and raises its precise error on real inconsistency) —
+            # the metric is counted THERE, never here too (one engine, one
+            # count)
+            mask = None
+        evaluated = kept = 0
+        try:
+            for row in self._rows_from_chunks(chunks, raw, ranges, sliced):
+                evaluated += 1
+                if not dnf_row_matches(row, dnf):
+                    continue
+                kept += 1
+                for parents, key in strips:
+                    d = row
+                    for part in parents:
+                        d = d.get(part) if isinstance(d, dict) else None
+                        if d is None:
+                            break
+                    if isinstance(d, dict):
+                        d.pop(key, None)
+                yield row
+        finally:
+            _metrics.inc(
+                "query_rows_filtered_total", evaluated - kept, engine="scalar"
+            )
+
+    def _decode_group_chunks(self, i: int, ranges, indexes, columns):
+        """(chunks, sliced) for one row group: selective page decode when
+        the page index proves `ranges` (sorted disjoint row windows) cover
+        few enough rows, else the full decode. sliced=True means the chunks
+        hold exactly the ranges' rows."""
         chunks = None
         sliced = False
         if ranges is not None:
@@ -1445,8 +1536,26 @@ class FileReader:
                 bump("selective_page_decode")
         if chunks is None:
             chunks = self._read_row_group(i, columns, pack=False)
+        return chunks, sliced
+
+    def _iter_group_rows(
+        self, i: int, raw: bool, ranges=None, indexes=None, columns=None
+    ):
+        """One row group's rows: a LIST for small vectorized shapes (callers
+        iterate without an extra generator frame per row), a window-batched
+        generator for large ones (bounds the live tracked-object count so
+        cyclic GC passes stay cheap), or the streaming Dremel fallback.
+        `ranges` (sorted disjoint [(start, stop)), from the page index)
+        limits which rows materialize; when every selected column is flat
+        and indexed, only the pages covering the ranges are even READ and
+        decoded (selective page decode). The Dremel fallback ignores ranges
+        (the caller's exact predicate check keeps the result correct)."""
+        chunks, sliced = self._decode_group_chunks(i, ranges, indexes, columns)
         if not chunks:
             return []  # quarantined group (on_error='skip'), or empty selection
+        return self._rows_from_chunks(chunks, raw, ranges, sliced)
+
+    def _rows_from_chunks(self, chunks: dict, raw: bool, ranges=None, sliced=False):
         rc = None
         if vec_enabled():
             # the vectorized engine: level prefix scans -> offsets/validity
@@ -1527,6 +1636,60 @@ class FileReader:
         return out
 
     @staticmethod
+    def _column_rows(names, columns, n):
+        """Row dicts from already-gathered column value lists, windowed to
+        bound live tracked objects like every other materialization path."""
+
+        def windows():
+            for s in range(0, n, _ASSEMBLE_WINDOW):
+                e = min(s + _ASSEMBLE_WINDOW, n)
+                with timed_stage("assembly.rows") as el, _gc_paused():
+                    rows = _zip_dict_rows(names, [c[s:e] for c in columns])
+                _metrics.inc("assembly_rows_total", e - s, engine="vec")
+                _metrics.observe("assembly_seconds", el.seconds)
+                yield rows
+
+        return itertools.chain.from_iterable(windows())
+
+    @staticmethod
+    def _masked_rows(names, columns, mask):
+        """Materialize only the rows a boolean mask keeps, windowed like
+        _ranged_rows. One itertools.compress pass per window gathers
+        arbitrary (even per-row fragmented) masks at C speed — a run-list
+        gather would pay a Python window round trip PER RUN, which for a
+        selective predicate over random data is one per kept row."""
+        from itertools import compress
+
+        from .assembly_vec import _materialize_spec
+
+        n = len(mask)
+
+        def windows():
+            for s in range(0, n, _ASSEMBLE_WINDOW):
+                e = min(s + _ASSEMBLE_WINDOW, n)
+                wm = mask[s:e]
+                k = int(wm.sum())
+                if not k:
+                    continue
+                with timed_stage("assembly.rows") as el, _gc_paused():
+                    if k == e - s:
+                        cols = [slice_column(c, s, e) for c in columns]
+                    else:
+                        wml = wm.tolist()
+                        cols = []
+                        for c in columns:
+                            wc = slice_column(c, s, e)
+                            if isinstance(wc, tuple):
+                                wc = _materialize_spec(wc)
+                            cols.append(list(compress(wc, wml)))
+                    rows = _zip_dict_rows(names, cols)
+                _metrics.inc("assembly_rows_total", k, engine="vec")
+                _metrics.observe("assembly_seconds", el.seconds)
+                yield rows
+
+        return itertools.chain.from_iterable(windows())
+
+    @staticmethod
     def _ranged_rows(names, columns, ranges):
         # chain.from_iterable over window LISTS: the per-row next() is pure
         # C (no Python generator frame resumes per row — those cost more
@@ -1582,46 +1745,9 @@ class FileReader:
             )
         import pyarrow as pa
 
-        from ..meta.parquet_types import Type
-        from .arrow_nested import build_top_field, nested_arrow_type, retype_leaf
-        from .arrays import ByteArrayData
+        from .arrow_nested import nested_arrow_type
 
-        def _fast_kind(paths):
-            """'flat' | 'list' | 'nested' for one top-level field's leaves."""
-            if len(paths) != 1:
-                return "nested"
-            path = paths[0]
-            leaf = self.schema.column(path)
-            if leaf.max_rep == 0 and len(path) == 1:
-                return "flat"
-            if self._is_canonical_list(path, leaf) and leaf.type not in (
-                Type.FIXED_LEN_BYTE_ARRAY, Type.INT96,
-            ):
-                return "list"
-            return "nested"
-
-        # dictionary-preserving columns: flat BYTE_ARRAY tops only
-        dict_paths = frozenset()
-        if read_dictionary:
-            wanted = set()
-            for name in read_dictionary:
-                path = (
-                    tuple(name.split(".")) if isinstance(name, str) else tuple(name)
-                )
-                try:
-                    leaf = self.schema.column(path)
-                except Exception as e:
-                    raise ParquetFileError(
-                        f"parquet: read_dictionary column {name!r} not in schema"
-                    ) from e
-                if (
-                    len(path) == 1
-                    and leaf.is_leaf
-                    and leaf.max_rep == 0
-                    and leaf.type == Type.BYTE_ARRAY
-                ):
-                    wanted.add(path)
-            dict_paths = frozenset(wanted)
+        dict_paths = self._dict_paths(read_dictionary)
         indices = list(
             range(self.num_row_groups) if row_groups is None else row_groups
         )
@@ -1652,80 +1778,7 @@ class FileReader:
             )
             if not chunks:
                 continue  # quarantined group (on_error != 'raise')
-            by_top: dict[str, dict] = {}
-            for path, cd in chunks.items():
-                by_top.setdefault(path[0], {})[path] = cd
-            cols = {}
-            for top_name, sub in by_top.items():
-                kind = _fast_kind(list(sub))
-                if kind == "nested":
-                    cols[top_name] = build_top_field(pa, self.schema, top_name, sub)
-                    continue
-                (path, cd), = sub.items()
-                leaf = self.schema.column(path)
-                if kind == "list":
-                    cols[top_name] = self._arrow_list_column(pa, path, leaf, cd)
-                    continue
-                if cd.indices is not None and isinstance(
-                    cd.dictionary, ByteArrayData
-                ):
-                    cols[top_name] = self._arrow_dictionary_column(pa, leaf, cd)
-                    continue
-                mask = None
-                if cd.def_levels is not None and leaf.max_def > 0:
-                    valid = np.asarray(cd.def_levels) == leaf.max_def
-                    if not valid.all():
-                        mask = ~valid
-                values = cd.values
-                if isinstance(values, ByteArrayData):
-                    atype = (
-                        pa.large_string() if leaf.is_string() else pa.large_binary()
-                    )
-                    offsets = np.ascontiguousarray(values.offsets, dtype=np.int64)
-                    data = values.data
-                    if mask is not None:
-                        # expand offsets to row positions: null rows repeat
-                        # the running offset (zero-length slot)
-                        offsets = _scatter_byte_offsets(valid, offsets)
-                    n = len(offsets) - 1
-                    bufs = [
-                        None
-                        if mask is None
-                        else pa.py_buffer(
-                            np.packbits(valid, bitorder="little").tobytes()
-                        ),
-                        pa.py_buffer(offsets),
-                        pa.py_buffer(data),
-                    ]
-                    arr = pa.Array.from_buffers(
-                        atype, n, bufs,
-                        null_count=int(mask.sum()) if mask is not None else 0,
-                    )
-                else:
-                    np_vals = np.asarray(values)
-                    if np_vals.ndim == 2:  # FLBA / INT96 rows
-                        atype = pa.binary(np_vals.shape[1])
-                        if mask is None:
-                            flat = np.ascontiguousarray(np_vals).reshape(-1)
-                            arr = pa.Array.from_buffers(
-                                atype, len(np_vals), [None, pa.py_buffer(flat)]
-                            )
-                        else:
-                            # values are DENSE (non-null cells only):
-                            # scatter them to their row positions
-                            it = iter(np_vals)
-                            rows = [
-                                bytes(next(it)) if ok else None for ok in valid
-                            ]
-                            arr = pa.array(rows, atype)
-                    elif mask is not None:
-                        # dense non-null cells scatter to row positions
-                        expanded = np.zeros(len(valid), np_vals.dtype)
-                        expanded[valid] = np_vals
-                        arr = pa.array(expanded, mask=mask)
-                    else:
-                        arr = pa.array(np_vals)
-                cols[path[0]] = retype_leaf(pa, leaf, arr)
+            cols = self._arrow_group_cols(pa, chunks, dict_paths)
             if names is None:
                 names = list(cols)
             per_group.append(cols)
@@ -1753,6 +1806,131 @@ class FileReader:
                 ]
             arrays.append(pa.chunked_array(parts))
         return pa.table(dict(zip(names, arrays)))
+
+    def _dict_paths(self, read_dictionary) -> frozenset:
+        """The dictionary-preserving projection (read_dictionary=): flat
+        BYTE_ARRAY tops only."""
+        from ..meta.parquet_types import Type
+
+        if not read_dictionary:
+            return frozenset()
+        wanted = set()
+        for name in read_dictionary:
+            path = (
+                tuple(name.split(".")) if isinstance(name, str) else tuple(name)
+            )
+            try:
+                leaf = self.schema.column(path)
+            except Exception as e:
+                raise ParquetFileError(
+                    f"parquet: read_dictionary column {name!r} not in schema"
+                ) from e
+            if (
+                len(path) == 1
+                and leaf.is_leaf
+                and leaf.max_rep == 0
+                and leaf.type == Type.BYTE_ARRAY
+            ):
+                wanted.add(path)
+        return frozenset(wanted)
+
+    def _arrow_group_cols(self, pa, chunks: dict, dict_paths) -> dict:
+        """{top-level name: pyarrow array} for one decoded row group — the
+        per-group body of to_arrow, shared with the filtered fast path so
+        a group's chunks decode exactly once however they were read."""
+        from ..meta.parquet_types import Type
+        from .arrow_nested import build_top_field, retype_leaf
+        from .arrays import ByteArrayData
+
+        def _fast_kind(paths):
+            """'flat' | 'list' | 'nested' for one top-level field's leaves."""
+            if len(paths) != 1:
+                return "nested"
+            path = paths[0]
+            leaf = self.schema.column(path)
+            if leaf.max_rep == 0 and len(path) == 1:
+                return "flat"
+            if self._is_canonical_list(path, leaf) and leaf.type not in (
+                Type.FIXED_LEN_BYTE_ARRAY, Type.INT96,
+            ):
+                return "list"
+            return "nested"
+
+        by_top: dict[str, dict] = {}
+        for path, cd in chunks.items():
+            by_top.setdefault(path[0], {})[path] = cd
+        cols = {}
+        for top_name, sub in by_top.items():
+            kind = _fast_kind(list(sub))
+            if kind == "nested":
+                cols[top_name] = build_top_field(pa, self.schema, top_name, sub)
+                continue
+            (path, cd), = sub.items()
+            leaf = self.schema.column(path)
+            if kind == "list":
+                cols[top_name] = self._arrow_list_column(pa, path, leaf, cd)
+                continue
+            if cd.indices is not None and isinstance(
+                cd.dictionary, ByteArrayData
+            ):
+                cols[top_name] = self._arrow_dictionary_column(pa, leaf, cd)
+                continue
+            mask = None
+            if cd.def_levels is not None and leaf.max_def > 0:
+                valid = np.asarray(cd.def_levels) == leaf.max_def
+                if not valid.all():
+                    mask = ~valid
+            values = cd.values
+            if isinstance(values, ByteArrayData):
+                atype = (
+                    pa.large_string() if leaf.is_string() else pa.large_binary()
+                )
+                offsets = np.ascontiguousarray(values.offsets, dtype=np.int64)
+                data = values.data
+                if mask is not None:
+                    # expand offsets to row positions: null rows repeat
+                    # the running offset (zero-length slot)
+                    offsets = _scatter_byte_offsets(valid, offsets)
+                n = len(offsets) - 1
+                bufs = [
+                    None
+                    if mask is None
+                    else pa.py_buffer(
+                        np.packbits(valid, bitorder="little").tobytes()
+                    ),
+                    pa.py_buffer(offsets),
+                    pa.py_buffer(data),
+                ]
+                arr = pa.Array.from_buffers(
+                    atype, n, bufs,
+                    null_count=int(mask.sum()) if mask is not None else 0,
+                )
+            else:
+                np_vals = np.asarray(values)
+                if np_vals.ndim == 2:  # FLBA / INT96 rows
+                    atype = pa.binary(np_vals.shape[1])
+                    if mask is None:
+                        flat = np.ascontiguousarray(np_vals).reshape(-1)
+                        arr = pa.Array.from_buffers(
+                            atype, len(np_vals), [None, pa.py_buffer(flat)]
+                        )
+                    else:
+                        # values are DENSE (non-null cells only):
+                        # scatter them to their row positions
+                        it = iter(np_vals)
+                        rows = [
+                            bytes(next(it)) if ok else None for ok in valid
+                        ]
+                        arr = pa.array(rows, atype)
+                elif mask is not None:
+                    # dense non-null cells scatter to row positions
+                    expanded = np.zeros(len(valid), np_vals.dtype)
+                    expanded[valid] = np_vals
+                    arr = pa.array(expanded, mask=mask)
+                else:
+                    arr = pa.array(np_vals)
+            cols[path[0]] = retype_leaf(pa, leaf, arr)
+        return cols
 
     def _arrow_dictionary_column(self, pa, leaf, cd):
         """A dictionary-preserved chunk -> pyarrow DictionaryArray: the
@@ -1787,7 +1965,14 @@ class FileReader:
         The row mask evaluates over a SEPARATE read of just the filter
         leaves, so a predicate on a projected-out column — even a nested
         sibling leaf — filters without leaking into the output schema
-        (leaf-granular, like iter_rows' strips)."""
+        (leaf-granular, like iter_rows' strips).
+
+        Fast path: when the vectorized mask pipeline covers every predicate
+        (core/filter_vec, arrow null semantics), each group's mask compiles
+        straight off the decoded filter-leaf chunks and applies as ONE
+        buffer-level take (`table.filter`) — no combine_chunks copies, no
+        per-row work, record batches stream zero-copy into the IPC writer.
+        VecFilterError falls back to the pyarrow-compute path below."""
         import pyarrow as pa
         import pyarrow.compute as pc
 
@@ -1801,6 +1986,13 @@ class FileReader:
             )
             if dnf_group_may_match(self.row_group(i), dnf, self._bloom_excludes, i)
         ]
+        vacuous = not dnf or any(not conj for conj in dnf)
+        if indices and not vacuous and self.on_error == "raise":
+            out = self._to_arrow_vec_filtered(
+                pa, dnf, indices, columns, read_dictionary
+            )
+            if out is not None:
+                return out
         # flat top-level filter columns already in the projection evaluate
         # straight off `table`; only projected-out or nested paths pay a
         # second (filter-leaves-only) read
@@ -1811,7 +2003,6 @@ class FileReader:
             for p in fpaths
             if len(p) > 1 or (sel is not None and p not in sel)
         ]
-        vacuous = not dnf or any(not conj for conj in dnf)
         ftab = None
         if extra and not vacuous and self.on_error != "raise":
             # Quarantine decisions depend on which columns a read touches,
@@ -1858,17 +2049,20 @@ class FileReader:
         combined: dict = {}
         leaf_cache: dict = {}
 
-        def leaf_col(path):
-            arr = leaf_cache.get(path)
-            if arr is not None:
-                return arr
+        def base_col(path):
             key = (path in extra or len(path) > 1, path[0])
             base = combined.get(key)
             if base is None:
                 src = ftab if key[0] else table
                 base = combined[key] = src.column(path[0]).combine_chunks()
                 bump("filter_combine_chunks")
-            arr = base
+            return base
+
+        def leaf_col(path):
+            arr = leaf_cache.get(path)
+            if arr is not None:
+                return arr
+            arr = base_col(path)
             if len(path) > 1:
                 arr = pc.struct_field(arr, list(path[1:]))
             leaf_cache[path] = arr
@@ -1879,6 +2073,13 @@ class FileReader:
             for conj in dnf:
                 m = None
                 for path, _leaf, op, rv, _lo, _hi in conj:
+                    if op == "contains":
+                        # the LIST wrapper itself carries the predicate: its
+                        # leaf path addresses the element for stats, but the
+                        # arrow column is the top-level list
+                        p = self._arrow_contains_mask(pa, pc, base_col(path), rv)
+                        m = p if m is None else pc.and_kleene(m, p)
+                        continue
                     arr = leaf_col(path)
                     if op == "is_null":
                         p = pc.is_null(arr)
@@ -1908,7 +2109,103 @@ class FileReader:
         # pc.is_in maps null to false, so invert KEEPS null rows (pyarrow's
         # convention). iter_rows' row predicate instead fails every op on
         # null (SQL-ish); the difference is pinned by tests.
-        return table.filter(mask)
+        out = table.filter(mask)
+        _metrics.inc(
+            "query_rows_filtered_total",
+            table.num_rows - out.num_rows,
+            engine="arrow",
+        )
+        return out
+
+    def _arrow_contains_mask(self, pa, pc, col, rv):
+        """Row mask for a ('tags', 'contains', x) predicate over an arrow
+        LIST column: one vectorized equality over the FLATTENED elements,
+        lifted to rows through list_parent_indices — null lists contribute
+        no elements and null elements compare null, so neither matches
+        (identical to the scalar walk and the chunk-level mask)."""
+        value = rv
+        t = col.type
+        if isinstance(rv, (bytes, bytearray)) and (
+            pa.types.is_list(t) or pa.types.is_large_list(t)
+        ) and (
+            pa.types.is_string(t.value_type)
+            or pa.types.is_large_string(t.value_type)
+        ):
+            # string element leaves coerce to bytes in the filter domain;
+            # the arrow column compares in str space
+            value = bytes(rv).decode("utf-8", errors="replace")
+        flat = pc.list_flatten(col)
+        parents = pc.list_parent_indices(col)
+        em = pc.fill_null(pc.equal(flat, value), False)
+        if isinstance(em, pa.ChunkedArray):
+            em = em.combine_chunks()
+        if isinstance(parents, pa.ChunkedArray):
+            parents = parents.combine_chunks()
+        hits = np.asarray(parents)[np.asarray(em)]
+        m = np.zeros(len(col), dtype=bool)
+        m[hits] = True
+        return pa.array(m)
+
+    def _to_arrow_vec_filtered(self, pa, dnf, indices, columns, read_dictionary):
+        """The zero-copy filtered-read fast path: per group, the residual
+        mask compiles off the decoded filter-leaf chunks (core/filter_vec,
+        arrow null semantics so both paths stay value-identical) and
+        applies as ONE buffer-level take (`Table.filter`) — no
+        combine_chunks copies, no per-row predicate work. Returns None when
+        the mask pipeline declines any predicate (VecFilterError), letting
+        the pyarrow-compute path decide."""
+        from .filter_vec import (
+            VecFilterError,
+            dnf_mask,
+            group_row_count,
+            vec_filter_enabled,
+        )
+
+        if not vec_filter_enabled() or not vec_enabled():
+            return None
+        fcols = {p for conj in dnf for p, *_ in conj}
+        sel = self._resolve_columns(columns) if columns else self._selected
+        # ONE decode per group covers projection AND filter leaves; the
+        # mask compiles off the same chunks the table is built from
+        read_cols = None if sel is None else sorted(sel | fcols)
+        dict_paths = self._dict_paths(read_dictionary)
+        parts = []
+        filtered = 0
+        try:
+            for i in indices:
+                chunks = self._read_row_group(
+                    i, read_cols, pack=False, dict_paths=dict_paths
+                )
+                if not chunks:
+                    raise VecFilterError("filter_vec: group undecodable")
+                n_rows = group_row_count(chunks)
+                with timed_stage("assembly.filter") as el:
+                    mask = dnf_mask(chunks, dnf, n_rows, null_mode="arrow")
+                _metrics.observe("filter_mask_seconds", el.seconds)
+                kept = int(mask.sum())
+                filtered += n_rows - kept
+                if not kept:
+                    continue  # the whole group drops: never build its table
+                proj = (
+                    chunks
+                    if sel is None
+                    else {p: cd for p, cd in chunks.items() if p in sel}
+                )
+                t_i = pa.table(self._arrow_group_cols(pa, proj, dict_paths))
+                if t_i.num_rows != n_rows:
+                    raise VecFilterError("filter_vec: projection row drift")
+                parts.append(
+                    t_i if kept == n_rows else t_i.filter(pa.array(mask))
+                )
+        except VecFilterError:
+            return None
+        _metrics.inc("query_rows_filtered_total", filtered, engine="vec")
+        table = _concat_group_tables(pa, parts)
+        if table is None:
+            return self.to_arrow(
+                row_groups=[], columns=columns, read_dictionary=read_dictionary
+            )
+        return table
 
     def _is_canonical_list(self, path, leaf) -> bool:
         """True for the one list shape _arrow_list_column's level math

@@ -76,6 +76,9 @@ __all__ = [
     "dict_gather_device",
     "list_layout_device",
     "record_starts_device",
+    "predicate_mask_device",
+    "list_contains_mask_device",
+    "mask_take_device",
 ]
 
 # Largest bit offset representable in the int32 position math (host drivers
@@ -310,6 +313,90 @@ def list_layout_device(
         .add(jnp.where(boundary, dfl, 0).astype(jnp.int32))
     )
     return offsets, first_def, jnp.sum(boundary.astype(jnp.int32))
+
+
+# -- query push-down: predicate -> mask -> gather, device-resident --------------
+
+
+@partial(jax.jit, static_argnames=("op", "exact"))
+def predicate_mask_device(values: jnp.ndarray, op: str, lo, hi, exact: bool = True):
+    """One leaf predicate as a device boolean mask — the jittable twin of
+    core/filter_vec's bracket comparison, so residual filtering of
+    device-resident columns (read_row_group_device / DeviceColumn values)
+    never round-trips the host.
+
+    `lo`/`hi` bracket the filter value in the column's physical domain
+    exactly like normalize_filters computes them; `exact` (static) is
+    lo == hi — an inexact bracket means the value falls BETWEEN
+    representable stored values, so equality is impossible and ordered ops
+    use the end that stays exact. Masks combine with & / | (conjunction /
+    DNF) and feed mask_take_device for the gather."""
+    if op == "==":
+        return (values == lo) if exact else jnp.zeros(values.shape, dtype=bool)
+    if op == "!=":
+        return (values != lo) if exact else jnp.ones(values.shape, dtype=bool)
+    if op == "<":
+        return (values < lo) if exact else (values <= lo)
+    if op == "<=":
+        return values <= lo
+    if op == ">":
+        return (values > hi) if exact else (values >= hi)
+    if op == ">=":
+        return values >= hi
+    raise ValueError(f"predicate_mask_device: unsupported op {op!r}")
+
+
+@jax.jit
+def list_contains_mask_device(
+    rep: jnp.ndarray,  # int32[n]: repetition levels of one LIST leaf
+    dfl: jnp.ndarray,  # int32[n]: definition levels of the same leaf
+    dense_match: jnp.ndarray,  # bool[nv]: equality mask over the DENSE values
+    elem_def,  # int32 scalar: def level at which an element is present
+):
+    """('tags', 'contains', x) at the list-slot level, on device: the dense
+    per-element equality mask scatters through the level streams to row
+    membership — the same record-start prefix scan as record_starts_device
+    composed with the validity gather of list_layout_device. Returns
+    (rows bool[n], n_rows int32): entries past n_rows are padding."""
+    n = rep.shape[0]
+    valid = dfl == elem_def
+    didx = jnp.clip(
+        jnp.cumsum(valid.astype(jnp.int32)) - 1,
+        0,
+        max(dense_match.shape[0] - 1, 0),
+    )
+    if dense_match.shape[0]:
+        entry_match = valid & dense_match[didx]
+    else:
+        entry_match = jnp.zeros(n, dtype=bool)
+    starts = (rep == 0).astype(jnp.int32)
+    row_of = jnp.cumsum(starts) - 1
+    rows = (
+        jnp.zeros(n, dtype=bool)
+        .at[jnp.clip(row_of, 0, max(n - 1, 0))]
+        .max(entry_match)
+    )
+    return rows, jnp.sum(starts)
+
+
+@partial(jax.jit, static_argnames=("out_pad",))
+def mask_take_device(values: jnp.ndarray, mask: jnp.ndarray, out_pad: int):
+    """Compact `values[mask]` into a static out_pad-sized buffer on device
+    (the gather stage of predicate -> mask -> gather; static shapes bound
+    the compile count, SURVEY §7.1). Returns (taken, count): positions past
+    `count` hold values[0] as padding — callers slice on the host after a
+    (tiny) count fetch, or carry (taken, count) into downstream masked
+    kernels unsliced."""
+    n = values.shape[0]
+    pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    tgt = jnp.where(mask, pos, out_pad)
+    src = (
+        jnp.zeros(out_pad + 1, dtype=jnp.int32)
+        .at[jnp.clip(tgt, 0, out_pad)]
+        .max(jnp.arange(n, dtype=jnp.int32))[:out_pad]
+    )
+    taken = values[src] if n else jnp.zeros((out_pad,), values.dtype)
+    return taken, jnp.sum(mask.astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("rows_pad",))

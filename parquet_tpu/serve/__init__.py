@@ -9,12 +9,17 @@ deadlines, graceful drain), all behind a stdlib HTTP daemon
 """
 
 from .admission import AdmissionController, Deadline  # noqa: F401
-from .executor import execute_stream, serve_pool  # noqa: F401
+from .aggregate import render_query_body, run_local_query  # noqa: F401
+from .executor import execute_query, execute_stream, serve_pool  # noqa: F401
 from .protocol import (  # noqa: F401
+    AggregateSpec,
+    QueryRequest,
     ScanRequest,
     ServeError,
+    aggregates_from_spec,
     filters_from_spec,
     json_default,
+    parse_query_request,
     parse_scan_request,
 )
 from .server import ScanServer, ScanService, ServeConfig  # noqa: F401
@@ -23,16 +28,23 @@ from .session import PlannedScan, ScanSession  # noqa: F401
 __all__ = [
     "ServeError",
     "ScanRequest",
+    "QueryRequest",
+    "AggregateSpec",
     "parse_scan_request",
+    "parse_query_request",
     "filters_from_spec",
+    "aggregates_from_spec",
     "json_default",
+    "render_query_body",
+    "run_local_query",
     "ScanSession",
     "PlannedScan",
     "AdmissionController",
     "Deadline",
     "execute_stream",
+    "execute_query",
     "serve_pool",
     "ServeConfig",
-    "ScanService",
     "ScanServer",
+    "ScanService",
 ]

@@ -1,0 +1,293 @@
+"""Aggregation push-down: per-unit partials, exact merge, canonical body.
+
+The serve bench measured the daemon serialization-bound: a dashboard-style
+"how many rows match, grouped by X" question paid for boxing and shipping
+every matching row. This module answers it server-side: each unit (one row
+group of one file) computes a PARTIAL aggregate over its filtered arrow
+table on the pqt-serve pool, partials merge with exact semantics, and the
+response is kilobytes regardless of how many rows matched.
+
+Semantics are PINNED AGAINST PYARROW by construction, not by reimplementation:
+unit partials are pyarrow.compute kernels (count/sum/min/max and
+TableGroupBy for group-by), and merging two partial values runs the same
+kernel over a two-element array OF THE PARTIAL'S ARROW TYPE — so null
+skipping (sum/min/max ignore nulls, all-null yields null), NaN propagation
+(sum) vs NaN skipping (min/max), decimal precision, and int64 wraparound
+all come out identical to a single whole-corpus pyarrow aggregation
+(differential tests assert exactly that).
+
+Group-by cardinality is BOUNDED: the merged table growing past the
+request's max_groups raises the typed overflow ServeError (413
+group_overflow) instead of buffering an unbounded result — push-down must
+not become a memory vector.
+
+The canonical JSON rendering lives here too (render_query_body): the
+daemon's POST /v1/query response and `parquet-tool scan --aggregate`
+output are the SAME bytes for the same corpus and spec, like the
+jsonl-scan contract protocol.py pins for rows.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .protocol import QueryRequest, ServeError, agg_name, json_default
+
+__all__ = [
+    "QueryState",
+    "query_columns",
+    "unit_partial",
+    "unit_count_partial",
+    "result_dict",
+    "render_query_body",
+    "run_local_query",
+]
+
+
+def query_columns(query: QueryRequest) -> list:
+    """The column projection a query's units must decode: group-by keys
+    plus aggregate inputs, order-stable. Empty + no filters means NO decode
+    at all (pure count(*) answers from footer-promised row counts); empty
+    WITH filters borrows the first filter column so the filtered row count
+    is still observable."""
+    cols: list = []
+    for c in query.group_by:
+        if c not in cols:
+            cols.append(c)
+    for a in query.aggregates:
+        if a.column is not None and a.column not in cols:
+            cols.append(a.column)
+    if not cols and query.filters is not None:
+        first = query.filters[0]
+        if isinstance(first, (list, tuple)) and first and isinstance(
+            first[0], (list, tuple)
+        ):
+            first = first[0]  # DNF: first conjunction's first triple
+        cols.append(first[0])
+    return cols
+
+
+def _agg_column(table, name: str):
+    import pyarrow.compute as pc
+
+    parts = name.split(".")
+    try:
+        col = table.column(parts[0])
+    except KeyError:
+        raise ServeError(
+            400, "bad_aggregates", f"aggregate column {name!r} not in scan"
+        ) from None
+    for p in parts[1:]:
+        col = pc.struct_field(col, p)
+    return col
+
+
+def unit_partial(table, query: QueryRequest):
+    """(groups, types) partial of one unit's filtered arrow table:
+    groups maps key tuple -> [one python value per aggregate] (the global
+    form uses the () key); types carries each aggregate's arrow type so
+    merges run in the exact same domain."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    aggs = query.aggregates
+    if not query.group_by:
+        vals: list = []
+        types: list = [None] * len(aggs)
+        for j, a in enumerate(aggs):
+            if a.column is None:
+                vals.append(table.num_rows)
+                continue
+            col = _agg_column(table, a.column)
+            try:
+                if a.op == "count":
+                    vals.append(int(pc.count(col).as_py()))
+                    continue
+                s = {"sum": pc.sum, "min": pc.min, "max": pc.max}[a.op](col)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError) as e:
+                raise ServeError(
+                    400, "bad_aggregates",
+                    f"cannot {a.op} column {a.column!r}: {e}",
+                ) from None
+            vals.append(s.as_py())
+            types[j] = s.type
+        return {(): vals}, types
+    keys = list(query.group_by)
+    spec = []
+    for a in aggs:
+        if a.column is None:
+            spec.append(([], "count_all"))
+        elif a.op == "count":
+            spec.append((a.column, "count"))
+        else:
+            spec.append((a.column, a.op))
+    try:
+        res = table.group_by(keys).aggregate(spec)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, KeyError) as e:
+        raise ServeError(
+            400, "bad_aggregates", f"cannot group by {keys}: {e}"
+        ) from None
+    if res.num_columns != len(keys) + len(aggs):
+        raise ServeError(
+            500, "internal", "group-by result shape mismatch"
+        )
+    # pyarrow's aggregate table leads with the key columns, then the
+    # aggregates in spec order — read positionally (names can collide)
+    kl = [res.column(i).to_pylist() for i in range(len(keys))]
+    al = [res.column(len(keys) + j).to_pylist() for j in range(len(aggs))]
+    types = [
+        None
+        if a.op == "count"
+        else res.column(len(keys) + j).type
+        for j, a in enumerate(aggs)
+    ]
+    groups = {}
+    for g in range(res.num_rows):
+        key = tuple(k[g] for k in kl)
+        groups[key] = [a[g] for a in al]
+    return groups, types
+
+
+def unit_count_partial(query: QueryRequest, num_rows: int):
+    """The zero-decode partial: every aggregate is count(*) (query_columns
+    returned empty with no filters), so the footer-promised row count IS
+    the answer and the unit never opens its file."""
+    return {(): [num_rows for _ in query.aggregates]}, [None] * len(
+        query.aggregates
+    )
+
+
+def _merge_value(op: str, a, b, typ):
+    if op == "count":
+        return int(a) + int(b)
+    if a is None:
+        return b
+    if b is None:
+        return a
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    arr = pa.array([a, b], type=typ)
+    if op == "sum":
+        return pc.sum(arr).as_py()
+    if op == "min":
+        return pc.min(arr).as_py()
+    return pc.max(arr).as_py()
+
+
+class QueryState:
+    """The merged aggregate state one request accumulates unit by unit."""
+
+    __slots__ = ("query", "groups", "types", "rows_scanned", "rows_matched")
+
+    def __init__(self, query: QueryRequest):
+        self.query = query
+        self.types: list = [None] * len(query.aggregates)
+        self.rows_scanned = 0
+        self.rows_matched = 0
+        if query.group_by:
+            self.groups: dict = {}
+        else:
+            # the global row exists even over zero units: count 0, sum/min/
+            # max null — matching pyarrow kernels over an empty column
+            self.groups = {
+                (): [0 if a.column is None or a.op == "count" else None
+                     for a in query.aggregates]
+            }
+
+    def absorb(self, part) -> None:
+        """Merge one unit's ((groups, types), scanned, matched) partial."""
+        (groups, types), scanned, matched = part
+        self.rows_scanned += scanned
+        self.rows_matched += matched
+        for j, t in enumerate(types):
+            if self.types[j] is None:
+                self.types[j] = t
+        q = self.query
+        for key, vals in groups.items():
+            cur = self.groups.get(key)
+            if cur is None:
+                if len(self.groups) >= q.max_groups:
+                    raise ServeError(
+                        413, "group_overflow",
+                        f"group-by cardinality exceeded max_groups="
+                        f"{q.max_groups}; narrow the filter or raise "
+                        "max_groups",
+                    )
+                self.groups[key] = list(vals)
+                continue
+            for j, a in enumerate(q.aggregates):
+                op = "count" if a.column is None else a.op
+                cur[j] = _merge_value(op, cur[j], vals[j], self.types[j])
+
+
+def _key_order(key: tuple) -> str:
+    # deterministic total order over arbitrary (possibly None/mixed) keys:
+    # their canonical JSON encoding — the same bytes the body renders
+    return json.dumps(list(key), default=json_default)
+
+
+def result_dict(query: QueryRequest, state: QueryState, *, units: int) -> dict:
+    """The response body, deterministically ordered (groups sort by their
+    canonical key encoding) so daemon bytes == CLI bytes."""
+    names = [agg_name(a) for a in query.aggregates]
+    body: dict = {
+        "group_by": list(query.group_by),
+        "aggregates": names,
+        "units": units,
+        "rows_scanned": state.rows_scanned,
+        "rows_matched": state.rows_matched,
+    }
+    if query.group_by:
+        body["group_count"] = len(state.groups)
+        body["groups"] = [
+            {
+                "key": list(key),
+                "aggregates": dict(zip(names, state.groups[key])),
+            }
+            for key in sorted(state.groups, key=_key_order)
+        ]
+    else:
+        body["result"] = dict(zip(names, state.groups[()]))
+    return body
+
+
+def render_query_body(body: dict) -> bytes:
+    """ONE canonical serialization (shared with `parquet-tool scan
+    --aggregate`), so a daemon response is byte-identical to the CLI's."""
+    return (json.dumps(body, default=json_default) + "\n").encode()
+
+
+def run_local_query(paths, query: QueryRequest, *, footer_cache=None) -> dict:
+    """The daemon-free twin of POST /v1/query: plan, execute every unit
+    sequentially, merge — `parquet-tool scan --aggregate` and the parity
+    tests run the daemon's exact semantics against local files."""
+    from ..core.reader import FileReader
+    from ..data.plan import build_plan, expand_paths
+
+    files: list = []
+    for p in paths:
+        files.extend(expand_paths(p))
+    files = sorted(set(files))
+    plan = build_plan(files, filters=query.filters, footer_cache=footer_cache)
+    if query.shard is not None:
+        order = plan.epoch_order(
+            0, shard_index=query.shard[0], shard_count=query.shard[1]
+        )
+        units = [plan.units[k] for k in order]
+    else:
+        units = list(plan.units)
+    cols = query_columns(query)
+    decode = bool(cols) or query.filters is not None
+    state = QueryState(query)
+    for u in units:
+        if not decode:
+            state.absorb(
+                (unit_count_partial(query, u.num_rows), u.num_rows, u.num_rows)
+            )
+            continue
+        meta = plan.metas[u.file_index]
+        with FileReader(u.path, columns=cols or None, metadata=meta) as r:
+            t = r.to_arrow(row_groups=[u.row_group], filters=query.filters)
+        state.absorb((unit_partial(t, query), u.num_rows, t.num_rows))
+    return result_dict(query, state, units=len(units))

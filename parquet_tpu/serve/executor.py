@@ -43,12 +43,13 @@ from ..utils import metrics as _metrics
 from ..utils.trace import stage
 from .protocol import ServeError, json_default
 
-__all__ = ["serve_pool", "execute_stream"]
+__all__ = ["serve_pool", "execute_stream", "execute_query"]
 
 _ROW_CHECK_EVERY = 4096  # rows between cooperative cancellation checks
 _WAIT_SLICE_S = 0.1  # result-wait poll granularity (bounds deadline latency)
 
 _pool = None
+_pool_size = 0
 _pool_lock = threading.Lock()
 
 
@@ -56,16 +57,23 @@ def serve_pool() -> ThreadPoolExecutor:
     """The process-wide scan-execution pool. Sized by PQT_SERVE_THREADS
     (default: min(8, cpus)); dedicated so nested pools (chunk prepare,
     pqt-io readahead) can never self-deadlock against serve traffic."""
-    global _pool
+    global _pool, _pool_size
     with _pool_lock:
         if _pool is None:
             n = int(
                 os.environ.get("PQT_SERVE_THREADS", min(8, os.cpu_count() or 4))
             )
+            _pool_size = max(1, n)
             _pool = ThreadPoolExecutor(
-                max_workers=max(1, n), thread_name_prefix="pqt-serve"
+                max_workers=_pool_size, thread_name_prefix="pqt-serve"
             )
         return _pool
+
+
+def pool_size() -> int:
+    """The serve pool's worker count (creating the pool if needed)."""
+    serve_pool()
+    return _pool_size
 
 
 class _Check:
@@ -377,6 +385,64 @@ def _stream_arrow(planned, session, check, window):
             yield payload
     finally:
         check.abort.set()
+
+
+def execute_query(planned, query, session, *, deadline=None, window: int = 2):
+    """Aggregation push-down over the planned units (POST /v1/query).
+
+    Each unit decodes + filters + partially aggregates as one pqt-serve
+    pool task (the residual filter runs the vectorized mask pipeline via
+    to_arrow's buffer-level take); partials merge on the caller's thread
+    with exact pyarrow semantics (serve/aggregate.py), bounded by the
+    request's max_groups. Pure count(*) with no filters never opens a
+    file — the footer-promised unit row counts ARE the answer. Returns the
+    response body dict; every failure mode is a typed ServeError, and the
+    deadline/abort checks run between units exactly like streamed scans."""
+    from .aggregate import (
+        QueryState,
+        query_columns,
+        result_dict,
+        unit_count_partial,
+        unit_partial,
+    )
+
+    check = _Check(deadline)
+    if window < 1:
+        raise ValueError("executor: window must be >= 1")
+    cols = query_columns(query)
+    decode = bool(cols) or query.filters is not None
+    state = QueryState(query)
+    units = planned.units
+    # a streamed scan's window bounds BUFFERED payload; a query's unit
+    # results are kilobyte partials, so the lookahead widens to the pool —
+    # merge order doesn't matter and idle workers are pure waste
+    window = max(window, min(pool_size(), len(units) or 1))
+
+    def run(u):
+        check()
+        if not decode:
+            return (
+                unit_count_partial(query, u.num_rows), u.num_rows, u.num_rows
+            )
+        with unit_clock(), stage("serve.aggregate"):
+            reader = _open_reader(session, planned, u)
+            try:
+                t = reader.to_arrow(
+                    row_groups=[u.row_group], filters=planned.request.filters
+                )
+            finally:
+                _close_unit_reader(session, reader)
+            return (unit_partial(t, query), u.num_rows, t.num_rows)
+
+    gen = _pipelined(units, run, window, check)
+    try:
+        for part in _wrap_decode_errors(gen):
+            with stage("serve.merge"):
+                state.absorb(part)
+    finally:
+        gen.close()
+    _metrics.inc("serve_aggregate_requests_total")
+    return result_dict(query, state, units=len(units))
 
 
 def execute_stream(planned, session, *, deadline=None, window: int = 2):

@@ -6,9 +6,11 @@ costs one JSON parse and produces a structured error body, never a
 traceback and never a half-planned scan. The same module owns the
 JSON filter-spec parser (`filters_from_spec`) so `parquet-tool scan
 --filters` and `POST /v1/scan {"filters": ...}` accept the exact same
-language, and the canonical JSON row serialization (`json_default`) so a
-daemon response is byte-identical to `parquet-tool cat` / a direct
-`FileReader.iter_rows()` dump of the same rows.
+language, the aggregate-spec parser (`aggregates_from_spec` /
+`parse_query_request`) shared the same way by `POST /v1/query` and
+`parquet-tool scan --aggregate`, and the canonical JSON row serialization
+(`json_default`) so a daemon response is byte-identical to `parquet-tool
+cat` / a direct `FileReader.iter_rows()` dump of the same rows.
 
 ServeError is the one error currency of the serving stack: every layer
 (protocol, session, admission, executor) raises it with an HTTP status +
@@ -24,11 +26,17 @@ from typing import NamedTuple
 __all__ = [
     "ServeError",
     "ScanRequest",
+    "QueryRequest",
+    "AggregateSpec",
     "FORMATS",
+    "AGG_OPS",
     "filters_from_spec",
+    "aggregates_from_spec",
     "parse_scan_request",
+    "parse_query_request",
     "scan_request_from_query",
     "json_default",
+    "agg_name",
 ]
 
 FORMATS = ("jsonl", "arrow-ipc")
@@ -36,10 +44,27 @@ FORMATS = ("jsonl", "arrow-ipc")
 # ops accepted by the filter spec; mirrors core/filter._OPS (validated
 # again, against the actual schema, at normalize time — this early check
 # exists so a typo'd op fails the REQUEST, not the first file's plan)
-_OPS = ("==", "!=", "<", "<=", ">", ">=", "is_null", "not_null", "in", "not_in")
+_OPS = (
+    "==", "!=", "<", "<=", ">", ">=", "is_null", "not_null", "in", "not_in",
+    "contains",
+)
+
+# aggregate ops accepted by /v1/query and `parquet-tool scan --aggregate`
+AGG_OPS = ("count", "sum", "min", "max")
+
+# group-by cardinality is BOUNDED: past max_groups the query fails with a
+# typed overflow error instead of buffering an unbounded result (the whole
+# point of push-down is that results are kilobytes)
+DEFAULT_MAX_GROUPS = 10_000
+MAX_MAX_GROUPS = 1_000_000
 
 _SCAN_KEYS = {
     "paths", "columns", "filters", "limit", "format", "shard", "timeout_ms",
+}
+
+_QUERY_KEYS = {
+    "paths", "filters", "aggregates", "group_by", "max_groups", "shard",
+    "timeout_ms",
 }
 
 
@@ -64,6 +89,32 @@ class ServeError(ValueError):
                 "status": self.status,
             }
         }
+
+
+class AggregateSpec(NamedTuple):
+    """One validated aggregate: op in AGG_OPS; column None only for the
+    row-count form of count (count(*))."""
+
+    op: str
+    column: str | None
+
+
+def agg_name(a: AggregateSpec) -> str:
+    """The stable result key of one aggregate — shared by the daemon body
+    and the CLI output so the rendered bytes match."""
+    return a.op if a.column is None else f"{a.op}({a.column})"
+
+
+class QueryRequest(NamedTuple):
+    """One validated aggregation push-down request (POST /v1/query)."""
+
+    paths: list  # file paths and/or glob patterns, server-root relative
+    filters: list | None  # normalized triples/DNF, core/filter convention
+    aggregates: tuple  # AggregateSpec, >= 1
+    group_by: tuple  # column names (empty = one global row)
+    max_groups: int  # typed overflow past this many distinct groups
+    shard: tuple | None  # (index, count) unit striping for this request
+    timeout_ms: int | None  # per-request deadline override
 
 
 class ScanRequest(NamedTuple):
@@ -206,8 +257,106 @@ def _build_request(obj: dict) -> ScanRequest:
     )
 
 
-def parse_scan_request(raw: bytes) -> ScanRequest:
-    """Parse + validate a POST /v1/scan (or /v1/plan) JSON body."""
+def aggregates_from_spec(spec):
+    """Validate a JSON-decoded aggregate spec into AggregateSpec tuples.
+
+    Accepts a list whose entries are "count" (count(*)), [op] / [op,
+    column] pairs, or {"op": ..., "column": ...} objects. Column existence
+    is checked later against each file's schema — like filters_from_spec,
+    this pins the SHAPE so a bad spec fails the request typed before any
+    file is touched."""
+    if not isinstance(spec, (list, tuple)) or not spec:
+        raise ServeError(
+            400, "bad_aggregates",
+            "'aggregates' must be a non-empty list of [op, column] pairs "
+            f"(ops: {', '.join(AGG_OPS)}; count may omit the column)",
+        )
+    out = []
+    for a in spec:
+        if isinstance(a, str):
+            op, column = a, None
+        elif isinstance(a, dict):
+            unknown = set(a) - {"op", "column"}
+            if unknown:
+                raise ServeError(
+                    400, "bad_aggregates",
+                    f"unknown aggregate field(s) {sorted(unknown)}",
+                )
+            op, column = a.get("op"), a.get("column")
+        elif isinstance(a, (list, tuple)) and 1 <= len(a) <= 2:
+            op = a[0]
+            column = a[1] if len(a) == 2 else None
+        else:
+            raise ServeError(
+                400, "bad_aggregates", f"bad aggregate entry {a!r}"
+            )
+        if op not in AGG_OPS:
+            raise ServeError(
+                400, "bad_aggregates",
+                f"unknown aggregate op {op!r} (use one of {', '.join(AGG_OPS)})",
+            )
+        if column is not None and (not isinstance(column, str) or not column):
+            raise ServeError(
+                400, "bad_aggregates",
+                f"aggregate column must be a non-empty string, got {column!r}",
+            )
+        if column is None and op != "count":
+            raise ServeError(
+                400, "bad_aggregates", f"aggregate {op!r} needs a column"
+            )
+        out.append(AggregateSpec(op=op, column=column))
+    return tuple(out)
+
+
+def _build_query_request(obj: dict) -> QueryRequest:
+    unknown = set(obj) - _QUERY_KEYS
+    if unknown:
+        raise _bad(
+            f"unknown request field(s) {sorted(unknown)} "
+            f"(accepted: {sorted(_QUERY_KEYS)})"
+        )
+    paths = obj.get("paths")
+    if isinstance(paths, str):
+        paths = [paths]
+    if (
+        not isinstance(paths, list)
+        or not paths
+        or not all(isinstance(p, str) and p for p in paths)
+    ):
+        raise _bad("'paths' must be a non-empty string or list of strings")
+    group_by = obj.get("group_by") or []
+    if isinstance(group_by, str):
+        group_by = [c for c in group_by.split(",") if c]
+    if not isinstance(group_by, list) or not all(
+        isinstance(c, str) and c for c in group_by
+    ):
+        raise _bad("'group_by' must be a list of column names")
+    max_groups = obj.get("max_groups", DEFAULT_MAX_GROUPS)
+    if (
+        not isinstance(max_groups, int)
+        or isinstance(max_groups, bool)
+        or not 1 <= max_groups <= MAX_MAX_GROUPS
+    ):
+        raise _bad(
+            f"'max_groups' must be an integer in [1, {MAX_MAX_GROUPS}], "
+            f"got {max_groups!r}"
+        )
+    timeout_ms = obj.get("timeout_ms")
+    if timeout_ms is not None:
+        if not isinstance(timeout_ms, int) or isinstance(timeout_ms, bool) or timeout_ms <= 0:
+            raise _bad(f"'timeout_ms' must be a positive integer, got {timeout_ms!r}")
+    return QueryRequest(
+        paths=paths,
+        filters=filters_from_spec(obj.get("filters")),
+        aggregates=aggregates_from_spec(obj.get("aggregates")),
+        group_by=tuple(group_by),
+        max_groups=max_groups,
+        shard=_parse_shard(obj.get("shard")),
+        timeout_ms=timeout_ms,
+    )
+
+
+def _parse_body(raw: bytes) -> dict:
     if not raw:
         raise _bad("empty request body (expected a JSON object)")
     try:
@@ -216,7 +365,17 @@ def parse_scan_request(raw: bytes) -> ScanRequest:
         raise _bad(f"request body is not valid JSON: {e}") from None
     if not isinstance(obj, dict):
         raise _bad(f"request body must be a JSON object, got {type(obj).__name__}")
-    return _build_request(obj)
+    return obj
+
+
+def parse_scan_request(raw: bytes) -> ScanRequest:
+    """Parse + validate a POST /v1/scan (or /v1/plan) JSON body."""
+    return _build_request(_parse_body(raw))
+
+
+def parse_query_request(raw: bytes) -> QueryRequest:
+    """Parse + validate a POST /v1/query JSON body."""
+    return _build_query_request(_parse_body(raw))
 
 
 def scan_request_from_query(qs: dict) -> ScanRequest:

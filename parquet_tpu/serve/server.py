@@ -8,6 +8,12 @@ the bounded pqt-serve pool), four endpoints:
                     → chunked-transfer stream of results. Headers:
                     `X-Tenant` (budget accounting key), `X-Timeout-Ms`
                     (deadline override).
+  POST /v1/query    {"paths": ..., "filters": ..., "aggregates":
+                    [["count"], ["sum", "v"], ...], "group_by": [...],
+                    "max_groups": N} → ONE small JSON body: aggregation
+                    push-down executed per row-group unit on the pqt-serve
+                    pool and merged exactly (serve/aggregate.py). Same
+                    admission/budget/deadline discipline as /v1/scan.
   GET  /v1/plan     dry-run of the same request (query params or POSTed
                     body): pruned vs total row groups, estimated bytes —
                     zero source reads when the footer cache is warm.
@@ -57,8 +63,14 @@ from ..obs.recorder import sanitize_request_id as _sanitize_request_id
 from ..utils import metrics as _metrics
 from ..utils.trace import decode_trace
 from .admission import AdmissionController
-from .executor import execute_stream
-from .protocol import ServeError, parse_scan_request, scan_request_from_query
+from .executor import execute_query, execute_stream
+from .protocol import (
+    ScanRequest,
+    ServeError,
+    parse_query_request,
+    parse_scan_request,
+    scan_request_from_query,
+)
 from .session import ScanSession
 
 __all__ = ["ServeConfig", "ScanService", "ScanServer"]
@@ -236,6 +248,66 @@ class ScanService:
             else "application/x-ndjson"
         )
         return ticket, content_type, chunks
+
+    def query(self, request, tenant: str, timeout_ms=None, record=None):
+        """POST /v1/query: aggregation push-down. Admission is EXACTLY the
+        scan discipline — same ticket, same deadline clamp, and the tenant
+        byte budget is charged with the same plan estimate (aggregation
+        must not become a budget bypass: the daemon still decodes those
+        bytes, it just doesn't ship them). Returns (ticket, body dict); the
+        caller renders and must release the ticket."""
+        from .aggregate import query_columns
+
+        deadline = self.admission.deadline_for(
+            timeout_ms if timeout_ms is not None else request.timeout_ms
+        )
+        ticket = self.admission.admit(tenant)
+        try:
+            cols = query_columns(request)
+            planned = self.session.plan(
+                ScanRequest(
+                    paths=request.paths,
+                    # [] is meaningful: a pure count(*) decodes nothing and
+                    # its plan estimate is zero bytes
+                    columns=cols,
+                    filters=request.filters,
+                    limit=None,
+                    format="jsonl",
+                    shard=request.shard,
+                    timeout_ms=request.timeout_ms,
+                )
+            )
+            if record is not None:
+                record.plan = planned.summary()
+            self.admission.charge(ticket.tenant, planned.estimated_bytes)
+            deadline.check()
+            body = execute_query(
+                planned,
+                request,
+                self.session,
+                deadline=deadline,
+                window=self.config.window,
+            )
+        except BaseException:
+            ticket.release()
+            raise
+        if record is not None and isinstance(record.plan, dict):
+            # mask selectivity rides NEXT TO the pruning summary: the two
+            # numbers together say how much each rung (stats/bloom vs the
+            # residual mask) actually cut
+            scanned = body.get("rows_scanned", 0)
+            matched = body.get("rows_matched", 0)
+            record.plan = {
+                **record.plan,
+                "residual": {
+                    "rows_scanned": scanned,
+                    "rows_matched": matched,
+                    "selectivity": (
+                        round(matched / scanned, 6) if scanned else None
+                    ),
+                },
+            }
+        return ticket, body
 
     def healthz(self) -> tuple[int, dict]:
         draining = self.admission.draining
@@ -470,10 +542,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
 
     def _send_json(self, status: int, body: dict, *, retry_after=None) -> None:
+        self._send_payload(
+            status, (json.dumps(body) + "\n").encode(), retry_after=retry_after
+        )
+
+    def _send_payload(
+        self, status: int, payload: bytes, *,
+        content_type: str = "application/json", retry_after=None,
+    ) -> None:
         self._drain_body()
-        payload = (json.dumps(body) + "\n").encode()
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(payload)))
         if getattr(self, "_rid", None):
             self.send_header("X-Request-Id", self._rid)
@@ -654,6 +733,25 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._recorded_request("/v1/scan", tenant, t0, run)
 
+    def _query_request(self, tenant: str, t0: float) -> None:
+        """POST /v1/query under the record discipline: aggregation
+        push-down. The response is ONE small JSON body (Content-Length,
+        not chunked) rendered through the canonical serializer, so daemon
+        bytes match `parquet-tool scan --aggregate` bytes."""
+        from .aggregate import render_query_body
+
+        def run(rec):
+            request = parse_query_request(self._read_body())
+            ticket, body = self.service.query(
+                request, tenant, timeout_ms=self._timeout_ms(), record=rec
+            )
+            with ticket:
+                payload = render_query_body(body)
+                self._send_payload(200, payload)
+                return 200, len(payload), None
+
+        self._recorded_request("/v1/query", tenant, t0, run)
+
     def _plan_request(self, tenant: str, t0: float, request_fn) -> None:
         """GET/POST /v1/plan under the same record discipline."""
 
@@ -831,6 +929,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if route == "/v1/scan":
                 self._scan_request(tenant, t0)
+                return
+            if route == "/v1/query":
+                self._query_request(tenant, t0)
                 return
             if route == "/v1/plan":
                 self._plan_request(

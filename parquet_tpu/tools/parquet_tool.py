@@ -826,6 +826,8 @@ def cmd_scan(args) -> int:
         filters = filters_from_spec(spec)
     else:
         filters = _parse_filters(args.filter)
+    if args.aggregate:
+        return _scan_aggregate(args, filters)
     ds = ParquetDataset(
         args.glob,
         batch_size=args.batch_size,
@@ -951,6 +953,52 @@ def cmd_scan(args) -> int:
         )
         if not slo["held"]:
             return 1
+    return 0
+
+
+def _scan_aggregate(args, filters) -> int:
+    """`scan --aggregate`: aggregation push-down over the glob, printing
+    the CANONICAL query body — the exact bytes POST /v1/query would return
+    for the same corpus and spec (serve/aggregate.py owns both)."""
+    from ..serve.aggregate import render_query_body, run_local_query
+    from ..serve.protocol import (
+        DEFAULT_MAX_GROUPS,
+        MAX_MAX_GROUPS,
+        QueryRequest,
+        ServeError,
+        aggregates_from_spec,
+    )
+
+    try:
+        spec = json.loads(args.aggregate)
+    except ValueError as e:
+        raise ValueError(f"--aggregate is not valid JSON: {e}") from None
+    max_groups = (
+        args.max_groups if args.max_groups is not None else DEFAULT_MAX_GROUPS
+    )
+    if not 1 <= max_groups <= MAX_MAX_GROUPS:
+        # the same bound the daemon's request parser enforces with a 400
+        raise ValueError(
+            f"--max-groups must be in [1, {MAX_MAX_GROUPS}], got {max_groups}"
+        )
+    try:
+        aggs = aggregates_from_spec(spec)
+        query = QueryRequest(
+            paths=[args.glob],
+            filters=filters,
+            aggregates=aggs,
+            group_by=tuple(
+                c for c in (args.group_by or "").split(",") if c
+            ),
+            max_groups=max_groups,
+            shard=None,
+            timeout_ms=None,
+        )
+        body = run_local_query(query.paths, query)
+    except ServeError as e:
+        # same typed-message discipline as the daemon, CLI-rendered
+        raise ValueError(f"{e.code}: {e.message}") from None
+    sys.stdout.write(render_query_body(body).decode())
     return 0
 
 
@@ -1310,6 +1358,25 @@ def main(argv=None) -> int:
         default="zero",
         help="null handling: zero-fill (default — a throughput scan should "
         "not die on nullable data) or error",
+    )
+    pn.add_argument(
+        "--aggregate",
+        metavar="JSON",
+        help="aggregation push-down instead of a throughput scan: a JSON "
+        'list of aggregates — e.g. \'["count", ["sum", "v"]]\' — exactly '
+        "what POST /v1/query accepts; prints the canonical query body "
+        "(byte-identical to the daemon's response for the same corpus)",
+    )
+    pn.add_argument(
+        "--group-by",
+        help="comma-separated group-by columns (with --aggregate)",
+    )
+    pn.add_argument(
+        "--max-groups",
+        type=int,
+        default=None,
+        help="typed overflow past this many distinct groups "
+        "(default: the protocol's bound)",
     )
     pn.add_argument(
         "--json", action="store_true", help="also print a JSON result line"

@@ -278,6 +278,16 @@ _HELP = {
     "serve_tenant_decoded_bytes_total": "decoded (uncompressed) bytes charged per tenant",
     "obs_profile_samples_total": "sampling-profiler stack samples, per pool lane",
     "obs_profile_windows_total": "sampling-profiler capture windows completed",
+    # query push-down (PR 12): residual filtering + aggregation
+    "query_rows_filtered_total": (
+        "rows removed by residual predicate evaluation, per engine "
+        "(vec: the chunk-level mask pipeline; arrow: pyarrow-compute "
+        "fallback masks; scalar: the per-row walk)"
+    ),
+    "filter_mask_seconds": "vectorized residual mask build wall time",
+    "serve_aggregate_requests_total": (
+        "aggregation push-down queries executed (/v1/query and the CLI twin)"
+    ),
 }
 
 

@@ -537,10 +537,12 @@ class TestDnfOrFilters:
 
 
 class TestFilterCombineMemo:
-    def test_column_in_many_conjunctions_combines_once(self, tmp_path):
-        """A column referenced in N DNF conjunctions must pay its
-        combine_chunks exactly once per mask evaluation (pinned by the
-        filter_combine_chunks trace counter)."""
+    def test_column_in_many_conjunctions_combines_once(self, tmp_path, monkeypatch):
+        """In the pyarrow-compute FALLBACK path a column referenced in N
+        DNF conjunctions must pay its combine_chunks exactly once per mask
+        evaluation (pinned by the filter_combine_chunks trace counter).
+        The vectorized fast path (PR 12) masks straight off the decoded
+        chunk buffers and never combines at all — pinned as zero."""
         from parquet_tpu import FileReader, FileWriter, parse_schema
         from parquet_tpu.utils.trace import decode_trace
 
@@ -558,10 +560,18 @@ class TestFilterCombineMemo:
             [("id", ">=", 19_998)],
             [("id", "in", [7, 8]), ("c", "!=", "c0")],
         ]
+        want = sorted([1, 7, 8, 19_998, 19_999])
         with FileReader(path) as r:
             with decode_trace() as tr:
                 got = r.to_arrow(filters=filters)
-            want = sorted([1, 7, 8, 19_998, 19_999])
+            assert sorted(got.column("id").to_pylist()) == want
+        # fast path: no table-level masks, so no combines at all
+        combines = tr.stages.get("filter_combine_chunks")
+        assert combines is None
+        monkeypatch.setenv("PQT_VEC_FILTER", "0")
+        with FileReader(path) as r:
+            with decode_trace() as tr:
+                got = r.to_arrow(filters=filters)
             assert sorted(got.column("id").to_pylist()) == want
         combines = tr.stages.get("filter_combine_chunks")
         # two distinct leaves referenced across five predicates: two combines

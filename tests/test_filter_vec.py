@@ -1,0 +1,442 @@
+"""The vectorized residual filter (core/filter_vec) vs the scalar oracle.
+
+Differential matrix, same contract as tests/test_assembly_vec.py: every
+predicate the mask pipeline claims must produce BYTE-IDENTICAL rows to the
+scalar row_matches walk (PQT_VEC_FILTER=0) across the type zoo — ints,
+floats (incl. NaN), unsigned, decimal (int- and binary-backed), strings
+and raw binary (incl. embedded/trailing NULs), timestamps, dates, bools,
+nulls everywhere, and nested LIST 'contains' predicates — and corrupt
+inputs must fail typed-or-identical under either engine. The arrow path
+(`to_arrow(filters=)`) is pinned the same way: the buffer-level-take fast
+path must match the pyarrow-compute fallback, including not_in's
+null-keeping convention. The device twins (kernels/device_ops) are pinned
+against the host masks.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import decimal
+import glob
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.core.filter import FilterError, normalize_dnf
+from parquet_tpu.core.filter_vec import (
+    VecFilterError,
+    dnf_mask,
+    group_row_count,
+    mask_to_ranges,
+    masked_flat_columns,
+)
+from parquet_tpu.core.reader import PARQUET_ERRORS, FileReader
+from parquet_tpu.utils import metrics
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "data", "corrupt")
+
+N = 800
+
+
+def _zoo_table() -> pa.Table:
+    rng = np.random.default_rng(29)
+
+    def some(i, v, k=7):
+        return None if i % k == 0 else v
+
+    f = rng.standard_normal(N)
+    f[::13] = np.nan
+    return pa.table(
+        {
+            "i64": pa.array([some(i, i - N // 2) for i in range(N)], pa.int64()),
+            "i32": pa.array(np.arange(N, dtype=np.int32)),
+            "u32": pa.array(
+                [some(i, (1 << 31) + i, 11) for i in range(N)], pa.uint32()
+            ),
+            "u64": pa.array(
+                [(1 << 63) + i for i in range(N)], pa.uint64()
+            ),
+            "f": pa.array([some(i, float(x), 5) for i, x in enumerate(f)]),
+            "s": pa.array([some(i + 1, f"v{i % 23}") for i in range(N)]),
+            "b": pa.array(
+                [
+                    some(i, [b"a", b"a\x00", b"a\x00b", b"", b"ab"][i % 5], 9)
+                    for i in range(N)
+                ],
+                pa.binary(),
+            ),
+            "dec": pa.array(
+                [some(i, decimal.Decimal(i - 40) / 4) for i in range(N)],
+                pa.decimal128(9, 2),
+            ),
+            "bigdec": pa.array(
+                [some(i, decimal.Decimal(i) / 100) for i in range(N)],
+                pa.decimal128(30, 2),  # binary-backed: vec must decline
+            ),
+            "ts": pa.array(
+                [
+                    some(i, dt.datetime(2024, 1, 1) + dt.timedelta(seconds=i))
+                    for i in range(N)
+                ],
+                pa.timestamp("us"),
+            ),
+            "day": pa.array(
+                [some(i, dt.date(2024, 1, 1) + dt.timedelta(days=i % 90))
+                 for i in range(N)],
+                pa.date32(),
+            ),
+            "flag": pa.array([some(i, i % 3 == 0) for i in range(N)]),
+            "tags": pa.array(
+                [some(i, [f"t{j % 6}" for j in range(i % 5)]) for i in range(N)],
+                pa.list_(pa.string()),
+            ),
+            "nums": pa.array(
+                [some(i, [some(j, j, 4) for j in range(i % 4)], 6)
+                 for i in range(N)],
+                pa.list_(pa.int64()),
+            ),
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def zoo(tmp_path_factory):
+    p = tmp_path_factory.mktemp("filter_vec") / "zoo.parquet"
+    pq.write_table(_zoo_table(), str(p), row_group_size=256)
+    return str(p)
+
+
+ZOO_FILTERS = [
+    [("i64", "==", 0)],
+    [("i64", "!=", 0)],
+    [("i64", "<", -100)],
+    [("i64", ">=", 2.5)],  # inexact bracket on an int column
+    [("i32", ">", 400)],
+    [("u32", ">=", (1 << 31) + 500)],
+    [("u64", ">", (1 << 63) + 700)],
+    [("f", ">", 0.5)],
+    [("f", "<=", float("nan"))],  # NaN comparisons: everything fails
+    [("f", "!=", float("nan"))],
+    [("f", "is_null")],
+    [("f", "not_null"), ("i32", "<", 50)],
+    [("s", "==", "v3")],
+    [("s", ">=", "v20")],
+    [("s", "in", ["v1", "v9", "zzz"])],
+    [("s", "not_in", ["v1"])],
+    [("b", "==", b"a\x00")],
+    [("b", "<", b"a\x00b")],
+    [("b", ">=", b"a")],
+    [("b", "in", [b"", b"ab"])],
+    [("dec", ">=", decimal.Decimal("1.505"))],  # between representables
+    [("dec", "==", decimal.Decimal("1.50"))],
+    [("bigdec", ">", decimal.Decimal("1.0"))],  # binary-backed: scalar path
+    [("ts", "<", dt.datetime(2024, 1, 1, 0, 5))],
+    [("ts", ">=", dt.datetime(2024, 1, 1, 0, 5, 0, 500_000))],
+    [("day", "==", dt.date(2024, 1, 10))],
+    [("flag", "==", True)],
+    [("flag", "!=", False)],
+    [("tags", "contains", "t4")],
+    [("nums", "contains", 2)],
+    [[("i32", "<", 20)], [("s", "==", "v7"), ("i32", ">", 700)]],
+    [("i64", "not_null"), ("s", "not_null"), ("f", ">", -0.5), ("i32", "<", 600)],
+]
+
+
+def _rows(path, filt, engine, **kw):
+    os.environ["PQT_VEC_FILTER"] = "1" if engine == "vec" else "0"
+    try:
+        with FileReader(path, **kw) as r:
+            return list(r.iter_rows(filters=filt))
+    finally:
+        os.environ.pop("PQT_VEC_FILTER", None)
+
+
+def _norm(rows):
+    """NaN-aware equality form: NaN cells must count as identical across
+    engines (x != x would fail dict equality on genuinely matching rows)."""
+    import math
+
+    def nv(v):
+        if isinstance(v, float) and math.isnan(v):
+            return "__nan__"
+        if isinstance(v, list):
+            return [nv(x) for x in v]
+        if isinstance(v, dict):
+            return {k: nv(x) for k, x in v.items()}
+        return v
+
+    return [nv(r) for r in rows]
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("filt", ZOO_FILTERS, ids=[str(f) for f in ZOO_FILTERS])
+    def test_rows_identical(self, zoo, filt):
+        assert _norm(_rows(zoo, filt, "vec")) == _norm(_rows(zoo, filt, "scalar"))
+
+    def test_vec_engine_engages(self, zoo):
+        snap = metrics.snapshot()
+        rows = _rows(zoo, [("i32", ">", 100)], "vec")
+        d = metrics.delta(snap)
+        assert rows
+        assert d.get('query_rows_filtered_total{engine="vec"}', 0) > 0
+        assert not d.get('query_rows_filtered_total{engine="scalar"}', 0)
+
+    def test_scalar_fallback_counts_and_matches(self, zoo):
+        # binary-backed decimal has no orderable physical form: the mask
+        # pipeline must decline and the scalar oracle must be counted
+        snap = metrics.snapshot()
+        filt = [("bigdec", ">", decimal.Decimal("1.0"))]
+        vec = _rows(zoo, filt, "vec")
+        d = metrics.delta(snap)
+        assert d.get('query_rows_filtered_total{engine="scalar"}', 0) > 0
+        assert _norm(vec) == _norm(_rows(zoo, filt, "scalar"))
+
+    def test_projection_strips_filter_columns(self, zoo):
+        filt = [("s", "==", "v3")]
+        vec = _rows(zoo, filt, "vec", columns=["i32"])
+        assert vec == _rows(zoo, filt, "scalar", columns=["i32"])
+        assert vec and all(set(row) == {"i32"} for row in vec)
+
+    def test_raw_mode_rejected_with_filters(self, zoo):
+        with FileReader(zoo) as r:
+            with pytest.raises(FilterError):
+                list(r.iter_rows(raw=True, filters=[("i32", ">", 1)]))
+
+
+class TestArrowPath:
+    @pytest.mark.parametrize(
+        "filt",
+        [
+            [("i32", ">", 400)],
+            [("f", ">", 0.5)],
+            [("s", "not_in", ["v1"])],  # nulls KEPT: pyarrow convention
+            [("s", "in", ["v1", "v9"])],
+            [("f", "is_null")],
+            [("tags", "contains", "t4")],
+            [[("i32", "<", 20)], [("s", "==", "v7")]],
+        ],
+        ids=str,
+    )
+    def test_fast_path_matches_fallback(self, zoo, filt):
+        with FileReader(zoo) as r:
+            fast = r.to_arrow(filters=filt)
+        os.environ["PQT_VEC_FILTER"] = "0"
+        try:
+            with FileReader(zoo) as r:
+                slow = r.to_arrow(filters=filt)
+        finally:
+            os.environ.pop("PQT_VEC_FILTER", None)
+        # Table.equals treats NaN cells as unequal even when both sides
+        # carry the identical NaN: compare schema + NaN-normalized values
+        assert fast.schema.equals(slow.schema)
+        assert _norm(fast.to_pylist()) == _norm(slow.to_pylist())
+
+    def test_not_in_keeps_nulls_unlike_rows(self, zoo):
+        # the pinned convention split: arrow keeps nulls on not_in, the
+        # row predicate drops them
+        filt = [("s", "not_in", ["v1"])]
+        with FileReader(zoo) as r:
+            t = r.to_arrow(filters=filt)
+        rows = _rows(zoo, filt, "vec")
+        nulls = sum(1 for v in t.column("s").to_pylist() if v is None)
+        assert nulls > 0
+        assert t.num_rows == len(rows) + nulls
+
+    def test_float32_in_list_engines_agree(self, tmp_path):
+        """pc.is_in CASTS the value set to the column type, so a float64
+        member inexact in float32 matches under pyarrow but not under
+        exact semantics — the vec fast path must decline (fallback
+        decides) so to_arrow is engine-independent, while iter_rows keeps
+        the scalar walk's exact convention on both engines."""
+        p = str(tmp_path / "f32.parquet")
+        pq.write_table(
+            pa.table({"x": pa.array(np.array([0.1, 0.2, 0.3, 1.5], np.float32))}),
+            p,
+        )
+        for filt, arrow_rows, row_rows in (
+            ([("x", "in", [0.1, 1.5])], 2, 1),
+            ([("x", "not_in", [0.1])], 3, 4),
+        ):
+            with FileReader(p) as r:
+                fast = r.to_arrow(filters=filt)
+            os.environ["PQT_VEC_FILTER"] = "0"
+            try:
+                with FileReader(p) as r:
+                    slow = r.to_arrow(filters=filt)
+            finally:
+                os.environ.pop("PQT_VEC_FILTER", None)
+            assert fast.equals(slow)
+            assert fast.num_rows == arrow_rows, filt
+            assert len(_rows(p, filt, "vec")) == row_rows, filt
+            assert len(_rows(p, filt, "scalar")) == row_rows, filt
+
+    def test_matches_pyarrow_read_table(self, zoo):
+        import pyarrow.parquet as pqm
+
+        for filt, ora in [
+            ([("i32", ">", 400)], [("i32", ">", 400)]),
+            ([("s", "in", ["v1", "v9"])], [("s", "in", ["v1", "v9"])]),
+            ([("s", "not_in", ["v1"])], [("s", "not in", ["v1"])]),
+        ]:
+            with FileReader(zoo) as r:
+                mine = r.to_arrow(filters=filt)
+            assert mine.num_rows == pqm.read_table(zoo, filters=ora).num_rows
+
+
+class TestContains:
+    def test_contains_requires_list_column(self, zoo):
+        with FileReader(zoo) as r:
+            with pytest.raises(FilterError):
+                list(r.iter_rows(filters=[("i32", "contains", 1)]))
+
+    def test_contains_prunes_conservatively(self, tmp_path):
+        # element stats bracket membership: a value outside every group's
+        # min/max range prunes the group, a present one keeps it
+        p = tmp_path / "lists.parquet"
+        t = pa.table(
+            {"xs": pa.array([[i, i + 1] for i in range(100)], pa.list_(pa.int64()))}
+        )
+        pq.write_table(t, str(p), row_group_size=25)
+        with FileReader(p) as r:
+            assert r.prune_row_groups([("xs", "contains", 1_000_000)]) == []
+            assert list(r.iter_rows(filters=[("xs", "contains", 30)])) == [
+                {"xs": [29, 30]},
+                {"xs": [30, 31]},
+            ]
+
+    def test_null_and_empty_lists_never_match(self, zoo):
+        for row in _rows(zoo, [("tags", "contains", "t0")], "vec"):
+            assert row["tags"] and "t0" in row["tags"]
+
+
+class TestCorruptCorpus:
+    """Typed-or-identical on the corrupt corpus, under both engines."""
+
+    @pytest.mark.parametrize(
+        "path", sorted(glob.glob(os.path.join(CORPUS_DIR, "*.parquet")))
+    )
+    def test_typed_or_identical(self, path):
+        filt = [("id", ">=", 0)] if "empty" not in path else [("id", ">=", 0)]
+        results = {}
+        for engine in ("vec", "scalar"):
+            os.environ["PQT_VEC_FILTER"] = "1" if engine == "vec" else "0"
+            try:
+                with FileReader(path) as r:
+                    try:
+                        flt = filt
+                        # pick a real column when 'id' isn't in this file
+                        names = [c.name for c in r.schema.root.children]
+                        if "id" not in names and names:
+                            flt = [(names[0], "not_null")]
+                        results[engine] = ("rows", list(r.iter_rows(filters=flt)))
+                    except PARQUET_ERRORS as e:
+                        results[engine] = ("error", type(e).__name__)
+                    except FilterError as e:
+                        results[engine] = ("filter_error", str(e))
+            except PARQUET_ERRORS as e:
+                results[engine] = ("open_error", type(e).__name__)
+            finally:
+                os.environ.pop("PQT_VEC_FILTER", None)
+        assert results["vec"] == results["scalar"], path
+
+
+class TestMaskUnits:
+    def _chunks(self, path):
+        with FileReader(path) as r:
+            return r.schema, r._read_row_group(0, None, pack=False)
+
+    def test_mask_to_ranges(self):
+        m = np.array([0, 1, 1, 0, 1, 0, 0, 1, 1, 1], dtype=bool)
+        assert mask_to_ranges(m) == [(1, 3), (4, 5), (7, 10)]
+        assert mask_to_ranges(np.zeros(4, dtype=bool)) == []
+        assert mask_to_ranges(np.ones(3, dtype=bool)) == [(0, 3)]
+
+    def test_unorderable_domain_declines(self, zoo):
+        schema, chunks = self._chunks(zoo)
+        dnf = normalize_dnf(schema, [("bigdec", ">", decimal.Decimal("1"))])
+        with pytest.raises(VecFilterError):
+            dnf_mask(chunks, dnf, group_row_count(chunks))
+
+    def test_missing_column_declines(self, zoo):
+        schema, chunks = self._chunks(zoo)
+        dnf = normalize_dnf(schema, [("i32", ">", 1)])
+        chunks = {p: c for p, c in chunks.items() if p != ("i32",)}
+        with pytest.raises(VecFilterError):
+            dnf_mask(chunks, dnf, 256)
+
+    def test_vacuous_conjunction_admits_all(self, zoo):
+        schema, chunks = self._chunks(zoo)
+        n = group_row_count(chunks)
+        assert dnf_mask(chunks, [[]], n).all()
+
+    def test_masked_flat_columns_declines_lists(self, zoo):
+        _schema, chunks = self._chunks(zoo)
+        mask = np.ones(group_row_count(chunks), dtype=bool)
+        assert masked_flat_columns(chunks, False, mask) is None  # has lists
+        flat = {p: c for p, c in chunks.items() if p in (("i32",), ("s",))}
+        names, cols, k = masked_flat_columns(flat, False, mask)
+        assert set(names) == {"i32", "s"} and k == len(mask)
+
+
+class TestDeviceTwins:
+    def test_predicate_mask_device_matches_host(self):
+        jax = pytest.importorskip("jax")
+        jnp = jax.numpy
+        from parquet_tpu.kernels.device_ops import predicate_mask_device
+
+        vals = np.array([3, 9, 4, 4, 12, -1], dtype=np.int64)
+        dv = jnp.asarray(vals)
+        for op in ("==", "!=", "<", "<=", ">", ">="):
+            # exact bracket (value 4) and inexact bracket (value 4.5)
+            host_exact = {
+                "==": vals == 4, "!=": vals != 4, "<": vals < 4,
+                "<=": vals <= 4, ">": vals > 4, ">=": vals >= 4,
+            }[op]
+            got = np.asarray(predicate_mask_device(dv, op, 4, 4, True))
+            assert np.array_equal(got, host_exact), op
+            host_inexact = {
+                "==": np.zeros(6, bool), "!=": np.ones(6, bool),
+                "<": vals <= 4, "<=": vals <= 4,
+                ">": vals >= 5, ">=": vals >= 5,
+            }[op]
+            got = np.asarray(predicate_mask_device(dv, op, 4, 5, False))
+            assert np.array_equal(got, host_inexact), op
+
+    def test_list_contains_device_matches_host(self, zoo):
+        jax = pytest.importorskip("jax")
+        jnp = jax.numpy
+        from parquet_tpu.kernels.device_ops import list_contains_mask_device
+
+        with FileReader(zoo) as r:
+            schema = r.schema
+            chunks = r._read_row_group(0, ["nums"], pack=False)
+            dnf = normalize_dnf(schema, [("nums", "contains", 2)])
+            n = group_row_count(chunks)
+            host = dnf_mask(chunks, dnf, n)
+        cd = chunks[("nums", "list", "element")]
+        leaf = schema.column(("nums", "list", "element"))
+        rl = np.asarray(cd.rep_levels, dtype=np.int32)
+        dl = np.asarray(cd.def_levels, dtype=np.int32)
+        dense = np.asarray(cd.values) == 2
+        rows, n_rows = list_contains_mask_device(
+            jnp.asarray(rl), jnp.asarray(dl), jnp.asarray(dense), leaf.max_def
+        )
+        assert int(n_rows) == n
+        # row k's flag lives at index k; entries past n_rows are padding
+        assert np.array_equal(np.asarray(rows)[:n], host)
+
+    def test_mask_take_device(self):
+        jax = pytest.importorskip("jax")
+        jnp = jax.numpy
+        from parquet_tpu.kernels.device_ops import mask_take_device
+
+        vals = np.arange(10, dtype=np.int64) * 3
+        mask = np.array([0, 1, 0, 0, 1, 1, 0, 0, 0, 1], dtype=bool)
+        taken, count = mask_take_device(
+            jnp.asarray(vals), jnp.asarray(mask), 8
+        )
+        assert int(count) == 4
+        assert np.asarray(taken)[:4].tolist() == vals[mask].tolist()
