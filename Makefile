@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-write bench-assembly bench-serve bench-query bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-write bench-assembly bench-serve bench-query bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
@@ -43,6 +43,13 @@ bench-dataset: native
 # latency-injected FlakySource (the object-store shape); host-only
 bench-io: native
 	python bench.py --io
+
+# remote-IO bench: httpstub (real loopback HTTP range GETs) at injected
+# RTT 0/5/25 ms — auto-tuned coalesce/readahead vs the fixed local knobs,
+# plus the tiered RAM->disk cache's warm re-scan (asserted to read ZERO
+# source bytes before timing); host-only
+bench-io-remote: native
+	python bench.py --io-remote
 
 # write-path bench: FileWriter vs pyarrow + the pqt-encode parallelism
 # sweep (pool 1/4/8 x 8/16 row groups, byte-identical to serial); host-only

@@ -52,6 +52,15 @@ EIO rate absorbed by the retry ladder): a coalesce-gap sweep (0 / 64 KiB /
 (0/2/4 row groups prefetched into a shared block cache on the pqt-io pool).
 The result rides the --json artifact under "io".
 
+`--io-remote` benchmarks the REMOTE io stack (io.remote + io.tiercache +
+io.autotune) over real loopback HTTP: testing.httpstub serves the fixture
+at injected RTT 0/5/25 ms and a 4-of-8 projection scans through HttpSource
+with fixed local knobs vs coalesce_gap="auto" (the latency-aware tuner),
+plus a tiered RAM->disk cache whose warm re-scan is asserted to read ZERO
+source bytes before timing. PQT_IO_REMOTE_ROWS (default 200_000) and
+PQT_IO_REMOTE_REPEATS (default 3) size it; PQT_BENCH_IO_REMOTE=0 skips it
+in a full run. The result rides the --json artifact under "io_remote".
+
 `--write` benchmarks the write path: FileWriter vs pyarrow (snappy headline)
 plus the pqt-encode PARALLELISM sweep — pool 1/4/8 x 8/16 row groups on a
 GZIP log-ingest table (PQT_WRITE_ROWS rows, default 400K), every parallel
@@ -1195,6 +1204,175 @@ def _phase_io() -> None:
     _emit(out)
 
 
+# -- the remote-IO benchmark (--io-remote / phase "io_remote") -----------------
+
+IO_REMOTE_ROWS = int(os.environ.get("PQT_IO_REMOTE_ROWS", 200_000))
+IO_REMOTE_RTTS_MS = (0.0, 5.0, 25.0)
+IO_REMOTE_REPEATS = int(os.environ.get("PQT_IO_REMOTE_REPEATS", 3))
+
+
+def _io_remote_file() -> Path:
+    """A smaller-row-group variant of the io fixture for the remote sweep:
+    ~128 KiB column chunks leave per-group gaps the auto-tuner's
+    bandwidth-delay verdict has to decide about at every injected RTT."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = Path(f"/tmp/pqt_io_remote_{IO_REMOTE_ROWS}.parquet")
+    if not path.exists():
+        rng = np.random.default_rng(13)
+        log(
+            f"bench: generating {IO_REMOTE_ROWS:,}-row 8-column remote "
+            f"fixture at {path}"
+        )
+        t = pa.table(
+            {
+                f"c{k}": pa.array(
+                    rng.integers(0, 1 << 40, IO_REMOTE_ROWS).astype(np.int64)
+                )
+                for k in range(8)
+            }
+        )
+        pq.write_table(
+            t, path, compression="snappy", row_group_size=1 << 14,
+            use_dictionary=False,
+        )
+    return path
+
+
+def _phase_io_remote() -> None:
+    """Remote-latency profile sweep (`bench.py --io-remote` /
+    `make bench-io-remote`).
+
+    Serves the fixture through testing.httpstub (real loopback HTTP,
+    range GETs on pooled connections) at injected RTT 0/5/25 ms and scans
+    a 4-of-8 projection via io.remote.HttpSource three ways per RTT:
+
+      fixed   the local-profile knobs (64 KiB coalesce gap) — what a
+              reader naive about the transport pays
+      auto    coalesce_gap="auto": the io.autotune profile observed from
+              this sweep's own reads (reset per run) — the acceptance
+              pin: auto beats fixed at the 25 ms RTT
+      warm    a tiered (RAM->disk) cache filled by one cold auto scan,
+              then re-scanned — asserted to read ZERO source bytes (the
+              ROADMAP pin) before timing
+
+    Host-only; the result rides the --json artifact as "io_remote"."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from parquet_tpu.core.reader import FileReader
+    from parquet_tpu.io import FooterCache, TieredCache, io_tuner
+    from parquet_tpu.testing.httpstub import RangeHttpStub
+    from parquet_tpu.utils import metrics
+
+    path = _io_remote_file()
+    data = path.read_bytes()
+    cols = [f"c{k}" for k in range(0, 8, 2)]  # 4-of-8: gappy projection
+
+    def scan(url, gap, fc=None, cache=None) -> int:
+        with FileReader(
+            url, columns=cols, footer_cache=fc, block_cache=cache,
+            coalesce_gap=gap,
+        ) as r:
+            rows = 0
+            for g in range(r.num_row_groups):
+                rows += next(iter(r.read_row_group(g).values())).num_values
+            assert rows == IO_REMOTE_ROWS
+            return rows
+
+    out = {
+        "config": "io_remote",
+        "rows": IO_REMOTE_ROWS,
+        "file_mb": round(len(data) / 1e6, 2),
+        "projection": cols,
+        "stat": "median",
+        "repeats": IO_REMOTE_REPEATS,
+    }
+    sweep = {}
+    for rtt_ms in IO_REMOTE_RTTS_MS:
+        with RangeHttpStub(
+            files={"c.parquet": data}, latency_s=rtt_ms / 1e3
+        ) as stub:
+            url = stub.url_for("c.parquet")
+
+            def run(gap):
+                # a COLD tuner per SAMPLE (reset inside the timed fn):
+                # "auto" must earn its knobs from each scan's own
+                # observations, or samples 2..n would measure a
+                # pre-trained tuner the comment's "cold" claim belies
+                def one_cold_scan():
+                    io_tuner().reset()
+                    scan(url, gap)
+
+                s0 = metrics.snapshot()
+                t = timed_stats(
+                    one_cold_scan, IO_REMOTE_REPEATS,
+                    f"io-remote rtt={rtt_ms:g}ms gap={gap}",
+                    rows=IO_REMOTE_ROWS,
+                )
+                d = metrics.delta(s0)
+                return t, {
+                    "t": t["t"],
+                    "rows_s": round(IO_REMOTE_ROWS / t["t"], 1),
+                    "http_requests": sum(
+                        v for k, v in d.items()
+                        if k.startswith("io_http_requests_total")
+                    ) // IO_REMOTE_REPEATS,
+                    "bytes_read": d.get("io_bytes_read_total", 0)
+                    // IO_REMOTE_REPEATS,
+                }
+
+            _, fixed = run(None)
+            _, auto = run("auto")
+            # tiered warm: one cold fill, then the warm re-scan (zero
+            # source bytes asserted BEFORE timing)
+            io_tuner().reset()
+            fc = FooterCache()
+            with TieredCache(
+                ram_bytes=32 << 20, disk_bytes=256 << 20
+            ) as cache:
+                scan(url, "auto", fc, cache)  # cold fill
+                s0 = metrics.snapshot()
+                scan(url, "auto", fc, cache)
+                d0 = metrics.delta(s0)
+                assert d0.get("io_bytes_read_total", 0) == 0, (
+                    "warm tiered scan touched the source"
+                )
+                tw = timed_stats(
+                    lambda: scan(url, "auto", fc, cache),
+                    IO_REMOTE_REPEATS,
+                    f"io-remote rtt={rtt_ms:g}ms warm-tiered",
+                    rows=IO_REMOTE_ROWS,
+                )
+            sweep[f"{rtt_ms:g}"] = {
+                "fixed": fixed,
+                "auto": auto,
+                "auto_speedup": round(fixed["t"] / auto["t"], 3),
+                "warm_tiered": {
+                    "t": tw["t"],
+                    "rows_s": round(IO_REMOTE_ROWS / tw["t"], 1),
+                    "zero_source_bytes": True,
+                },
+            }
+    out["rtt_sweep"] = sweep
+    hot = sweep[f"{IO_REMOTE_RTTS_MS[-1]:g}"]
+    out["auto_speedup_at_max_rtt"] = hot["auto_speedup"]
+    out["warm_vs_fixed_at_max_rtt"] = round(
+        hot["fixed"]["t"] / hot["warm_tiered"]["t"], 3
+    )
+    log(
+        "bench: io-remote @"
+        + ", ".join(
+            f"{k}ms auto {v['auto_speedup']:.2f}x fixed"
+            f" ({v['fixed']['http_requests']}->{v['auto']['http_requests']}"
+            " reqs)"
+            for k, v in sweep.items()
+        )
+        + f"; warm tiered {out['warm_vs_fixed_at_max_rtt']:.1f}x fixed "
+        f"at {IO_REMOTE_RTTS_MS[-1]:g}ms (zero source bytes)"
+    )
+    _emit(out)
+
+
 # -- the scan-service benchmark (--serve / phase "serve") ----------------------
 
 SERVE_ROWS = int(os.environ.get("PQT_SERVE_ROWS", 160_000))
@@ -2164,6 +2342,19 @@ def main() -> None:
                 f"({r_io['gap_speedup']:.2f}x over gap 0)"
             )
 
+    # remote-IO sweep (PQT_BENCH_IO_REMOTE=0 to skip): httpstub at 0/5/25ms
+    # injected RTT, auto-tuned vs fixed knobs, tiered-cache warm re-scan
+    r_io_remote = None
+    if os.environ.get("PQT_BENCH_IO_REMOTE", "1") != "0":
+        r_io_remote = _run_phase("io_remote")
+        if r_io_remote:
+            log(
+                f"bench: io-remote auto-tune "
+                f"{r_io_remote['auto_speedup_at_max_rtt']:.2f}x fixed knobs "
+                f"at {IO_REMOTE_RTTS_MS[-1]:g}ms RTT; warm tiered "
+                f"{r_io_remote['warm_vs_fixed_at_max_rtt']:.1f}x"
+            )
+
     # chaos sweep (PQT_BENCH_CHAOS=0 to skip): the scripted fault schedule
     # against the SLO-controlled pipeline, breaker fast-fail, serve brownout
     r_chaos = None
@@ -2286,6 +2477,8 @@ def main() -> None:
         artifact["dataset"] = r_ds
     if r_io:
         artifact["io"] = r_io
+    if r_io_remote:
+        artifact["io_remote"] = r_io_remote
     if r_serve:
         artifact["serve"] = r_serve
     if r_query:
@@ -2732,6 +2925,8 @@ if __name__ == "__main__":
         _phase_assembly()
     elif argv and argv[0] == "--io":
         _phase_io()
+    elif argv and argv[0] == "--io-remote":
+        _phase_io_remote()
     elif argv and argv[0] == "--write":
         _phase_write()
     elif argv and argv[0] == "--serve":
@@ -2754,6 +2949,8 @@ if __name__ == "__main__":
             _phase_dataset()
         elif name == "io":
             _phase_io()
+        elif name == "io_remote":
+            _phase_io_remote()
         elif name == "serve":
             _phase_serve()
         elif name == "query":

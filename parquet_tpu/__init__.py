@@ -83,10 +83,14 @@ from .io import (  # noqa: F401
     BlockCache,
     ByteSource,
     FooterCache,
+    HttpSource,
     LocalFileSource,
     MemorySource,
+    ObjectStoreSource,
     RetryingSource,
     SourceError,
+    TieredCache,
+    TransientSourceError,
 )
 from .sink import (  # noqa: F401
     BufferedSink,

@@ -351,15 +351,22 @@ class FileReader:
         # a per-reader SourceFile cursor for the stream-shaped page walks.
         self._source, self._owns_file = open_source(source)
         self._f = SourceFile(self._source)
-        # block_cache: a shared io.cache.BlockCache chunk/range reads check
-        # before touching the source (the dataset layer passes one so
-        # readahead and repeated epochs hit memory). footer_cache: an
+        # block_cache: a shared io.cache.BlockCache (or io.tiercache
+        # TieredCache — same contract) chunk/range reads check before
+        # touching the source (the dataset layer passes one so readahead
+        # and repeated epochs hit memory). footer_cache: an
         # io.cache.FooterCache consulted/filled for path sources, so a
-        # re-opened file parses its footer zero times.
+        # re-opened file parses its footer zero times. coalesce_gap:
+        # an explicit byte gap, None (the 64 KiB local default) or
+        # "auto" — resolve per fetch through the io.autotune profile of
+        # this source's transport (remote stores coalesce MiB-scale).
         self._block_cache = block_cache
-        self._coalesce_gap = (
-            DEFAULT_COALESCE_GAP if coalesce_gap is None else int(coalesce_gap)
-        )
+        if coalesce_gap is None:
+            self._coalesce_gap = DEFAULT_COALESCE_GAP
+        elif coalesce_gap == "auto":
+            self._coalesce_gap = "auto"
+        else:
+            self._coalesce_gap = int(coalesce_gap)
         try:
             if metadata is not None:
                 self.metadata = metadata
@@ -367,8 +374,13 @@ class FileReader:
                 path_key = (
                     str(source) if isinstance(source, (str, Path)) else None
                 )
+                # URL keys can't os.stat: validate against the remote
+                # source's generation (size, ETag) instead
+                gen = (
+                    self._source.generation() if path_key is not None else None
+                )
                 cached = (
-                    footer_cache.get(path_key)
+                    footer_cache.get(path_key, sig=gen)
                     if footer_cache is not None and path_key is not None
                     else None
                 )
@@ -377,7 +389,7 @@ class FileReader:
                 else:
                     self.metadata = read_file_metadata(self._f)
                     if footer_cache is not None and path_key is not None:
-                        footer_cache.put(path_key, self.metadata)
+                        footer_cache.put(path_key, self.metadata, sig=gen)
             # schema=: a pre-built Schema for this metadata (high-churn
             # callers like the dataset layer open one reader per row group;
             # rebuilding the schema tree from thrift every open is waste)
@@ -2334,7 +2346,29 @@ class FileReader:
         with `metadata=` so the footer never re-parses. `footer_cache` (an
         io.cache.FooterCache) makes the parse once-per-file-GENERATION: a
         warm hit performs zero source reads; staleness is checked against
-        the file's (size, mtime)."""
+        the file's (size, mtime).
+
+        `path` may be an http(s):// URL (io.remote.HttpSource under the
+        installed resilience policy): the footer cache then validates
+        against the object's (size, ETag) generation — a warm remote
+        re-plan costs one HEAD and zero body bytes per file."""
+        if isinstance(path, str) and path.startswith(("http://", "https://")):
+            from ..io.source import open_source
+
+            src, owns = open_source(path)
+            try:
+                gen = src.generation()
+                if footer_cache is not None:
+                    meta = footer_cache.get(path, sig=gen)
+                    if meta is not None:
+                        return meta
+                meta = read_file_metadata(SourceFile(src))
+                if footer_cache is not None:
+                    footer_cache.put(path, meta, sig=gen)
+                return meta
+            finally:
+                if owns:
+                    src.close()
         if footer_cache is not None:
             meta = footer_cache.get(path)
             if meta is not None:

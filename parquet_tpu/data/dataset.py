@@ -112,7 +112,11 @@ class ParquetDataset:
         validate_crc: bool = False,
         device=None,
         cache_bytes: int = 0,
+        cache_disk_bytes: int = 0,
+        cache_dir=None,
+        block_cache=None,
         readahead_bytes: int | None = None,
+        io_autotune: bool = False,
         slo_wait_ms: float | None = None,
         controller=None,
     ):
@@ -143,6 +147,8 @@ class ParquetDataset:
             raise ValueError("dataset: prefetch depth must be >= 0")
         if cache_bytes < 0:
             raise ValueError("dataset: cache_bytes must be >= 0")
+        if cache_disk_bytes < 0:
+            raise ValueError("dataset: cache_disk_bytes must be >= 0")
         self.paths_or_glob = paths_or_glob
         self.batch_size = int(batch_size)
         self.columns = list(columns) if columns is not None else None
@@ -167,17 +173,42 @@ class ParquetDataset:
         self._pool: ThreadPoolExecutor | None = None
         self._closed = False
         # IO layer: footers cache process-wide (validated per generation by
-        # size+mtime, so it is always safe); cache_bytes > 0 adds a shared
-        # byte-budgeted block cache — unit decodes read through it, repeat
-        # epochs hit memory, and the pqt-io readahead scheduler streams the
-        # NEXT units' planned byte ranges into it while pqt-data decodes the
-        # current window (readahead_bytes bounds its in-flight budget,
-        # default = cache_bytes / 4).
+        # size+mtime for paths, size+ETag for URLs, so it is always safe);
+        # cache_bytes > 0 adds a shared byte-budgeted block cache — unit
+        # decodes read through it, repeat epochs hit memory, and the pqt-io
+        # readahead scheduler streams the NEXT units' planned byte ranges
+        # into it while pqt-data decodes the current window
+        # (readahead_bytes bounds its in-flight budget, default =
+        # cache_bytes / 4). cache_disk_bytes > 0 grows the block cache
+        # into a RAM -> disk TieredCache spilling to cache_dir (a private
+        # temp dir when None) — the remote-corpus shape, where the hot set
+        # outlives RAM but a local disk beats the store by ~100x.
+        # block_cache= passes a PRE-BUILT cache (BlockCache or
+        # TieredCache, caller-owned) so co-resident consumers — a serve
+        # daemon and its training loaders — pool ONE tier budget.
+        # io_autotune=True resolves the coalesce gap per fetch (and
+        # deepens the readahead budget) from the observed per-transport
+        # latency profile (io/autotune.py): local corpora keep the 64 KiB
+        # default, remote ones coalesce MiB-scale.
         from ..io.cache import BlockCache, shared_footer_cache
         from ..io.planner import Readahead
+        from ..io.tiercache import TieredCache
 
         self._footer_cache = shared_footer_cache()
-        self._block_cache = BlockCache(cache_bytes) if cache_bytes else None
+        self.io_autotune = bool(io_autotune)
+        self._owns_cache = block_cache is None
+        if block_cache is not None:
+            self._block_cache = block_cache
+        elif cache_disk_bytes:
+            self._block_cache = TieredCache(
+                ram_bytes=cache_bytes or (64 << 20),
+                disk_bytes=cache_disk_bytes,
+                cache_dir=cache_dir,
+            )
+        elif cache_bytes:
+            self._block_cache = BlockCache(cache_bytes)
+        else:
+            self._block_cache = None
         self._readahead = (
             Readahead(
                 self._block_cache,
@@ -186,6 +217,7 @@ class ParquetDataset:
                     if readahead_bytes is not None
                     else max(cache_bytes // 4, 1 << 20)
                 ),
+                autotune=self.io_autotune,
             )
             if self._block_cache is not None
             else None
@@ -389,6 +421,10 @@ class ParquetDataset:
             self._readahead.close()
         if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
+        # a tiered cache the DATASET built owns its spill files; a passed
+        # block_cache= belongs to the caller (it may be the daemon's)
+        if self._owns_cache and hasattr(self._block_cache, "close"):
+            self._block_cache.close()
 
     def __enter__(self):
         return self
@@ -742,6 +778,7 @@ class DatasetIterator:
                     validate_crc=ds.validate_crc,
                     on_error=ds.on_error,
                     block_cache=ds._block_cache,
+                    coalesce_gap="auto" if ds.io_autotune else None,
                 )
             except PARQUET_ERRORS + (OSError,):
                 if ds.on_error == "raise":

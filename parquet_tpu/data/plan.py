@@ -53,11 +53,14 @@ def expand_paths(paths_or_glob) -> list[str]:
 
     A string (or Path) is treated as a glob pattern when it contains magic
     characters, otherwise as a single file; a list/tuple passes through.
-    The result is lexicographically sorted — glob order is filesystem-
-    dependent, and the shard/shuffle math needs every process to see the
-    SAME file indices."""
+    http(s):// URLs pass through verbatim (remote objects don't glob or
+    stat — existence surfaces as the open's typed error). The result is
+    lexicographically sorted — glob order is filesystem-dependent, and the
+    shard/shuffle math needs every process to see the SAME file indices."""
     if isinstance(paths_or_glob, (str, Path)):
         s = str(paths_or_glob)
+        if s.startswith(("http://", "https://")):
+            return [s]
         if _glob.has_magic(s):
             hits = _glob.glob(s)
             if not hits:

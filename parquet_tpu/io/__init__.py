@@ -1,12 +1,16 @@
 """parquet_tpu.io — pluggable byte sources, range planning, and caching.
 
 The IO seam under the decode stack: ByteSource implementations (lock-free
-local pread, in-memory, retrying remote-shaped wrappers), a planner that
-derives the exact byte ranges a projected read needs from the footer and
-coalesces them into batched reads, a bounded pqt-io readahead scheduler,
-and byte-budgeted block + footer caches. See each module's docstring.
+local pread, in-memory, HTTP(S) range-GET remote sources with presigned-
+URL object-store variants, retrying/breaker/hedged wrappers), a planner
+that derives the exact byte ranges a projected read needs from the footer
+and coalesces them into batched reads, a bounded pqt-io readahead
+scheduler, byte-budgeted block + footer caches with a RAM -> local-disk
+TieredCache for remote corpora, and a latency-aware auto-tuner that picks
+coalesce/readahead knobs per transport. See each module's docstring.
 """
 
+from .autotune import IOParams, IOTuner, io_tuner, profile_key  # noqa: F401
 from .cache import BlockCache, FooterCache, shared_footer_cache  # noqa: F401
 from .hedge import (  # noqa: F401
     BreakerRegistry,
@@ -27,6 +31,11 @@ from .planner import (  # noqa: F401
     io_pool,
     plan_ranges,
 )
+from .remote import (  # noqa: F401
+    HttpSource,
+    ObjectStoreSource,
+    TransientSourceError,
+)
 from .source import (  # noqa: F401
     ByteSource,
     FileObjectSource,
@@ -37,6 +46,7 @@ from .source import (  # noqa: F401
     SourceFile,
     open_source,
 )
+from .tiercache import TieredCache  # noqa: F401
 
 __all__ = [
     "ByteSource",
@@ -65,4 +75,12 @@ __all__ = [
     "configure_resilience",
     "resilience_config",
     "wrap_resilient",
+    "HttpSource",
+    "ObjectStoreSource",
+    "TransientSourceError",
+    "TieredCache",
+    "IOParams",
+    "IOTuner",
+    "io_tuner",
+    "profile_key",
 ]

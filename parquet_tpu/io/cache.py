@@ -122,16 +122,21 @@ class FooterCache:
         st = os.stat(path)
         return (st.st_size, st.st_mtime_ns)
 
-    def get(self, path):
+    def get(self, path, sig=None):
         """The cached FileMetaData for `path` when the file on disk still
         matches the cached generation; None (counted as a miss) otherwise.
         A stat failure — vanished file — is a miss too: the caller's open
-        will raise the real error with its real context."""
+        will raise the real error with its real context.
+
+        `sig` overrides the stat-derived signature for keys that are not
+        stat-able paths: a URL-keyed footer validates against the remote
+        source's generation() — (size, ETag) — instead of (size, mtime)."""
         path = os.fspath(path)
-        try:
-            sig = self._sig(path)
-        except OSError:
-            sig = None
+        if sig is None:
+            try:
+                sig = self._sig(path)
+            except OSError:
+                sig = None
         with self._lock:
             hit = self._entries.get(path)
             if hit is not None and sig is not None and hit[0] == sig:
@@ -143,12 +148,13 @@ class FooterCache:
         _metrics.inc("io_footer_cache_misses_total")
         return None
 
-    def put(self, path, meta) -> None:
+    def put(self, path, meta, sig=None) -> None:
         path = os.fspath(path)
-        try:
-            sig = self._sig(path)
-        except OSError:
-            return  # can't pin a generation: don't cache
+        if sig is None:
+            try:
+                sig = self._sig(path)
+            except OSError:
+                return  # can't pin a generation: don't cache
         with self._lock:
             self._entries[path] = (sig, meta)
             self._entries.move_to_end(path)

@@ -171,6 +171,10 @@ class HedgedSource(ByteSource):
     def source_id(self) -> str:
         return self.inner.source_id
 
+    def generation(self):
+        gen = getattr(self.inner, "generation", None)
+        return gen() if gen is not None else None
+
     def size(self) -> int:
         return self.inner.size()
 
@@ -490,6 +494,10 @@ class BreakerSource(ByteSource):
     @property
     def source_id(self) -> str:
         return self.inner.source_id
+
+    def generation(self):
+        gen = getattr(self.inner, "generation", None)
+        return gen() if gen is not None else None
 
     def size(self) -> int:
         return self.inner.size()

@@ -33,11 +33,13 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..utils import metrics as _metrics
 from ..obs.pool import instrumented_submit
 from ..utils.trace import stage
+from .autotune import io_tuner, profile_key
 
 __all__ = [
     "DEFAULT_COALESCE_GAP",
@@ -160,7 +162,16 @@ def fetch_ranges(
     the rest coalesce (io.coalesce stage) into batched read_ranges calls
     (io.read stage, byte volume billed) and fill the cache. Buffers for
     members of one run are zero-copy memoryview slices of the run buffer;
-    cached entries are bytes."""
+    cached entries are bytes.
+
+    `gap="auto"` resolves through the process IOTuner's profile for this
+    source's transport (io/autotune.py) — 64 KiB until the transport has
+    demonstrably remote latency, MiB-scale after. Every batched read here
+    also FEEDS that tuner (latency + achieved bandwidth), whichever gap
+    was used, so opting into "auto" anywhere benefits from observations
+    made everywhere."""
+    if gap == "auto":
+        gap = io_tuner().gap_for(source.source_id)
     out: dict = {}
     missing = []
     sid = source.source_id if cache is not None else None
@@ -179,8 +190,18 @@ def fetch_ranges(
     with stage("io.coalesce"):
         runs = coalesce(missing, gap=gap, max_run=max_run)
     run_spans = [(off, n) for off, n, _m in runs]
-    with stage("io.read", sum(n for _o, n in run_spans)):
+    total = sum(n for _o, n in run_spans)
+    t0 = time.perf_counter()
+    with stage("io.read", total):
         bufs = source.read_ranges(run_spans)
+    # wall/runs is only an honest per-request latency when the runs were
+    # SEQUENTIAL — remote sources fan read_ranges out concurrently and
+    # feed the tuner per request themselves (HttpSource._observe), so
+    # only local-profiled transports are observed from here
+    if profile_key(source.source_id) == "local":
+        io_tuner().observe(
+            source.source_id, total, time.perf_counter() - t0, len(run_spans)
+        )
     for (run_off, _run_len, members), buf in zip(runs, bufs):
         mv = memoryview(buf)
         for off, n in members:
@@ -226,16 +247,36 @@ class Readahead:
     the same fault with its full typed-error context."""
 
     def __init__(self, cache, *, budget_bytes: int = 64 << 20,
-                 gap: int = DEFAULT_COALESCE_GAP):
+                 gap: int = DEFAULT_COALESCE_GAP, autotune: bool = False):
         if cache is None:
             raise ValueError("Readahead needs a BlockCache to fetch into")
         self.cache = cache
         self.budget_bytes = int(budget_bytes)
         self.gap = gap
+        # autotune=True consults the IOTuner per schedule(): the in-flight
+        # budget GROWS to the transport's recommended readahead (deep for
+        # high-latency stores, the configured budget otherwise), and
+        # fetches coalesce at the tuned gap. The configured budget_bytes
+        # stays the floor — autotune only ever deepens readahead.
+        self.autotune = bool(autotune)
+        if autotune and gap == DEFAULT_COALESCE_GAP:
+            self.gap = "auto"
         self._lock = threading.Lock()
         self._inflight = 0
         self._futures: list = []
         self._closed = False
+
+    def _budget_for(self, source_or_path) -> int:
+        if not self.autotune:
+            return self.budget_bytes
+        key = (
+            source_or_path
+            if isinstance(source_or_path, (str, os.PathLike))
+            else source_or_path.source_id
+        )
+        return max(
+            self.budget_bytes, io_tuner().readahead_for(os.fspath(key))
+        )
 
     def schedule(self, source_or_path, ranges) -> bool:
         """Queue a background fetch of `ranges` from a ByteSource or a local
@@ -243,10 +284,11 @@ class Readahead:
         total = sum(int(n) for _o, n in ranges)
         if total <= 0:
             return False
+        budget = self._budget_for(source_or_path)
         with self._lock:
             if self._closed:
                 return False
-            if self._inflight + total > self.budget_bytes:
+            if self._inflight + total > budget:
                 _metrics.inc("io_readahead_dropped_total")
                 return False
             self._inflight += total

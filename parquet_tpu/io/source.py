@@ -107,6 +107,14 @@ class ByteSource:
         rewritten files never hit stale ones)."""
         return f"{type(self).__name__}:{id(self):#x}"
 
+    def generation(self):
+        """A hashable content-generation signature, or None when the
+        source has no cheaper validity check than its bytes. Remote
+        sources return (size, ETag) — what lets the FooterCache validate
+        a URL-keyed footer the way it stats a local path. Wrapper sources
+        delegate to their inner source."""
+        return None
+
     def close(self) -> None:
         pass
 
@@ -321,6 +329,10 @@ class RetryingSource(ByteSource):
     def source_id(self) -> str:
         return self.inner.source_id
 
+    def generation(self):
+        gen = getattr(self.inner, "generation", None)
+        return gen() if gen is not None else None
+
     def size(self) -> int:
         return self.inner.size()
 
@@ -379,8 +391,32 @@ class RetryingSource(ByteSource):
         ) from last
 
     def read_ranges(self, ranges) -> list:
-        # per-range retry: one flaky range must not re-fetch its healthy
-        # batch-mates
+        ranges = list(ranges)
+        if len(ranges) > 1:
+            # fast path: ONE batched attempt through the inner source, so
+            # a concurrency-capable transport (HttpSource fans read_ranges
+            # out on pqt-io) keeps its parallelism under the retry ladder.
+            # A retryable fault drops to the per-range ladder below —
+            # healthy batch-mates may re-fetch once on that path, the
+            # price of never letting one flaky range burn the batch's
+            # retry budget.
+            try:
+                bufs = self.inner.read_ranges(ranges)
+            except ValueError:
+                raise  # caller bug, not a transport fault
+            except self.retry_on as e:
+                if isinstance(e, SourceError) and not any(
+                    rt is SourceError for rt in self.retry_on
+                ):
+                    raise  # terminal (past-EOF, breaker open, ...)
+                _metrics.inc("io_retries_total", reason=self._reason(e))
+            else:
+                if len(bufs) == len(ranges) and all(
+                    len(b) == n for b, (_o, n) in zip(bufs, ranges)
+                ):
+                    return bufs
+                _metrics.inc("io_retries_total", reason="short_read")
+        # per-range retry: each range gets its own full ladder
         return [self.read_at(off, n) for off, n in ranges]
 
     def close(self) -> None:
@@ -459,9 +495,18 @@ def open_source(obj) -> tuple[ByteSource, bool]:
     policy installed, every reader/dataset/daemon open inherits breakers,
     retries and hedging here, with no per-callsite wiring. Pre-built
     ByteSource and file-like objects pass through untouched — an explicit
-    stack is the caller's to compose."""
+    stack is the caller's to compose.
+
+    An http(s):// URL string opens an io.remote.HttpSource (range GETs on
+    the pooled persistent connections), so URLs ride every path-shaped
+    API — FileReader, ParquetDataset, readahead — and inherit the same
+    policy stack remote reads were built for."""
     if isinstance(obj, ByteSource):
         return obj, False
+    if isinstance(obj, str) and obj.startswith(("http://", "https://")):
+        from .remote import HttpSource
+
+        return _wrap_policy(HttpSource(obj)), True
     if isinstance(obj, (str, Path)):
         return _wrap_policy(LocalFileSource(obj)), True
     if isinstance(obj, (bytes, bytearray, memoryview)):

@@ -110,6 +110,7 @@ def _open_reader(session, planned, unit) -> FileReader:
         columns=planned.request.columns,
         metadata=meta,
         block_cache=session.block_cache,
+        coalesce_gap=getattr(session, "coalesce_gap", None),
     )
 
 

@@ -87,6 +87,18 @@ class ServeConfig:
     port: int = 8080
     root: str | None = None  # confine requested paths to this directory
     cache_mb: int = 64  # shared block cache (0 disables)
+    # tiered cache: cache_disk_mb > 0 grows the block cache into a RAM ->
+    # local-disk TieredCache (io/tiercache.py) spilling to cache_dir (a
+    # private temp dir when None; a given dir is REUSED across restarts —
+    # intact spilled blocks re-serve after a crash). The RAM tier is
+    # cache_mb (its default applies when 0 but a disk tier is asked for).
+    cache_disk_mb: int = 0
+    cache_dir: str | None = None
+    # resolve the read coalesce gap (and readahead depth) per fetch from
+    # the observed per-transport latency profile (io/autotune.py): local
+    # corpora keep the 64 KiB default, remote-backed source factories
+    # coalesce MiB-scale
+    io_autotune: bool = False
     max_inflight: int = 32
     tenant_concurrent: int = 8
     tenant_budget_mb: int | None = None  # scanned-byte budget per window
@@ -112,6 +124,10 @@ class ServeConfig:
     socket_timeout_s: float = 60.0
     shard: tuple | None = None  # this daemon's (index, count) corpus stripe
     source_factory: object = None  # chaos/remote seam: path -> ByteSource
+    # a PRE-BUILT BlockCache/TieredCache (caller-owned, survives close()):
+    # how a daemon and co-resident dataset workers pool ONE tier budget.
+    # Overrides cache_mb/cache_disk_mb.
+    block_cache: object = None
     # observability (parquet_tpu.obs): every request runs under a
     # request-scoped DecodeTrace whose stage rollup is ALWAYS retained in
     # the flight-recorder ring; the full span tree is kept for a
@@ -129,6 +145,8 @@ class ServeConfig:
             raise ValueError("serve: window must be >= 1")
         if self.cache_mb < 0:
             raise ValueError("serve: cache_mb must be >= 0")
+        if self.cache_disk_mb < 0:
+            raise ValueError("serve: cache_disk_mb must be >= 0")
         if self.socket_timeout_s is not None and self.socket_timeout_s <= 0:
             raise ValueError("serve: socket_timeout_s must be positive")
         if self.max_body_bytes < 1:
@@ -164,13 +182,30 @@ class ScanService:
 
     def __init__(self, config: ServeConfig):
         self.config = config
+        if config.block_cache is not None:
+            block_cache = config.block_cache
+            self._owns_cache = False
+        elif config.cache_disk_mb:
+            from ..io.tiercache import TieredCache
+
+            block_cache = TieredCache(
+                ram_bytes=(config.cache_mb or 64) << 20,
+                disk_bytes=config.cache_disk_mb << 20,
+                cache_dir=config.cache_dir,
+            )
+            self._owns_cache = True
+        elif config.cache_mb:
+            block_cache = BlockCache(config.cache_mb << 20)
+            self._owns_cache = True
+        else:
+            block_cache = None
+            self._owns_cache = True
         self.session = ScanSession(
             root=config.root,
-            block_cache=(
-                BlockCache(config.cache_mb << 20) if config.cache_mb else None
-            ),
+            block_cache=block_cache,
             source_factory=config.source_factory,
             shard=config.shard,
+            coalesce_gap="auto" if config.io_autotune else None,
         )
         self.admission = AdmissionController(
             max_inflight=config.max_inflight,
@@ -388,6 +423,7 @@ class ScanService:
         import os
 
         from .. import __version__ as _version
+        from ..io.autotune import io_tuner as _io_tuner
         from ..io.hedge import resilience_config
         from ..obs.pool import pool_depths
 
@@ -418,6 +454,9 @@ class ScanService:
             "serve": {
                 "root": cfg.root,
                 "cache_mb": cfg.cache_mb,
+                "cache_disk_mb": cfg.cache_disk_mb,
+                "cache_dir": cfg.cache_dir,
+                "io_autotune": cfg.io_autotune,
                 "max_inflight": cfg.max_inflight,
                 "tenant_concurrent": cfg.tenant_concurrent,
                 "tenant_budget_mb": cfg.tenant_budget_mb,
@@ -442,6 +481,16 @@ class ScanService:
                 "retry": res.retry,
                 "hedge": res.hedge,
             },
+            # the shared cache's live occupancy (tier-split for a
+            # TieredCache) and the IO tuner's per-transport profiles —
+            # what `parquet-tool debug --vars` shows an operator asking
+            # "is the tier actually absorbing the hot set?"
+            "cache": (
+                self.session.block_cache.stats()
+                if self.session.block_cache is not None
+                else None
+            ),
+            "io_autotune": _io_tuner().stats(),
         }
 
     def debug_profile(
@@ -1021,6 +1070,14 @@ class ScanServer:
             self.shutdown()
         finally:
             self._httpd.server_close()
+            # a tiered cache the SERVICE built owns spill files/fds; a
+            # config-passed block_cache belongs to the caller (it may be
+            # shared with live dataset workers). BlockCache has no close.
+            cache = self.service.session.block_cache
+            if getattr(self.service, "_owns_cache", True) and hasattr(
+                cache, "close"
+            ):
+                cache.close()
 
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT → graceful drain then stop (main thread only —

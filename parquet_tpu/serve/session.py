@@ -93,6 +93,7 @@ class ScanSession:
         block_cache: BlockCache | None = None,
         source_factory=None,
         shard: tuple | None = None,
+        coalesce_gap=None,
     ):
         self.root = os.path.realpath(os.fspath(root)) if root is not None else None
         self.footer_cache = footer_cache if footer_cache is not None else FooterCache()
@@ -102,6 +103,10 @@ class ScanSession:
         # local footer reads, which the footer cache already absorbs)
         self.source_factory = source_factory
         self.shard = shard
+        # what executor readers coalesce with: None (the 64 KiB default),
+        # an explicit gap, or "auto" (per-transport profile — the
+        # ServeConfig.io_autotune wire)
+        self.coalesce_gap = coalesce_gap
 
     # -- path confinement ------------------------------------------------------
 

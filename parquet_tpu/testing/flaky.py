@@ -133,6 +133,10 @@ class FlakySource:
     def source_id(self) -> str:
         return self.inner.source_id
 
+    def generation(self):
+        gen = getattr(self.inner, "generation", None)
+        return gen() if gen is not None else None
+
     def size(self) -> int:
         return self.inner.size()
 

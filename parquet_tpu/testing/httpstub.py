@@ -1,0 +1,418 @@
+"""RangeHttpStub: a loopback range-GET HTTP server with injectable faults.
+
+The chaos substrate for parquet_tpu.io.remote — what FlakySource is to a
+local ByteSource, this is to a real HTTP transport: a stdlib
+ThreadingHTTPServer on 127.0.0.1:<ephemeral> serving a dict of named
+blobs (or files from a directory) with honest range semantics — 206 +
+Content-Range for `Range: bytes=a-b`, 200 for full GETs, HEAD, strong
+ETags, 404/416 where HTTP says so — and SEEDED transport faults layered
+on top:
+
+    stub = RangeHttpStub(files={"corpus.parquet": data}, seed=7,
+                         error_rate=0.2, latency_s=0.005)
+    with stub:
+        src = HttpSource(stub.url_for("corpus.parquet"))
+        ...
+
+Fault knobs (each draw from ONE seeded numpy rng stream, so a failing
+test replays exactly; knobs are plain attributes, mutable mid-test):
+
+  error_rate       probability a request answers 503 (the transient
+                   server-fault shape RetryingSource must absorb)
+  drop_rate        probability the connection closes with NO response
+                   (the reset/LB-kill shape -> client-side transport
+                   fault)
+  short_rate       probability a response body is TRUNCATED below its
+                   declared Content-Length (the torn-transfer shape ->
+                   typed truncated_body)
+  latency_s (+latency_jitter_s)  per-request injected RTT (the remote
+                   profile the IO auto-tuner keys on)
+  spike_rate/spike_s  occasional EXTRA stall (tail-latency shape)
+  permanent        every request 503s (blackout)
+
+`schedule=` accepts the same testing.chaos.FaultSchedule the FlakySource
+machinery uses: the current phase's params overlay the knobs per request
+(under the injectable `clock`), so one scripted spike -> errors ->
+blackout -> recovery timeline drives local sources AND this stub from a
+single object. Fault draws and the request counters are lock-serialized;
+payload writes are not (requests stream concurrently).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+__all__ = ["RangeHttpStub"]
+
+# the knobs a FaultSchedule phase may override here (chaos.Phase validates
+# names against the FlakySource vocabulary; drop_rate is stub-local and
+# settable only via the constructor/attribute)
+_STUB_KNOBS = (
+    "error_rate", "short_rate", "latency_s", "latency_jitter_s",
+    "spike_rate", "spike_s", "permanent", "drop_rate",
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"  # keep-alive: the connection-pool shape
+    stub: "RangeHttpStub" = None  # set per served stub via type()
+
+    # -- plumbing --------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet: tests read assertions,
+        pass  # not access logs
+
+    def _fail_503(self) -> None:
+        body = b'{"error": "injected fault"}'
+        self.send_response(503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _drop(self) -> None:
+        # no status line at all: the client sees the connection die
+        # (RemoteDisconnected), the transport-fault shape. shutdown, not
+        # close — the framework's post-handler wfile.flush() must stay a
+        # no-op instead of raising into the server thread
+        import socket
+
+        self.close_connection = True
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+
+    # -- request handling ------------------------------------------------------
+
+    def _serve(self, head_only: bool) -> None:
+        stub = self.stub
+        p = stub._draw_and_wait()
+        if p is None:  # drop was drawn
+            self._drop()
+            return
+        if p["permanent"] or p["__error"]:
+            stub._count_fault()
+            self._fail_503()
+            return
+        if stub.require_token is not None:
+            # the presigned-URL shape: a `token` query param must match
+            # the currently-valid signature or the store answers 403 —
+            # the ObjectStoreSource reactive re-sign adversary
+            query = self.path.partition("?")[2]
+            tokens = [
+                kv.partition("=")[2]
+                for kv in query.split("&")
+                if kv.startswith("token=")
+            ]
+            if stub.require_token not in tokens:
+                body = b'{"error": "signature rejected"}'
+                self.send_response(403)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if not head_only:
+                    self.wfile.write(body)
+                return
+        name = self.path.lstrip("/").split("?", 1)[0]
+        entry = stub._entry(name)
+        if entry is None:
+            body = b'{"error": "no such object"}'
+            self.send_response(404)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if not head_only:
+                self.wfile.write(body)
+            return
+        data, etag = entry
+        size = len(data)
+        rng_header = self.headers.get("Range")
+        if rng_header is None or stub.ignore_range:
+            status, start, end = 200, 0, size - 1
+        else:
+            span = stub._parse_range(rng_header, size)
+            if span is None:
+                self.send_response(416)
+                self.send_header("Content-Range", f"bytes */{size}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            status, (start, end) = 206, span
+        payload = data[start : end + 1] if size else b""
+        declared = len(payload)
+        truncate_to = stub._maybe_truncate(declared) if not head_only else None
+        self.send_response(status)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Accept-Ranges", "bytes")
+        self.send_header("ETag", etag)
+        if status == 206:
+            self.send_header("Content-Range", f"bytes {start}-{end}/{size}")
+        self.send_header("Content-Length", str(declared))
+        if truncate_to is not None:
+            # a torn transfer: promise `declared`, deliver less, slam the
+            # connection — the client's read raises IncompleteRead
+            self.send_header("Connection", "close")
+            self.close_connection = True
+        self.end_headers()
+        if head_only:
+            return
+        sent = payload if truncate_to is None else payload[:truncate_to]
+        try:
+            self.wfile.write(sent)
+            stub._count_sent(len(sent))
+        except OSError:
+            self.close_connection = True
+        if truncate_to is not None:
+            # flush + FIN below the declared length: the client's read
+            # comes up short (IncompleteRead), the torn-transfer shape
+            import socket
+
+            try:
+                self.wfile.flush()
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except (OSError, ValueError):
+                pass
+
+    def do_GET(self):
+        self._serve(head_only=False)
+
+    def do_HEAD(self):
+        if self.stub.reject_head:
+            body = b""
+            self.send_response(405)
+            self.send_header("Content-Length", "0")
+            self.send_header("Allow", "GET")
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self._serve(head_only=True)
+
+
+class RangeHttpStub:
+    """See module docstring. Construct, `start()` (or use as a context
+    manager), point HttpSource at `url_for(name)`.
+
+    files         {name: bytes} served from memory
+    root          a directory; files load (and cache) on first request
+    seed          the fault rng seed (one stream across all draws)
+    ignore_range  serve 200 + the FULL object even for ranged GETs (the
+                  misbehaving-server shape HttpSource must slice through)
+    reject_head   405 every HEAD (forces HttpSource's range-GET stat
+                  fallback)
+    schedule      a chaos.FaultSchedule overlaying the knobs per request
+    """
+
+    def __init__(
+        self,
+        *,
+        files: dict | None = None,
+        root=None,
+        seed: int = 0,
+        error_rate: float = 0.0,
+        drop_rate: float = 0.0,
+        short_rate: float = 0.0,
+        latency_s: float = 0.0,
+        latency_jitter_s: float = 0.0,
+        spike_rate: float = 0.0,
+        spike_s: float = 0.0,
+        permanent: bool = False,
+        ignore_range: bool = False,
+        reject_head: bool = False,
+        require_token: str | None = None,
+        schedule=None,
+        clock=time.monotonic,
+        sleep=time.sleep,
+    ):
+        self._files = {str(k): bytes(v) for k, v in (files or {}).items()}
+        self.root = os.fspath(root) if root is not None else None
+        if not self._files and self.root is None:
+            raise ValueError("RangeHttpStub: need files= and/or root=")
+        self._rng = np.random.default_rng(seed)
+        self.error_rate = float(error_rate)
+        self.drop_rate = float(drop_rate)
+        self.short_rate = float(short_rate)
+        self.latency_s = float(latency_s)
+        self.latency_jitter_s = float(latency_jitter_s)
+        self.spike_rate = float(spike_rate)
+        self.spike_s = float(spike_s)
+        self.permanent = bool(permanent)
+        self.ignore_range = bool(ignore_range)
+        self.reject_head = bool(reject_head)
+        self.require_token = require_token
+        self.schedule = schedule
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple] = {}  # name -> (bytes, etag)
+        self.requests = 0
+        self.faults_injected = 0
+        self.bytes_served = 0
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> "RangeHttpStub":
+        if self._server is not None:
+            return self
+        handler = type("_StubHandler", (_Handler,), {"stub": self})
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="pqt-httpstub",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None
+
+    stop = close
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- addressing ------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("RangeHttpStub: not started")
+        return self._server.server_address[1]
+
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def url_for(self, name: str) -> str:
+        return f"{self.base_url}/{name}"
+
+    def set_file(self, name: str, data: bytes) -> None:
+        """Publish (or REWRITE — new ETag, the source_changed shape) one
+        in-memory object."""
+        with self._lock:
+            self._files[str(name)] = bytes(data)
+            self._entries.pop(str(name), None)
+
+    # -- handler callbacks -----------------------------------------------------
+
+    @staticmethod
+    def _parse_range(header: str, size: int):
+        """`bytes=a-b` / `bytes=a-` / `bytes=-n` -> (start, end) clamped
+        inclusive, or None for unsatisfiable/malformed (-> 416)."""
+        if not header.startswith("bytes=") or "," in header:
+            return None
+        spec = header[len("bytes="):].strip()
+        first, _, last = spec.partition("-")
+        try:
+            if first == "":  # suffix form: the last N bytes
+                n = int(last)
+                if n <= 0 or size == 0:
+                    return None
+                return (max(0, size - n), size - 1)
+            start = int(first)
+            end = int(last) if last else size - 1
+        except ValueError:
+            return None
+        if start >= size or end < start:
+            return None
+        return (start, min(end, size - 1))
+
+    def _entry(self, name: str):
+        with self._lock:
+            hit = self._entries.get(name)
+            if hit is not None:
+                return hit
+            data = self._files.get(name)
+        if data is None and self.root is not None and name:
+            realroot = os.path.realpath(self.root)
+            path = os.path.normpath(os.path.join(realroot, name))
+            # stay inside the root (the stub is a test double, but an
+            # escape-serving double invites escape-shaped tests)
+            if path.startswith(realroot + os.sep):
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    data = None
+        if data is None:
+            return None
+        etag = f'"{hashlib.sha1(data).hexdigest()[:16]}"'
+        with self._lock:
+            self._entries[name] = (data, etag)
+            return self._entries[name]
+
+    def _params(self) -> dict:
+        p = {k: getattr(self, k) for k in _STUB_KNOBS}
+        if self.schedule is not None:
+            p.update(
+                (k, v)
+                for k, v in self.schedule.params_at(self._clock()).items()
+                if k in p
+            )
+        return p
+
+    def _draw_and_wait(self):
+        """Latency + the per-request fault draw (seeded, lock-serialized).
+        Returns the effective params with "__error" resolved, or None when
+        the connection should drop."""
+        with self._lock:
+            self.requests += 1
+            p = self._params()
+            extra = (
+                float(self._rng.uniform(0, p["latency_jitter_s"]))
+                if p["latency_jitter_s"]
+                else 0.0
+            )
+            spike = 0.0
+            if p["spike_rate"] and float(self._rng.random()) < p["spike_rate"]:
+                spike = p["spike_s"]
+            roll = (
+                float(self._rng.random())
+                if (p["error_rate"] or p["drop_rate"])
+                else 1.0
+            )
+            p["__error"] = roll < p["error_rate"]
+            dropped = not p["__error"] and roll < p["error_rate"] + p["drop_rate"]
+            if p["__error"] or dropped:
+                self.faults_injected += 1
+        # sleep OUTSIDE the lock: injected latency must overlap across
+        # concurrent requests or it models a single-threaded store
+        if p["latency_s"] or extra or spike:
+            self._sleep(p["latency_s"] + extra + spike)
+        return None if dropped else p
+
+    def _maybe_truncate(self, declared: int):
+        if declared <= 1:
+            return None
+        with self._lock:
+            rate = self._params()["short_rate"]
+            if rate and float(self._rng.random()) < rate:
+                self.faults_injected += 1
+                return int(self._rng.integers(0, declared))
+        return None
+
+    def _count_fault(self) -> None:
+        pass  # counted at draw time (one lock acquisition per request)
+
+    def _count_sent(self, n: int) -> None:
+        with self._lock:
+            self.bytes_served += n

@@ -841,6 +841,9 @@ def cmd_scan(args) -> int:
         on_error=args.on_error,
         nullable=args.nullable,
         cache_bytes=args.cache_mb << 20,
+        cache_disk_bytes=args.cache_disk_mb << 20,
+        cache_dir=args.cache_dir,
+        io_autotune=args.io_autotune,
         # --slo-ms doubles as the controller opt-in: the gate measures the
         # ADAPTIVE pipeline, the same thing production would run
         slo_wait_ms=args.slo_ms,
@@ -876,6 +879,11 @@ def cmd_scan(args) -> int:
             first = next(iter(batch.values()))
             rows += int(first.shape[0])
             batches += 1
+        # snapshot BEFORE close(): an owned tiered cache tears down (and
+        # zeroes its stats) when the dataset does
+        cache_stats = (
+            ds._block_cache.stats() if ds._block_cache is not None else None
+        )
     wall = time.perf_counter() - t0
     d = metrics.delta(snap0)
     wait = d.get("dataset_wait_seconds_sum", 0.0)
@@ -907,6 +915,15 @@ def cmd_scan(args) -> int:
     if hit_rate is not None:
         io_line += f", cache hit rate {hit_rate:.1%}"
     print(io_line)
+    if cache_stats and "disk" in cache_stats:
+        spills = d.get("cache_tier_spills_total", 0)
+        print(
+            f"scan: cache tiers ram {cache_stats['ram']['bytes']:,} B "
+            f"({cache_stats['ram']['blocks']} blocks) / disk "
+            f"{cache_stats['disk']['bytes']:,} B "
+            f"({cache_stats['disk']['blocks']} blocks, "
+            f"{cache_stats['disk']['segments']} segments, {spills} spills)"
+        )
     slo = None
     if args.slo_ms is not None:
         from ..testing.chaos import percentile
@@ -1019,6 +1036,9 @@ def cmd_serve(args) -> int:
         port=args.port,
         root=args.root,
         cache_mb=args.cache_mb,
+        cache_disk_mb=args.cache_disk_mb,
+        cache_dir=args.cache_dir,
+        io_autotune=args.io_autotune,
         max_inflight=args.max_inflight,
         tenant_concurrent=args.tenant_concurrent,
         tenant_budget_mb=args.tenant_budget_mb,
@@ -1342,6 +1362,27 @@ def main(argv=None) -> int:
         help="shared block-cache budget in MiB (0 = off); enables pqt-io "
         "readahead of upcoming units' byte ranges",
     )
+    pn.add_argument(
+        "--cache-disk-mb",
+        type=int,
+        default=0,
+        help="grow the block cache into a RAM->disk TieredCache with this "
+        "many MiB of local-disk spill (the remote-corpus shape; 0 = RAM "
+        "only)",
+    )
+    pn.add_argument(
+        "--cache-dir",
+        help="tiered-cache spill directory (default: a private temp dir "
+        "removed on exit; a given dir is reused across runs — intact "
+        "spilled blocks survive restarts)",
+    )
+    pn.add_argument(
+        "--io-autotune",
+        action="store_true",
+        help="resolve the read coalesce gap + readahead depth per fetch "
+        "from the observed per-transport latency profile (remote sources "
+        "coalesce MiB-scale; local corpora keep the 64 KiB default)",
+    )
     pn.add_argument("--epochs", type=int, default=1)
     pn.add_argument("--shuffle", action="store_true")
     pn.add_argument("--seed", type=int, default=0)
@@ -1411,6 +1452,27 @@ def main(argv=None) -> int:
         default=64,
         help="shared block-cache budget in MiB (0 = off); footers always "
         "cache, so warm repeat plans do zero source reads",
+    )
+    pe.add_argument(
+        "--cache-disk-mb",
+        type=int,
+        default=0,
+        help="grow the block cache into a RAM->disk TieredCache with this "
+        "many MiB of local-disk spill (tier stats ride /v1/debug/vars; "
+        "0 = RAM only)",
+    )
+    pe.add_argument(
+        "--cache-dir",
+        help="tiered-cache spill directory (default: a private temp dir "
+        "removed on close; a given dir is reused across restarts — "
+        "intact spilled blocks re-serve after a crash)",
+    )
+    pe.add_argument(
+        "--io-autotune",
+        action="store_true",
+        help="resolve executor read coalescing + readahead from observed "
+        "per-transport latency profiles (matters with a remote "
+        "source-factory; local roots keep the defaults)",
     )
     pe.add_argument(
         "--max-inflight",

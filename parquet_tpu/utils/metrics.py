@@ -144,6 +144,38 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
                                     pqt-dispatch/other) —
                                     obs_profile_windows_total counts
                                     completed capture windows
+  io_http_requests_total{status=}   HTTP round trips issued by remote
+                                    sources (io.remote), per response
+                                    status code
+  io_http_connections_total{event=} pooled-connection lifecycle: "new"
+                                    sockets opened vs "reused" checkouts
+                                    from the per-host persistent pool
+  io_resigns_total                  presigned-URL refreshes by
+                                    ObjectStoreSource (proactive expiry
+                                    refresh + reactive 401/403 re-signs)
+  cache_tier_hits_total{tier=}      tiered-cache hits per tier (ram /
+                                    disk); cache_tier_misses_total
+                                    counts full misses (both tiers)
+  cache_tier_evictions_total{tier=} blocks evicted per tier (ram
+                                    evictions SPILL to disk; disk
+                                    evictions drop whole oldest
+                                    segments)
+  cache_tier_spills_total           blocks spilled RAM -> disk
+                                    (cache_tier_spill_bytes_total is
+                                    the payload byte volume)
+  cache_tier_promotions_total       disk hits promoted back to RAM
+  cache_tier_restored_blocks_total  intact spilled blocks re-indexed
+                                    from a persistent cache_dir at
+                                    startup (restart survival)
+  cache_tier_torn_segments_total    spill segments found torn at replay
+                                    — the rest of the segment is
+                                    DISCARDED, never served
+  cache_tier_bytes{tier=}           gauge: resident bytes per tier
+  io_autotune_gap_bytes{profile=}   gauge: the IO tuner's current
+                                    coalesce-gap verdict per transport
+                                    profile ("local", "http://host:port")
+  io_autotune_latency_ms{profile=}  gauge: the EWMA per-request read
+                                    latency behind that verdict
 
 Exposition variants: render_prometheus() is the classic text format every
 scraper understands; render_openmetrics() is the content-negotiated
@@ -287,6 +319,31 @@ _HELP = {
     "filter_mask_seconds": "vectorized residual mask build wall time",
     "serve_aggregate_requests_total": (
         "aggregation push-down queries executed (/v1/query and the CLI twin)"
+    ),
+    # remote IO + tiered cache + auto-tuning (PR 13)
+    "io_http_requests_total": "HTTP round trips by remote sources, per status",
+    "io_http_connections_total": (
+        "pooled HTTP connections: new sockets vs reused checkouts"
+    ),
+    "io_resigns_total": "presigned-URL refreshes by ObjectStoreSource",
+    "cache_tier_hits_total": "tiered-cache hits, per tier (ram/disk)",
+    "cache_tier_misses_total": "tiered-cache full misses (both tiers)",
+    "cache_tier_evictions_total": "tiered-cache blocks evicted, per tier",
+    "cache_tier_spills_total": "blocks spilled RAM -> disk",
+    "cache_tier_spill_bytes_total": "payload bytes spilled RAM -> disk",
+    "cache_tier_promotions_total": "disk hits promoted back to RAM",
+    "cache_tier_restored_blocks_total": (
+        "spilled blocks re-indexed from a persistent cache dir at startup"
+    ),
+    "cache_tier_torn_segments_total": (
+        "spill segments found torn at replay (their tails are discarded)"
+    ),
+    "cache_tier_bytes": "tiered-cache resident bytes, per tier",
+    "io_autotune_gap_bytes": (
+        "the IO tuner's current coalesce-gap verdict, per transport profile"
+    ),
+    "io_autotune_latency_ms": (
+        "EWMA per-request read latency, per transport profile"
     ),
 }
 

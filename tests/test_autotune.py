@@ -1,0 +1,213 @@
+"""IOTuner tests: profile keying, the local-profile noise guard, the
+bandwidth-delay-product knob math, and the fetch_ranges feed/resolve
+wiring ("auto" gap)."""
+
+import pytest
+
+from parquet_tpu.io import (
+    IOTuner,
+    MemorySource,
+    Readahead,
+    TieredCache,
+    fetch_ranges,
+    io_tuner,
+    profile_key,
+)
+from parquet_tpu.io.autotune import (
+    LOCAL_GAP,
+    LOCAL_READAHEAD,
+    MAX_GAP,
+    MAX_READAHEAD,
+)
+
+HTTP_ID = 'http:http://store:9000/bucket/shard-000.parquet#"e1":12345'
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_tuner():
+    io_tuner().reset()
+    yield
+    io_tuner().reset()
+
+
+class TestProfileKey:
+    def test_http_source_ids_collapse_to_origin(self):
+        assert profile_key(HTTP_ID) == "http://store:9000"
+        assert (
+            profile_key('http:https://s3.example#"e":1') == "https://s3.example"
+        )
+
+    def test_plain_urls(self):
+        assert profile_key("http://h:8080/x.parquet") == "http://h:8080"
+        assert profile_key("https://h/a/b") == "https://h"
+
+    def test_local_shapes(self):
+        assert profile_key("file:/data/x.parquet:41:9:17") == "local"
+        assert profile_key("mem:0x7f:128") == "local"
+        assert profile_key("/data/x.parquet") == "local"
+
+    def test_two_files_one_store_share_a_profile(self):
+        t = IOTuner(min_observations=1)
+        t.observe(HTTP_ID, 1 << 20, 0.1, 1)
+        other = 'http:http://store:9000/bucket/shard-999.parquet#"e9":7'
+        assert t.params_for(other).observations == 1
+
+
+class TestLocalGuard:
+    def test_unknown_source_is_local(self):
+        t = IOTuner()
+        p = t.params_for("file:/x:1:2:3")
+        assert p.coalesce_gap == LOCAL_GAP
+        assert p.readahead_bytes == LOCAL_READAHEAD
+        assert not p.remote
+
+    def test_below_floor_latency_stays_local_exactly(self):
+        # a noisy-but-fast transport (sub-2ms per run) must keep the
+        # byte-for-byte default whatever its bandwidth says
+        t = IOTuner()
+        for _ in range(50):
+            t.observe(HTTP_ID, 8 << 20, 0.001, 1)  # 1ms, 8 GB/s
+        assert t.params_for(HTTP_ID).coalesce_gap == LOCAL_GAP
+
+    def test_min_observations_gate(self):
+        t = IOTuner(min_observations=3)
+        t.observe(HTTP_ID, 1 << 20, 0.025, 1)
+        t.observe(HTTP_ID, 1 << 20, 0.025, 1)
+        assert t.params_for(HTTP_ID).coalesce_gap == LOCAL_GAP  # 2 < 3
+        t.observe(HTTP_ID, 1 << 20, 0.025, 1)
+        assert t.params_for(HTTP_ID).coalesce_gap > LOCAL_GAP
+
+
+class TestKnobMath:
+    def _trained(self, latency_s, bandwidth_bps, n=5):
+        t = IOTuner(min_observations=1)
+        nbytes = int(bandwidth_bps * latency_s)
+        for _ in range(n):
+            t.observe(HTTP_ID, nbytes, latency_s, 1)
+        return t.params_for(HTTP_ID)
+
+    def test_bandwidth_delay_product(self):
+        # 10ms at 100 MB/s -> ~1 MB break-even gap
+        p = self._trained(0.010, 100e6)
+        assert (512 << 10) < p.coalesce_gap < (2 << 20)
+        assert p.remote
+
+    def test_higher_latency_means_bigger_gap(self):
+        gaps = [
+            self._trained(lat, 50e6).coalesce_gap
+            for lat in (0.005, 0.010, 0.025, 0.100)
+        ]
+        assert gaps == sorted(gaps)
+        assert gaps[0] > LOCAL_GAP
+
+    def test_clamped_to_ceiling(self):
+        p = self._trained(2.0, 500e6)  # absurd: 1 GB bdp
+        assert p.coalesce_gap == MAX_GAP
+        assert p.readahead_bytes == MAX_READAHEAD
+
+    def test_readahead_deepens_with_latency(self):
+        p = self._trained(0.025, 40e6)  # 1 MB bdp
+        assert p.readahead_bytes > LOCAL_READAHEAD
+
+    def test_ewma_recovers_to_local(self):
+        # a transport that WAS slow and got fast decays back to local
+        t = IOTuner(min_observations=1, alpha=0.5)
+        for _ in range(5):
+            t.observe(HTTP_ID, 1 << 20, 0.050, 1)
+        assert t.params_for(HTTP_ID).remote
+        for _ in range(20):
+            t.observe(HTTP_ID, 1 << 20, 0.0002, 1)
+        assert t.params_for(HTTP_ID).coalesce_gap == LOCAL_GAP
+
+    def test_degenerate_observations_dropped(self):
+        t = IOTuner(min_observations=1)
+        t.observe(HTTP_ID, 0, 0.1, 1)
+        t.observe(HTTP_ID, 100, 0.0, 1)
+        t.observe(HTTP_ID, 100, 0.1, 0)
+        assert t.params_for(HTTP_ID).observations == 0
+
+    def test_max_profiles_lru_bound(self):
+        t = IOTuner(max_profiles=4, min_observations=1)
+        for i in range(8):
+            t.observe(f"http://h{i}/x", 1 << 20, 0.025, 1)
+        assert len(t.stats()) == 4
+        assert "http://h7" in t.stats()
+        assert "http://h0" not in t.stats()
+
+    def test_reset_and_stats_shape(self):
+        t = IOTuner(min_observations=1)
+        t.observe(HTTP_ID, 1 << 20, 0.025, 1)
+        st = t.stats()["http://store:9000"]
+        assert set(st) == {
+            "latency_ms", "bandwidth_mb_s", "observations",
+            "coalesce_gap", "readahead_bytes", "remote",
+        }
+        t.reset()
+        assert t.stats() == {}
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            IOTuner(alpha=0)
+        with pytest.raises(ValueError):
+            IOTuner(min_observations=0)
+
+
+class _FakeRemote:
+    """A source whose source_id claims a remote origin; records the run
+    spans fetch_ranges actually issued."""
+
+    source_id = HTTP_ID
+
+    def __init__(self, size=8 << 20):
+        self._size = size
+        self.calls = []
+
+    def size(self):
+        return self._size
+
+    def read_ranges(self, ranges):
+        self.calls.append(list(ranges))
+        return [b"\x00" * n for _o, n in ranges]
+
+    def read_at(self, off, n):
+        return b"\x00" * n
+
+
+class TestWiring:
+    def test_fetch_ranges_feeds_the_global_tuner(self):
+        src = MemorySource(b"x" * 4096)
+        before = io_tuner().params_for(src.source_id).observations
+        fetch_ranges(src, [(0, 1024)])
+        assert io_tuner().params_for(src.source_id).observations == before + 1
+
+    def test_auto_gap_resolves_from_the_profile(self):
+        # train the global tuner: 25ms/run at 40 MB/s -> ~1 MB gap
+        for _ in range(5):
+            io_tuner().observe(HTTP_ID, 1 << 20, 0.025, 1)
+        src = _FakeRemote()
+        # two ranges 512 KiB apart: the LOCAL 64 KiB gap keeps them as two
+        # reads; the tuned gap merges them into ONE run
+        ranges = [(0, 1024), ((512 << 10) + 1024, 1024)]
+        fetch_ranges(src, ranges, gap=64 << 10)
+        assert len(src.calls[-1]) == 2
+        fetch_ranges(src, ranges, gap="auto")
+        assert len(src.calls[-1]) == 1
+
+    def test_auto_gap_on_untrained_source_is_the_local_default(self):
+        src = _FakeRemote()
+        ranges = [(0, 1024), ((512 << 10) + 1024, 1024)]
+        fetch_ranges(src, ranges, gap="auto")
+        assert len(src.calls[-1]) == 2  # nothing observed yet: 64 KiB
+
+    def test_readahead_autotune_deepens_budget(self):
+        for _ in range(5):
+            io_tuner().observe(HTTP_ID, 1 << 20, 0.025, 1)
+        with TieredCache(ram_bytes=1 << 20, disk_bytes=1 << 20) as tc:
+            fixed = Readahead(tc, budget_bytes=10)
+            assert not fixed.schedule(_FakeRemote(), [(0, 4096)])  # over budget
+            auto = Readahead(tc, budget_bytes=10, autotune=True)
+            assert auto.gap == "auto"
+            assert auto.schedule(_FakeRemote(), [(0, 4096)])  # tuned budget
+            auto.drain()
+            auto.close()
+            fixed.close()
