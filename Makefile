@@ -1,13 +1,14 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-write bench-assembly bench-serve bench-query bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
 # obs-smoke validates the bench trend store's schema and pins the
-# sampling profiler's overhead on a decode loop
-check: native lint chaos-smoke obs-smoke
+# sampling profiler's overhead on a decode loop; encode-smoke pins the
+# fused native encoder byte-identical to the staged Python rung
+check: native lint chaos-smoke obs-smoke encode-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -55,6 +56,18 @@ bench-io-remote: native
 # sweep (pool 1/4/8 x 8/16 row groups, byte-identical to serial); host-only
 bench-write: native
 	python bench.py --write
+
+# fused-vs-staged encode ladder: per-shape serial chunk-encode throughput
+# (dict-string/dict-int/delta/plain), byte-identity asserted pre-timing;
+# skips cleanly when the native extension is not built
+bench-encode: native
+	python bench.py --encode
+
+# the make-check-sized encode gate: the fused native encoder must produce
+# bytes IDENTICAL to the staged Python rung across the small
+# encodings x codecs x dpv matrix (skips cleanly without the extension)
+encode-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_sink.py -q -k 'FusedEncodeLadder and (matrix or crc or page)'
 
 # scan-service bench: requests/s + p50/p99 latency at client concurrency
 # 1/4/16 against a warm in-process daemon over real HTTP, plus the

@@ -2263,6 +2263,123 @@ def _run_phase(name: str, timeout_s: float = 1800.0) -> dict | None:
     return None
 
 
+def _phase_encode() -> dict | None:
+    """Fused-vs-staged encode ladder microbench (`bench.py --encode`,
+    `make bench-encode`).
+
+    Per column shape (dict-string / dict-int64 / delta-int64 / plain-double
+    / plain-string), write one single-column file serially with the fused
+    native encoder and again with PQT_FUSED_ENCODE=0 (the staged Python
+    rung), assert the outputs BYTE-IDENTICAL before any timing, then report
+    rows/s for both sides and the median of PAIRED fused/staged ratios.
+    Skips cleanly (exit 0, "skipped" artifact) when the native extension
+    is not built — the staged rung is then the only encoder and there is
+    nothing to compare."""
+    from parquet_tpu.core.writer import FileWriter
+    from parquet_tpu.schema.dsl import parse_schema
+    from parquet_tpu.sink import MemorySink
+    from parquet_tpu.utils.native import get_native
+
+    lib = get_native()
+    if lib is None or not getattr(lib, "has_chunk_encode", False):
+        out = {"config": "encode", "skipped": "native chunk_encode unavailable"}
+        log("bench: encode — native chunk_encode unavailable, skipping cleanly")
+        _emit(out)
+        return out
+
+    rows = int(os.environ.get("PQT_ENCODE_ROWS", "500000"))
+    rng = np.random.default_rng(11)
+    keys = [f"key_{i:05d}" for i in range(5000)]
+    shapes = {
+        "dict_string": (
+            "message m { required binary s (UTF8); }",
+            {"s": [keys[k] for k in rng.integers(0, len(keys), rows)]},
+            {},
+        ),
+        "dict_int64": (
+            "message m { required int64 a; }",
+            {"a": rng.integers(0, 1000, rows).astype(np.int64)},
+            {},
+        ),
+        "delta_int64": (
+            "message m { required int64 ts; }",
+            {"ts": np.cumsum(rng.integers(0, 1000, rows)).astype(np.int64)},
+            {"column_encodings": {"ts": "DELTA_BINARY_PACKED"},
+             "use_dictionary": False},
+        ),
+        "plain_double": (
+            "message m { required double x; }",
+            {"x": rng.random(rows)},
+            {"use_dictionary": False},
+        ),
+        "plain_string": (
+            # all-unique strings: the dictionary probe must bail and the
+            # PLAIN byte-array route carries the page
+            "message m { required binary u (UTF8); }",
+            {"u": [f"u{i:07d}x{i % 911}" for i in range(rows)]},
+            {},
+        ),
+    }
+
+    def write(schema_text, cols, kw):
+        schema = parse_schema(schema_text)
+        sink = MemorySink()
+        w = FileWriter(sink, schema, codec="snappy", **kw)
+        for name, vals in cols.items():
+            w.write_column(name, vals)
+        w.close()
+        return sink.getvalue()
+
+    out = {"config": "encode", "rows": rows, "codec": "snappy", "shapes": {}}
+    for name, (schema_text, cols, kw) in shapes.items():
+        fused = write(schema_text, cols, kw)
+        os.environ["PQT_FUSED_ENCODE"] = "0"
+        try:
+            staged = write(schema_text, cols, kw)
+        finally:
+            del os.environ["PQT_FUSED_ENCODE"]
+        if fused != staged:
+            raise SystemExit(
+                f"bench: encode shape {name}: fused output is NOT "
+                "byte-identical to the staged encoder"
+            )
+        # PAIRED sampling: each repeat times staged then fused back to back
+        # (same load window), speedup = median of paired ratios
+        ratios, t_f, t_s = [], [], []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            os.environ["PQT_FUSED_ENCODE"] = "0"
+            try:
+                write(schema_text, cols, kw)
+            finally:
+                del os.environ["PQT_FUSED_ENCODE"]
+            s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            write(schema_text, cols, kw)
+            f = time.perf_counter() - t0
+            t_s.append(round(s, 5))
+            t_f.append(round(f, 5))
+            ratios.append(s / f)
+        med_f = sorted(t_f)[len(t_f) // 2]
+        med_s = sorted(t_s)[len(t_s) // 2]
+        r = sorted(ratios)[len(ratios) // 2]
+        out["shapes"][name] = {
+            "fused_rows_s": round(rows / med_f, 1),
+            "staged_rows_s": round(rows / med_s, 1),
+            "fused_speedup": round(r, 3),
+            "samples_fused_s": t_f,
+            "samples_staged_s": t_s,
+        }
+        log(
+            f"bench: encode {name}: fused {rows / med_f / 1e6:.2f} M rows/s "
+            f"vs staged {rows / med_s / 1e6:.2f} M rows/s "
+            f"({r:.2f}x, byte-identical ✓)"
+        )
+    out["byte_identical"] = True
+    _emit(out)
+    return out
+
+
 def main() -> None:
     path = build_file()
     if not _device_ready():
@@ -2329,6 +2446,20 @@ def main() -> None:
             log(
                 f"bench: assembly: nested vec {t1['rows_s_vec'] / 1e6:.2f} M rows/s, "
                 f"{r_asm['nested_vec_vs_scalar']:.1f}x over the scalar engine"
+            )
+
+    # fused-vs-staged encode ladder (PQT_BENCH_ENCODE=0 to skip): per-shape
+    # serial chunk-encode throughput, byte-identity asserted pre-timing
+    r_enc = None
+    if os.environ.get("PQT_BENCH_ENCODE", "1") != "0":
+        r_enc = _run_phase("encode")
+        if r_enc and "shapes" in r_enc:
+            log(
+                "bench: encode ladder: "
+                + ", ".join(
+                    f"{k} {v['fused_speedup']:.2f}x"
+                    for k, v in r_enc["shapes"].items()
+                )
             )
 
     # io-layer sweeps (PQT_BENCH_IO=0 to skip): coalesce gap + readahead
@@ -2487,6 +2618,8 @@ def main() -> None:
         artifact["chaos"] = r_chaos
     if r_asm:
         artifact["assembly"] = r_asm
+    if r_enc:
+        artifact["encode"] = r_enc
     if results is not None:
         artifact["matrix"] = results
         for r in results:
@@ -2929,6 +3062,8 @@ if __name__ == "__main__":
         _phase_io_remote()
     elif argv and argv[0] == "--write":
         _phase_write()
+    elif argv and argv[0] == "--encode":
+        _phase_encode()
     elif argv and argv[0] == "--serve":
         _phase_serve()
     elif argv and argv[0] == "--query":
@@ -2941,6 +3076,8 @@ if __name__ == "__main__":
             _phase_matrix(int(name[len("matrix") :]))
         elif name == "write":
             _phase_write()
+        elif name == "encode":
+            _phase_encode()
         elif name == "verify":
             _phase_verify(build_file())
         elif name == "prepare":

@@ -125,6 +125,225 @@ static PyObject *chunk_prepare(PyObject *self, PyObject *args) {
   return PyLong_FromSsize_t(rc);
 }
 
+/* chunk_encode(route, values, ba_offsets|None, nv, type_size, dict_width,
+ *              dict_raw|None, dict_num, def_levels|None, num_entries,
+ *              max_def, codec, dpv, with_crc, per_page, out, scratch,
+ *              pages, totals, stage_ns|None, err_info) -> rc
+ *
+ * The fused whole-chunk ENCODE walk (ptq_chunk_encode): ONE Python->C
+ * transition per column chunk with the entire page split + level pack +
+ * value encode + compress + Thrift framing under Py_BEGIN_ALLOW_THREADS —
+ * the write-side mirror of chunk_prepare, so parallel encoders scale with
+ * cores instead of the GIL. Table capacity derives from the pages buffer
+ * length (8 int64 per row). Returns the data-page count or a negative
+ * PTQ_E_* code; err_info (int64[4]) carries {stage, page, 0, 0} on
+ * failure.
+ */
+static PyObject *chunk_encode(PyObject *self, PyObject *args) {
+  int route, type_size, dict_width, max_def, codec, dpv, with_crc;
+  long long nv, dict_num, num_entries, per_page;
+  Py_buffer values, out, scratch, pages, totals, err_info;
+  PyObject *ba_obj, *dict_obj, *def_obj, *stage_obj;
+  if (!PyArg_ParseTuple(args, "iy*OLiiOLOLiiiiLw*w*w*w*Ow*", &route, &values,
+                        &ba_obj, &nv, &type_size, &dict_width, &dict_obj,
+                        &dict_num, &def_obj, &num_entries, &max_def, &codec,
+                        &dpv, &with_crc, &per_page, &out, &scratch, &pages,
+                        &totals, &stage_obj, &err_info))
+    return NULL;
+  Py_buffer ba, dict_raw, def_b, stage;
+  ba.buf = NULL;
+  dict_raw.buf = NULL;
+  def_b.buf = NULL;
+  stage.buf = NULL;
+  int ok = 1;
+  if (ba_obj != Py_None && PyObject_GetBuffer(ba_obj, &ba, PyBUF_CONTIG_RO) < 0)
+    ok = 0;
+  if (ok && dict_obj != Py_None &&
+      PyObject_GetBuffer(dict_obj, &dict_raw, PyBUF_CONTIG_RO) < 0)
+    ok = 0;
+  if (ok && def_obj != Py_None &&
+      PyObject_GetBuffer(def_obj, &def_b, PyBUF_CONTIG_RO) < 0)
+    ok = 0;
+  if (ok && stage_obj != Py_None &&
+      PyObject_GetBuffer(stage_obj, &stage, PyBUF_CONTIG) < 0)
+    ok = 0;
+  Py_ssize_t rc = -1;
+  if (ok) {
+    Py_BEGIN_ALLOW_THREADS
+    rc = ptq_chunk_encode(
+        route, (const uint8_t *)values.buf, (size_t)values.len,
+        ba.buf ? (const int64_t *)ba.buf : NULL, (int64_t)nv, type_size,
+        dict_width, dict_raw.buf ? (const uint8_t *)dict_raw.buf : NULL,
+        dict_raw.buf ? (size_t)dict_raw.len : 0, (int64_t)dict_num,
+        def_b.buf ? (const uint16_t *)def_b.buf : NULL, (int64_t)num_entries,
+        max_def, codec, dpv, with_crc, (int64_t)per_page, (uint8_t *)out.buf,
+        (size_t)out.len, (uint8_t *)scratch.buf, (size_t)scratch.len,
+        (int64_t *)pages.buf, (size_t)(pages.len / (8 * 8)),
+        (int64_t *)totals.buf, stage.buf ? (int64_t *)stage.buf : NULL,
+        err_info.len >= 32 ? (int64_t *)err_info.buf : NULL);
+    Py_END_ALLOW_THREADS
+  }
+  if (ba.buf) PyBuffer_Release(&ba);
+  if (dict_raw.buf) PyBuffer_Release(&dict_raw);
+  if (def_b.buf) PyBuffer_Release(&def_b);
+  if (stage.buf) PyBuffer_Release(&stage);
+  PyBuffer_Release(&values);
+  PyBuffer_Release(&out);
+  PyBuffer_Release(&scratch);
+  PyBuffer_Release(&pages);
+  PyBuffer_Release(&totals);
+  PyBuffer_Release(&err_info);
+  if (!ok) return NULL;
+  return PyLong_FromSsize_t(rc);
+}
+
+/* dict_indices_str(list_of_str, max_uniques)
+ *   -> (uniques_list, indices_u32_bytes, total_utf8, uniq_utf8)
+ *   | None (unique count exceeds max_uniques)
+ *   | False (an item is not exactly `str`: caller takes the byte-domain path)
+ *
+ * The OBJECT-domain dictionary probe for string columns: dedup the Python
+ * str objects BEFORE any UTF-8 materialization, so a dictionary-encoded
+ * chunk only ever encodes its (few) uniques to bytes — the 1M-row string
+ * column's byte conversion was the serial write path's single biggest cost.
+ * Byte-identical to probing the encoded bytes because str -> UTF-8 is
+ * injective (first occurrences coincide, so the dictionary order matches).
+ * total_utf8/uniq_utf8 are the summed encoded lengths of all values /
+ * of the uniques (the inputs of the dict-vs-plain size cutoff), computed
+ * from the cached UTF-8 forms during the same pass.
+ */
+typedef struct {
+  Py_hash_t hash; /* cached str hash of the unique */
+  uint32_t uid;   /* 0xffffffff = empty slot */
+} dstr_slot;
+
+static PyObject *dict_indices_str(PyObject *self, PyObject *args) {
+  PyObject *seq;
+  Py_ssize_t max_uniques;
+  if (!PyArg_ParseTuple(args, "On", &seq, &max_uniques)) return NULL;
+  PyObject *fast = PySequence_Fast(seq, "dict_indices_str expects a sequence");
+  if (fast == NULL) return NULL;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject **items = PySequence_Fast_ITEMS(fast);
+
+  /* open-addressed (hash, uid) table instead of a PyDict: no PyLong boxing
+   * per hit, no dict-resize churn — str objects cache their hash after the
+   * first PyObject_Hash, so warm probes are a table walk plus (rarely more
+   * than) one string equality check. The table starts SMALL and doubles as
+   * uniques arrive (rehash over the few uniques is cheap), so the probe's
+   * random accesses stay cache-resident for low-cardinality columns — the
+   * case dictionary encoding exists for. */
+  size_t tsize = 4096;
+  dstr_slot *table = (dstr_slot *)malloc(tsize * sizeof(dstr_slot));
+  int64_t *ulens = (int64_t *)malloc((size_t)(max_uniques + 1) * sizeof(int64_t));
+  PyObject *indices = PyBytes_FromStringAndSize(NULL, n * 4);
+  PyObject *uniques = PyList_New(0);
+  if (table == NULL || ulens == NULL || indices == NULL || uniques == NULL) {
+    if (table == NULL || ulens == NULL) PyErr_NoMemory();
+    goto fail;
+  }
+  memset(table, 0xff, tsize * sizeof(dstr_slot));
+  {
+    size_t tmask = tsize - 1;
+    uint32_t *idx = (uint32_t *)PyBytes_AS_STRING(indices);
+    int64_t total_utf8 = 0;
+    int64_t uniq_utf8 = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *it = items[i];
+      if (!PyUnicode_CheckExact(it)) {
+        /* mixed/other input: the byte-domain probe is the oracle there
+         * (object equality and byte equality diverge across types) */
+        free(table);
+        free(ulens);
+        Py_DECREF(indices);
+        Py_DECREF(uniques);
+        Py_DECREF(fast);
+        Py_RETURN_FALSE;
+      }
+      /* the cached str hash, without the tp_hash dispatch per item (str
+       * computes it once and memoizes; -1 means not yet computed) */
+      Py_hash_t h = ((PyASCIIObject *)it)->hash;
+      if (h == -1) {
+        h = PyObject_Hash(it);
+        if (h == -1) goto fail;
+      }
+      size_t slot = (size_t)h & tmask;
+      for (;;) {
+        dstr_slot *s = &table[slot];
+        if (s->uid == 0xffffffffu) {
+          Py_ssize_t next = PyList_GET_SIZE(uniques);
+          if (next >= max_uniques) {
+            /* would exceed the cutoff: dictionary encoding does not pay */
+            free(table);
+            free(ulens);
+            Py_DECREF(indices);
+            Py_DECREF(uniques);
+            Py_DECREF(fast);
+            Py_RETURN_NONE;
+          }
+          Py_ssize_t ul;
+          if (PyUnicode_AsUTF8AndSize(it, &ul) == NULL) goto fail;
+          s->hash = h;
+          s->uid = (uint32_t)next;
+          ulens[next] = (int64_t)ul;
+          uniq_utf8 += (int64_t)ul;
+          total_utf8 += (int64_t)ul;
+          if (PyList_Append(uniques, it) < 0) goto fail;
+          idx[i] = (uint32_t)next;
+          if ((size_t)(next + 2) * 2 > tsize) {
+            /* double + rehash over the (few) uniques so the load factor —
+             * and the probe's working set — stays small */
+            size_t nsize = tsize * 2;
+            dstr_slot *nt = (dstr_slot *)malloc(nsize * sizeof(dstr_slot));
+            if (nt == NULL) {
+              PyErr_NoMemory();
+              goto fail;
+            }
+            memset(nt, 0xff, nsize * sizeof(dstr_slot));
+            for (size_t o = 0; o < tsize; o++) {
+              if (table[o].uid == 0xffffffffu) continue;
+              size_t ns = (size_t)table[o].hash & (nsize - 1);
+              while (nt[ns].uid != 0xffffffffu) ns = (ns + 1) & (nsize - 1);
+              nt[ns] = table[o];
+            }
+            free(table);
+            table = nt;
+            tsize = nsize;
+            tmask = nsize - 1;
+          }
+          break;
+        }
+        if (s->hash == h) {
+          PyObject *u = PyList_GET_ITEM(uniques, (Py_ssize_t)s->uid);
+          if (u == it || PyUnicode_Compare(u, it) == 0) {
+            idx[i] = s->uid;
+            total_utf8 += ulens[s->uid];
+            break;
+          }
+          if (PyErr_Occurred()) goto fail;
+        }
+        slot = (slot + 1) & tmask;
+      }
+    }
+    free(table);
+    free(ulens);
+    Py_DECREF(fast);
+    PyObject *out = Py_BuildValue("(OOLL)", uniques, indices,
+                                  (long long)total_utf8, (long long)uniq_utf8);
+    Py_DECREF(uniques);
+    Py_DECREF(indices);
+    return out;
+  }
+
+fail:
+  free(table);
+  free(ulens);
+  Py_XDECREF(indices);
+  Py_XDECREF(uniques);
+  Py_DECREF(fast);
+  return NULL;
+}
+
 /* encode_items(seq) -> (flat_bytes, lengths_int64_le_bytes)
  *
  * One C pass over a sequence of str/bytes: str encodes UTF-8, bytes copies
@@ -633,6 +852,12 @@ static PyMethodDef methods[] = {
     {"chunk_prepare", chunk_prepare, METH_VARARGS,
      "chunk_prepare(src, ints..., buffers..., stage_ns|None) -> rc; the "
      "fused GIL-free whole-chunk prepare walk"},
+    {"chunk_encode", chunk_encode, METH_VARARGS,
+     "chunk_encode(route, values, buffers..., stage_ns|None, err) -> rc; the "
+     "fused GIL-free whole-chunk encode walk"},
+    {"dict_indices_str", dict_indices_str, METH_VARARGS,
+     "dict_indices_str(seq, max_uniques) -> (uniques, u32le_indices, "
+     "total_utf8, uniq_utf8) | None | False"},
     {"encode_items", encode_items, METH_O,
      "encode_items(seq) -> (flat_bytes, int64le_lengths_bytes)"},
     {"dict_indices", dict_indices, METH_VARARGS,

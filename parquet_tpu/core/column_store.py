@@ -21,10 +21,20 @@ from ..meta.parquet_types import Type
 from .arrays import ByteArrayData, byte_array_from_items, _ext
 from .schema import Column
 
-__all__ = ["ColumnChunkBuilder", "StoreError", "MAX_PAGE_SIZE_DEFAULT", "DICT_MAX_UNIQUES"]
+__all__ = [
+    "ColumnChunkBuilder",
+    "StoreError",
+    "MAX_PAGE_SIZE_DEFAULT",
+    "DICT_MAX_UNIQUES",
+    "PROBE_NA",
+]
 
 MAX_PAGE_SIZE_DEFAULT = 1 << 20  # 1 MiB, reference data_store.go:149-154
 DICT_MAX_UNIQUES = (1 << 15) - 1  # 32767, reference chunk_writer.go:188-200
+
+# fast_dictionary's "probe not applicable" sentinel (distinct from None,
+# which is the definitive "dictionary encoding does not pay" verdict)
+PROBE_NA = object()
 
 
 class StoreError(ValueError):
@@ -430,6 +440,46 @@ class ColumnChunkBuilder:
         raise StoreError(f"store: cannot convert {type(v).__name__} to bytes")
 
     # -- dictionary decision (whole-chunk, reference: chunk_writer.go:174-209) --
+
+    def fast_dictionary(self):
+        """OBJECT-domain dictionary probe for string columns: dedup the
+        Python str values BEFORE any UTF-8 materialization, so a
+        dictionary-encoded chunk only ever byte-encodes its (few) uniques —
+        the whole-column string conversion was the serial write path's
+        single biggest cost. Byte-identical to probing the encoded bytes
+        (str -> UTF-8 is injective, so uniques, first-occurrence order and
+        the dict-vs-plain size cutoff all coincide); the probe refuses
+        mixed-type input, where object equality and byte equality diverge.
+
+        Returns (dict_values, indices) when dictionary encoding pays, None
+        when it provably does not (the caller must NOT re-probe), or the
+        PROBE_NA sentinel when the probe does not apply (non-list input,
+        non-BYTE_ARRAY column, extension absent — take build_dictionary)."""
+        if not self.enable_dict or self.column.type != Type.BYTE_ARRAY:
+            return PROBE_NA
+        raw = self._columnar_values if self._columnar_values is not None else self.values
+        if not isinstance(raw, list) or not raw:
+            return PROBE_NA
+        if _ext is None or not hasattr(_ext, "dict_indices_str"):
+            return PROBE_NA
+        res = _ext.dict_indices_str(raw, DICT_MAX_UNIQUES)
+        if res is False:
+            return PROBE_NA  # non-str item seen: byte-domain path decides
+        if res is None:
+            return None  # uniques exceed the cutoff: dict never pays
+        uniques, idx_b, total_utf8, uniq_utf8 = res
+        n = len(raw)
+        n_uniques = len(uniques)
+        # the exact size cutoff of the ByteArrayData branch below, computed
+        # from the probe's cached UTF-8 lengths
+        plain_size = total_utf8 + 4 * n
+        dict_size = uniq_utf8 + 4 * n_uniques + n * 4
+        if dict_size >= plain_size:
+            return None
+        dict_values = ByteArrayData.from_list(
+            [u.encode("utf-8") for u in uniques]
+        )
+        return dict_values, np.frombuffer(idx_b, dtype="<u4")
 
     def build_dictionary(self, typed):
         """Return (dict_values, indices) or None if dict encoding doesn't pay."""

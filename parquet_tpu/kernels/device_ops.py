@@ -79,6 +79,9 @@ __all__ = [
     "predicate_mask_device",
     "list_contains_mask_device",
     "mask_take_device",
+    "bitpack_encode_device",
+    "rle_hybrid_encode_device",
+    "dict_indices_device",
 ]
 
 # Largest bit offset representable in the int32 position math (host drivers
@@ -397,6 +400,151 @@ def mask_take_device(values: jnp.ndarray, mask: jnp.ndarray, out_pad: int):
     )
     taken = values[src] if n else jnp.zeros((out_pad,), values.dtype)
     return taken, jnp.sum(mask.astype(jnp.int32))
+
+
+# -- write path: device ENCODE kernels (inverses of the decode formulations) ----
+
+
+@partial(jax.jit, static_argnames=("width",))
+def bitpack_encode_device(values: jnp.ndarray, width: int) -> jnp.ndarray:
+    """LSB-first bit-pack of uint32 `values` at `width` bits — the jittable
+    inverse of the two-gather unpack at the top of this module (and of
+    ops/bitpack.pack_bits on host). Value i lands at bits
+    [i*width, (i+1)*width): each value splits into a lo/hi uint32 word
+    contribution and one scatter-add assembles the stream (contributions
+    occupy disjoint bits, so add IS or and no carries can occur).
+
+    Returns uint32 LE words covering ceil(n*width/32) (+1 guard word of
+    zeros, mirroring bytes_to_words32); the host trims the byte tail.
+    The caller pads `values` to a multiple of 8 where the hybrid format
+    requires whole groups (pack_bits has the same contract)."""
+    n = values.shape[0]
+    if width == 0 or n == 0:
+        return jnp.zeros(1, dtype=jnp.uint32)
+    n_words = (n * width + 31) // 32 + 1
+    i = jnp.arange(n, dtype=jnp.int32)
+    bitpos = i * width
+    w0 = bitpos >> 5
+    s = (bitpos & 31).astype(jnp.uint64)
+    v = values.astype(jnp.uint64) << s
+    lo = (v & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (v >> jnp.uint64(32)).astype(jnp.uint32)
+    words = (
+        jnp.zeros(n_words, dtype=jnp.uint32)
+        .at[w0]
+        .add(lo)
+        .at[jnp.minimum(w0 + 1, n_words - 1)]
+        .add(hi)
+    )
+    return words
+
+
+@partial(jax.jit, static_argnames=("width",))
+def rle_hybrid_encode_device(values: jnp.ndarray, width: int):
+    """The device half of hybrid RLE/bit-pack ENCODE — the inverse of
+    expand_hybrid_device, mirroring ops/rle_hybrid.encode_hybrid's run
+    policy exactly: an 8-aligned window of >= 8 identical values becomes an
+    RLE run; everything else bit-packs in groups of 8.
+
+    All the per-value work happens here with static shapes: run discovery
+    (one boundary scan + prefix sums), the 8-aligned RLE-window arithmetic
+    per position, compaction of the bit-packed positions, and the packed
+    payload itself (bitpack_encode_device over the compacted stream — legal
+    as ONE pack because every mid-stream segment covers whole groups of 8,
+    so concatenating per-segment payloads equals packing the compacted
+    sequence, zero-padded only at the very end). What remains on host is
+    header emission over the (few) segments — the write-side twin of the
+    prescan/expand split on the read side.
+
+    Returns (in_rle bool[n], rle_break bool[n], packed uint32 words,
+    n_bp int32 scalar): in_rle marks positions covered by an RLE window;
+    rle_break marks the first position of each window (adjacent windows
+    from DIFFERENT runs are separate RLE runs on the wire — a flat mask
+    alone would fuse them); packed holds the bit-packed payload of the
+    remaining positions in order; n_bp counts them.
+    kernels/pipeline.assemble_hybrid_device_stream turns this into the
+    exact encode_hybrid byte stream."""
+    n = values.shape[0]
+    i = jnp.arange(n, dtype=jnp.int32)
+    if n == 0:
+        return (
+            jnp.zeros(0, dtype=bool),
+            jnp.zeros(0, dtype=bool),
+            jnp.zeros(1, dtype=jnp.uint32),
+            jnp.int32(0),
+        )
+    boundary = jnp.concatenate(
+        [jnp.ones(1, dtype=bool), values[1:] != values[:-1]]
+    )
+    run_of = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    # per-position run extent via segment scatter of starts/ends
+    run_start = (
+        jnp.full(n, n, dtype=jnp.int32).at[run_of].min(jnp.where(boundary, i, n))
+    )[run_of]
+    run_end = (
+        jnp.zeros(n, dtype=jnp.int32).at[run_of].max(i + 1)
+    )[run_of]
+    rle_s = (run_start + 7) & ~7
+    rle_e = run_end & ~7
+    qualifies = (run_end - run_start >= 8) & (rle_e - rle_s >= 8)
+    in_rle = qualifies & (i >= rle_s) & (i < rle_e)
+    rle_break = in_rle & (i == rle_s)
+    n_bp = jnp.sum(~in_rle)
+    # compact the bit-packed positions (stable order), pad tail with zeros
+    # so the trailing partial group packs its zero padding
+    pos = jnp.cumsum((~in_rle).astype(jnp.int32)) - 1
+    tgt = jnp.where(~in_rle, pos, n)
+    src = (
+        jnp.full(n + 1, -1, dtype=jnp.int32)
+        .at[jnp.clip(tgt, 0, n)]
+        .max(i)[:n]
+    )
+    bp_vals = jnp.where(src >= 0, values[jnp.clip(src, 0, n - 1)], 0).astype(
+        jnp.uint32
+    )
+    packed = bitpack_encode_device(bp_vals, width)
+    return in_rle, rle_break, packed, n_bp.astype(jnp.int32)
+
+
+@jax.jit
+def dict_indices_device(values: jnp.ndarray):
+    """First-occurrence dictionary probe on device — the jittable inverse of
+    dict_gather_device and the twin of the host u64/bytes probes (same
+    first-occurrence unique order, so the dictionary PAGE bytes match).
+    `values` must already be the column's uniqueness domain (bit patterns
+    for floats, like build_dictionary's view). Static shapes throughout:
+
+      sort -> group boundaries -> group id -> first-occurrence row per
+      group (segment min) -> dictionary rank = order of groups by first
+      occurrence -> per-row index gather.
+
+    Returns (indices int32[n], firsts int32[n], n_uniques int32): firsts
+    holds each unique's first row in dictionary order, padded with n past
+    n_uniques; dictionary value k is values[firsts[k]]."""
+    n = values.shape[0]
+    if n == 0:
+        return (
+            jnp.zeros(0, dtype=jnp.int32),
+            jnp.zeros(0, dtype=jnp.int32),
+            jnp.int32(0),
+        )
+    order = jnp.argsort(values, stable=True).astype(jnp.int32)
+    sv = values[order]
+    newg = jnp.concatenate([jnp.ones(1, dtype=bool), sv[1:] != sv[:-1]])
+    gid_sorted = jnp.cumsum(newg.astype(jnp.int32)) - 1
+    n_uniques = gid_sorted[-1] + 1
+    # first occurrence row of each (sorted-domain) group
+    first_of_group = (
+        jnp.full(n, n, dtype=jnp.int32).at[gid_sorted].min(order)
+    )
+    # dictionary order = groups sorted by first occurrence; unused group
+    # slots carry n and sort last
+    perm = jnp.argsort(first_of_group, stable=True).astype(jnp.int32)
+    rank = jnp.zeros(n, dtype=jnp.int32).at[perm].set(jnp.arange(n, dtype=jnp.int32))
+    gid = jnp.zeros(n, dtype=jnp.int32).at[order].set(gid_sorted)
+    indices = rank[gid]
+    firsts = first_of_group[perm]
+    return indices, firsts, n_uniques.astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("rows_pad",))

@@ -63,7 +63,9 @@ from .device_ops import (
     bytes_to_words64,
     delta_packed_decode_device,
     dict_gather_device,
+    dict_indices_device,
     expand_hybrid_device,
+    rle_hybrid_encode_device,
 )
 
 __all__ = [
@@ -73,6 +75,8 @@ __all__ = [
     "TpuDecodeStats",
     "dispatch_pool",
     "device_put_pipelined",
+    "assemble_hybrid_device_stream",
+    "encode_device_column",
 ]
 
 # Patchable in tests to force multi-batch splitting on small inputs.
@@ -2066,3 +2070,263 @@ def _concat_values(parts, column: Column):
     if column.type == Type.BYTE_ARRAY:
         return ByteArrayData(offsets=np.zeros(1, dtype=np.int64), data=b"")
     return np.empty(0, dtype=_empty_dtype(column))
+
+
+# -- write path: DeviceColumn -> encoded pages ---------------------------------
+#
+# The batch-materialization inverse of read_chunk_tpu: a device-resident
+# numeric column (a training batch, a checkpoint shard, a DeviceColumn's
+# `values`) encodes into parquet pages WITHOUT first round-tripping the raw
+# column through host encode loops. The expensive transforms — the
+# dictionary probe and the hybrid bit-pack — run as the jittable inverses in
+# device_ops (dict_indices_device / rle_hybrid_encode_device /
+# bitpack_encode_device); the host's remaining share is run-header emission
+# over the (few) segments plus page framing/compression, and the bytes are
+# pinned identical to sink.encoder.encode_chunk for the same values.
+
+
+def assemble_hybrid_device_stream(
+    in_rle: np.ndarray, rle_break: np.ndarray, packed: np.ndarray,
+    width: int, value_at
+) -> bytes:
+    """Turn rle_hybrid_encode_device's run plan into the exact
+    ops/rle_hybrid.encode_hybrid byte stream. `in_rle`/`rle_break` are the
+    device masks (fetched; one byte per value — rle_break splits ADJACENT
+    RLE windows of different runs, which a flat mask would fuse), `packed`
+    the device-packed payload words, `value_at(pos)` resolves an RLE
+    window's repeated value (a tiny device gather per segment — segments
+    are few by construction)."""
+    from ..ops.varint import emit_uvarint as _emit_uvarint
+
+    n = len(in_rle)
+    out = bytearray()
+    if n == 0:
+        return b""
+    if width == 0:
+        _emit_uvarint(out, n << 1)
+        return bytes(out)
+    vbytes = (width + 7) // 8
+    packed_bytes = memoryview(np.ascontiguousarray(packed)).cast("B")
+    mask = np.asarray(in_rle, dtype=bool)
+    breaks = np.asarray(rle_break, dtype=bool)
+    seg_start = breaks.copy()
+    seg_start[0] = True
+    seg_start[1:] |= mask[1:] != mask[:-1]
+    starts = np.flatnonzero(seg_start)
+    bounds = np.append(starts, n)
+    bp_done = 0  # bit-packed values consumed (tracks the payload cursor)
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        a, b = int(a), int(b)
+        if mask[a]:
+            _emit_uvarint(out, (b - a) << 1)
+            out += int(value_at(a)).to_bytes(vbytes, "little")
+        else:
+            groups = (b - a + 7) // 8
+            _emit_uvarint(out, (groups << 1) | 1)
+            byte0 = (bp_done // 8) * width
+            out += packed_bytes[byte0 : byte0 + groups * width]
+            bp_done += groups * 8
+    return bytes(out)
+
+
+def encode_device_column(
+    column: Column,
+    values,
+    cfg,
+    kv: dict | None = None,
+    *,
+    enable_dict: bool = True,
+):
+    """Encode one device-resident numeric column into an EncodedChunk whose
+    bytes are IDENTICAL to the host encoder's for the same values — drop-in
+    for sink.encoder's assemble_group/commit_group, so a device training
+    batch materializes to parquet through the same sink seam.
+
+    `values` is a 1-D int32/int64/float32/float64 jax array (or anything
+    jnp.asarray accepts); the column must be flat REQUIRED (the dense
+    batch shape device pipelines produce — levels stay a host concern).
+    The dictionary decision, index hybrid-encode and bit-pack all run on
+    device; the host frames pages and compresses blocks."""
+    import jax.numpy as _jnp
+
+    from ..core.column_store import DICT_MAX_UNIQUES
+    from ..core.compress import compress_block
+    from ..core.page import encode_dict_page
+    from ..meta.parquet_types import (
+        DataPageHeader,
+        DataPageHeaderV2,
+        PageHeader,
+    )
+    from ..ops.bitpack import bit_width
+    from ..core.page import _crc32_signed
+    from ..sink.encoder import (
+        EncodedChunk,
+        _ChunkEncodePlan,
+        _chunk_meta,
+        _split_starts,
+    )
+
+    if column.max_rep > 0 or column.max_def > 0:
+        raise ValueError(
+            "encode_device_column: only flat REQUIRED columns encode "
+            "device-side (nested/optional batches go through the host writer)"
+        )
+    if cfg.write_page_index:
+        # per-page stat collection lives in the host encoder's
+        # _PageIndexBuilder; silently dropping a requested page index would
+        # break the drop-in identity this function promises
+        raise ValueError(
+            "encode_device_column: write_page_index is host-encoder-only "
+            "(use sink.encoder.encode_chunk for indexed chunks)"
+        )
+    dev = _jnp.asarray(values)
+    if dev.ndim != 1 or dev.dtype.itemsize not in (4, 8):
+        raise ValueError(
+            "encode_device_column: expected a 1-D 4/8-byte numeric column"
+        )
+    n = int(dev.shape[0])
+    np_dt = np.dtype(dev.dtype.name)
+    # uniqueness domain: bit patterns, so NaN payloads dedup like the host
+    bits = jax.lax.bitcast_convert_type(
+        dev, _jnp.uint32 if np_dt.itemsize == 4 else _jnp.uint64
+    )
+    dict_result = None
+    indices = None
+    if enable_dict and n:
+        idx_dev, firsts_dev, nu_dev = dict_indices_device(bits)
+        nu = int(nu_dev)
+        if nu <= DICT_MAX_UNIQUES:
+            width = max(int(nu - 1).bit_length(), 1)
+            dict_nbytes = nu * np_dt.itemsize
+            if dict_nbytes + (n * width) // 8 < n * np_dt.itemsize:
+                dict_values = np.asarray(dev[firsts_dev[:nu]]).astype(
+                    np_dt, copy=False
+                )
+                dict_result = (dict_values, None)
+                indices = idx_dev.astype(_jnp.uint32)
+    host_typed = None
+    if dict_result is None:
+        host_typed = np.asarray(dev).astype(np_dt, copy=False)
+
+    parts: list = []
+    pos = 0
+    uncompressed_total = 0
+
+    def frame_page(raw: bytes, n_values: int) -> None:
+        nonlocal pos, uncompressed_total
+        block = compress_block(raw, cfg.codec)
+        if cfg.data_page_version == 1:
+            header = PageHeader(
+                type=0,
+                uncompressed_page_size=len(raw),
+                compressed_page_size=len(block),
+                data_page_header=DataPageHeader(
+                    num_values=n_values,
+                    encoding=int(value_encoding),
+                    definition_level_encoding=int(Encoding.RLE),
+                    repetition_level_encoding=int(Encoding.RLE),
+                ),
+            )
+        else:
+            header = PageHeader(
+                type=3,
+                uncompressed_page_size=len(raw),
+                compressed_page_size=len(block),
+                data_page_header_v2=DataPageHeaderV2(
+                    num_values=n_values,
+                    num_nulls=0,
+                    num_rows=n_values,
+                    encoding=int(value_encoding),
+                    definition_levels_byte_length=0,
+                    repetition_levels_byte_length=0,
+                    is_compressed=True,
+                ),
+            )
+        if cfg.with_crc:
+            header.crc = _crc32_signed(block)
+        hdr = header.dumps()
+        parts.append(hdr)
+        parts.append(block)
+        pos += len(hdr) + len(block)
+        uncompressed_total += len(hdr) + len(raw)
+
+    dict_offset = None
+    n_pages = 0
+    if dict_result is not None:
+        value_encoding = Encoding.RLE_DICTIONARY
+        header, block = encode_dict_page(
+            column, dict_result[0], cfg.codec, cfg.with_crc
+        )
+        hdr = header.dumps()
+        dict_offset = pos
+        parts.append(hdr)
+        parts.append(block)
+        pos += len(hdr) + len(block)
+        uncompressed_total += len(hdr) + (header.uncompressed_page_size or 0)
+        _metrics.inc("pages_written_total", encoding="PLAIN")
+        data_offset = pos
+        width = max(int(len(dict_result[0]) - 1).bit_length(), 1)
+        for a, b in _split_starts(n, max(int(cfg.max_page_size // 4), 1)):
+            page_idx = indices[a:b]
+            in_rle, rle_break, packed, _n_bp = rle_hybrid_encode_device(
+                page_idx, width
+            )
+            stream = assemble_hybrid_device_stream(
+                np.asarray(in_rle),
+                np.asarray(rle_break),
+                np.asarray(packed),
+                width,
+                lambda p, _pi=page_idx: int(_pi[p]),
+            )
+            frame_page(bytes([width]) + stream, b - a)
+            n_pages += 1
+    else:
+        value_encoding = cfg.column_encodings.get(column.path, Encoding.PLAIN)
+        if value_encoding != Encoding.PLAIN:
+            raise ValueError(
+                "encode_device_column: only PLAIN/dictionary device encodes "
+                f"are supported (column asks for {value_encoding})"
+            )
+        data_offset = pos
+        per_page = max(int(cfg.max_page_size // np_dt.itemsize), 1)
+        for a, b in _split_starts(n, per_page):
+            frame_page(host_typed[a:b].tobytes(), b - a)
+            n_pages += 1
+    _metrics.inc(
+        "pages_written_total", n_pages,
+        encoding=_metrics.encoding_name(value_encoding),
+    )
+    plan = _ChunkEncodePlan(
+        nv=n,
+        num_entries=n,
+        null_count=0,
+        def_levels=None,
+        rep_levels=None,
+        typed=host_typed,
+        dict_result=dict_result,
+        value_encoding=value_encoding,
+        page_values=None,
+        dict_size=len(dict_result[0]) if dict_result is not None else None,
+        stats_src=dict_result[0] if dict_result is not None else host_typed,
+    )
+    cc, bloom = _chunk_meta(
+        cfg,
+        _DeviceBuilderShim(column),
+        kv,
+        plan,
+        uncompressed_total=uncompressed_total,
+        pos=pos,
+        data_offset=data_offset,
+        dict_offset=dict_offset,
+        n_pages=n_pages,
+    )
+    return EncodedChunk(
+        parts=parts, nbytes=pos, chunk=cc, index=None, bloom=bloom
+    )
+
+
+class _DeviceBuilderShim:
+    """The slice of ColumnChunkBuilder _chunk_meta actually reads."""
+
+    def __init__(self, column: Column):
+        self.column = column

@@ -83,14 +83,18 @@ def _credit_wait_and_call(wait: float, fn, args):
     return fn(*args)
 
 
-def instrumented_submit(executor, fn, *args, pool: str | None = None):
+def instrumented_submit(executor, fn, *args, pool: str | None = None, ctx=None):
     """Submit `fn(*args)` to `executor` with contextvars carry (the
     traced_submit contract) plus queue/active gauges and wait/task-time
     histograms under the `pool` label (defaults to the executor's thread
     name prefix). The drop-in replacement for traced_submit at every
-    pqt-* pool call site."""
+    pqt-* pool call site. Callers fanning ONE logical group out as N tasks
+    pass a shared `ctx` template (snapshotted once per group): each task
+    still receives a private copy — Context.run refuses re-entry on a
+    shared object, and group tasks overlap — but the per-task cost drops
+    to Context.copy instead of a fresh per-submit thread-state snapshot."""
     name = pool or getattr(executor, "_thread_name_prefix", "") or "pool"
-    ctx = copy_context()
+    ctx = ctx.copy() if ctx is not None else copy_context()
     _adjust(name, dq=+1)
     t_submit = time.perf_counter()
     try:

@@ -47,12 +47,14 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..core.arrays import ByteArrayData
+from ..core.column_store import PROBE_NA
 from ..core.page import (
     encode_data_page_v1,
     encode_data_page_v2,
     encode_dict_page,
 )
 from ..core.stats import column_is_unsigned, compute_statistics
+from ..ops import plain as plain_ops
 from ..meta.parquet_types import (
     BoundaryOrder,
     ColumnChunk,
@@ -70,6 +72,7 @@ from ..obs.log import log_event as _log_event
 from ..obs.pool import instrumented_submit
 from ..obs.recorder import recorder as _recorder
 from ..utils import metrics as _metrics
+from ..utils.trace import bump as trace_bump
 from ..utils.trace import stage, timed_stage
 
 __all__ = [
@@ -266,6 +269,20 @@ def _value_width(values) -> int:
     return max(arr.itemsize, 1)
 
 
+def _split_starts(n: int, per_page: int):
+    """The flat-column page boundaries of _split_pages as (a, b) pairs —
+    shared with the device batch-materialization path so its page split
+    can never drift from the host's."""
+    if n == 0:
+        yield 0, 0
+        return
+    if n <= per_page:
+        yield 0, n
+        return
+    starts = list(range(0, n, per_page)) + [n]
+    yield from zip(starts[:-1], starts[1:])
+
+
 def _split_pages(values, def_levels, rep_levels, column, max_page_size: int):
     """Split a chunk into page-sized slices (~max_page_size of value data),
     keeping repeated-value rows intact (page boundaries at rep==0)."""
@@ -307,11 +324,406 @@ def _split_pages(values, def_levels, rep_levels, column, max_page_size: int):
         yield v_slice, d_slice, r_slice
 
 
+@dataclass
+class _ChunkEncodePlan:
+    """Shared front half of the encode ladder: typed/level normalization and
+    the dictionary decision, computed ONCE and consumed by whichever rung
+    (fused native or staged Python) produces the bytes — the two rungs
+    cannot diverge on inputs because they read the same plan."""
+
+    nv: int  # non-null value count
+    num_entries: int  # level entries (nulls/empty lists included)
+    null_count: int
+    def_levels: np.ndarray | None
+    rep_levels: np.ndarray | None
+    typed: object | None  # None iff the object-domain probe engaged a dict
+    dict_result: tuple | None  # (dict_values, indices) | None
+    value_encoding: object  # Encoding
+    page_values: object  # indices when dict, typed otherwise
+    dict_size: int | None
+    stats_src: object  # dict_values when dict (same min/max, ~U values)
+
+
+def _plan_chunk(cfg: EncoderConfig, builder) -> _ChunkEncodePlan:
+    column = builder.column
+    nv = builder._n_values()
+    def_levels = (
+        np.asarray(builder.def_levels, dtype=np.uint16)
+        if column.max_def > 0
+        else None
+    )
+    rep_levels = (
+        np.asarray(builder.rep_levels, dtype=np.uint16)
+        if column.max_rep > 0
+        else None
+    )
+    if def_levels is None:
+        num_entries = nv
+    else:
+        num_entries = len(def_levels)
+        if builder._columnar_values is not None and len(def_levels) == 0:
+            # columnar input for optional column without explicit levels:
+            # treat as fully present
+            def_levels = np.full(nv, column.max_def, dtype=np.uint16)
+            num_entries = nv
+    if rep_levels is not None and len(rep_levels) == 0:
+        rep_levels = np.zeros(num_entries, dtype=np.uint16)
+    null_count = (
+        int((def_levels != column.max_def).sum()) if def_levels is not None else 0
+    )
+    # Dictionary decision. The object-domain probe dedups Python str values
+    # BEFORE any UTF-8 materialization — when it engages, `typed` is never
+    # built and only the (few) uniques are byte-encoded; when it rules
+    # dictionary encoding out (None) the verdict is definitive and only the
+    # typed conversion remains. PROBE_NA falls back to the byte/bit-pattern
+    # probes over the typed array, exactly as before.
+    typed = None
+    with timed_stage("encode.dict", record_span=True):
+        dict_result = builder.fast_dictionary()
+        if dict_result is PROBE_NA:
+            typed = builder.typed_values()
+            dict_result = builder.build_dictionary(typed)
+        elif dict_result is None:
+            typed = builder.typed_values()
+    if dict_result is not None:
+        dict_values, indices = dict_result
+        value_encoding = Encoding.RLE_DICTIONARY
+        page_values = indices
+        dict_size = len(dict_values)
+        # the dictionary holds exactly the distinct value set: chunk min/max
+        # over it equals min/max over the full column at ~U values scanned
+        stats_src = dict_values
+    else:
+        value_encoding = cfg.column_encodings.get(column.path, Encoding.PLAIN)
+        page_values = typed
+        dict_size = None
+        stats_src = typed
+    return _ChunkEncodePlan(
+        nv=nv,
+        num_entries=num_entries,
+        null_count=null_count,
+        def_levels=def_levels,
+        rep_levels=rep_levels,
+        typed=typed,
+        dict_result=dict_result,
+        value_encoding=value_encoding,
+        page_values=page_values,
+        dict_size=dict_size,
+        stats_src=stats_src,
+    )
+
+
+def _chunk_meta(cfg: EncoderConfig, builder, kv, plan, *,
+                uncompressed_total, pos, data_offset, dict_offset,
+                n_pages) -> tuple:
+    """Footer structs shared by both rungs: ColumnMetaData + statistics +
+    bloom, built from the plan and the (rung-produced) page accounting."""
+    column = builder.column
+    encodings = {int(Encoding.RLE)}
+    enc_stats: list[PageEncodingStats] = []
+    if plan.dict_result is not None:
+        encodings.add(int(Encoding.PLAIN))
+        encodings.add(int(Encoding.RLE_DICTIONARY))
+        enc_stats.append(
+            PageEncodingStats(
+                page_type=int(PageType.DICTIONARY_PAGE),
+                encoding=int(Encoding.PLAIN),
+                count=1,
+            )
+        )
+    page_type = (
+        int(PageType.DATA_PAGE)
+        if cfg.data_page_version == 1
+        else int(PageType.DATA_PAGE_V2)
+    )
+    encodings.add(int(plan.value_encoding))
+    enc_stats.append(
+        PageEncodingStats(
+            page_type=page_type, encoding=int(plan.value_encoding), count=n_pages
+        )
+    )
+    stats = compute_statistics(
+        column.type, plan.stats_src, plan.null_count, column_is_unsigned(column)
+    )
+    if plan.dict_result is not None:
+        # the dictionary IS the distinct set: record the exact count
+        stats.distinct_count = plan.dict_size
+    md = ColumnMetaData(
+        type=int(column.type),
+        encodings=sorted(encodings),
+        path_in_schema=list(column.path),
+        codec=cfg.codec,
+        num_values=plan.num_entries,
+        total_uncompressed_size=uncompressed_total,
+        total_compressed_size=pos,
+        data_page_offset=data_offset,
+        dictionary_page_offset=dict_offset,
+        statistics=stats,
+        encoding_stats=enc_stats,
+        key_value_metadata=(
+            [KeyValue(key=k, value=v) for k, v in kv.items()] if kv else None
+        ),
+    )
+    bloom = None
+    spec = cfg.bloom_specs.get(column.path)
+    if spec is not None:
+        hash_src = (
+            plan.dict_result[0] if plan.dict_result is not None else plan.typed
+        )
+        if len(hash_src):
+            from ..core.bloom import BloomFilter, bloom_hash_values
+
+            ndv, fpp = spec
+            bf = BloomFilter.sized_for(ndv or len(hash_src), fpp)
+            bf.insert_hashes(bloom_hash_values(column.type, hash_src))
+            bloom = (md, bf)
+    # file_offset: where this chunk's pages begin (parquet-cpp's
+    # convention; some readers sanity-check it against the page offsets)
+    cc = ColumnChunk(
+        file_offset=dict_offset if dict_offset is not None else data_offset,
+        meta_data=md,
+    )
+    return cc, bloom
+
+
 def encode_chunk(cfg: EncoderConfig, builder, kv: dict | None) -> EncodedChunk:
     """Encode one buffered column chunk into page bytes + footer structs,
     offsets relative to the chunk start. Pure w.r.t. the writer: the only
     inputs are the frozen config, the builder SNAPSHOT (the writer has
-    already swapped in fresh builders), and this flush's KV metadata."""
+    already swapped in fresh builders), and this flush's KV metadata.
+
+    Runs the fused -> staged encode ladder (the write-side mirror of the
+    prepare ladder in kernels/pipeline.py): one GIL-free native call
+    (ptq_chunk_encode) does page split + level pack + value encode +
+    compress + Thrift framing for the common flat shapes, byte-identical
+    to the staged per-page Python loop, which remains the fallback rung for
+    everything else and the error-semantics oracle. PQT_FUSED_ENCODE=0
+    forces the staged rung; the outcome is pinned by the
+    encode_fused_engaged / encode_fused_declined / encode_fallback_recovered
+    counters."""
+    with timed_stage("write.encode", record_span=True) as clock:
+        plan = _plan_chunk(cfg, builder)
+        fault = None
+        ec = _fused_encode_chunk(cfg, builder, kv, plan)
+        if ec is not None and not isinstance(ec, EncodedChunk):
+            fault, ec = ec, None  # EncodeFault: remember for the recovery pin
+        if ec is None:
+            ec = _staged_encode_chunk(cfg, builder, kv, plan)
+            if fault is not None:
+                # the staged rung salvaged a chunk the native walk refused
+                trace_bump("encode_fallback_recovered")
+                _log_event(
+                    "encode_fallback_recovered",
+                    level="warning",
+                    column=builder.column.path_str,
+                    stage=fault.stage,
+                    code=fault.code,
+                    page=fault.page,
+                )
+    _metrics.observe("encode_seconds", clock.seconds)
+    return ec
+
+
+# codecs the native encode walk inlines (must also still resolve to the
+# stock implementation — core.compress.is_fused_encode_codec checks both)
+_FUSED_ENCODE_CODECS = (0, 1, 2)
+
+# stage_ns slot -> trace lane, mirroring the prepare.* sub-clock lanes
+_ENCODE_STAGE_LANES = (
+    "encode.levels",
+    "encode.values",
+    "encode.compress",
+    "encode.frame",
+    "encode.crc",
+)
+
+
+def _fused_encode_chunk(cfg: EncoderConfig, builder, kv, plan):
+    """The native rung: returns an EncodedChunk, an EncodeFault (walk ran
+    and aborted — the caller retries staged and counts the recovery), or
+    None (declined: shape/codec/config outside the fused envelope, or the
+    escape hatch is set)."""
+    if os.environ.get("PQT_FUSED_ENCODE", "1") == "0":
+        return None  # forced staged path: not a decline, no counter
+    from ..utils.native import delta_encode_cap, get_native, hybrid_encode_cap
+
+    lib = get_native()
+    if lib is None or not lib.has_chunk_encode:
+        return None
+    column = builder.column
+    if column.max_rep > 0 or cfg.write_page_index:
+        # nested page splits / per-page index stats are staged-only shapes
+        trace_bump("encode_fused_declined")
+        return None
+    from ..core.compress import is_fused_encode_codec
+
+    if cfg.codec not in _FUSED_ENCODE_CODECS or not is_fused_encode_codec(
+        cfg.codec
+    ):
+        trace_bump("encode_fused_declined")
+        return None
+
+    ba_offsets = None
+    dict_raw = None
+    dict_width = 0
+    dict_num = 0
+    if plan.dict_result is not None:
+        from ..ops.bitpack import bit_width
+
+        dict_values = plan.dict_result[0]
+        values_buf = np.ascontiguousarray(plan.dict_result[1], dtype=np.uint32)
+        route = 2
+        type_size = 4
+        per_value = 4
+        dict_width = bit_width(max(plan.dict_size - 1, 0))
+        dict_num = plan.dict_size
+        try:
+            dict_raw = plain_ops.encode_plain(
+                dict_values, column.type, column.type_length
+            )
+        except Exception:
+            trace_bump("encode_fused_declined")
+            return None
+        values_worst = 1 + hybrid_encode_cap(plan.nv, dict_width)
+    else:
+        typed = plan.typed
+        enc = plan.value_encoding
+        if enc == Encoding.PLAIN and isinstance(typed, ByteArrayData):
+            route = 1
+            type_size = 0
+            values_buf = typed.data
+            ba_offsets = np.ascontiguousarray(typed.offsets, dtype=np.int64)
+            n = plan.nv
+            per_value = max(int(len(typed.data) / n) + 4, 5) if n else 8
+            values_worst = len(typed.data) + 4 * n + 16
+        elif (
+            enc == Encoding.PLAIN
+            and isinstance(typed, np.ndarray)
+            and typed.ndim == 1
+            and typed.dtype.kind in "iuf"
+            and typed.itemsize in (4, 8)
+        ):
+            route = 0
+            values_buf = np.ascontiguousarray(typed)
+            type_size = per_value = typed.itemsize
+            values_worst = values_buf.nbytes + 16
+        elif (
+            enc == Encoding.PLAIN
+            and isinstance(typed, np.ndarray)
+            and typed.ndim == 2
+            and typed.dtype == np.uint8
+            and 1 <= typed.shape[1] <= 4096
+        ):
+            # FIXED_LEN_BYTE_ARRAY / INT96: PLAIN is a row-major memcpy
+            route = 0
+            values_buf = np.ascontiguousarray(typed)
+            type_size = per_value = typed.shape[1]
+            values_worst = values_buf.nbytes + 16
+        elif (
+            enc == Encoding.DELTA_BINARY_PACKED
+            and isinstance(typed, np.ndarray)
+            and typed.ndim == 1
+            and typed.dtype in (np.dtype(np.int32), np.dtype(np.int64))
+        ):
+            route = 3
+            values_buf = np.ascontiguousarray(typed)
+            type_size = per_value = typed.itemsize
+            values_worst = delta_encode_cap(plan.nv, type_size * 8)
+        else:
+            # BOOLEAN bit-packing, BYTE_STREAM_SPLIT, DELTA_*_BYTE_ARRAY,
+            # RLE-bool and exotic inputs stay on the staged rung
+            trace_bump("encode_fused_declined")
+            return None
+
+    per_page = max(int(cfg.max_page_size // max(per_value, 1)), 1)
+    levels_worst = 0
+    if column.max_def > 0:
+        from ..ops.bitpack import bit_width
+
+        levels_worst = 4 + hybrid_encode_cap(
+            plan.num_entries, bit_width(column.max_def)
+        )
+    from ..utils import trace as _trace
+
+    res = lib.chunk_encode(
+        route,
+        values_buf,
+        ba_offsets,
+        plan.nv,
+        type_size,
+        dict_width,
+        dict_raw,
+        dict_num,
+        plan.def_levels if column.max_def > 0 else None,
+        plan.num_entries,
+        column.max_def,
+        int(cfg.codec),
+        cfg.data_page_version,
+        cfg.with_crc,
+        per_page,
+        values_worst + levels_worst + 64,
+        collect_stages=_trace.active(),
+    )
+    if not isinstance(res, dict):
+        trace_bump("encode_fused_declined")
+        trace_bump(f"encode_fused_fault_{res.stage}")
+        return res  # EncodeFault: the caller runs the staged rung + counters
+    trace_bump("encode_fused_engaged")
+    stage_ns = res.get("stage_ns")
+    if stage_ns is not None:
+        _trace.add_seconds_batch(
+            [
+                (lane, int(stage_ns[slot]) / 1e9)
+                for slot, lane in enumerate(_ENCODE_STAGE_LANES)
+                if stage_ns[slot]
+            ]
+        )
+    totals = res["totals"]
+    n_pages = int(totals[2])
+    if plan.dict_result is not None:
+        _metrics.inc("pages_written_total", encoding="PLAIN")
+    _metrics.inc(
+        "pages_written_total",
+        n_pages,
+        encoding=_metrics.encoding_name(plan.value_encoding),
+    )
+    dict_offset = int(totals[3]) if int(totals[3]) >= 0 else None
+    data_offset = int(totals[4])
+    pos = int(totals[0])
+    cc, bloom = _chunk_meta(
+        cfg,
+        builder,
+        kv,
+        plan,
+        uncompressed_total=int(totals[1]),
+        pos=pos,
+        data_offset=data_offset,
+        dict_offset=dict_offset,
+        n_pages=n_pages,
+    )
+    # bytes-like part, not the ndarray itself: sinks concatenate parts into
+    # bytearrays/files, and an ndarray would be swallowed by numpy's
+    # arithmetic overloads instead. The slice VIEW pins the whole
+    # worst-case-sized staging buffer until the group commits, so when
+    # compression left significant slack (the common gzip/snappy case)
+    # copy out exactly the encoded bytes — the parallel pipeline's
+    # in-flight window then holds encoded sizes, not capacities.
+    out = res["out"]
+    if out.base is not None and out.base.nbytes > pos + pos // 4 + 4096:
+        part = out.tobytes()
+    else:
+        part = memoryview(out)
+    return EncodedChunk(
+        parts=[part], nbytes=pos, chunk=cc, index=None, bloom=bloom
+    )
+
+
+def _staged_encode_chunk(
+    cfg: EncoderConfig, builder, kv: dict | None, plan: _ChunkEncodePlan
+) -> EncodedChunk:
+    """The staged rung: the original per-page Python loop over the shared
+    plan — the byte oracle of the differential matrix and the path every
+    shape outside the fused envelope takes."""
     column = builder.column
     parts: list = []
     pos = 0
@@ -325,146 +737,62 @@ def encode_chunk(cfg: EncoderConfig, builder, kv: dict | None) -> EncodedChunk:
         pos += len(hdr) + len(block)
         uncompressed_total += len(hdr) + (header.uncompressed_page_size or 0)
 
-    with timed_stage("write.encode", record_span=True) as clock:
-        typed = builder.typed_values()
-        def_levels = (
-            np.asarray(builder.def_levels, dtype=np.uint16)
-            if column.max_def > 0
-            else None
+    dict_offset = None
+    if plan.dict_result is not None:
+        dict_values = plan.dict_result[0]
+        header, block = encode_dict_page(
+            column, dict_values, cfg.codec, cfg.with_crc
         )
-        rep_levels = (
-            np.asarray(builder.rep_levels, dtype=np.uint16)
-            if column.max_rep > 0
-            else None
+        dict_offset = pos
+        write_page(header, block)
+        _metrics.inc("pages_written_total", encoding="PLAIN")
+
+    data_offset = pos
+    n_pages = 0
+    index = (
+        _PageIndexBuilder(
+            column, plan.dict_result[0] if plan.dict_result else None
         )
-        if def_levels is None:
-            num_entries = len(typed)
+        if cfg.write_page_index
+        else None
+    )
+    for v_slice, d_slice, r_slice in _split_pages(
+        plan.page_values, plan.def_levels, plan.rep_levels, column,
+        cfg.max_page_size,
+    ):
+        page_offset = pos
+        if cfg.data_page_version == 1:
+            header, block = encode_data_page_v1(
+                column, v_slice, d_slice, r_slice, plan.value_encoding,
+                cfg.codec, plan.dict_size, cfg.with_crc,
+            )
         else:
-            num_entries = len(def_levels)
-            if builder._columnar_values is not None and len(def_levels) == 0:
-                # columnar input for optional column without explicit levels:
-                # treat as fully present
-                def_levels = np.full(len(typed), column.max_def, dtype=np.uint16)
-                num_entries = len(def_levels)
-        if rep_levels is not None and len(rep_levels) == 0:
-            rep_levels = np.zeros(num_entries, dtype=np.uint16)
-        null_count = (
-            int((def_levels != column.max_def).sum()) if def_levels is not None else 0
-        )
-
-        dict_result = builder.build_dictionary(typed)
-        dict_offset = None
-        encodings = {int(Encoding.RLE)}
-        enc_stats: list[PageEncodingStats] = []
-
-        if dict_result is not None:
-            dict_values, indices = dict_result
-            header, block = encode_dict_page(
-                column, dict_values, cfg.codec, cfg.with_crc
+            header, block = encode_data_page_v2(
+                column, v_slice, d_slice, r_slice, plan.value_encoding,
+                cfg.codec, plan.dict_size, cfg.with_crc,
             )
-            dict_offset = pos
-            write_page(header, block)
-            _metrics.inc("pages_written_total", encoding="PLAIN")
-            encodings.add(int(Encoding.PLAIN))
-            encodings.add(int(Encoding.RLE_DICTIONARY))
-            enc_stats.append(
-                PageEncodingStats(
-                    page_type=int(PageType.DICTIONARY_PAGE),
-                    encoding=int(Encoding.PLAIN),
-                    count=1,
-                )
+        write_page(header, block)
+        if index is not None:
+            index.add_page(
+                page_offset, pos - page_offset, v_slice, d_slice, r_slice
             )
-            value_encoding = Encoding.RLE_DICTIONARY
-            page_values = indices
-            dict_size = len(dict_values)
-        else:
-            value_encoding = cfg.column_encodings.get(column.path, Encoding.PLAIN)
-            page_values = typed
-            dict_size = None
-
-        data_offset = pos
-        n_pages = 0
-        index = (
-            _PageIndexBuilder(column, dict_result[0] if dict_result else None)
-            if cfg.write_page_index
-            else None
-        )
-        for v_slice, d_slice, r_slice in _split_pages(
-            page_values, def_levels, rep_levels, column, cfg.max_page_size
-        ):
-            page_offset = pos
-            if cfg.data_page_version == 1:
-                header, block = encode_data_page_v1(
-                    column, v_slice, d_slice, r_slice, value_encoding,
-                    cfg.codec, dict_size, cfg.with_crc,
-                )
-            else:
-                header, block = encode_data_page_v2(
-                    column, v_slice, d_slice, r_slice, value_encoding,
-                    cfg.codec, dict_size, cfg.with_crc,
-                )
-            write_page(header, block)
-            if index is not None:
-                index.add_page(
-                    page_offset, pos - page_offset, v_slice, d_slice, r_slice
-                )
-            n_pages += 1
-        _metrics.inc(
-            "pages_written_total", n_pages,
-            encoding=_metrics.encoding_name(value_encoding),
-        )
-        page_type = (
-            int(PageType.DATA_PAGE)
-            if cfg.data_page_version == 1
-            else int(PageType.DATA_PAGE_V2)
-        )
-        encodings.add(int(value_encoding))
-        enc_stats.append(
-            PageEncodingStats(
-                page_type=page_type, encoding=int(value_encoding), count=n_pages
-            )
-        )
-        stats = compute_statistics(
-            column.type, typed, null_count, column_is_unsigned(column)
-        )
-        if dict_result is not None:
-            # the dictionary IS the distinct set: record the exact count
-            stats.distinct_count = len(dict_result[0])
-        md = ColumnMetaData(
-            type=int(column.type),
-            encodings=sorted(encodings),
-            path_in_schema=list(column.path),
-            codec=cfg.codec,
-            num_values=num_entries,
-            total_uncompressed_size=uncompressed_total,
-            total_compressed_size=pos,
-            data_page_offset=data_offset,
-            dictionary_page_offset=dict_offset,
-            statistics=stats,
-            encoding_stats=enc_stats,
-            key_value_metadata=(
-                [KeyValue(key=k, value=v) for k, v in kv.items()] if kv else None
-            ),
-        )
-        bloom = None
-        spec = cfg.bloom_specs.get(column.path)
-        if spec is not None:
-            hash_src = dict_result[0] if dict_result is not None else typed
-            if len(hash_src):
-                from ..core.bloom import BloomFilter, bloom_hash_values
-
-                ndv, fpp = spec
-                bf = BloomFilter.sized_for(ndv or len(hash_src), fpp)
-                bf.insert_hashes(bloom_hash_values(column.type, hash_src))
-                bloom = (md, bf)
-        # file_offset: where this chunk's pages begin (parquet-cpp's
-        # convention; some readers sanity-check it against the page offsets)
-        cc = ColumnChunk(
-            file_offset=dict_offset if dict_offset is not None else data_offset,
-            meta_data=md,
-        )
-        built = index.build() if index is not None else None
-    _metrics.observe("encode_seconds", clock.seconds)
+        n_pages += 1
+    _metrics.inc(
+        "pages_written_total", n_pages,
+        encoding=_metrics.encoding_name(plan.value_encoding),
+    )
+    cc, bloom = _chunk_meta(
+        cfg,
+        builder,
+        kv,
+        plan,
+        uncompressed_total=uncompressed_total,
+        pos=pos,
+        data_offset=data_offset,
+        dict_offset=dict_offset,
+        n_pages=n_pages,
+    )
+    built = index.build() if index is not None else None
     return EncodedChunk(
         parts=parts, nbytes=pos, chunk=cc, index=built or None, bloom=bloom
     )
@@ -630,6 +958,8 @@ class EncodePipeline:
         """Fan out one row group's chunk encodes (builders in leaf order,
         kvs aligned) and queue it for in-order commit. Blocks for
         backpressure; raises the captured pipeline error if one is set."""
+        from contextvars import copy_context
+
         with self._lock:
             self._raise_pending()
             while (
@@ -638,9 +968,14 @@ class EncodePipeline:
             ):
                 self._room.wait()
                 self._raise_pending()
+        # ONE context snapshot per group, shared as a template by every
+        # chunk task — the group's chunks all carry the same trace/tenant
+        # state, so there is nothing per-task left to capture
+        group_ctx = copy_context()
         futs = [
             instrumented_submit(
-                self.pool, encode_chunk, self.cfg, b, kv, pool="pqt-encode"
+                self.pool, encode_chunk, self.cfg, b, kv,
+                pool="pqt-encode", ctx=group_ctx,
             )
             for b, kv in zip(builders, kvs)
         ]

@@ -672,6 +672,7 @@ def cmd_profile(args) -> int:
             for name, v in (
                 ("--columns", args.columns),
                 ("--rows", args.rows),
+                ("--write", args.write),
                 ("--host", args.host),
                 ("--cpu", args.cpu),
                 ("--metrics", args.metrics),
@@ -703,19 +704,48 @@ def cmd_profile(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    backend = "host" if (args.host or args.rows) else "tpu_roundtrip"
+    if args.write and args.rows:
+        print("profile: --write and --rows are mutually exclusive", file=sys.stderr)
+        return 2
+    backend = "host" if (args.host or args.rows or args.write) else "tpu_roundtrip"
     cols = args.columns.split(",") if args.columns else None
     snap0 = metrics.snapshot()
     with FileReader(args.file, columns=cols, backend=backend) as r:
         rows = r.num_rows
-        with decode_trace() as t:
-            with span("file", {"path": str(args.file), "backend": backend}):
-                if args.rows:
-                    for _row in r.iter_rows():
-                        pass
-                else:
-                    for i in range(r.num_row_groups):
-                        r.read_row_group(i)
+        if args.write:
+            # profile the ENCODE: decode rows OUTSIDE the trace window, then
+            # re-encode them (same schema, same codec) to a memory sink —
+            # the trace carries only write.encode and its encode.* sub-clocks
+            # plus the encode_fused_* ladder counters
+            from ..core.writer import FileWriter
+            from ..meta.parquet_types import CompressionCodec
+            from ..sink.sink import MemorySink
+
+            all_rows = list(r.iter_rows())
+            md0 = r.metadata.row_groups[0].columns[0].meta_data if (
+                r.metadata.row_groups
+            ) else None
+            codec = CompressionCodec(md0.codec) if md0 is not None else (
+                CompressionCodec.UNCOMPRESSED
+            )
+            snap0 = metrics.snapshot()  # exclude the decode from the delta
+            with decode_trace() as t:
+                with span(
+                    "file", {"path": str(args.file), "mode": "write-encode"}
+                ):
+                    w = FileWriter(MemorySink(), r.schema, codec=codec)
+                    for row in all_rows:
+                        w.write_row(row)
+                    w.close()
+        else:
+            with decode_trace() as t:
+                with span("file", {"path": str(args.file), "backend": backend}):
+                    if args.rows:
+                        for _row in r.iter_rows():
+                            pass
+                    else:
+                        for i in range(r.num_row_groups):
+                            r.read_row_group(i)
     doc = t.to_chrome_trace()
     # computed once: the registry is live process state, so a re-read could
     # disagree with what the file artifact recorded
@@ -725,22 +755,32 @@ def cmd_profile(args) -> int:
         json.dump(doc, f)
     print(t.report())
     print()
+    mode = "write-encode" if args.write else f"backend={backend}"
     print(
-        f"profile: {rows:,} rows via backend={backend}, "
+        f"profile: {rows:,} rows via {mode}, "
         f"{len(doc['traceEvents'])} trace events -> {args.out} "
         "(load in ui.perfetto.dev or chrome://tracing)"
     )
-    # projection efficiency: the planner fetches only the projected chunks'
-    # exact byte ranges, so bytes-read vs bytes-in-file shows what a
-    # columns= projection actually saves at the source
-    bytes_read = mdelta.get("io_bytes_read_total", 0)
-    fsize = os.path.getsize(args.file)
-    print(
-        f"profile: io {bytes_read:,} B read / {fsize:,} B in file "
-        f"({bytes_read / fsize:.1%} of file bytes)"
-        if fsize
-        else f"profile: io {bytes_read:,} B read"
-    )
+    if args.write:
+        engaged = mdelta.get('events_total{event="encode_fused_engaged"}', 0)
+        declined = mdelta.get('events_total{event="encode_fused_declined"}', 0)
+        written = mdelta.get("sink_bytes_written_total", 0)
+        print(
+            f"profile: encode ladder fused={engaged} staged={declined}, "
+            f"{written:,} B written"
+        )
+    else:
+        # projection efficiency: the planner fetches only the projected
+        # chunks' exact byte ranges, so bytes-read vs bytes-in-file shows
+        # what a columns= projection actually saves at the source
+        bytes_read = mdelta.get("io_bytes_read_total", 0)
+        fsize = os.path.getsize(args.file)
+        print(
+            f"profile: io {bytes_read:,} B read / {fsize:,} B in file "
+            f"({bytes_read / fsize:.1%} of file bytes)"
+            if fsize
+            else f"profile: io {bytes_read:,} B read"
+        )
     if args.metrics:
         print()
         print("metrics delta (this profile run):")
@@ -1299,6 +1339,14 @@ def main(argv=None) -> int:
         help="profile an assembled read (iter_rows) instead of the column "
         "decode: the assemble/assembly.rows stages show where record "
         "assembly spends its time (host path)",
+    )
+    pf.add_argument(
+        "--write",
+        action="store_true",
+        help="profile an ENCODE instead of a decode: read the file's rows, "
+        "then re-encode them (same schema + codec) to a memory sink under "
+        "the tracer — the write.encode / encode.* stages show where the "
+        "write path spends its time, fused-vs-staged counters included",
     )
     pf.add_argument(
         "--host",

@@ -25,6 +25,16 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   events_total{event=}              every trace.bump() event, always-on —
                                     prepare_fused_engaged/_declined,
                                     prepare_fallback_recovered,
+                                    encode_fused_engaged/_declined (the
+                                    write-side ladder: one per chunk the
+                                    fused native ptq_chunk_encode walk
+                                    produced / stood down from),
+                                    encode_fused_fault_<stage> (native
+                                    encode aborts by stage: split/levels/
+                                    values/compress/frame),
+                                    encode_fallback_recovered (chunks the
+                                    staged Python rung salvaged after a
+                                    native abort),
                                     chunks_quarantined, ... dual-report here
   io_bytes_read_total               bytes actually read from byte sources
   io_read_calls_total               source read calls (coalescing shrinks it)
@@ -36,7 +46,11 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   io_readahead_fetched/dropped_total  pqt-io readahead accepted vs shed
                                       (budget full); _errors_total swallowed
   pages_written_total{encoding=}    pages ENCODED by the write side, per
-                                    wire encoding (dict pages count PLAIN)
+                                    wire encoding (dict pages count PLAIN);
+                                    fed by BOTH encode rungs and by the
+                                    device batch-materialization path
+                                    (kernels/pipeline.encode_device_column),
+                                    so page accounting is rung-independent
   write_bytes_total{codec=}         encoded row-group bytes committed to
                                     byte sinks, per codec
   encode_seconds                    histogram of per-chunk encode wall time

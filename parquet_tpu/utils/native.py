@@ -33,6 +33,52 @@ PREPARE_E_CORRUPT = -1
 PREPARE_E_CAPACITY = -5
 PREPARE_E_CRC = -6
 
+# ptq_chunk_encode err_info[0] stage codes (parquet_tpu_native.h PTQ_ENC_STAGE_*).
+ENCODE_STAGES = {
+    0: "none",
+    1: "split",
+    2: "levels",
+    3: "values",
+    4: "compress",
+    5: "frame",
+}
+
+
+def hybrid_encode_cap(n: int, width: int) -> int:
+    """Worst-case hybrid RLE/bit-pack stream size for n values at `width`
+    bits — the ONE sizing formula behind hybrid_encode's output buffer and
+    the fused encode walk's capacity planning (a drifted copy would turn
+    into silent -5 capacity faults and a quiet staged fallback)."""
+    vbytes = (width + 7) // 8
+    return 64 + (n // 8 + 2) * (5 + vbytes) + ((n + 7) // 8) * max(width, 1)
+
+
+def delta_encode_cap(
+    n: int, nbits: int, block_size: int = 128, mini_count: int = 4
+) -> int:
+    """Worst-case DELTA_BINARY_PACKED size: header + per-block zigzag +
+    widths + payloads at full width (shared by delta_encode and the fused
+    encode walk's capacity planning)."""
+    blocks = max(n // block_size + 2, 1)
+    return (
+        64
+        + blocks * (10 + mini_count)
+        + ((n + block_size) * nbits) // 8
+        + block_size
+    )
+
+
+class EncodeFault(NamedTuple):
+    """Structured failure report from the fused native chunk encode: the
+    negative return code plus the stage/page context. NOT an exception —
+    encode_chunk's fallback ladder retries the chunk on the staged Python
+    encoder, which raises the exact typed error if the input is genuinely
+    unencodable."""
+
+    code: int
+    stage: str
+    page: int
+
 
 class PrepareFault(NamedTuple):
     """Structured failure report from the fused native chunk walk: the
@@ -297,6 +343,32 @@ class NativeLib:
                 ctypes.c_void_p,
                 ctypes.c_void_p,
             ]
+        self.has_gzip_encode = hasattr(lib, "ptq_gzip_compress")
+        if self.has_gzip_encode:
+            lib.ptq_gzip_compress.restype = ctypes.c_ssize_t
+            lib.ptq_gzip_compress.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+                ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
+        self.has_chunk_encode = hasattr(lib, "ptq_chunk_encode")
+        if self.has_chunk_encode:
+            lib.ptq_chunk_encode.restype = ctypes.c_ssize_t
+            lib.ptq_chunk_encode.argtypes = (
+                [ctypes.c_int]  # route
+                + [ctypes.c_void_p, ctypes.c_size_t]  # values
+                + [ctypes.c_void_p, ctypes.c_int64]  # ba_offsets, nv
+                + [ctypes.c_int, ctypes.c_int]  # type_size, dict_width
+                + [ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int64]  # dict
+                + [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]  # def levels
+                # codec, dpv, with_crc
+                + [ctypes.c_int] * 3
+                + [ctypes.c_int64]  # per_page
+                + [ctypes.c_void_p, ctypes.c_size_t] * 2  # out, scratch
+                + [ctypes.c_void_p, ctypes.c_size_t]  # pages
+                + [ctypes.c_void_p] * 3  # totals, stage_ns, err_info
+            )
         self.has_chunk_prepare = hasattr(lib, "ptq_chunk_prepare")
         if self.has_chunk_prepare:
             lib.ptq_chunk_prepare.restype = ctypes.c_ssize_t
@@ -322,11 +394,13 @@ class NativeLib:
         # absent (ctypes also drops the GIL during the foreign call, so
         # multi-thread prepare stays correct either way, just slower).
         self._ext_chunk_prepare = None
-        if self.has_chunk_prepare:
+        self._ext_chunk_encode = None
+        if self.has_chunk_prepare or self.has_chunk_encode:
             try:
                 from .. import _native_ext as _ext
 
                 self._ext_chunk_prepare = getattr(_ext, "chunk_prepare", None)
+                self._ext_chunk_encode = getattr(_ext, "chunk_encode", None)
             except ImportError:
                 pass
         self.fused_gil_free = self._ext_chunk_prepare is not None
@@ -801,6 +875,143 @@ class NativeLib:
                 "stage_ns": stage_ns,
             }
 
+    def gzip_compress(self, data) -> bytes:
+        """Deflate with the fused encode walk's exact gzip parameters (the
+        startup identity probe against CPython's zlib)."""
+        addr, n_in, _keep = _ptr(data)
+        cap = n_in + n_in // 4 + 128
+        out = ctypes.create_string_buffer(cap)
+        n = self._lib.ptq_gzip_compress(addr, n_in, out, cap)
+        if n < 0:
+            raise ValueError("native gzip: compression failed")
+        return out.raw[:n]
+
+    def chunk_encode(
+        self,
+        route: int,
+        values,
+        ba_offsets,
+        nv: int,
+        type_size: int,
+        dict_width: int,
+        dict_raw,
+        dict_num: int,
+        def_levels,
+        num_entries: int,
+        max_def: int,
+        codec: int,
+        dpv: int,
+        with_crc: bool,
+        per_page: int,
+        raw_worst: int,
+        collect_stages: bool = False,
+    ):
+        """Whole-chunk encode walk (ptq_chunk_encode): ONE native call does
+        page split + level pack + value encode + compress + Thrift page
+        framing, GIL-free via the CPython-extension binding (ctypes
+        fallback drops the GIL at the foreign-call boundary). Returns a
+        dict {out, pages, totals, stage_ns} on success — `out` is a uint8
+        view of exactly the framed chunk bytes — or an EncodeFault naming
+        the failing {code, stage, page} when the chunk needs the staged
+        Python encoder. `raw_worst` is the caller's worst-case raw
+        (uncompressed) page-block bound; output/scratch capacities derive
+        from it with compression-expansion slack, and a -5 capacity verdict
+        retries once with doubled buffers before reporting the fault."""
+        import numpy as np
+
+        ext = self._ext_chunk_encode
+        # worst case for an incompressible block: snappy adds ~n/6 + 32,
+        # deflate ~n/1000 + 13 — one shared slack covers every codec
+        comp_slack = raw_worst // 4 + 1024
+        scratch_cap = 2 * (raw_worst + comp_slack)
+        out_cap = (
+            raw_worst
+            + comp_slack
+            + int(dict_raw.nbytes if hasattr(dict_raw, "nbytes") else len(dict_raw or b""))
+            + 4096
+        )
+        max_pages = int(num_entries // max(per_page, 1)) + 3
+        totals = np.zeros(8, dtype=np.int64)
+        stage_ns = np.zeros(5, dtype=np.int64) if collect_stages else None
+        err_info = np.zeros(4, dtype=np.int64)
+        p = ctypes.c_void_p
+        attempts = 0
+        while True:
+            out = np.empty(out_cap, dtype=np.uint8)
+            scratch = self._take_buf(scratch_cap)
+            pages = np.empty((max_pages, 8), dtype=np.int64)
+            if stage_ns is not None:
+                stage_ns[:] = 0
+            if ext is not None:
+                rc = ext(
+                    route,
+                    values,
+                    ba_offsets,
+                    nv,
+                    type_size,
+                    dict_width,
+                    dict_raw,
+                    dict_num,
+                    def_levels,
+                    num_entries,
+                    max_def,
+                    codec,
+                    dpv,
+                    1 if with_crc else 0,
+                    per_page,
+                    out,
+                    memoryview(scratch)[:scratch_cap],
+                    pages,
+                    totals,
+                    stage_ns,
+                    err_info,
+                )
+            else:
+                va, v_len, _vk = _ptr(values)
+                oa = ok = da = dk = fa = fk = None
+                if ba_offsets is not None:
+                    oa, _n, ok = _ptr(ba_offsets)
+                if dict_raw is not None:
+                    da, d_len, dk = _ptr(dict_raw)
+                else:
+                    d_len = 0
+                if def_levels is not None:
+                    fa, _n, fk = _ptr(def_levels)
+                rc = self._lib.ptq_chunk_encode(
+                    route, va, v_len, oa, nv, type_size, dict_width,
+                    da, d_len, dict_num, fa, num_entries, max_def,
+                    codec, dpv, 1 if with_crc else 0, per_page,
+                    ctypes.c_void_p(out.ctypes.data), out_cap,
+                    ctypes.c_void_p(scratch.ctypes.data), scratch_cap,
+                    pages.ctypes.data_as(p), max_pages,
+                    totals.ctypes.data_as(p),
+                    None if stage_ns is None else stage_ns.ctypes.data_as(p),
+                    err_info.ctypes.data_as(p),
+                )
+                del ok, dk, fk  # keepalives live through the call
+            # scratch never escapes the walk: always pool it back
+            self.release_buffers({"_bases": {"scratch": scratch}}, ("scratch",))
+            if rc == -2 and max_pages < (1 << 24):
+                max_pages *= 8
+                continue
+            if rc == -5 and attempts < 2:
+                attempts += 1
+                out_cap *= 2
+                scratch_cap *= 2
+                continue
+            if rc < 0:
+                return EncodeFault(
+                    code=int(rc),
+                    stage=ENCODE_STAGES.get(int(err_info[0]), "none"),
+                    page=int(err_info[1]),
+                )
+            return {
+                "out": out[: int(totals[0])],
+                "pages": pages[: int(rc)],
+                "totals": totals,
+                "stage_ns": stage_ns,
+            }
+
     def hybrid_encode(self, values, width: int) -> bytes:
         """RLE/bit-pack hybrid encode of a uint64 array (byte-identical to
         ops/rle_hybrid.py encode_hybrid)."""
@@ -808,8 +1019,7 @@ class NativeLib:
 
         v = np.ascontiguousarray(values, dtype=np.uint64)
         n = len(v)
-        vbytes = (width + 7) // 8
-        cap = 64 + (n // 8 + 2) * (5 + vbytes) + ((n + 7) // 8) * max(width, 1)
+        cap = hybrid_encode_cap(n, width)
         out = np.empty(cap, dtype=np.uint8)
         rc = self._lib.ptq_hybrid_encode(
             ctypes.c_void_p(v.ctypes.data), n, width,
@@ -829,9 +1039,7 @@ class NativeLib:
         dt = np.int32 if nbits == 32 else np.int64
         v = np.ascontiguousarray(values, dtype=dt)
         n = len(v)
-        # header + per-block (zigzag + widths) + payloads at worst full width
-        blocks = max(n // block_size + 2, 1)
-        cap = 64 + blocks * (10 + mini_count) + ((n + block_size) * nbits) // 8 + block_size
+        cap = delta_encode_cap(n, nbits, block_size, mini_count)
         out = np.empty(cap, dtype=np.uint8)
         rc = self._lib.ptq_delta_encode(
             ctypes.c_void_p(v.ctypes.data), n, nbits, block_size, mini_count,

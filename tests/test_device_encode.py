@@ -1,0 +1,185 @@
+"""Device ENCODE kernels pinned byte-identical to the host encoders.
+
+The write-side mirror of the decode pins: bitpack_encode_device /
+rle_hybrid_encode_device / dict_indices_device are the jittable inverses of
+ops/bitpack.py, ops/rle_hybrid.py and the dictionary probes, and
+kernels/pipeline.encode_device_column materializes a device-resident numeric
+column into parquet pages whose bytes must equal sink.encoder.encode_chunk's
+for the same values. Runs under CPU jax (the differential contract is
+platform-independent: same bytes everywhere).
+"""
+
+import numpy as np
+import pytest
+
+from parquet_tpu.core.column_store import ColumnChunkBuilder
+from parquet_tpu.kernels.device_ops import (
+    bitpack_encode_device,
+    dict_indices_device,
+    rle_hybrid_encode_device,
+)
+from parquet_tpu.kernels.pipeline import (
+    assemble_hybrid_device_stream,
+    encode_device_column,
+)
+from parquet_tpu.ops.bitpack import pack_bits
+from parquet_tpu.ops.rle_hybrid import encode_hybrid
+from parquet_tpu.schema.dsl import parse_schema
+from parquet_tpu.sink.encoder import EncoderConfig, encode_chunk
+
+import jax.numpy as jnp
+
+
+def _device_hybrid_bytes(values: np.ndarray, width: int) -> bytes:
+    v = jnp.asarray(values.astype(np.uint32))
+    in_rle, rle_break, packed, _n_bp = rle_hybrid_encode_device(v, width)
+    return assemble_hybrid_device_stream(
+        np.asarray(in_rle),
+        np.asarray(rle_break),
+        np.asarray(packed),
+        width,
+        lambda p: int(values[p]),
+    )
+
+
+class TestBitpackEncodeDevice:
+    @pytest.mark.parametrize("width", [1, 2, 3, 5, 8, 13, 16])
+    def test_matches_pack_bits(self, width):
+        rng = np.random.default_rng(width)
+        n = 8 * 97  # whole groups (the hybrid format's contract)
+        vals = rng.integers(0, 1 << width, n).astype(np.uint32)
+        words = np.asarray(bitpack_encode_device(jnp.asarray(vals), width))
+        got = memoryview(words).cast("B")[: (n * width + 7) // 8]
+        assert bytes(got) == pack_bits(vals, width)
+
+    def test_zero_width_and_empty(self):
+        assert (
+            np.asarray(bitpack_encode_device(jnp.zeros(8, jnp.uint32), 0)).sum()
+            == 0
+        )
+        np.asarray(bitpack_encode_device(jnp.zeros(0, jnp.uint32), 4))
+
+
+class TestHybridEncodeDevice:
+    @pytest.mark.parametrize(
+        "maker",
+        [
+            lambda rng, n: rng.integers(0, 7, n),  # low width, no long runs
+            lambda rng, n: np.repeat(
+                rng.integers(0, 50, n // 20 + 1), 20
+            )[:n],  # long runs -> RLE windows
+            lambda rng, n: np.full(n, 3),  # one giant RLE run
+            lambda rng, n: np.arange(n) % 1000,  # no runs at all
+            lambda rng, n: np.concatenate(
+                [np.zeros(5), np.full(40, 9), rng.integers(0, 100, max(n - 45, 0))]
+            )[:n],  # unaligned run start (8-alignment arithmetic)
+        ],
+    )
+    @pytest.mark.parametrize("n", [1, 7, 8, 65, 4096])
+    def test_matches_encode_hybrid(self, maker, n):
+        rng = np.random.default_rng(n)
+        vals = np.asarray(maker(rng, n)).astype(np.uint32)
+        width = max(int(vals.max()).bit_length(), 1)
+        assert _device_hybrid_bytes(vals, width) == encode_hybrid(vals, width)
+
+    def test_width_zero_stream(self):
+        vals = np.zeros(123, dtype=np.uint32)
+        assert _device_hybrid_bytes(vals, 0) == encode_hybrid(vals, 0)
+
+
+class TestDictIndicesDevice:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_first_occurrence_order(self, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(1, 3000))
+        vals = rng.integers(0, int(rng.integers(2, 400)), n).astype(np.int64)
+        idx, firsts, nu = dict_indices_device(jnp.asarray(vals))
+        idx, firsts, nu = np.asarray(idx), np.asarray(firsts), int(nu)
+        # reference: plain first-occurrence probe
+        seen: dict = {}
+        ref_idx = np.empty(n, dtype=np.int64)
+        ref_firsts = []
+        for i, v in enumerate(vals.tolist()):
+            if v not in seen:
+                seen[v] = len(seen)
+                ref_firsts.append(i)
+            ref_idx[i] = seen[v]
+        assert nu == len(seen)
+        assert np.array_equal(idx, ref_idx)
+        assert np.array_equal(firsts[:nu], np.asarray(ref_firsts))
+
+    def test_float_bit_patterns(self):
+        # NaN payloads dedup by bits, like the host probe
+        vals = np.array([1.0, np.nan, 1.0, -0.0, 0.0, np.nan], dtype=np.float64)
+        bits = vals.view(np.uint64)
+        idx, firsts, nu = dict_indices_device(jnp.asarray(bits))
+        assert int(nu) == 4  # 1.0, nan, -0.0, +0.0
+        assert np.asarray(idx).tolist() == [0, 1, 0, 2, 3, 1]
+
+
+class TestEncodeDeviceColumn:
+    def _cfg(self, **kw):
+        base = dict(
+            codec=0,
+            data_page_version=1,
+            max_page_size=1 << 20,
+            with_crc=False,
+            write_page_index=False,
+            column_encodings={},
+            bloom_specs={},
+        )
+        base.update(kw)
+        return EncoderConfig(**base)
+
+    def _host_chunk(self, column, values, cfg):
+        b = ColumnChunkBuilder(column, True)
+        b.set_columnar(values)
+        return encode_chunk(cfg, b, None)
+
+    @pytest.mark.parametrize("codec", [0, 1])  # uncompressed, snappy
+    @pytest.mark.parametrize("dpv", [1, 2])
+    def test_dict_int64_byte_identical(self, codec, dpv):
+        schema = parse_schema("message m { required int64 a; }")
+        column = schema.column("a")
+        rng = np.random.default_rng(7)
+        vals = rng.integers(0, 300, 50_000).astype(np.int64)
+        cfg = self._cfg(codec=codec, data_page_version=dpv)
+        host = self._host_chunk(column, vals, cfg)
+        dev = encode_device_column(column, jnp.asarray(vals), cfg)
+        assert b"".join(bytes(p) for p in dev.parts) == b"".join(
+            bytes(p) for p in host.parts
+        )
+        assert dev.nbytes == host.nbytes
+        assert dev.chunk.meta_data.dumps() == host.chunk.meta_data.dumps()
+
+    def test_plain_double_and_crc(self):
+        schema = parse_schema("message m { required double x; }")
+        column = schema.column("x")
+        vals = np.random.default_rng(9).random(20_000)  # all-unique: no dict
+        cfg = self._cfg(codec=1, with_crc=True, max_page_size=1 << 15)
+        host = self._host_chunk(column, vals, cfg)
+        dev = encode_device_column(column, jnp.asarray(vals), cfg)
+        assert b"".join(bytes(p) for p in dev.parts) == b"".join(
+            bytes(p) for p in host.parts
+        )
+        assert dev.chunk.meta_data.dumps() == host.chunk.meta_data.dumps()
+
+    def test_multi_page_dict_stream(self):
+        schema = parse_schema("message m { required int32 v; }")
+        column = schema.column("v")
+        rng = np.random.default_rng(3)
+        # repeats + runs across page boundaries, tiny pages
+        vals = np.repeat(rng.integers(0, 40, 3000), 4)[:10_000].astype(np.int32)
+        cfg = self._cfg(codec=1, max_page_size=4096)
+        host = self._host_chunk(column, vals, cfg)
+        dev = encode_device_column(column, jnp.asarray(vals), cfg)
+        assert b"".join(bytes(p) for p in dev.parts) == b"".join(
+            bytes(p) for p in host.parts
+        )
+
+    def test_rejects_nested_and_optional(self):
+        schema = parse_schema("message m { optional int64 a; }")
+        with pytest.raises(ValueError):
+            encode_device_column(
+                schema.column("a"), jnp.zeros(4, jnp.int64), self._cfg()
+            )

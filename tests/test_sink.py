@@ -378,6 +378,321 @@ class TestParallelSerialDifferential:
                     )
 
 
+def _fused_available() -> bool:
+    from parquet_tpu.utils.native import get_native
+
+    lib = get_native()
+    return lib is not None and getattr(lib, "has_chunk_encode", False)
+
+
+def _write_cols(schema_text, cols, n_groups=3, rows=700, **kw) -> bytes:
+    schema = parse_schema(schema_text)
+    sink = MemorySink()
+    w = FileWriter(sink, schema, **kw)
+    for g in range(n_groups):
+        for name, make in cols.items():
+            w.write_column(name, make(g, rows))
+        w.flush_row_group()
+    w.close()
+    return sink.getvalue()
+
+
+@pytest.mark.skipif(not _fused_available(), reason="native chunk_encode not built")
+class TestFusedEncodeLadder:
+    """The fused native encode walk's hard promise: bytes IDENTICAL to the
+    staged Python encoder (PQT_FUSED_ENCODE=0) for every shape it accepts,
+    a counted decline for shapes it doesn't, and a counted staged recovery
+    for faults — never divergent output, never a torn sink."""
+
+    MATRIX_COLS = {
+        "a": lambda g, n: np.arange(g * n, (g + 1) * n, dtype=np.int64),
+        "s": lambda g, n: [f"k{(g * 31 + i) % 59}" for i in range(n)],
+        "hi": lambda g, n: [f"u{g}_{i}" for i in range(n)],  # all-unique strings
+        "d": lambda g, n: np.random.default_rng(g).random(n),
+        "ts": lambda g, n: np.arange(n, dtype=np.int64) * 3 + g,
+    }
+    MATRIX_SCHEMA = (
+        "message m { required int64 a; required binary s (UTF8); "
+        "required binary hi (UTF8); required double d; required int64 ts; }"
+    )
+
+    def _differential(self, schema_text, cols, **kw):
+        fused = _write_cols(schema_text, cols, **kw)
+        os.environ["PQT_FUSED_ENCODE"] = "0"
+        try:
+            staged = _write_cols(schema_text, cols, **kw)
+        finally:
+            del os.environ["PQT_FUSED_ENCODE"]
+        assert fused == staged
+        return fused
+
+    @pytest.mark.parametrize("codec", CODECS)
+    @pytest.mark.parametrize("dpv", [1, 2])
+    def test_flat_matrix_byte_identical(self, codec, dpv):
+        s0 = metrics.snapshot()
+        data = self._differential(
+            self.MATRIX_SCHEMA,
+            self.MATRIX_COLS,
+            codec=codec,
+            data_page_version=dpv,
+            column_encodings={"ts": "DELTA_BINARY_PACKED"},
+        )
+        d = metrics.delta(s0)
+        assert d.get('events_total{event="encode_fused_engaged"}', 0) > 0
+        got = pq.read_table(io.BytesIO(data))
+        assert got.num_rows == 2100
+
+    @pytest.mark.parametrize("codec", CODECS)
+    @pytest.mark.parametrize("dpv", [1, 2])
+    def test_crc_and_optional_levels(self, dpv, codec):
+        schema = parse_schema(
+            "message m { required int64 a; optional binary s (UTF8); }"
+        )
+
+        def write():
+            sink = MemorySink()
+            w = FileWriter(
+                sink, schema, codec=codec, with_crc=True,
+                data_page_version=dpv,
+            )
+            rng = np.random.default_rng(5)
+            for g in range(3):
+                n = 900
+                dl = (rng.random(n) < 0.8).astype(np.uint16)
+                vals = [f"v{i % 17}" for i in range(int(dl.sum()))]
+                w.write_column("a", np.arange(n, dtype=np.int64) * 7)
+                w.write_column("s", vals, def_levels=dl)
+                w.flush_row_group()
+            w.close()
+            return sink.getvalue()
+
+        fused = write()
+        os.environ["PQT_FUSED_ENCODE"] = "0"
+        try:
+            staged = write()
+        finally:
+            del os.environ["PQT_FUSED_ENCODE"]
+        assert fused == staged
+        got = pq.read_table(io.BytesIO(fused))
+        assert got.num_rows == 2700
+
+    def test_multi_page_and_tiny_page_split(self):
+        # tiny max_page_size forces many pages through the fused splitter
+        self._differential(
+            "message m { required int64 a; required binary s (UTF8); }",
+            {
+                "a": lambda g, n: np.arange(n, dtype=np.int64),
+                "s": lambda g, n: [f"s{i % 13}" for i in range(n)],
+            },
+            n_groups=2,
+            rows=2000,
+            codec="snappy",
+            max_page_size=512,
+        )
+
+    def test_fixed_len_and_float32(self):
+        self._differential(
+            "message m { required fixed_len_byte_array(6) f; "
+            "required float r; }",
+            {
+                "f": lambda g, n: [bytes([g, i % 251, 3, 4, 5, 6]) for i in range(n)],
+                "r": lambda g, n: np.random.default_rng(g).random(n).astype(
+                    np.float32
+                ),
+            },
+            n_groups=2,
+            rows=500,
+            use_dictionary=False,
+        )
+
+    def test_empty_and_single_row_groups(self):
+        schema = parse_schema("message m { required int64 a; }")
+
+        def write():
+            sink = MemorySink()
+            w = FileWriter(sink, schema, codec="gzip")
+            w.write_column("a", np.array([7], dtype=np.int64))
+            w.flush_row_group()
+            w.close()
+            return sink.getvalue()
+
+        fused = write()
+        os.environ["PQT_FUSED_ENCODE"] = "0"
+        try:
+            staged = write()
+        finally:
+            del os.environ["PQT_FUSED_ENCODE"]
+        assert fused == staged
+
+    def test_ineligible_shapes_decline_to_staged(self):
+        # nested column (max_rep > 0), BSS encoding, page index: all must
+        # DECLINE (counter) and still produce correct files
+        s0 = metrics.snapshot()
+        data = _write_cols(
+            "message m { required float f; }",
+            {"f": lambda g, n: np.random.default_rng(g).random(n).astype(np.float32)},
+            n_groups=1,
+            rows=300,
+            column_encodings={"f": "BYTE_STREAM_SPLIT"},
+            use_dictionary=False,
+        )
+        d = metrics.delta(s0)
+        assert d.get('events_total{event="encode_fused_declined"}', 0) > 0
+        assert d.get('events_total{event="encode_fused_engaged"}', 0) == 0
+        pq.read_table(io.BytesIO(data))
+        # page index keeps the staged rung (per-page stats live there)
+        s0 = metrics.snapshot()
+        _write_cols(
+            "message m { required int64 a; }",
+            {"a": lambda g, n: np.arange(n, dtype=np.int64)},
+            n_groups=1,
+            rows=300,
+            write_page_index=True,
+        )
+        d = metrics.delta(s0)
+        assert d.get('events_total{event="encode_fused_engaged"}', 0) == 0
+
+    def test_native_fault_recovers_on_staged_rung(self, monkeypatch):
+        """A native-walk abort mid-ladder must fall back to the staged rung
+        byte-identically and count the recovery."""
+        from parquet_tpu.utils import native as native_mod
+        from parquet_tpu.utils.native import EncodeFault
+
+        lib = native_mod.get_native()
+        real = lib.chunk_encode
+
+        def faulty(*a, **kw):
+            return EncodeFault(code=-1, stage="values", page=0)
+
+        staged_oracle = _write_cols(
+            "message m { required int64 a; }",
+            {"a": lambda g, n: np.arange(n, dtype=np.int64) % 9},
+            n_groups=2,
+            rows=400,
+            codec="snappy",
+        )
+        monkeypatch.setattr(lib, "chunk_encode", faulty)
+        s0 = metrics.snapshot()
+        recovered = _write_cols(
+            "message m { required int64 a; }",
+            {"a": lambda g, n: np.arange(n, dtype=np.int64) % 9},
+            n_groups=2,
+            rows=400,
+            codec="snappy",
+        )
+        monkeypatch.setattr(lib, "chunk_encode", real)
+        d = metrics.delta(s0)
+        assert recovered == staged_oracle
+        assert d.get('events_total{event="encode_fallback_recovered"}', 0) > 0
+        assert d.get('events_total{event="encode_fused_fault_values"}', 0) > 0
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_hostile_inputs_typed_or_identical(self, seed, tmp_path):
+        """Seeded hostile-input sweep over the fused rung: adversarial level
+        streams and value shapes either encode byte-identically to staged or
+        raise the same typed error — and a path sink never commits a torn
+        file either way (testing/faults.py's typed-or-identical contract,
+        applied to the write side)."""
+        rng = np.random.default_rng(seed)
+        schema = parse_schema(
+            "message m { required int64 a; optional binary s (UTF8); }"
+        )
+        n = int(rng.integers(1, 1200))
+        dl = (rng.random(n) < rng.random()).astype(np.uint16)
+        vals = [
+            bytes(rng.integers(0, 256, int(rng.integers(0, 12))).astype(np.uint8))
+            for _ in range(int(dl.sum()))
+        ]
+        hostile_dl = dl.copy()
+        if seed % 2 and n > 3:
+            hostile_dl[int(rng.integers(0, n))] = 7  # exceeds max_def
+        a_col = rng.integers(0, 50, n).astype(np.int64)
+        page_size = int(rng.integers(64, 4096))
+
+        def write(path, use_dl):
+            w = FileWriter(
+                str(path), schema, codec="snappy", max_page_size=page_size
+            )
+            w.write_column("a", a_col)
+            w.write_column("s", vals, def_levels=use_dl)
+            w.flush_row_group()
+            return w.close()
+
+        for use_dl, tag in ((dl, "ok"), (hostile_dl, "hostile")):
+            p_fused = tmp_path / f"fused_{tag}.parquet"
+            p_staged = tmp_path / f"staged_{tag}.parquet"
+            fused_err = staged_err = None
+            try:
+                write(p_fused, use_dl)
+            except Exception as e:  # noqa: BLE001 — compared classwise below
+                fused_err = e
+            os.environ["PQT_FUSED_ENCODE"] = "0"
+            try:
+                write(p_staged, use_dl)
+            except Exception as e:  # noqa: BLE001
+                staged_err = e
+            finally:
+                del os.environ["PQT_FUSED_ENCODE"]
+            if staged_err is None:
+                assert fused_err is None
+                assert p_fused.read_bytes() == p_staged.read_bytes()
+            else:
+                # both rungs fail with the SAME typed error, and the
+                # destination is never committed (atomic sink)
+                assert type(fused_err) is type(staged_err)
+                assert not p_fused.exists()
+                assert not p_staged.exists()
+            assert _tmp_leftovers(tmp_path) == []
+
+    @pytest.mark.slow
+    def test_full_matrix_slow(self):
+        """Extended fused-vs-staged sweep: every fused-eligible value route
+        x codec x dpv x crc x page size, byte-identical or bust."""
+        for codec in CODECS:
+            for dpv in (1, 2):
+                for crc in (False, True):
+                    for mp in (512, 1 << 20):
+                        self._differential(
+                            self.MATRIX_SCHEMA,
+                            self.MATRIX_COLS,
+                            n_groups=2,
+                            rows=1200,
+                            codec=codec,
+                            data_page_version=dpv,
+                            with_crc=crc,
+                            max_page_size=mp,
+                            column_encodings={"ts": "DELTA_BINARY_PACKED"},
+                        )
+
+    def test_flaky_sink_under_fused_encoder(self, tmp_path):
+        """FlakySink faults during fused-encoded writes: complete file or
+        typed error and nothing committed (the PR 6 contract, re-pinned with
+        the native rung producing the bytes)."""
+        for seed in range(6):
+            path = tmp_path / f"f{seed}.parquet"
+            flaky = FlakySink(
+                LocalFileSink(path), seed=seed, error_rate=0.2, permanent=True
+            )
+            try:
+                _w = FileWriter(flaky, SCHEMA, codec="snappy")
+                for g in range(3):
+                    _w.write_column(
+                        "id", np.arange(g * 200, (g + 1) * 200, dtype=np.int64)
+                    )
+                    _w.write_column("name", [f"n{i % 7}" for i in range(200)])
+                    _w.write_column(
+                        "x",
+                        np.arange(200) * 0.25,
+                        def_levels=np.ones(200, dtype=np.uint16),
+                    )
+                    _w.flush_row_group()
+                _w.close()
+                assert pq.read_table(str(path)).num_rows == 600
+            except WriterError:
+                assert not path.exists()
+            assert _tmp_leftovers(tmp_path) == []
+
+
 class TestFlakySinkFaults:
     """Flush failures surface as typed WriterError and NEVER corrupt
     committed output: the destination either holds the complete file or

@@ -439,6 +439,29 @@ class TestProfile:
         for expected in ("file", "row_group", "chunk", "page"):
             assert expected in names, names
 
+    def test_profile_write_mode(self, sample, tmp_path, capsys):
+        """--write profiles an ENCODE: the trace carries write.encode and,
+        when the fused rung ran, its encode.* sub-clock lanes."""
+        out = str(tmp_path / "trace_write.json")
+        assert tool_main(["profile", sample, "-o", out, "--write"]) == 0
+        with open(out) as f:
+            doc = json.load(f)
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "write.encode" in names
+        text = capsys.readouterr().out
+        assert "write-encode" in text
+        assert "encode ladder" in text
+
+    def test_profile_write_rows_exclusive(self, sample, tmp_path, capsys):
+        assert (
+            tool_main(
+                ["profile", sample, "-o", str(tmp_path / "t.json"),
+                 "--write", "--rows"]
+            )
+            == 2
+        )
+        assert "mutually exclusive" in capsys.readouterr().err
+
     def test_meta_per_column_summary(self, sample, capsys):
         assert tool_main(["meta", sample]) == 0
         out = capsys.readouterr().out
