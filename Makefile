@@ -1,14 +1,16 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
 # obs-smoke validates the bench trend store's schema and pins the
 # sampling profiler's overhead on a decode loop; encode-smoke pins the
-# fused native encoder byte-identical to the staged Python rung
-check: native lint chaos-smoke obs-smoke encode-smoke
+# fused native encoder byte-identical to the staged Python rung;
+# device-smoke pins the device query/write paths byte-identical to the
+# host engines (fast subset of tests/test_device_query.py)
+check: native lint chaos-smoke obs-smoke encode-smoke device-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -80,6 +82,15 @@ bench-serve: native
 # vs row-streaming req/s of the same predicate; host-only, no accelerator
 bench-query: native
 	python bench.py --query
+
+# HBM-loop bench: device-vs-host filter / aggregate / write timings on CPU
+# jax (byte identity asserted before any timer starts; real speedups need a
+# real accelerator — the ratios here are informational)
+bench-device: native
+	python bench.py --device
+
+device-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_device_query.py -q -k 'engages or fast or requires or host_config'
 
 # chaos bench: the scripted fault schedule (latency spike -> error burst ->
 # blackout -> recovery) against the SLO-controlled dataset pipeline vs

@@ -1729,6 +1729,229 @@ def _phase_query() -> None:
     _emit(out)
 
 
+# -- the device-resident query/write benchmark (--device / make bench-device) --
+
+DEVICE_QUERY_ROWS = int(os.environ.get("PQT_DEVICE_QUERY_ROWS", 500_000))
+
+
+def _device_corpus() -> Path:
+    """A cached numeric corpus written by OUR writer (int64 id + uint32 tag
+    + float64 v, several row groups) — the device query lanes filter and
+    aggregate it, and the write lane re-encodes its columns."""
+    from parquet_tpu.core.writer import FileWriter
+    from parquet_tpu.schema.dsl import parse_schema
+
+    p = Path(f"/tmp/pqt_device_{DEVICE_QUERY_ROWS}.parquet")
+    if p.exists():
+        return p
+    schema = parse_schema(
+        """
+        message bench {
+          required int64 id;
+          required int32 tag (UINT_32);
+          required double v;
+        }
+        """
+    )
+    rng = np.random.default_rng(19)
+    with FileWriter(
+        str(p), schema, codec="snappy", row_group_size=1 << 21
+    ) as w:
+        done = 0
+        while done < DEVICE_QUERY_ROWS:
+            n = min(1 << 16, DEVICE_QUERY_ROWS - done)
+            w.write_column(
+                "id", np.arange(done, done + n, dtype=np.int64)
+            )
+            w.write_column(
+                "tag",
+                rng.integers(0, 1 << 32, n, dtype=np.uint64)
+                .astype(np.uint32)
+                .view(np.int32),
+            )
+            w.write_column("v", rng.standard_normal(n))
+            w.flush_row_group()
+            done += n
+    return p
+
+
+def _phase_device() -> None:
+    """Device-resident query + write benchmark (`bench.py --device` /
+    `make bench-device`). Three lanes, each asserted byte-identical to its
+    host twin BEFORE any timing:
+      * filter: iter_device_batches(filter_rows=True) — the resident mask
+        + one shared compaction gather — vs host vec-mask filtering with a
+        post-filter upload;
+      * aggregate: POST /v1/query units on ServeConfig(device=True) vs the
+        host pyarrow unit path (render_query_body compared verbatim);
+      * write: FileWriter.write_device_column (device DELTA block scans +
+        dictionary probe) vs write_column, full-file bytes compared.
+    On CPU jax the speedups are INFORMATIONAL — identity is the contract
+    here, and the ratios only become meaningful with real HBM behind the
+    arrays. Rides the --json artifact as "device"."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from parquet_tpu.core.filter import normalize_dnf
+    from parquet_tpu.core.filter_vec import dnf_mask
+    from parquet_tpu.core.reader import FileReader
+
+    out = {"config": "device", "stat": "median", "rows": DEVICE_QUERY_ROWS}
+    path = _device_corpus()
+    lo, hi = DEVICE_QUERY_ROWS // 10, (DEVICE_QUERY_ROWS * 9) // 10
+    pred = [[["id", ">=", lo], ["id", "<", hi], ["tag", ">=", 1 << 31]]]
+
+    # -- lane 1: device-resident row filtering --------------------------------
+
+    def device_filtered():
+        ids = []
+        with FileReader(str(path)) as r:
+            for b in r.iter_device_batches(
+                1 << 15,
+                columns=["id", "v"],
+                drop_remainder=False,
+                filters=pred,
+                filter_rows=True,
+            ):
+                ids.append(b[("id",)])
+        jax.block_until_ready(ids)
+        return np.concatenate([np.asarray(a) for a in ids]) if ids else np.empty(0, np.int64)
+
+    def host_filtered():
+        ids = []
+        with FileReader(str(path)) as r:
+            nd = normalize_dnf(r.schema, pred)
+            for i in range(r.num_row_groups):
+                chunks = r._read_row_group(i, None, pack=False)
+                n = int(r.row_group(i).num_rows or 0)
+                mask = dnf_mask(chunks, nd, n)
+                kept = np.asarray(chunks[("id",)].values)[mask]
+                ids.append(jnp.asarray(kept))
+                jnp.asarray(np.asarray(chunks[("v",)].values)[mask])
+        jax.block_until_ready(ids)
+        return np.concatenate([np.asarray(a) for a in ids]) if ids else np.empty(0, np.int64)
+
+    d_ids = device_filtered()  # also warms the jit caches
+    h_ids = host_filtered()
+    assert np.array_equal(d_ids, h_ids), (
+        f"device/host filtered rows diverge: {d_ids.shape} vs {h_ids.shape}"
+    )
+    log(f"bench: device filter identity ✓ ({d_ids.shape[0]} rows kept)")
+    t_dev = timed_stats(device_filtered, REPEATS, "filter-device", rows=DEVICE_QUERY_ROWS)
+    t_host = timed_stats(host_filtered, REPEATS, "filter-host", rows=DEVICE_QUERY_ROWS)
+    out["filter"] = {
+        "rows_matched": int(d_ids.shape[0]),
+        "rows_s_device": round(DEVICE_QUERY_ROWS / t_dev["t"], 1),
+        "rows_s_host": round(DEVICE_QUERY_ROWS / t_host["t"], 1),
+        "device_vs_host": round(t_host["t"] / t_dev["t"], 2),
+    }
+    log(
+        f"bench: device filter {out['filter']['rows_s_device'] / 1e6:.2f} M rows/s "
+        f"vs host-filter+upload {out['filter']['rows_s_host'] / 1e6:.2f} M rows/s "
+        f"= {out['filter']['device_vs_host']}x"
+    )
+
+    # -- lane 2: device partial aggregation through the serve executor --------
+    from parquet_tpu.serve.aggregate import render_query_body
+    from parquet_tpu.serve.protocol import parse_query_request
+    from parquet_tpu.serve.server import ScanService, ServeConfig
+
+    q = parse_query_request(
+        json.dumps(
+            {
+                "paths": [str(path)],
+                "filters": pred,
+                "aggregates": [
+                    "count",
+                    {"op": "sum", "column": "id"},
+                    {"op": "min", "column": "id"},
+                    {"op": "max", "column": "tag"},
+                ],
+            }
+        ).encode()
+    )
+    svc_dev = ScanService(ServeConfig(root=str(path.parent), device=True))
+    svc_host = ScanService(ServeConfig(root=str(path.parent)))
+
+    def run_agg(svc):
+        ticket, got = svc.query(q, "bench")
+        ticket.release()
+        return render_query_body(got)
+
+    b_dev, b_host = run_agg(svc_dev), run_agg(svc_host)
+    assert b_dev == b_host, f"aggregate bodies diverge: {b_dev} vs {b_host}"
+    log(f"bench: device aggregate identity ✓ ({b_dev})")
+    t_adev = timed_stats(lambda: run_agg(svc_dev), REPEATS, "agg-device", rows=DEVICE_QUERY_ROWS)
+    t_ahost = timed_stats(lambda: run_agg(svc_host), REPEATS, "agg-host", rows=DEVICE_QUERY_ROWS)
+    out["aggregate"] = {
+        "rows_s_device": round(DEVICE_QUERY_ROWS / t_adev["t"], 1),
+        "rows_s_host": round(DEVICE_QUERY_ROWS / t_ahost["t"], 1),
+        "device_vs_host": round(t_ahost["t"] / t_adev["t"], 2),
+    }
+    log(
+        f"bench: device aggregate {out['aggregate']['rows_s_device'] / 1e6:.2f} "
+        f"M rows/s vs host {out['aggregate']['rows_s_host'] / 1e6:.2f} M rows/s "
+        f"= {out['aggregate']['device_vs_host']}x"
+    )
+
+    # -- lane 3: the device write path ----------------------------------------
+    from parquet_tpu.core.writer import FileWriter
+    from parquet_tpu.schema.dsl import parse_schema
+
+    wschema = parse_schema(
+        """
+        message w {
+          required int64 seq;
+          required int64 bucket;
+        }
+        """
+    )
+    rng = np.random.default_rng(5)
+    w_rows = min(DEVICE_QUERY_ROWS, 1 << 19)
+    seq = np.cumsum(rng.integers(0, 9, w_rows)).astype(np.int64)
+    bucket = rng.integers(0, 128, w_rows, dtype=np.int64)
+    d_seq, d_bucket = jnp.asarray(seq), jnp.asarray(bucket)
+    enc = {"seq": "DELTA_BINARY_PACKED"}
+
+    def write_host(dst):
+        with FileWriter(
+            dst, wschema, codec="snappy", column_encodings=enc,
+            row_group_size=1 << 22,
+        ) as w:
+            w.write_column("seq", seq)
+            w.write_column("bucket", bucket)
+
+    def write_device(dst):
+        with FileWriter(
+            dst, wschema, codec="snappy", column_encodings=enc,
+            row_group_size=1 << 22,
+        ) as w:
+            w.write_device_column("seq", d_seq)
+            w.write_device_column("bucket", d_bucket)
+
+    ph, pd = "/tmp/pqt_dev_write_h.parquet", "/tmp/pqt_dev_write_d.parquet"
+    write_host(ph)
+    write_device(pd)  # warms the device encode jit cache
+    hb, db = Path(ph).read_bytes(), Path(pd).read_bytes()
+    assert hb == db, f"write bytes diverge: {len(hb)} vs {len(db)}"
+    log(f"bench: device write identity ✓ ({len(hb)} bytes)")
+    t_wdev = timed_stats(lambda: write_device(pd), REPEATS, "write-device", rows=w_rows)
+    t_whost = timed_stats(lambda: write_host(ph), REPEATS, "write-host", rows=w_rows)
+    out["write"] = {
+        "rows": w_rows,
+        "rows_s_device": round(w_rows / t_wdev["t"], 1),
+        "rows_s_host": round(w_rows / t_whost["t"], 1),
+        "device_vs_host": round(t_whost["t"] / t_wdev["t"], 2),
+    }
+    log(
+        f"bench: device write {out['write']['rows_s_device'] / 1e6:.2f} M rows/s "
+        f"vs host {out['write']['rows_s_host'] / 1e6:.2f} M rows/s "
+        f"= {out['write']['device_vs_host']}x"
+    )
+    _emit(out)
+
+
 # -- the streaming-loader benchmark (--dataset / phase "dataset") -------------
 
 DATASET_ROWS = int(os.environ.get("PQT_DATASET_ROWS", 2_000_000))
@@ -2731,6 +2954,11 @@ def _config_fingerprint() -> tuple:
         },
         "python": platform.python_version(),
         "machine": platform.machine(),
+        # core count shapes every pool sweep (thread scaling, parallel
+        # encode, serve concurrency): a 1.0x pool result on an nproc=1
+        # box is the MACHINE, not a regression — record it so the trend
+        # reader can tell
+        "nproc": os.cpu_count() or 1,
     }
     digest = hashlib.sha256(
         json.dumps(basis, sort_keys=True).encode()
@@ -2863,6 +3091,15 @@ def _phase_trend(history_path, section=None) -> None:
             f"{len(configs)} config fingerprints — deltas may reflect "
             "config changes, not code"
         )
+    # surface the recorded core count: pool-scaling metrics (thread
+    # sweeps, parallel encode, serve concurrency) are meaningless to
+    # compare across machines with different nproc — and read as flat
+    # "regressions" on an nproc=1 box
+    nproc_cells = [
+        str(e.get("config_basis", {}).get("nproc", "?")) for e in entries
+    ]
+    if any(c != "?" for c in nproc_cells):
+        print(f"bench trend: nproc per round: {' -> '.join(nproc_cells)}")
     last_section = None
     width = max((len(k) for k in keys), default=10)
     for k in keys:
@@ -3068,6 +3305,8 @@ if __name__ == "__main__":
         _phase_serve()
     elif argv and argv[0] == "--query":
         _phase_query()
+    elif argv and argv[0] == "--device":
+        _phase_device()
     elif argv and argv[0] == "--chaos":
         _phase_chaos()
     elif len(argv) >= 2 and argv[0] == "--phase":
@@ -3092,6 +3331,8 @@ if __name__ == "__main__":
             _phase_serve()
         elif name == "query":
             _phase_query()
+        elif name == "device_query":
+            _phase_device()
         elif name == "chaos":
             _phase_chaos()
         elif name == "assembly":

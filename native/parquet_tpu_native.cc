@@ -2471,7 +2471,7 @@ ssize_t ptq_chunk_encode(
     return (code);                                     \
   } while (0)
 
-  if (route < 0 || route > 3 || (codec != 0 && codec != 1 && codec != 2) ||
+  if (route < 0 || route > 4 || (codec != 0 && codec != 1 && codec != 2) ||
       (dpv != 1 && dpv != 2) || per_page < 1 || num_entries < 0 || nv < 0 ||
       max_def < 0 || (max_def > 0 && def_levels == nullptr) ||
       (max_def == 0 && nv != num_entries))
@@ -2479,6 +2479,8 @@ ssize_t ptq_chunk_encode(
   if (route == 0 && (type_size < 1 || type_size > 4096))
     ENC_FAIL(PTQ_E_CORRUPT, PTQ_ENC_STAGE_SPLIT);
   if (route == 3 && type_size != 4 && type_size != 8)
+    ENC_FAIL(PTQ_E_CORRUPT, PTQ_ENC_STAGE_SPLIT);
+  if (route == 4 && type_size != 2)
     ENC_FAIL(PTQ_E_CORRUPT, PTQ_ENC_STAGE_SPLIT);
   if (route == 2 && (dict_width < 0 || dict_width > 32))
     ENC_FAIL(PTQ_E_CORRUPT, PTQ_ENC_STAGE_SPLIT);
@@ -2629,6 +2631,24 @@ ssize_t ptq_chunk_encode(
       if (ln < 0) ENC_FAIL(ln == -1 ? PTQ_E_CORRUPT : PTQ_E_CAPACITY,
                            PTQ_ENC_STAGE_VALUES);
       raw_pos += static_cast<size_t>(ln);
+    } else if (route == 4) {
+      // BOOLEAN RLE: hybrid stream at width 1 behind a 4-byte LE length
+      // prefix (the prefix is part of the VALUE encoding, so unlike def
+      // levels it stays in BOTH page versions — ops/levels.py
+      // encode_levels_v1 is the byte oracle)
+      if (raw_pos + 4 > raw_cap) ENC_FAIL(PTQ_E_CAPACITY, PTQ_ENC_STAGE_VALUES);
+      raw_pos += 4;  // back-patched length prefix
+      ssize_t ln = hybrid_encode_any(
+          reinterpret_cast<const uint16_t*>(values) + vpos, 2, nn, 1,
+          raw_buf + raw_pos, raw_cap - raw_pos);
+      if (ln < 0) ENC_FAIL(ln == -1 ? PTQ_E_CORRUPT : PTQ_E_CAPACITY,
+                           PTQ_ENC_STAGE_VALUES);
+      uint32_t l32 = static_cast<uint32_t>(ln);
+      raw_buf[raw_pos - 4] = static_cast<uint8_t>(l32);
+      raw_buf[raw_pos - 3] = static_cast<uint8_t>(l32 >> 8);
+      raw_buf[raw_pos - 2] = static_cast<uint8_t>(l32 >> 16);
+      raw_buf[raw_pos - 1] = static_cast<uint8_t>(l32 >> 24);
+      raw_pos += static_cast<size_t>(ln);
     } else {  // route 3: DELTA_BINARY_PACKED, one stream per page
       ssize_t ln = ptq_delta_encode(values + vpos * type_size, nn,
                                     type_size * 8, 128, 4,
@@ -2677,7 +2697,8 @@ ssize_t ptq_chunk_encode(
 
     // -- frame the PageHeader and copy the block -----------------------------
     if (page_idx >= static_cast<int64_t>(max_pages)) return PTQ_E_PAGES_FULL;
-    int encoding = route == 2 ? 8 : (route == 3 ? 5 : 0);
+    int encoding =
+        route == 2 ? 8 : (route == 3 ? 5 : (route == 4 ? 3 : 0));
     ThriftW w;
     th_init(&w, out, out_cap, pos);
     th_i32(&w, 1, dpv == 1 ? 0 : 3);                 // type

@@ -54,7 +54,8 @@ extern "C" {
  *          int64[nv+1]), 2 RLE_DICTIONARY (values = uint32 indices,
  *          dict_raw = pre-encoded PLAIN dictionary payload framed as the
  *          leading dictionary page), 3 DELTA_BINARY_PACKED (values =
- *          int32/int64 by type_size).
+ *          int32/int64 by type_size), 4 BOOLEAN RLE (values = uint16 0/1,
+ *          type_size 2; 4-byte-prefixed width-1 hybrid stream).
  * Returns the DATA page count (>= 0), or: -1 corrupt/unsupported input,
  * -2 page table full (retry larger), -5 out/scratch capacity exceeded
  * (retry larger or fall back). pages is int64[max_pages][8]:

@@ -646,7 +646,9 @@ class FileReader:
 
         return jax.default_device(dev)
 
-    def read_row_group_device(self, i: int, columns=None, device=None):
+    def read_row_group_device(
+        self, i: int, columns=None, device=None, *, filters=None
+    ):
         """Decode one row group straight into device memory (HBM).
 
         The TPU-native delivery point: returns {leaf path: DeviceColumn} whose
@@ -655,8 +657,104 @@ class FileReader:
         of the reader's configured backend. `device` pins this call's arrays
         to one jax.Device (overriding the reader-level `device=`); unlike a
         caller-side jax.default_device context it also reaches the internal
-        dispatch thread."""
-        return self._read_row_group_device(i, columns, pack=True, device=device)
+        dispatch thread.
+
+        `filters` (same spec as iter_rows) additionally evaluates the
+        predicate over the DELIVERED columns and returns ({leaf path:
+        DeviceColumn}, mask) — the mask a device bool[num_rows] row array
+        computed IN HBM (core/filter_device; the host vec engine takes over,
+        typed and counted, for any shape the device engine declines). Any
+        filter column missing from `columns` is read and delivered too (the
+        mask needs it resident). The columns are NOT compacted: feed the
+        mask to kernels.device_ops.mask_take_device for the gather, or carry
+        it into masked reductions unsliced — that is the
+        predicate -> mask -> gather pipeline with one jit cache entry per
+        (schema, pad-bucket)."""
+        if filters is None:
+            return self._read_row_group_device(i, columns, pack=True, device=device)
+        from .filter import normalize_dnf
+
+        normalized = normalize_dnf(self.schema, filters)
+        read_columns = self._columns_with_filters(columns, normalized)
+        cols = self._read_row_group_device(
+            i, read_columns, pack=True, device=device
+        )
+        n = int(self.row_group(i).num_rows or 0)
+        with self._devctx(device):
+            mask = self._device_group_mask(i, cols, normalized, n)
+        return cols, mask
+
+    def _columns_with_filters(self, columns, normalized):
+        """The read set a row-filtered device read needs: the caller's
+        projection plus any filter-referenced leaf it misses (None = all
+        columns, which already covers every filter leaf)."""
+        if columns is None:
+            return None
+        proj = self._resolve_columns(columns)
+        if proj is None:
+            return None
+        fpaths = {e[0] for conj in normalized for e in conj}
+        return sorted(proj) + sorted(p for p in fpaths if p not in proj)
+
+    def _device_group_mask(self, i, group, normalized, n, *, null_mode="row"):
+        """bool[n] DEVICE row mask for group i's delivered columns — the
+        engine ladder: device kernels (filter_device.device_dnf_mask) first;
+        any typed decline counts device_filter_declined and re-derives the
+        mask with the host vec engine (exact for everything the zoo holds;
+        a shape even IT declines raises its typed error)."""
+        import jax.numpy as jnp
+
+        from ..utils.trace import bump as trace_bump
+        from .filter_device import DeviceFilterError, device_dnf_mask
+
+        with span("query.mask", {"group": i, "terms": len(normalized)}):
+            try:
+                mask = device_dnf_mask(group, normalized, n, null_mode=null_mode)
+            except DeviceFilterError:
+                trace_bump("device_filter_declined")
+                return jnp.asarray(
+                    self._host_row_mask(i, normalized, n, null_mode)
+                )
+            trace_bump("device_filter_engaged")
+            return mask
+
+    def _host_row_mask(self, i, normalized, n, null_mode="row"):
+        """Host-engine fallback mask: decode the filter columns on host and
+        run the vec mask pipeline (np bool[n])."""
+        from .filter_vec import dnf_mask
+
+        cols = sorted({e[0] for conj in normalized for e in conj})
+        chunks = self._read_row_group(i, cols, pack=False)
+        if not chunks:
+            # quarantined under an on_error policy: no rows to admit
+            return np.zeros(n, dtype=bool)
+        return dnf_mask(chunks, normalized, n, null_mode=null_mode)
+
+    def _device_filter_rows(self, i, group, normalized, arrs, n):
+        """Row-level compaction for one staged group (iter_device_batches
+        filter_rows=True): DNF -> resident mask (_device_group_mask, with
+        its typed + counted host fallback) -> ONE mask_take_device index
+        shared by every delivered leaf — each pytree leaf compacts with a
+        single padded gather, so the jit cache stays bounded by the
+        (schema, pad-bucket) pair. Returns (filtered arrs, kept rows)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..kernels.device_ops import mask_take_device
+        from ..kernels.pipeline import _bucket
+
+        mask = self._device_group_mask(i, group, normalized, n)
+        with span("query.take", {"group": i, "rows": n}):
+            sel, cnt = mask_take_device(
+                jnp.arange(n, dtype=jnp.int32), mask, _bucket(n)
+            )
+            kept = int(cnt)
+            if kept == n:
+                return arrs, n
+            if kept == 0:
+                return arrs, 0
+            arrs = jax.tree_util.tree_map(lambda a: a[sel][:kept], arrs)
+            return arrs, kept
 
     def _read_row_group_device(self, i: int, columns, pack: bool, device=None):
         """pack=False mirrors _read_row_group: the batch iterator consumes
@@ -716,6 +814,7 @@ class FileReader:
         sharding=None,
         nullable: str = "error",
         filters=None,
+        filter_rows: bool = False,
         lists: str = "error",
         max_list_len: int | None = None,
         device=None,
@@ -768,6 +867,18 @@ class FileReader:
         individually filtered — filter columns may admit non-matching rows,
         exact per-row masking is the consumer's jnp.where).
 
+        `filter_rows=True` (requires `filters`) extends the push-down to ROW
+        granularity IN HBM: each surviving group's predicate evaluates as a
+        device mask over the resident columns (core/filter_device) and one
+        mask_take_device compaction gathers only matching rows into the
+        batch stream — predicate -> mask -> gather, never round-tripping the
+        host. Batches keep their static shape (matching rows pack densely
+        across group boundaries); a predicate shape the device engine
+        cannot run falls back, typed and counted
+        (device_filter_engaged/declined), to the host vec engine's mask
+        with the same compaction. Filter columns missing from `columns=`
+        are read for the mask but not delivered in batches.
+
         `device` pins every batch's arrays to one jax.Device (overriding the
         reader-level `device=`); unlike a caller-side jax.default_device
         context it also reaches the internal dispatch thread. Mutually
@@ -800,15 +911,17 @@ class FileReader:
             from .filter import normalize_dnf
 
             normalized = normalize_dnf(self.schema, filters)
+        if filter_rows and normalized is None:
+            raise ValueError("filter_rows=True requires filters")
         return self._iter_device_batches(
             batch_size, columns, drop_remainder, sharding, nullable,
-            normalized, lists, max_list_len, device,
+            normalized, lists, max_list_len, device, filter_rows,
         )
 
     def _iter_device_batches(
         self, batch_size: int, columns, drop_remainder: bool, sharding=None,
         nullable: str = "error", normalized=None, lists: str = "error",
-        max_list_len=None, device=None,
+        max_list_len=None, device=None, filter_rows: bool = False,
     ):
         import jax
         import jax.numpy as jnp
@@ -901,12 +1014,22 @@ class FileReader:
             groups = self._prune_groups_normalized(normalized)
         else:
             groups = list(range(self.num_row_groups))
+        # row-level pushdown reads filter-referenced leaves too (the mask
+        # needs them resident), but only the caller's projection batches
+        proj = None
+        read_columns = columns
+        if filter_rows:
+            proj = self._resolve_columns(columns) if columns else self._selected
+            read_columns = self._columns_with_filters(
+                columns if columns else (sorted(proj) if proj else None),
+                normalized,
+            )
         # a memory ceiling forbids the lookahead's two-groups residency
         lookahead = self.alloc is None
 
         def stage(i):
             if lookahead:
-                return self._plan_row_group_async(i, columns, device=device)
+                return self._plan_row_group_async(i, read_columns, device=device)
             return None
 
         staged_next = stage(groups[0]) if groups and lookahead else None
@@ -929,9 +1052,13 @@ class FileReader:
                     }
                 else:
                     group = self._read_row_group_device(
-                        i, columns, pack=False, device=device
+                        i, read_columns, pack=False, device=device
                     )
-                arrs = {path: _array_of(path, dc) for path, dc in group.items()}
+                arrs = {
+                    path: _array_of(path, dc)
+                    for path, dc in group.items()
+                    if proj is None or path in proj
+                }
                 if not arrs:
                     continue
                 lengths = {a.shape[0] for a in jax.tree_util.tree_leaves(arrs)}
@@ -941,6 +1068,10 @@ class FileReader:
                         f"{sorted(lengths)}"
                     )
                 n = lengths.pop()
+                if filter_rows:
+                    arrs, n = self._device_filter_rows(i, group, normalized, arrs, n)
+                    if not n:
+                        continue
                 if carry_n:
                     cat = jax.tree_util.tree_map(
                         lambda c, a: jnp.concatenate([c, a]), carry, arrs

@@ -356,6 +356,7 @@ class FileWriter:
             leaf.path: ColumnChunkBuilder(leaf, leaf.path in self._dict_columns)
             for leaf in self.schema.leaves
         }
+        self._device_columns: dict[tuple, object] = {}
         self._columnar_rows = None
 
     def _write(self, data: bytes) -> int:
@@ -472,6 +473,96 @@ class FileWriter:
                 f"others have {self._columnar_rows}"
             )
 
+    def write_device_column(self, path, values) -> None:
+        """Columnar fast path for a DEVICE-RESIDENT leaf: jax checkpoint
+        shards go array -> pages with no host round-trip of the raw values
+        (kernels/pipeline.encode_device_column does the dictionary probe,
+        hybrid/bit-pack, DELTA block scans and byte-array framing on
+        device; the host frames pages and compresses). Output bytes are
+        IDENTICAL to write_column for the same values.
+
+        `values` is a 1-D jax array for numeric leaves, or a
+        `(data, offsets)` device pair for BYTE_ARRAY leaves. The leaf must
+        be flat REQUIRED (levels stay a host concern). Shapes the device
+        encoder cannot take (BYTE_STREAM_SPLIT, booleans, page-index
+        writers, ...) fall back typed-and-counted through the host encoder
+        at flush time (`device_write_engaged` / `device_write_declined`).
+        Incompatible with `parallel=` — the encode pipeline snapshots host
+        builders, and device arrays must not outlive their buffer donor."""
+        self._check_open()
+        if self._shredder.num_rows:
+            raise WriterError(
+                "writer: cannot mix write_row and write_column in one row group"
+            )
+        if self._pipeline is not None:
+            raise WriterError(
+                "writer: write_device_column requires a serial writer "
+                "(parallel=False)"
+            )
+        leaf = self.schema.column(path)
+        if not leaf.is_leaf:
+            raise WriterError(f"writer: {leaf.path_str} is not a leaf column")
+        if leaf.max_rep > 0 or leaf.max_def > 0:
+            raise WriterError(
+                f"writer: {leaf.path_str} is not flat REQUIRED — device "
+                "columns carry no levels (use write_column)"
+            )
+        if leaf.type == Type.BYTE_ARRAY:
+            try:
+                _data, offsets = values
+            except (TypeError, ValueError):
+                raise WriterError(
+                    "writer: BYTE_ARRAY device columns take a "
+                    "(data, offsets) pair"
+                ) from None
+            n_rows = int(len(offsets)) - 1
+        else:
+            n_rows = int(len(values))
+        self._device_columns[leaf.path] = values
+        if self._columnar_rows is None:
+            self._columnar_rows = n_rows
+        elif self._columnar_rows != n_rows:
+            raise WriterError(
+                f"writer: column {leaf.path_str} has {n_rows} rows, "
+                f"others have {self._columnar_rows}"
+            )
+
+    def _encode_device_chunk(self, leaf: Column, values, kv):
+        """Encode one device-buffered leaf at flush time: the device route,
+        or the typed-and-counted host fallback for shapes it declines."""
+        from ..utils.trace import bump as trace_bump
+
+        use_dict = leaf.path in self._dict_columns
+        try:
+            from ..kernels.pipeline import encode_device_column
+        except Exception as e:  # jax missing/broken: host path still works
+            trace_bump("device_write_declined")
+            return self._host_encode_device_values(leaf, values, kv, use_dict)
+        try:
+            ec = encode_device_column(
+                leaf, values, self._cfg, kv, enable_dict=use_dict
+            )
+        except ValueError:
+            trace_bump("device_write_declined")
+            return self._host_encode_device_values(leaf, values, kv, use_dict)
+        trace_bump("device_write_engaged")
+        return ec
+
+    def _host_encode_device_values(self, leaf, values, kv, use_dict):
+        from .arrays import ByteArrayData
+
+        if leaf.type == Type.BYTE_ARRAY:
+            data, offsets = values
+            host = ByteArrayData(
+                offsets=np.asarray(offsets).astype(np.int64, copy=False),
+                data=np.asarray(data),
+            )
+        else:
+            host = np.asarray(values)
+        b = ColumnChunkBuilder(leaf, use_dict)
+        b.set_columnar(host)
+        return encode_chunk(self._cfg, b, kv)
+
     def _estimated_size(self) -> int:
         total = 0
         for b in self._shredder.buffers.values():
@@ -520,6 +611,7 @@ class FileWriter:
                 l.path_str
                 for l in self.schema.leaves
                 if self._builders[l.path]._columnar_values is None
+                and l.path not in self._device_columns
             ]
             if missing:
                 raise WriterError(f"writer: columnar row group missing columns {missing}")
@@ -531,6 +623,7 @@ class FileWriter:
         leaves = self.schema.leaves
         builders = [self._builders[leaf.path] for leaf in leaves]
         kvs = [per_col.get(leaf.path) for leaf in leaves]
+        device_cols = self._device_columns
         self._reset_builders()
         if self._pipeline is not None:
             try:
@@ -546,7 +639,12 @@ class FileWriter:
                 ) from e
             return
         try:
-            chunks = [encode_chunk(self._cfg, b, kv) for b, kv in zip(builders, kvs)]
+            chunks = [
+                self._encode_device_chunk(leaf, device_cols[leaf.path], kv)
+                if leaf.path in device_cols
+                else encode_chunk(self._cfg, b, kv)
+                for leaf, b, kv in zip(leaves, builders, kvs)
+            ]
             erg = assemble_group(self._cfg, chunks, n_rows)
         except Exception as e:
             # the group's builders are already consumed: continuing would

@@ -16,6 +16,12 @@ Semantics, in the order the pipeline applies them:
   plan      footers parse lazily (once per file); one work unit per
             (file, row group); `filters` prune units through the
             statistics/bloom path before any data page is read.
+            `filter_rows=True` additionally masks INDIVIDUAL rows inside
+            surviving groups with the vectorized filter engine (null mode
+            "row": a null fails every value predicate), so batches hold
+            only matching rows — the read set silently extends to cover
+            filter-referenced columns, which are dropped again before
+            delivery unless projected.
   shard     the epoch's unit order is a pure function of (seed, epoch),
             computed identically on every host, then striped over
             `shard_count * worker_count` slots — each unit visited by
@@ -100,6 +106,7 @@ class ParquetDataset:
         batch_size: int,
         columns=None,
         filters=None,
+        filter_rows: bool = False,
         shuffle: bool = False,
         seed: int = 0,
         num_epochs: int | None = 1,
@@ -141,6 +148,8 @@ class ParquetDataset:
                 'dataset: on_error="null" delivers nulled chunks, which need '
                 'nullable="zero" to batch'
             )
+        if filter_rows and filters is None:
+            raise ValueError("dataset: filter_rows=True requires filters")
         if num_epochs is not None and num_epochs < 0:
             raise ValueError("dataset: num_epochs must be >= 0 or None")
         if prefetch < 0:
@@ -153,6 +162,7 @@ class ParquetDataset:
         self.batch_size = int(batch_size)
         self.columns = list(columns) if columns is not None else None
         self.filters = filters
+        self.filter_rows = bool(filter_rows)
         self.shuffle = bool(shuffle)
         self.seed = int(seed)
         self.num_epochs = num_epochs
@@ -785,13 +795,40 @@ class DatasetIterator:
                     raise
                 return _skipped("open_failed")
             try:
-                chunks = reader._read_row_group(unit.row_group, None, pack=False)
+                read_cols = None
+                normalized = None
+                if ds.filter_rows:
+                    # extend the read set to cover filter leaves; the
+                    # projection (reader._selected) prunes them back out
+                    # below so filter-only columns never need a batch form
+                    from ..core.filter import normalize_dnf
+
+                    normalized = normalize_dnf(reader.schema, ds.filters)
+                    read_cols = reader._columns_with_filters(
+                        ds.columns, normalized
+                    )
+                chunks = reader._read_row_group(
+                    unit.row_group, read_cols, pack=False
+                )
                 if not chunks:
                     # quarantined by on_error (or empty selection)
                     return _skipped("quarantined")
+                mask = None
+                if normalized is not None:
+                    # a VecFilterError here is a deterministic shape decline
+                    # (it would quarantine EVERY unit) — always a raise, no
+                    # on_error swallowing
+                    from ..core.filter_vec import dnf_mask
+
+                    nrows = int(
+                        reader.row_group(unit.row_group).num_rows or 0
+                    )
+                    mask = dnf_mask(chunks, normalized, nrows)
+                keep = reader._selected
                 cols = {
                     p: self._batch_array(p, cd, reader.schema.column(p))
                     for p, cd in chunks.items()
+                    if keep is None or p in keep
                 }
             except OSError:
                 # transport failure mid-decode (a retry ladder exhausted,
@@ -811,6 +848,16 @@ class DatasetIterator:
                 f"{unit.path} group {unit.row_group}: {sorted(lens)}"
             )
         n = lens.pop()
+        if mask is not None and not mask.all():
+            # row filtering happens BEFORE the resume offset: row_offset
+            # counts positions in the FILTERED stream, so a resumed
+            # iterator replays byte-identically whether or not the
+            # original run filtered
+            bump("dataset_units_row_filtered")
+            cols = {p: a[mask] for p, a in cols.items()}
+            n = int(mask.sum())
+            if not n:
+                return None, 0
         if row_offset:
             if row_offset >= n:
                 return None, 0

@@ -82,6 +82,9 @@ __all__ = [
     "bitpack_encode_device",
     "rle_hybrid_encode_device",
     "dict_indices_device",
+    "delta_block_encode_device",
+    "plain_bytearray_encode_device",
+    "masked_agg_device",
 ]
 
 # Largest bit offset representable in the int32 position math (host drivers
@@ -545,6 +548,142 @@ def dict_indices_device(values: jnp.ndarray):
     indices = rank[gid]
     firsts = first_of_group[perm]
     return indices, firsts, n_uniques.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("nbits",))
+def delta_block_encode_device(values: jnp.ndarray, n, nbits: int):
+    """DELTA_BINARY_PACKED block scans + payload pack on device — the encode
+    inverse of delta_packed_decode_device, mirroring ops/delta.encode_delta's
+    block policy exactly (block_size=128, mini_count=4, mini_len=32).
+
+    `values` is one page's int32/int64 (or uint bit-pattern) slice padded to a
+    static multiple of 128; `n` (traced) is the true value count, so one
+    compilation serves every page in a pad bucket (SURVEY §7.1). The whole
+    sequential structure dissolves into segment reductions: wrapping unsigned
+    deltas (one shifted subtract), per-block signed min (one reshape min),
+    per-miniblock max-of-adjusted -> bit width (one reshape max + clz), and
+    the byte-aligned payload itself as one scatter-add of lo/hi word
+    contributions (mini_len=32 makes every miniblock payload 4*width bytes,
+    so payloads butt together byte-aligned at cumsum(4*width) offsets).
+
+    Returns (mins, widths, words):
+      mins    int32/int64[p_pad/128]  per-block min delta, signed; blocks
+                                      past the last real delta carry INT_MAX
+      widths  int32[p_pad/32]         per-miniblock bit width; minis with no
+                                      real deltas carry 0
+      words   uint32 LE words         payload stream at cumsum(4*width) byte
+                                      offsets (+ guard words)
+    kernels/pipeline.assemble_delta_device_stream frames these into the exact
+    encode_delta byte stream (uvarint header + per-block min/widths/payload)."""
+    p_pad = values.shape[0]
+    ut = jnp.uint32 if nbits == 32 else jnp.uint64
+    st = jnp.int32 if nbits == 32 else jnp.int64
+    u = jax.lax.bitcast_convert_type(values, ut)
+    i = jnp.arange(p_pad, dtype=jnp.int32)
+    nd = n - 1  # delta count
+    valid = i < nd
+    d = jnp.where(valid, jnp.roll(u, -1) - u, ut(0))
+    sd = jax.lax.bitcast_convert_type(d, st)
+    n_blocks = p_pad // 128
+    mins = jnp.min(
+        jnp.where(valid, sd, jnp.iinfo(st).max).reshape(n_blocks, 128), axis=1
+    )
+    adj = jnp.where(
+        valid, d - jax.lax.bitcast_convert_type(mins, ut)[i >> 7], ut(0)
+    )
+    n_minis = p_pad // 32
+    amax = jnp.max(adj.reshape(n_minis, 32), axis=1)
+    widths = jnp.where(
+        amax == 0, ut(0), ut(nbits) - jax.lax.clz(amax).astype(ut)
+    ).astype(jnp.int32)
+    pay_start = jnp.concatenate(
+        [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(4 * widths)]
+    )
+    m = i >> 5
+    w = widths[m]
+    bitpos = pay_start[m] * 8 + (i & 31) * w
+    n_words = n_minis * nbits + 2
+    w0 = jnp.clip(bitpos >> 5, 0, n_words - 2)
+    s = (bitpos & 31).astype(jnp.uint64)
+    vlo = (adj & ut(0xFFFFFFFF)).astype(jnp.uint64) << s
+    words = (
+        jnp.zeros(n_words, dtype=jnp.uint32)
+        .at[w0]
+        .add((vlo & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+        .at[w0 + 1]
+        .add((vlo >> jnp.uint64(32)).astype(jnp.uint32))
+    )
+    if nbits == 64:
+        # widths past 32 bits: the hi half of each delta lands 32 bits later
+        # (disjoint bits again: add is or)
+        vhi = (adj >> ut(32)).astype(jnp.uint64) << s
+        w1 = jnp.clip(w0 + 1, 0, n_words - 2)
+        words = (
+            words.at[w1]
+            .add((vhi & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32))
+            .at[w1 + 1]
+            .add((vhi >> jnp.uint64(32)).astype(jnp.uint32))
+        )
+    return mins, widths, words
+
+
+@partial(jax.jit, static_argnames=("out_pad",))
+def plain_bytearray_encode_device(
+    data: jnp.ndarray,  # uint8: dense value bytes
+    offsets: jnp.ndarray,  # int32/int64[nv + 1]: value byte offsets
+    n,  # int32 scalar: true value count (entries past it are padding)
+    out_pad: int,  # static bucketed output byte capacity
+) -> jnp.ndarray:
+    """PLAIN BYTE_ARRAY framing on device: `<4-byte LE length><bytes>` per
+    value, the encode inverse of the merge_mixed_bytes_device gather. One
+    searchsorted maps every output byte to its value; headers materialize
+    from the offset diffs and payload bytes gather straight out of `data` —
+    no per-value host loop, and PLAIN streams concatenate, so the host
+    slices page sub-ranges out of ONE framed chunk stream at
+    4*a + offsets[a]. Bytes past 4*n + offsets[n] are zero padding."""
+    nv = offsets.shape[0] - 1
+    v_idx = jnp.arange(offsets.shape[0], dtype=jnp.int64)
+    off = offsets.astype(jnp.int64)
+    off = jnp.where(v_idx <= n, off, off[jnp.int64(n)])
+    fout = 4 * jnp.minimum(v_idx, jnp.int64(n)) + off
+    total = fout[jnp.int64(n)]
+    pos = jnp.arange(out_pad, dtype=jnp.int64)
+    v = jnp.clip(
+        jnp.searchsorted(fout[1:], pos, side="right"), 0, max(nv - 1, 0)
+    )
+    rel = pos - fout[v]
+    ln = off[v + 1] - off[v]
+    hdr = ((ln >> (8 * jnp.clip(rel, 0, 3))) & 0xFF).astype(jnp.uint8)
+    db = data[jnp.clip(off[v] + rel - 4, 0, max(data.shape[0] - 1, 0))]
+    if data.shape[0] == 0:
+        db = jnp.zeros(out_pad, dtype=jnp.uint8)
+    return jnp.where(pos < total, jnp.where(rel < 4, hdr, db), jnp.uint8(0))
+
+
+@partial(jax.jit, static_argnames=("op",))
+def masked_agg_device(values: jnp.ndarray, mask: jnp.ndarray, op: str):
+    """One aggregation unit's partial as ONE jnp reduction over the resident
+    row mask (count/sum/min/max) — the device half of serve/aggregate's
+    unit_partial; the exact pyarrow-pinned cross-group merge stays on host.
+    sum accumulates in the 64-bit domain like pyarrow's sum kernel (the
+    caller pre-casts to int64/uint64); min/max mask losers with the dtype's
+    identity, so a matched count of zero means the scalar is garbage — the
+    caller must gate on count > 0 (serve/aggregate_device does)."""
+    if op == "count":
+        return jnp.sum(mask.astype(jnp.int64))
+    if op == "sum":
+        return jnp.sum(jnp.where(mask, values, values.dtype.type(0)))
+    if jnp.issubdtype(values.dtype, jnp.integer):
+        info = jnp.iinfo(values.dtype)
+        lose = info.max if op == "min" else info.min
+    else:
+        lose = jnp.inf if op == "min" else -jnp.inf
+    masked = jnp.where(mask, values, values.dtype.type(lose))
+    if op == "min":
+        return jnp.min(masked)
+    if op == "max":
+        return jnp.max(masked)
+    raise ValueError(f"masked_agg_device: unsupported op {op!r}")
 
 
 @partial(jax.jit, static_argnames=("rows_pad",))

@@ -61,10 +61,12 @@ from .device_ops import (
     MAX_DEVICE_BATCH_BITS,
     bytes_to_words32,
     bytes_to_words64,
+    delta_block_encode_device,
     delta_packed_decode_device,
     dict_gather_device,
     dict_indices_device,
     expand_hybrid_device,
+    plain_bytearray_encode_device,
     rle_hybrid_encode_device,
 )
 
@@ -76,6 +78,7 @@ __all__ = [
     "dispatch_pool",
     "device_put_pipelined",
     "assemble_hybrid_device_stream",
+    "assemble_delta_device_stream",
     "encode_device_column",
 ]
 
@@ -753,7 +756,16 @@ class _ChunkPlan:
             num_values=n_total, def_levels=def_levels, rep_levels=rep_levels
         )
 
-        if kinds <= {"dict", "empty"} and self.dev_hybrid and self.dictionary is not None:
+        if (
+            kinds <= {"dict", "empty"}
+            and self.dev_hybrid
+            and (
+                isinstance(self.dictionary, ByteArrayData)
+                # dict_dev is only uploaded for 1-D numeric dictionaries;
+                # 2-D FLBA dictionaries fall through to host decode + upload
+                or self.dict_dev is not None
+            )
+        ):
             idx = self._dev_indices()
             if isinstance(self.dictionary, ByteArrayData):
                 out.indices = idx
@@ -2129,6 +2141,111 @@ def assemble_hybrid_device_stream(
     return bytes(out)
 
 
+def assemble_delta_device_stream(
+    nbits: int,
+    n: int,
+    first: int,  # values[0] in the UNSIGNED nbits domain (0 when n == 0)
+    mins: np.ndarray,  # int32/int64[>= n_blocks]: per-block min delta, signed
+    widths: np.ndarray,  # int32[>= n_blocks * 4]: per-miniblock bit widths
+    payload: bytes,  # packed payloads at cumsum(4 * width) byte offsets
+) -> bytes:
+    """Frame delta_block_encode_device's tables into the exact
+    ops/delta.encode_delta byte stream (block_size=128, mini_count=4):
+    uvarint header, then per block `<zigzag min> <4 width bytes> <payloads>`.
+    mini_len=32 keeps every payload 4*width bytes, so the device stream
+    slices out by a running byte cursor — the only sequential work left is
+    header emission over the (few) blocks, the write-side twin of the
+    prescan/expand split on the read side."""
+    from ..ops.delta import _to_signed
+    from ..ops.varint import emit_uvarint, emit_zigzag
+
+    out = bytearray()
+    emit_uvarint(out, 128)
+    emit_uvarint(out, 4)
+    emit_uvarint(out, n)
+    emit_zigzag(out, _to_signed(int(first), nbits))
+    if n <= 1:
+        return bytes(out)
+    n_deltas = n - 1
+    pay = 0
+    for blk in range((n_deltas + 127) // 128):
+        emit_zigzag(out, int(mins[blk]))
+        ws = [int(widths[blk * 4 + k]) for k in range(4)]
+        out += bytes(ws)
+        for k, w in enumerate(ws):
+            if blk * 128 + k * 32 < n_deltas:  # mini has values: full payload
+                out += payload[pay : pay + 4 * w]
+            pay += 4 * w
+    return bytes(out)
+
+
+class _DevicePageFramer:
+    """Host framing of device-produced page payloads — compress + Thrift
+    header + optional CRC, shared by every encode_device_column route (the
+    exact mirror of core/page.encode_data_page_v1/v2 for flat REQUIRED
+    columns: no levels, no nulls)."""
+
+    def __init__(self, cfg, value_encoding):
+        from ..core.compress import compress_block
+        from ..core.page import _crc32_signed
+        from ..meta.parquet_types import (
+            DataPageHeader,
+            DataPageHeaderV2,
+            PageHeader,
+        )
+
+        self._cfg = cfg
+        self._value_encoding = value_encoding
+        self._compress = compress_block
+        self._crc = _crc32_signed
+        self._PageHeader = PageHeader
+        self._DataPageHeader = DataPageHeader
+        self._DataPageHeaderV2 = DataPageHeaderV2
+        self.parts: list = []
+        self.pos = 0
+        self.uncompressed_total = 0
+        self.n_pages = 0
+
+    def frame(self, raw: bytes, n_values: int) -> None:
+        cfg = self._cfg
+        block = self._compress(raw, cfg.codec)
+        if cfg.data_page_version == 1:
+            header = self._PageHeader(
+                type=0,
+                uncompressed_page_size=len(raw),
+                compressed_page_size=len(block),
+                data_page_header=self._DataPageHeader(
+                    num_values=n_values,
+                    encoding=int(self._value_encoding),
+                    definition_level_encoding=int(Encoding.RLE),
+                    repetition_level_encoding=int(Encoding.RLE),
+                ),
+            )
+        else:
+            header = self._PageHeader(
+                type=3,
+                uncompressed_page_size=len(raw),
+                compressed_page_size=len(block),
+                data_page_header_v2=self._DataPageHeaderV2(
+                    num_values=n_values,
+                    num_nulls=0,
+                    num_rows=n_values,
+                    encoding=int(self._value_encoding),
+                    definition_levels_byte_length=0,
+                    repetition_levels_byte_length=0,
+                    is_compressed=True,
+                ),
+            )
+        if cfg.with_crc:
+            header.crc = self._crc(block)
+        hdr = header.dumps()
+        self.parts.append(hdr)
+        self.parts.append(block)
+        self.pos += len(hdr) + len(block)
+        self.uncompressed_total += len(hdr) + len(raw)
+        self.n_pages += 1
+
+
 def encode_device_column(
     column: Column,
     values,
@@ -2143,22 +2260,18 @@ def encode_device_column(
     batch materializes to parquet through the same sink seam.
 
     `values` is a 1-D int32/int64/float32/float64 jax array (or anything
-    jnp.asarray accepts); the column must be flat REQUIRED (the dense
-    batch shape device pipelines produce — levels stay a host concern).
-    The dictionary decision, index hybrid-encode and bit-pack all run on
-    device; the host frames pages and compresses blocks."""
+    jnp.asarray accepts) — or, for a BYTE_ARRAY column, a `(data, offsets)`
+    pair of device arrays (uint8 payload + n+1 value offsets, the same
+    layout the device read path delivers). The column must be flat REQUIRED
+    (the dense batch shape device pipelines produce — levels stay a host
+    concern). The dictionary decision, index hybrid-encode, bit-pack,
+    DELTA block scans and byte-array framing all run on device; the host
+    frames pages and compresses blocks."""
     import jax.numpy as _jnp
 
     from ..core.column_store import DICT_MAX_UNIQUES
-    from ..core.compress import compress_block
     from ..core.page import encode_dict_page
-    from ..meta.parquet_types import (
-        DataPageHeader,
-        DataPageHeaderV2,
-        PageHeader,
-    )
-    from ..ops.bitpack import bit_width
-    from ..core.page import _crc32_signed
+    from ..core.stats import column_is_unsigned
     from ..sink.encoder import (
         EncodedChunk,
         _ChunkEncodePlan,
@@ -2179,10 +2292,35 @@ def encode_device_column(
             "encode_device_column: write_page_index is host-encoder-only "
             "(use sink.encoder.encode_chunk for indexed chunks)"
         )
+    if column.type == Type.BYTE_ARRAY:
+        if enable_dict:
+            # The host encoder would run its dictionary probe (and dict-
+            # encode when it pays); the device route has no byte-array
+            # uniqueness kernel, so declining here keeps the byte-identity
+            # contract — the writer's typed fallback re-encodes on host.
+            raise ValueError(
+                "encode_device_column: dictionary-eligible BYTE_ARRAY "
+                "columns encode host-side (disable the dictionary for "
+                "this column to engage the device PLAIN route)"
+            )
+        return _encode_device_bytearray(column, values, cfg, kv)
     dev = _jnp.asarray(values)
     if dev.ndim != 1 or dev.dtype.itemsize not in (4, 8):
         raise ValueError(
             "encode_device_column: expected a 1-D 4/8-byte numeric column"
+        )
+    want = {Type.INT32: 4, Type.INT64: 8, Type.FLOAT: 4, Type.DOUBLE: 8}.get(
+        column.type
+    )
+    if want is None or dev.dtype.itemsize != want:
+        # An int64 batch built before jax x64 was enabled arrives as int32:
+        # encoding its 4-byte values into an INT64 chunk would write a
+        # corrupt file. The typed decline routes through the host encoder,
+        # which widens correctly.
+        raise ValueError(
+            f"encode_device_column: {column.path_str} is {column.type!s} "
+            f"but the device array is {dev.dtype} — width mismatch "
+            "(was the array built before jax x64 was enabled?)"
         )
     n = int(dev.shape[0])
     np_dt = np.dtype(dev.dtype.name)
@@ -2204,67 +2342,60 @@ def encode_device_column(
                 )
                 dict_result = (dict_values, None)
                 indices = idx_dev.astype(_jnp.uint32)
+    value_encoding = (
+        Encoding.RLE_DICTIONARY
+        if dict_result is not None
+        else cfg.column_encodings.get(column.path, Encoding.PLAIN)
+    )
+    nbits = np_dt.itemsize * 8
+    delta_route = (
+        dict_result is None
+        and value_encoding == Encoding.DELTA_BINARY_PACKED
+        and column.type in (Type.INT32, Type.INT64)
+        and np_dt.kind in "iu"
+    )
     host_typed = None
-    if dict_result is None:
+    stats_src = None
+    if dict_result is None and not delta_route:
         host_typed = np.asarray(dev).astype(np_dt, copy=False)
-
-    parts: list = []
-    pos = 0
-    uncompressed_total = 0
-
-    def frame_page(raw: bytes, n_values: int) -> None:
-        nonlocal pos, uncompressed_total
-        block = compress_block(raw, cfg.codec)
-        if cfg.data_page_version == 1:
-            header = PageHeader(
-                type=0,
-                uncompressed_page_size=len(raw),
-                compressed_page_size=len(block),
-                data_page_header=DataPageHeader(
-                    num_values=n_values,
-                    encoding=int(value_encoding),
-                    definition_level_encoding=int(Encoding.RLE),
-                    repetition_level_encoding=int(Encoding.RLE),
-                ),
-            )
+        stats_src = host_typed
+    elif delta_route:
+        # DELTA never round-trips the raw column: min/max reduce on device
+        # (in the column's defined order) and a 2-element stats_src yields
+        # the identical Statistics bytes. Bloom is the one consumer that
+        # needs every value — download only when a spec asks for it.
+        udt = _jnp.uint32 if nbits == 32 else _jnp.uint64
+        view = (
+            jax.lax.bitcast_convert_type(dev, udt)
+            if column_is_unsigned(column) and np_dt.kind == "i"
+            else dev
+        )
+        if n:
+            stats_src = np.array(
+                [int(view.min()), int(view.max())],
+                dtype=np.dtype(view.dtype.name),
+            ).view(np_dt)
         else:
-            header = PageHeader(
-                type=3,
-                uncompressed_page_size=len(raw),
-                compressed_page_size=len(block),
-                data_page_header_v2=DataPageHeaderV2(
-                    num_values=n_values,
-                    num_nulls=0,
-                    num_rows=n_values,
-                    encoding=int(value_encoding),
-                    definition_levels_byte_length=0,
-                    repetition_levels_byte_length=0,
-                    is_compressed=True,
-                ),
-            )
-        if cfg.with_crc:
-            header.crc = _crc32_signed(block)
-        hdr = header.dumps()
-        parts.append(hdr)
-        parts.append(block)
-        pos += len(hdr) + len(block)
-        uncompressed_total += len(hdr) + len(raw)
+            stats_src = np.zeros(0, dtype=np_dt)
+        if cfg.bloom_specs.get(column.path) is not None:
+            host_typed = np.asarray(dev).astype(np_dt, copy=False)
 
+    framer = _DevicePageFramer(cfg, value_encoding)
     dict_offset = None
-    n_pages = 0
     if dict_result is not None:
-        value_encoding = Encoding.RLE_DICTIONARY
         header, block = encode_dict_page(
             column, dict_result[0], cfg.codec, cfg.with_crc
         )
         hdr = header.dumps()
-        dict_offset = pos
-        parts.append(hdr)
-        parts.append(block)
-        pos += len(hdr) + len(block)
-        uncompressed_total += len(hdr) + (header.uncompressed_page_size or 0)
+        dict_offset = framer.pos
+        framer.parts.append(hdr)
+        framer.parts.append(block)
+        framer.pos += len(hdr) + len(block)
+        framer.uncompressed_total += len(hdr) + (
+            header.uncompressed_page_size or 0
+        )
         _metrics.inc("pages_written_total", encoding="PLAIN")
-        data_offset = pos
+        data_offset = framer.pos
         width = max(int(len(dict_result[0]) - 1).bit_length(), 1)
         for a, b in _split_starts(n, max(int(cfg.max_page_size // 4), 1)):
             page_idx = indices[a:b]
@@ -2278,22 +2409,44 @@ def encode_device_column(
                 width,
                 lambda p, _pi=page_idx: int(_pi[p]),
             )
-            frame_page(bytes([width]) + stream, b - a)
-            n_pages += 1
+            framer.frame(bytes([width]) + stream, b - a)
+    elif delta_route:
+        data_offset = framer.pos
+        per_page = max(int(cfg.max_page_size // np_dt.itemsize), 1)
+        udt = _jnp.uint32 if nbits == 32 else _jnp.uint64
+        for a, b in _split_starts(n, per_page):
+            page = dev[a:b]
+            pad = _bucket(max(b - a, 1))
+            if pad > b - a:
+                page = _jnp.concatenate(
+                    [page, _jnp.zeros(pad - (b - a), dtype=dev.dtype)]
+                )
+            mins, widths, words = delta_block_encode_device(page, b - a, nbits)
+            first = (
+                int(jax.lax.bitcast_convert_type(dev[a], udt)) if b > a else 0
+            )
+            stream = assemble_delta_device_stream(
+                nbits,
+                b - a,
+                first,
+                np.asarray(mins),
+                np.asarray(widths),
+                memoryview(np.ascontiguousarray(words)).cast("B"),
+            )
+            framer.frame(stream, b - a)
     else:
-        value_encoding = cfg.column_encodings.get(column.path, Encoding.PLAIN)
         if value_encoding != Encoding.PLAIN:
             raise ValueError(
-                "encode_device_column: only PLAIN/dictionary device encodes "
-                f"are supported (column asks for {value_encoding})"
+                "encode_device_column: only PLAIN/dictionary/"
+                "DELTA_BINARY_PACKED device encodes are supported for "
+                f"numeric columns (column asks for {value_encoding})"
             )
-        data_offset = pos
+        data_offset = framer.pos
         per_page = max(int(cfg.max_page_size // np_dt.itemsize), 1)
         for a, b in _split_starts(n, per_page):
-            frame_page(host_typed[a:b].tobytes(), b - a)
-            n_pages += 1
+            framer.frame(host_typed[a:b].tobytes(), b - a)
     _metrics.inc(
-        "pages_written_total", n_pages,
+        "pages_written_total", framer.n_pages,
         encoding=_metrics.encoding_name(value_encoding),
     )
     plan = _ChunkEncodePlan(
@@ -2307,21 +2460,109 @@ def encode_device_column(
         value_encoding=value_encoding,
         page_values=None,
         dict_size=len(dict_result[0]) if dict_result is not None else None,
-        stats_src=dict_result[0] if dict_result is not None else host_typed,
+        stats_src=dict_result[0] if dict_result is not None else stats_src,
     )
     cc, bloom = _chunk_meta(
         cfg,
         _DeviceBuilderShim(column),
         kv,
         plan,
-        uncompressed_total=uncompressed_total,
-        pos=pos,
+        uncompressed_total=framer.uncompressed_total,
+        pos=framer.pos,
         data_offset=data_offset,
         dict_offset=dict_offset,
-        n_pages=n_pages,
+        n_pages=framer.n_pages,
     )
     return EncodedChunk(
-        parts=parts, nbytes=pos, chunk=cc, index=None, bloom=bloom
+        parts=framer.parts, nbytes=framer.pos, chunk=cc, index=None, bloom=bloom
+    )
+
+
+def _encode_device_bytearray(column: Column, values, cfg, kv: dict | None):
+    """BYTE_ARRAY half of encode_device_column: `values` is a
+    `(data, offsets)` device pair; the PLAIN framing — `<4-byte LE length>
+    <bytes>` per value — materializes on device as ONE fused program
+    (plain_bytearray_encode_device), and PLAIN streams concatenate, so the
+    host slices page sub-ranges out of the single framed download instead
+    of looping values. Statistics still scan host-side (lexicographic
+    byte-string min/max has no device formulation worth its dispatch), off
+    the same offsets the page split already needs."""
+    import jax.numpy as _jnp
+
+    from ..sink.encoder import (
+        EncodedChunk,
+        _ChunkEncodePlan,
+        _chunk_meta,
+        _split_starts,
+        _value_width,
+    )
+
+    try:
+        data, offsets = values
+    except (TypeError, ValueError):
+        raise ValueError(
+            "encode_device_column: BYTE_ARRAY columns take a "
+            "(data, offsets) device pair"
+        ) from None
+    value_encoding = cfg.column_encodings.get(column.path, Encoding.PLAIN)
+    if value_encoding != Encoding.PLAIN:
+        raise ValueError(
+            "encode_device_column: only PLAIN device encodes are supported "
+            f"for BYTE_ARRAY columns (column asks for {value_encoding})"
+        )
+    data = _jnp.asarray(data)
+    offsets = _jnp.asarray(offsets)
+    if data.dtype != _jnp.uint8 or data.ndim != 1 or offsets.ndim != 1:
+        raise ValueError(
+            "encode_device_column: BYTE_ARRAY expects 1-D uint8 data and "
+            "1-D integer offsets"
+        )
+    host_off = np.asarray(offsets).astype(np.int64, copy=False)
+    n = int(host_off.shape[0] - 1)
+    total = int(host_off[-1]) if n >= 0 else 0
+    out_pad = _bucket(max(4 * n + total, 1))
+    framed = np.asarray(
+        plain_bytearray_encode_device(
+            _pad_device(data), _pad_device(offsets), n, out_pad
+        )
+    )
+    bad = ByteArrayData(offsets=host_off, data=np.asarray(data))
+    framer = _DevicePageFramer(cfg, value_encoding)
+    data_offset = framer.pos
+    for a, b in _split_starts(n, max(int(cfg.max_page_size // _value_width(bad)), 1)):
+        lo = 4 * a + int(host_off[a])
+        hi = 4 * b + int(host_off[b])
+        framer.frame(framed[lo:hi].tobytes(), b - a)
+    _metrics.inc(
+        "pages_written_total", framer.n_pages,
+        encoding=_metrics.encoding_name(value_encoding),
+    )
+    plan = _ChunkEncodePlan(
+        nv=n,
+        num_entries=n,
+        null_count=0,
+        def_levels=None,
+        rep_levels=None,
+        typed=bad,
+        dict_result=None,
+        value_encoding=value_encoding,
+        page_values=None,
+        dict_size=None,
+        stats_src=bad,
+    )
+    cc, bloom = _chunk_meta(
+        cfg,
+        _DeviceBuilderShim(column),
+        kv,
+        plan,
+        uncompressed_total=framer.uncompressed_total,
+        pos=framer.pos,
+        data_offset=data_offset,
+        dict_offset=None,
+        n_pages=framer.n_pages,
+    )
+    return EncodedChunk(
+        parts=framer.parts, nbytes=framer.pos, chunk=cc, index=None, bloom=bloom
     )
 
 

@@ -388,7 +388,9 @@ def _stream_arrow(planned, session, check, window):
         check.abort.set()
 
 
-def execute_query(planned, query, session, *, deadline=None, window: int = 2):
+def execute_query(
+    planned, query, session, *, deadline=None, window: int = 2, device=None
+):
     """Aggregation push-down over the planned units (POST /v1/query).
 
     Each unit decodes + filters + partially aggregates as one pqt-serve
@@ -398,7 +400,15 @@ def execute_query(planned, query, session, *, deadline=None, window: int = 2):
     request's max_groups. Pure count(*) with no filters never opens a
     file — the footer-promised unit row counts ARE the answer. Returns the
     response body dict; every failure mode is a typed ServeError, and the
-    deadline/abort checks run between units exactly like streamed scans."""
+    deadline/abort checks run between units exactly like streamed scans.
+
+    `device` (ServeConfig(device=...)) attaches an accelerator backend:
+    each unit first tries the device-resident path (serve/query_device —
+    decode into HBM, resident residual mask, one masked reduction per
+    aggregate) and falls back, typed and counted
+    (query_device_units_total{engine=...}), to the host vec engine for any
+    shape outside the device envelope. True means the process-default jax
+    device; a jax.Device pins one."""
     from .aggregate import (
         QueryState,
         query_columns,
@@ -414,6 +424,17 @@ def execute_query(planned, query, session, *, deadline=None, window: int = 2):
     decode = bool(cols) or query.filters is not None
     state = QueryState(query)
     units = planned.units
+    device_unit = None
+    if device is not None and decode:
+        try:
+            from .query_device import DeviceQueryError, device_unit_partial
+
+            device_unit = device_unit_partial
+        except ImportError:
+            # jax-less deployment with device= set: every unit is a host
+            # unit; the counter makes the misconfiguration visible
+            _metrics.inc("query_device_unavailable_total")
+            device_unit = None
     # a streamed scan's window bounds BUFFERED payload; a query's unit
     # results are kilobyte partials, so the lookahead widens to the pool —
     # merge order doesn't matter and idle workers are pure waste
@@ -428,6 +449,23 @@ def execute_query(planned, query, session, *, deadline=None, window: int = 2):
         with unit_clock(), stage("serve.aggregate"):
             reader = _open_reader(session, planned, u)
             try:
+                if device_unit is not None:
+                    try:
+                        part = device_unit(
+                            reader,
+                            u.row_group,
+                            query,
+                            planned.request.filters,
+                            None if device is True else device,
+                        )
+                        _metrics.inc(
+                            "query_device_units_total", engine="device"
+                        )
+                        return part
+                    except DeviceQueryError:
+                        _metrics.inc(
+                            "query_device_units_total", engine="host_fallback"
+                        )
                 t = reader.to_arrow(
                     row_groups=[u.row_group], filters=planned.request.filters
                 )

@@ -124,6 +124,13 @@ class ServeConfig:
     socket_timeout_s: float = 60.0
     shard: tuple | None = None  # this daemon's (index, count) corpus stripe
     source_factory: object = None  # chaos/remote seam: path -> ByteSource
+    # attached accelerator backend for POST /v1/query: True runs query
+    # units device-resident on the process-default jax device, a
+    # jax.Device pins one — decode into HBM, resident residual mask, one
+    # masked reduction per aggregate (serve/query_device). Units outside
+    # the device envelope fall back, typed and counted, to the host vec
+    # engine; None (default) keeps every unit on the host.
+    device: object = None
     # a PRE-BUILT BlockCache/TieredCache (caller-owned, survives close()):
     # how a daemon and co-resident dataset workers pool ONE tier budget.
     # Overrides cache_mb/cache_disk_mb.
@@ -322,6 +329,7 @@ class ScanService:
                 self.session,
                 deadline=deadline,
                 window=self.config.window,
+                device=self.config.device,
             )
         except BaseException:
             ticket.release()

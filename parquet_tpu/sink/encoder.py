@@ -67,6 +67,7 @@ from ..meta.parquet_types import (
     PageLocation,
     PageType,
     RowGroup,
+    Type,
 )
 from ..obs.log import log_event as _log_event
 from ..obs.pool import instrumented_submit
@@ -629,9 +630,27 @@ def _fused_encode_chunk(cfg: EncoderConfig, builder, kv, plan):
             values_buf = np.ascontiguousarray(typed)
             type_size = per_value = typed.itemsize
             values_worst = delta_encode_cap(plan.nv, type_size * 8)
+        elif (
+            enc == Encoding.RLE
+            and column.type == Type.BOOLEAN
+            and isinstance(typed, np.ndarray)
+            and typed.ndim == 1
+        ):
+            # RLE-boolean: width-1 hybrid stream behind a 4-byte prefix.
+            # per_value stays the STAGED path's _value_width (the 1-byte
+            # bool element) so page splits cannot drift; the native walk
+            # reads the values as uint16 like the level packer.
+            route = 4
+            values_buf = np.ascontiguousarray(typed, dtype=np.uint16)
+            type_size = 2
+            per_value = 1
+            pages_est = plan.nv // max(int(cfg.max_page_size), 1) + 2
+            values_worst = (
+                hybrid_encode_cap(plan.nv, 1) + 96 * pages_est
+            )
         else:
-            # BOOLEAN bit-packing, BYTE_STREAM_SPLIT, DELTA_*_BYTE_ARRAY,
-            # RLE-bool and exotic inputs stay on the staged rung
+            # BOOLEAN bit-packing, BYTE_STREAM_SPLIT, DELTA_*_BYTE_ARRAY
+            # and exotic inputs stay on the staged rung
             trace_bump("encode_fused_declined")
             return None
 

@@ -676,6 +676,8 @@ def cmd_profile(args) -> int:
                 ("--host", args.host),
                 ("--cpu", args.cpu),
                 ("--metrics", args.metrics),
+                ("--device", args.device),
+                ("--filter", args.filter),
             )
             if v
         ]
@@ -707,6 +709,17 @@ def cmd_profile(args) -> int:
     if args.write and args.rows:
         print("profile: --write and --rows are mutually exclusive", file=sys.stderr)
         return 2
+    if args.filter and not args.device:
+        print("profile: --filter applies to --device mode", file=sys.stderr)
+        return 2
+    if args.device:
+        if args.host or args.rows or args.write:
+            print(
+                "profile: --device is exclusive with --host/--rows/--write",
+                file=sys.stderr,
+            )
+            return 2
+        return _profile_device_query(args)
     backend = "host" if (args.host or args.rows or args.write) else "tpu_roundtrip"
     cols = args.columns.split(",") if args.columns else None
     snap0 = metrics.snapshot()
@@ -781,6 +794,109 @@ def cmd_profile(args) -> int:
             if fsize
             else f"profile: io {bytes_read:,} B read"
         )
+    if args.metrics:
+        print()
+        print("metrics delta (this profile run):")
+        for k, v in sorted(mdelta.items()):
+            print(f"  {k} = {v}")
+        print()
+        print(metrics.report())
+    return 0
+
+
+def _profile_device_query(args) -> int:
+    """The `profile --device` body: the device QUERY path under the span
+    tracer — filtered device batches (query.mask / query.take lanes) and a
+    per-row-group device partial aggregate (query.aggregate lane). The
+    trace shows where the predicate -> mask -> gather -> reduce pipeline
+    spends its wall time; on CPU jax the lanes are real but the ratios are
+    not accelerator-representative."""
+    from ..core.filter_vec import VecFilterError
+    from ..serve.protocol import parse_query_request
+    from ..serve.query_device import DeviceQueryError, device_unit_partial
+    from ..utils import metrics
+    from ..utils.trace import decode_trace, span
+
+    import numpy as np
+
+    with FileReader(args.file) as r:
+        numeric = next(
+            (
+                leaf
+                for leaf in r.schema.leaves
+                if leaf.max_rep == 0
+                and leaf.type in (Type.INT32, Type.INT64, Type.FLOAT, Type.DOUBLE)
+            ),
+            None,
+        )
+        if args.filter:
+            filt = json.loads(args.filter)
+        elif numeric is not None:
+            # midpoint of the first group: a predicate that actually splits
+            # rows, so the mask/take lanes carry real work
+            chunk = r.read_row_group(0, [numeric.path_str]).get(numeric.path)
+            vals = np.asarray(chunk.values) if chunk is not None else None
+            if vals is not None and len(vals):
+                filt = [[[numeric.path_str, ">=", float(np.median(vals))]]]
+            else:
+                filt = [[[numeric.path_str, "not_null"]]]
+        else:
+            print(
+                "profile: --device needs a numeric column or --filter",
+                file=sys.stderr,
+            )
+            return 2
+        aggs = ["count"]
+        if numeric is not None and numeric.type in (Type.INT32, Type.INT64):
+            aggs.append({"op": "sum", "column": numeric.path_str})
+        q = parse_query_request(
+            json.dumps(
+                {"paths": [args.file], "aggregates": aggs, "filters": filt}
+            ).encode()
+        )
+        cols = args.columns.split(",") if args.columns else None
+        snap0 = metrics.snapshot()
+        scanned = matched = kept = 0
+        agg_engine = "device"
+        with decode_trace() as t:
+            with span("file", {"path": str(args.file), "mode": "device-query"}):
+                try:
+                    for i in range(r.num_row_groups):
+                        _part, n_scan, n_match = device_unit_partial(
+                            r, i, q, filt
+                        )
+                        scanned += n_scan
+                        matched += n_match
+                except DeviceQueryError:
+                    agg_engine = "host (device declined)"
+                try:
+                    for b in r.iter_device_batches(
+                        1 << 15,
+                        columns=cols,
+                        drop_remainder=False,
+                        filters=filt,
+                        filter_rows=True,
+                    ):
+                        first = next(iter(b.values()))
+                        kept += int(first.shape[0])
+                except VecFilterError as e:
+                    print(f"profile: filter declined by every engine: {e}")
+    doc = t.to_chrome_trace()
+    mdelta = metrics.delta(snap0)
+    doc["otherData"]["metrics_delta"] = mdelta
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    print(t.report())
+    print()
+    print(
+        f"profile: device query over {scanned:,} rows -> {matched:,} matched "
+        f"(aggregate lane: {agg_engine}), {kept:,} rows compacted into "
+        f"filtered batches, {len(doc['traceEvents'])} trace events -> "
+        f"{args.out} (load in ui.perfetto.dev or chrome://tracing)"
+    )
+    engaged = mdelta.get('events_total{event="device_filter_engaged"}', 0)
+    declined = mdelta.get('events_total{event="device_filter_declined"}', 0)
+    print(f"profile: mask engine device={engaged} host_fallback={declined}")
     if args.metrics:
         print()
         print("metrics delta (this profile run):")
@@ -1353,6 +1469,20 @@ def main(argv=None) -> int:
         action="store_true",
         help="profile the pure host decode path (no jax) instead of the "
         "device-decode pipeline",
+    )
+    pf.add_argument(
+        "--device",
+        action="store_true",
+        help="profile the device QUERY path instead: filtered device "
+        "batches and per-row-group device partial aggregates — the "
+        "query.mask / query.take / query.aggregate lanes show where the "
+        "predicate -> mask -> gather -> reduce pipeline spends its time",
+    )
+    pf.add_argument(
+        "--filter",
+        help="DNF predicate as JSON for --device mode (e.g. "
+        "'[[[\"id\", \">\", 100]]]'); default: first numeric leaf >= its "
+        "first-group median",
     )
     pf.add_argument(
         "--cpu",

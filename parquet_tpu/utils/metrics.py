@@ -190,6 +190,38 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
                                     profile ("local", "http://host:port")
   io_autotune_latency_ms{profile=}  gauge: the EWMA per-request read
                                     latency behind that verdict
+  events_total{event="device_filter_engaged"|"device_filter_declined"}
+                                    the device row-filter engine ladder,
+                                    one per row-group mask: "engaged" =
+                                    the mask reduced in HBM
+                                    (core/filter_device), "declined" = a
+                                    typed DeviceFilterError re-derived it
+                                    on the host vec engine — output
+                                    identical either way
+  events_total{event="device_write_engaged"|"device_write_declined"}
+                                    the device write ladder, one per
+                                    write_device_column chunk at flush:
+                                    "engaged" = pages encoded by
+                                    encode_device_column, "declined" = a
+                                    typed shape refusal (dict byte
+                                    arrays, BYTE_STREAM_SPLIT, width
+                                    mismatches, ...) re-encoded host-side
+                                    — bytes identical either way
+  events_total{event="dataset_units_row_filtered"}
+                                    dataset units whose delivered batch
+                                    rows were masked by
+                                    ParquetDataset(filter_rows=True)
+  query_device_units_total{engine=} /v1/query row-group units under
+                                    ServeConfig(device=): "device" =
+                                    partial aggregate reduced in HBM
+                                    (serve/query_device), "host_fallback"
+                                    = shape outside the device envelope
+                                    (float sums, group_by, decimals),
+                                    answered by the exact pyarrow host
+                                    path — rendered bytes identical
+  query_device_unavailable_total    units that wanted the device path but
+                                    jax was not importable (device=
+                                    misconfiguration made visible)
 
 Exposition variants: render_prometheus() is the classic text format every
 scraper understands; render_openmetrics() is the content-negotiated
