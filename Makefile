@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-io-write remote-write-smoke bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
@@ -9,8 +9,10 @@
 # sampling profiler's overhead on a decode loop; encode-smoke pins the
 # fused native encoder byte-identical to the staged Python rung;
 # device-smoke pins the device query/write paths byte-identical to the
-# host engines (fast subset of tests/test_device_query.py)
-check: native lint chaos-smoke obs-smoke encode-smoke device-smoke
+# host engines (fast subset of tests/test_device_query.py);
+# remote-write-smoke pins the multipart sink's zero-torn-object contract
+# over real loopback HTTP (fast subset of tests/test_remote_sink.py)
+check: native lint chaos-smoke obs-smoke encode-smoke device-smoke remote-write-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -53,6 +55,18 @@ bench-io: native
 # source bytes before timing); host-only
 bench-io-remote: native
 	python bench.py --io-remote
+
+# remote-WRITE bench: HttpSink's multipart protocol into a writable
+# httpstub at injected RTT 0/5/25 ms, part-size sweep 2/4/8 MiB, every
+# committed object asserted byte-identical before timing; host-only
+bench-io-write: native
+	python bench.py --io-write
+
+# the make-check-sized remote-write gate: a signed FileWriter(url) ->
+# FileReader(url) round trip plus the atomicity pins (no object visible
+# before complete, none after abort) over real loopback HTTP
+remote-write-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_remote_sink.py -q -k 'roundtrip or torn or signed or abort'
 
 # write-path bench: FileWriter vs pyarrow + the pqt-encode parallelism
 # sweep (pool 1/4/8 x 8/16 row groups, byte-identical to serial); host-only

@@ -61,6 +61,15 @@ source bytes before timing. PQT_IO_REMOTE_ROWS (default 200_000) and
 PQT_IO_REMOTE_REPEATS (default 3) size it; PQT_BENCH_IO_REMOTE=0 skips it
 in a full run. The result rides the --json artifact under "io_remote".
 
+`--io-write` benchmarks the remote WRITE path (io.remote_sink) over real
+loopback HTTP: an IO_WRITE_MB payload streams through HttpSink's multipart
+protocol into a writable testing.httpstub at injected RTT 0/5/25 ms,
+sweeping the part size (2/4/8 MiB), with every committed object asserted
+byte-identical to the payload before its time counts. PQT_IO_WRITE_MB
+(default 32) and PQT_IO_WRITE_REPEATS (default 3) size it;
+PQT_BENCH_IO_WRITE=0 skips it in a full run. The result rides the --json
+artifact under "io_write".
+
 `--write` benchmarks the write path: FileWriter vs pyarrow (snappy headline)
 plus the pqt-encode PARALLELISM sweep — pool 1/4/8 x 8/16 row groups on a
 GZIP log-ingest table (PQT_WRITE_ROWS rows, default 400K), every parallel
@@ -1369,6 +1378,97 @@ def _phase_io_remote() -> None:
         )
         + f"; warm tiered {out['warm_vs_fixed_at_max_rtt']:.1f}x fixed "
         f"at {IO_REMOTE_RTTS_MS[-1]:g}ms (zero source bytes)"
+    )
+    _emit(out)
+
+
+# -- the remote-WRITE benchmark (--io-write / phase "io_write") ----------------
+
+IO_WRITE_MB = int(os.environ.get("PQT_IO_WRITE_MB", 32))
+IO_WRITE_RTTS_MS = (0.0, 5.0, 25.0)
+IO_WRITE_PART_MB = (2, 4, 8)
+IO_WRITE_REPEATS = int(os.environ.get("PQT_IO_WRITE_REPEATS", 3))
+
+
+def _phase_io_write() -> None:
+    """Remote write-throughput sweep (`bench.py --io-write` /
+    `make bench-io-write`).
+
+    Streams an IO_WRITE_MB payload through io.remote_sink.HttpSink into a
+    WRITABLE testing.httpstub (real loopback HTTP, multipart initiate ->
+    part PUTs -> complete) at injected RTT 0/5/25 ms, sweeping the
+    multipart part size — the knob that trades request count (each part
+    pays one RTT) against in-flight memory (part_bytes x max_in_flight).
+    Every sample's committed object is asserted BYTE-IDENTICAL to the
+    payload before its time counts: a fast write of wrong bytes is not a
+    result. Host-only; rides the --json artifact as "io_write"."""
+    from parquet_tpu.io.remote_sink import HttpSink
+    from parquet_tpu.testing.httpstub import RangeHttpStub
+    from parquet_tpu.utils import metrics
+
+    data = (
+        np.random.default_rng(23)
+        .integers(0, 256, IO_WRITE_MB << 20, dtype=np.uint8)
+        .tobytes()
+    )
+    chunk = 1 << 20  # writer-shaped: row groups arrive in ~MiB runs
+    out = {
+        "config": "io_write",
+        "file_mb": IO_WRITE_MB,
+        "stat": "median",
+        "repeats": IO_WRITE_REPEATS,
+        "part_mb_sweep": list(IO_WRITE_PART_MB),
+    }
+    sweep = {}
+    for rtt_ms in IO_WRITE_RTTS_MS:
+        with RangeHttpStub(
+            writable=True, latency_s=rtt_ms / 1e3
+        ) as stub:
+            url = stub.url_for("bench.bin")
+            per_part = {}
+            for part_mb in IO_WRITE_PART_MB:
+
+                def one_write():
+                    with HttpSink(url, part_bytes=part_mb << 20) as s:
+                        for i in range(0, len(data), chunk):
+                            s.write(data[i : i + chunk])
+
+                s0 = metrics.snapshot()
+                t = timed_stats(
+                    one_write,
+                    IO_WRITE_REPEATS,
+                    f"io-write rtt={rtt_ms:g}ms part={part_mb}MiB",
+                    rows=IO_WRITE_MB,
+                )
+                d = metrics.delta(s0)
+                assert stub.object_bytes("bench.bin") == data, (
+                    "committed object differs from the written payload"
+                )
+                per_part[f"{part_mb}"] = {
+                    "t": t["t"],
+                    "mb_s": round(len(data) / 1e6 / t["t"], 1),
+                    "put_requests": sum(
+                        v
+                        for k, v in d.items()
+                        if k.startswith("io_put_requests_total")
+                    )
+                    // IO_WRITE_REPEATS,
+                }
+            best = max(per_part, key=lambda k: per_part[k]["mb_s"])
+            sweep[f"{rtt_ms:g}"] = {
+                "parts": per_part,
+                "best_part_mb": int(best),
+                "mb_s": per_part[best]["mb_s"],
+            }
+    out["rtt_sweep"] = sweep
+    out["mb_s_at_max_rtt"] = sweep[f"{IO_WRITE_RTTS_MS[-1]:g}"]["mb_s"]
+    log(
+        "bench: io-write @"
+        + ", ".join(
+            f"{k}ms {v['mb_s']:.0f} MB/s (best part {v['best_part_mb']}MiB)"
+            for k, v in sweep.items()
+        )
+        + "; every committed object verified byte-identical"
     )
     _emit(out)
 
@@ -2709,6 +2809,18 @@ def main() -> None:
                 f"{r_io_remote['warm_vs_fixed_at_max_rtt']:.1f}x"
             )
 
+    # remote-WRITE sweep (PQT_BENCH_IO_WRITE=0 to skip): multipart HttpSink
+    # into a writable httpstub at 0/5/25ms RTT, part-size sweep, every
+    # committed object byte-verified
+    r_io_write = None
+    if os.environ.get("PQT_BENCH_IO_WRITE", "1") != "0":
+        r_io_write = _run_phase("io_write")
+        if r_io_write:
+            log(
+                f"bench: io-write {r_io_write['mb_s_at_max_rtt']:.0f} MB/s "
+                f"at {IO_WRITE_RTTS_MS[-1]:g}ms RTT"
+            )
+
     # chaos sweep (PQT_BENCH_CHAOS=0 to skip): the scripted fault schedule
     # against the SLO-controlled pipeline, breaker fast-fail, serve brownout
     r_chaos = None
@@ -2833,6 +2945,8 @@ def main() -> None:
         artifact["io"] = r_io
     if r_io_remote:
         artifact["io_remote"] = r_io_remote
+    if r_io_write:
+        artifact["io_write"] = r_io_write
     if r_serve:
         artifact["serve"] = r_serve
     if r_query:
@@ -3297,6 +3411,8 @@ if __name__ == "__main__":
         _phase_io()
     elif argv and argv[0] == "--io-remote":
         _phase_io_remote()
+    elif argv and argv[0] == "--io-write":
+        _phase_io_write()
     elif argv and argv[0] == "--write":
         _phase_write()
     elif argv and argv[0] == "--encode":
@@ -3327,6 +3443,8 @@ if __name__ == "__main__":
             _phase_io()
         elif name == "io_remote":
             _phase_io_remote()
+        elif name == "io_write":
+            _phase_io_write()
         elif name == "serve":
             _phase_serve()
         elif name == "query":

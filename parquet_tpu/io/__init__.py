@@ -2,7 +2,9 @@
 
 The IO seam under the decode stack: ByteSource implementations (lock-free
 local pread, in-memory, HTTP(S) range-GET remote sources with presigned-
-URL object-store variants, retrying/breaker/hedged wrappers), a planner
+URL object-store variants, retrying/breaker/hedged wrappers), remote
+ByteSinks (atomic multipart object-store writes) with SigV4-style request
+signing applied symmetrically to reads and writes, a planner
 that derives the exact byte ranges a projected read needs from the footer
 and coalesces them into batched reads, a bounded pqt-io readahead
 scheduler, byte-budgeted block + footer caches with a RAM -> local-disk
@@ -35,6 +37,14 @@ from .remote import (  # noqa: F401
     HttpSource,
     ObjectStoreSource,
     TransientSourceError,
+)
+from .remote_sink import HttpSink, ObjectStoreSink  # noqa: F401
+from .sign import (  # noqa: F401
+    SigV4Signer,
+    clear_signers,
+    configure_signer,
+    signer_for,
+    verify_request,
 )
 from .source import (  # noqa: F401
     ByteSource,
@@ -78,6 +88,13 @@ __all__ = [
     "HttpSource",
     "ObjectStoreSource",
     "TransientSourceError",
+    "HttpSink",
+    "ObjectStoreSink",
+    "SigV4Signer",
+    "configure_signer",
+    "signer_for",
+    "clear_signers",
+    "verify_request",
     "TieredCache",
     "IOParams",
     "IOTuner",

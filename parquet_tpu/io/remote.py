@@ -62,6 +62,7 @@ __all__ = [
     "ObjectStoreSource",
     "TransientSourceError",
     "host_pool",
+    "pooled_roundtrip",
 ]
 
 _MAX_HOST_POOLS = 64
@@ -164,6 +165,55 @@ def _status_error(status: int, reason: str, context: str):
     return SourceError(msg, code=f"http_{status}")
 
 
+def pooled_roundtrip(
+    pool: _HostPool,
+    method: str,
+    target: str,
+    headers: dict,
+    *,
+    body: bytes | None = None,
+    timeout_s: float = 20.0,
+    counter: str = "io_http_requests_total",
+):
+    """One request on a pooled connection — the shared transport core of
+    HttpSource reads AND remote_sink's PUT path. Returns (status, reason,
+    headers, body); transport-level failures discard the connection and
+    surface as TransientSourceError(code="transport").
+
+    A transport fault on a REUSED connection gets one silent retry on a
+    fresh socket first: a parked keep-alive the server idle-closed says
+    nothing about source health, and every mainstream HTTP client absorbs
+    that shape rather than failing the call (with the default all-off
+    resilience policy there is no ladder above to catch it). The retry
+    resends `body` verbatim — every caller's requests are idempotent
+    (range GET, part PUT, complete-by-manifest)."""
+    for attempt in (0, 1):
+        conn, reused = pool.acquire(timeout_s)
+        try:
+            conn.request(method, target, body=body, headers=headers)
+            resp = conn.getresponse()
+            # the body MUST drain fully before the connection can be
+            # reused; HEAD bodies are empty by contract
+            resp_body = resp.read()
+        except (http.client.HTTPException, OSError, EOFError) as e:
+            pool.discard(conn)
+            if isinstance(e, (SourceError, TransientSourceError)):
+                raise
+            if reused and attempt == 0:
+                continue  # stale keep-alive: once more, fresh socket
+            raise TransientSourceError(
+                f"http transport fault on {pool.host}:{pool.port}: "
+                f"{type(e).__name__}: {e}",
+                code="transport",
+            ) from e
+        _metrics.inc(counter, status=str(resp.status))
+        if resp.will_close:
+            pool.discard(conn)
+        else:
+            pool.release(conn)
+        return resp.status, resp.reason, resp.headers, resp_body
+
+
 class HttpSource(ByteSource):
     """Range-GET ByteSource over one HTTP(S) URL (see module docstring).
 
@@ -181,6 +231,7 @@ class HttpSource(ByteSource):
         headers: dict | None = None,
         size: int | None = None,
         etag: str | None = None,
+        signer=None,
     ):
         split = urlsplit(url)
         if split.scheme not in ("http", "https"):
@@ -198,6 +249,13 @@ class HttpSource(ByteSource):
         path = split.path or "/"
         self._target = f"{path}?{split.query}" if split.query else path
         self._pool = host_pool(self._scheme, self._host, self._port)
+        if signer is None:
+            # the registry seam: open_source("https://...") picks up header
+            # signing with zero per-callsite wiring (resolved ONCE, here)
+            from .sign import signer_for
+
+            signer = signer_for(url)
+        self._signer = signer
         if size is None:
             self._size, self._etag = self._stat()
         else:
@@ -232,44 +290,17 @@ class HttpSource(ByteSource):
     # -- one HTTP round trip ---------------------------------------------------
 
     def _request(self, method: str, extra_headers: dict | None = None):
-        """One request on a pooled connection. Returns (status, reason,
-        headers, body); transport-level failures discard the connection
-        and surface as TransientSourceError.
-
-        A transport fault on a REUSED connection gets one silent retry on
-        a fresh socket first: a parked keep-alive the server idle-closed
-        says nothing about source health, and every mainstream HTTP
-        client absorbs that shape for idempotent requests rather than
-        failing the read (with the default all-off resilience policy
-        there is no ladder above to catch it)."""
+        """One request on a pooled connection (see pooled_roundtrip, which
+        holds the transport-fault semantics): merges the instance headers,
+        applies the header-auth signer when one is bound."""
         hdrs = dict(self.headers)
         if extra_headers:
             hdrs.update(extra_headers)
-        for attempt in (0, 1):
-            conn, reused = self._pool.acquire(self.timeout_s)
-            try:
-                conn.request(method, self._target, headers=hdrs)
-                resp = conn.getresponse()
-                # the body MUST drain fully before the connection can be
-                # reused; HEAD bodies are empty by contract
-                body = resp.read()
-            except (http.client.HTTPException, OSError, EOFError) as e:
-                self._pool.discard(conn)
-                if isinstance(e, (SourceError, TransientSourceError)):
-                    raise
-                if reused and attempt == 0:
-                    continue  # stale keep-alive: once more, fresh socket
-                raise TransientSourceError(
-                    f"http transport fault on {self._host}:{self._port}: "
-                    f"{type(e).__name__}: {e}",
-                    code="transport",
-                ) from e
-            _metrics.inc("io_http_requests_total", status=str(resp.status))
-            if resp.will_close:
-                self._pool.discard(conn)
-            else:
-                self._pool.release(conn)
-            return resp.status, resp.reason, resp.headers, body
+        if self._signer is not None:
+            hdrs.update(self._signer.headers(method, self.url, b""))
+        return pooled_roundtrip(
+            self._pool, method, self._target, hdrs, timeout_s=self.timeout_s
+        )
 
     def _stat(self) -> tuple:
         """Learn (size, etag) via HEAD, falling back to a 1-byte range GET
@@ -332,10 +363,16 @@ class HttpSource(ByteSource):
                 f"[{offset}, {offset + n}) > {self._size}"
             )
         context = f"GET {self.url} [{offset}, {offset + n})"
+        hdrs = {"Range": f"bytes={offset}-{offset + n - 1}"}
+        if self._etag:
+            # mid-scan revalidation: a server seeing a stale validator
+            # answers 200 + the CURRENT full body instead of a 206 slice
+            # of bytes that no longer exist — the 200 path below then
+            # surfaces the rewrite as a typed source_changed rather than
+            # silently mis-slicing the new generation
+            hdrs["If-Range"] = self._etag
         t0 = time.perf_counter()
-        status, reason, headers, body = self._request(
-            "GET", {"Range": f"bytes={offset}-{offset + n - 1}"}
-        )
+        status, reason, headers, body = self._request("GET", hdrs)
         dt = time.perf_counter() - t0
         if status == 206:
             self._validate_generation(headers, context)
@@ -350,9 +387,20 @@ class HttpSource(ByteSource):
             self._observe(n, dt)
             return body
         if status == 200:
-            # a server that ignores Range ships the whole object; honest
-            # accounting bills the FULL transfer
+            # a server that ignores Range — or one whose If-Range check
+            # failed — ships the whole CURRENT object; honest accounting
+            # bills the full transfer
             self._validate_generation(headers, context)
+            declared = headers.get("Content-Length")
+            if declared is not None and declared.isdigit() and (
+                int(declared) != self._size
+            ):
+                # an ETag-less server can only betray a rewrite by length
+                raise SourceError(
+                    f"{context}: object changed "
+                    f"(size {self._size} -> {declared})",
+                    code="source_changed",
+                )
             if len(body) < offset + n:
                 raise TransientSourceError(
                     f"{context}: truncated body "

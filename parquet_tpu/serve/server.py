@@ -124,6 +124,10 @@ class ServeConfig:
     socket_timeout_s: float = 60.0
     shard: tuple | None = None  # this daemon's (index, count) corpus stripe
     source_factory: object = None  # chaos/remote seam: path -> ByteSource
+    # {path prefix -> object-store base URL}: requested paths under a
+    # mapped prefix resolve to URLs and read through the shared block/
+    # footer caches; everything else stays root-confined (escapes 403)
+    remote_map: dict | None = None
     # attached accelerator backend for POST /v1/query: True runs query
     # units device-resident on the process-default jax device, a
     # jax.Device pins one — decode into HBM, resident residual mask, one
@@ -213,6 +217,7 @@ class ScanService:
             source_factory=config.source_factory,
             shard=config.shard,
             coalesce_gap="auto" if config.io_autotune else None,
+            remote_map=config.remote_map,
         )
         self.admission = AdmissionController(
             max_inflight=config.max_inflight,

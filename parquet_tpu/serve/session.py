@@ -94,8 +94,18 @@ class ScanSession:
         source_factory=None,
         shard: tuple | None = None,
         coalesce_gap=None,
+        remote_map: dict | None = None,
     ):
         self.root = os.path.realpath(os.fspath(root)) if root is not None else None
+        # {path prefix -> object-store base URL}: requested paths under a
+        # mapped prefix resolve to URLs (longest prefix wins) and flow
+        # through the ordinary URL read path — shared TieredCache, footer
+        # cache, resilience policy — while everything else stays
+        # root-confined exactly as before
+        self.remote_map = {
+            prefix.strip("/"): url.rstrip("/")
+            for prefix, url in (remote_map or {}).items()
+        }
         self.footer_cache = footer_cache if footer_cache is not None else FooterCache()
         self.block_cache = block_cache
         # source_factory(path) -> ByteSource: the chaos/remote seam — when
@@ -110,13 +120,35 @@ class ScanSession:
 
     # -- path confinement ------------------------------------------------------
 
+    def _map_remote(self, p: str):
+        """Resolve `p` to an object-store URL when it sits under a mapped
+        prefix (longest prefix wins), else None. The path is normpath-
+        collapsed FIRST, so `remote/../../etc` cannot ride a mapping out
+        of its prefix — a collapsed path that no longer starts with the
+        prefix simply falls through to local handling (and its 403)."""
+        if not self.remote_map or os.path.isabs(p):
+            return None
+        norm = os.path.normpath(p).replace(os.sep, "/")
+        for prefix in sorted(self.remote_map, key=len, reverse=True):
+            if norm == prefix or norm.startswith(prefix + "/"):
+                rest = norm[len(prefix):].lstrip("/")
+                base = self.remote_map[prefix]
+                return f"{base}/{rest}" if rest else base
+        return None
+
     def resolve_paths(self, paths: list) -> list:
         """Expand the request's paths/globs into a concrete file list,
-        confined to the session root when one is set. Relative paths are
-        rooted there; anything resolving outside it (.. tricks, absolute
-        paths, symlink escapes) is refused with a typed 403."""
+        confined to the session root when one is set. Paths under a
+        remote_map prefix resolve to object-store URLs instead; relative
+        paths are rooted at the session root; anything resolving outside
+        it (.. tricks, absolute paths, symlink escapes) is refused with a
+        typed 403."""
         specs = []
         for p in paths:
+            mapped = self._map_remote(p)
+            if mapped is not None:
+                specs.append(mapped)
+                continue
             if self.root is not None and not os.path.isabs(p):
                 p = os.path.join(self.root, p)
             if self.root is not None:
@@ -140,6 +172,8 @@ class ScanSession:
         files = sorted(set(files))
         if self.root is not None:
             for f in files:
+                if f.startswith(("http://", "https://")):
+                    continue  # mapped object-store URLs are not root paths
                 real = os.path.realpath(f)
                 if not (real == self.root or real.startswith(self.root + os.sep)):
                     raise ServeError(

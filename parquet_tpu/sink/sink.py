@@ -56,9 +56,16 @@ __all__ = [
 
 class SinkError(OSError):
     """Terminal IO failure of a byte sink: the write/flush/commit is not
-    satisfiable (sink closed or aborted, rename failed). An OSError
-    subclass so callers treating IO failures generically need no new
-    clause; FileWriter re-raises sink failures as typed WriterError."""
+    satisfiable (sink closed or aborted, rename failed, remote store
+    refused). An OSError subclass so callers treating IO failures
+    generically need no new clause; FileWriter re-raises sink failures as
+    typed WriterError. `code` names the failure shape ("http_403",
+    "put_retry_exhausted", "breaker_open", "sink_closed") for tests and
+    error routing, mirroring SourceError."""
+
+    def __init__(self, *args, code: str | None = None):
+        super().__init__(*args)
+        self.code = code
 
 
 def _count_write(nbytes: int) -> None:
@@ -333,11 +340,21 @@ def open_sink(obj) -> tuple[ByteSink, bool]:
 
       str / Path           -> LocalFileSink        (owned: writer commits
                                                     atomically at close)
+      http(s):// URL       -> io.remote_sink.HttpSink  (owned: multipart
+                                                    commit at close, same
+                                                    atomicity contract)
       ByteSink             -> passed through       (caller keeps lifetime)
       writable file-like   -> FileObjectSink       (caller keeps lifetime)
     """
     if isinstance(obj, ByteSink):
         return obj, False
+    if isinstance(obj, str) and obj.startswith(("http://", "https://")):
+        # the write-side twin of open_source's URL coercion: remote
+        # writes inherit signing (io.sign registry) and the resilience
+        # policy's breaker with zero per-callsite wiring
+        from ..io.remote_sink import HttpSink
+
+        return HttpSink(obj), True
     if isinstance(obj, (str, Path)):
         return LocalFileSink(obj), True
     if (

@@ -398,7 +398,11 @@ def cmd_merge(args) -> int:
             "existing output now requires --force in both forms)",
             file=sys.stderr,
         )
-    if os.path.exists(out) and not args.force:
+    is_url = out.startswith(("http://", "https://"))
+    if not is_url and os.path.exists(out) and not args.force:
+        # URL outputs skip the existence probe: multipart commit replaces
+        # the object atomically (last-commit-wins, like --force locally),
+        # and a HEAD here would need credentials the sink already owns
         raise ValueError(
             f"merge: output {out!r} already exists (pass --force to overwrite)"
         )
@@ -1187,10 +1191,21 @@ def cmd_serve(args) -> int:
     # the daemon is the one place the LIBRARY's silent-by-default logging
     # opts in: structured JSON lines on stderr, request ids injected
     configure_logging()
+    remote_map = {}
+    for spec in args.remote_map or ():
+        prefix, sep, url = spec.partition("=")
+        if not sep or not prefix or not url.startswith(("http://", "https://")):
+            print(
+                f"error: --remote-map {spec!r}: expected PREFIX=http(s)://...",
+                file=sys.stderr,
+            )
+            return 2
+        remote_map[prefix] = url
     config = ServeConfig(
         host=args.host,
         port=args.port,
         root=args.root,
+        remote_map=remote_map or None,
         cache_mb=args.cache_mb,
         cache_disk_mb=args.cache_disk_mb,
         cache_dir=args.cache_dir,
@@ -1717,6 +1732,16 @@ def main(argv=None) -> int:
         "--shard",
         help="this daemon's corpus stripe as 'i/n' — run n daemons with "
         "i=0..n-1 over the same files to split one logical corpus",
+    )
+    pe.add_argument(
+        "--remote-map",
+        action="append",
+        metavar="PREFIX=URL",
+        help="map requested paths under PREFIX to an object-store base "
+        "URL (repeatable; longest prefix wins) — e.g. "
+        "--remote-map warm=https://store/bucket; mapped reads flow "
+        "through the shared cache tiers, everything else stays "
+        "root-confined",
     )
     pe.add_argument(
         "--verbose", action="store_true", help="log every request line"

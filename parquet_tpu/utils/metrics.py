@@ -167,6 +167,26 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   io_resigns_total                  presigned-URL refreshes by
                                     ObjectStoreSource (proactive expiry
                                     refresh + reactive 401/403 re-signs)
+  io_put_requests_total{status=}    HTTP round trips issued by remote
+                                    SINKS (io.remote_sink: part PUTs,
+                                    initiate/complete/abort), per status
+  io_put_bytes_total                payload bytes acknowledged by the
+                                    remote store (CRC-verified parts +
+                                    single-shot PUTs — retries of a part
+                                    count its bytes once)
+  io_put_retries_total{reason=}     per-part/commit retry ladder steps,
+                                    by fault shape ("http_503",
+                                    "transport", "part_etag_mismatch")
+  io_sign_requests_total{method=}   requests signed by the SigV4-style
+                                    header signer (io.sign), per HTTP
+                                    method — symmetric GET/PUT auth
+  sink_multipart_initiated_total    multipart uploads initiated by
+                                    HttpSink; _parts_total counts
+                                    acknowledged part PUTs,
+                                    _completed_total commits (the object
+                                    became visible), _aborted_total
+                                    abort-upload teardowns (nothing
+                                    became visible)
   cache_tier_hits_total{tier=}      tiered-cache hits per tier (ram /
                                     disk); cache_tier_misses_total
                                     counts full misses (both tiers)
@@ -372,6 +392,15 @@ _HELP = {
         "pooled HTTP connections: new sockets vs reused checkouts"
     ),
     "io_resigns_total": "presigned-URL refreshes by ObjectStoreSource",
+    # remote writes + request signing (PR 17)
+    "io_put_requests_total": "HTTP round trips by remote sinks, per status",
+    "io_put_bytes_total": "payload bytes acknowledged by the remote store",
+    "io_put_retries_total": "remote-write retry ladder steps, per fault shape",
+    "io_sign_requests_total": "requests header-signed by io.sign, per method",
+    "sink_multipart_initiated_total": "multipart uploads initiated",
+    "sink_multipart_parts_total": "multipart part PUTs acknowledged",
+    "sink_multipart_completed_total": "multipart uploads committed",
+    "sink_multipart_aborted_total": "multipart uploads aborted (torn-free)",
     "cache_tier_hits_total": "tiered-cache hits, per tier (ram/disk)",
     "cache_tier_misses_total": "tiered-cache full misses (both tiers)",
     "cache_tier_evictions_total": "tiered-cache blocks evicted, per tier",

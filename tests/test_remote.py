@@ -231,6 +231,34 @@ class TestHttpSource:
                 src.read_at(0, 64)
             assert ei.value.code == "source_changed"
 
+    def test_if_range_downgrade_mid_scan_is_source_changed(self, blob):
+        # PR 17: reads of a pinned-ETag source carry If-Range, so a server
+        # whose object was rewritten MID-SCAN answers 200 + the full NEW
+        # body instead of slicing stale-vs-new ranges together — and the
+        # read surfaces as typed source_changed, never as mixed bytes
+        with RangeHttpStub(files={"a.bin": blob}) as stub:
+            src = HttpSource(stub.url_for("a.bin"))
+            assert src.read_at(0, 64) == blob[:64]  # scan under way
+            stub.set_file("a.bin", bytes(reversed(blob)))
+            with pytest.raises(SourceError) as ei:
+                src.read_at(64, 64)
+            assert ei.value.code == "source_changed"
+
+    def test_etag_less_rewrite_betrayed_by_content_length(self, blob):
+        # a validator-less server (no ETag) that also ignores Range: the
+        # only rewrite signal left is the 200's Content-Length vs the
+        # pinned size — a size-changing rewrite must still be typed, not
+        # silently sliced out of the wrong generation
+        with RangeHttpStub(
+            files={"a.bin": blob}, send_etag=False, ignore_range=True
+        ) as stub:
+            src = HttpSource(stub.url_for("a.bin"))
+            assert src.read_at(0, 64) == blob[:64]
+            stub.set_file("a.bin", blob[: len(blob) // 2])
+            with pytest.raises(SourceError) as ei:
+                src.read_at(0, 64)
+            assert ei.value.code == "source_changed"
+
     def test_head_less_server_stat_fallback(self, blob):
         with RangeHttpStub(
             files={"a.bin": blob}, reject_head=True

@@ -1050,6 +1050,77 @@ class TestServeCLI:
         assert rc == 1 and "not both" in err
 
 
+class TestRemoteMap:
+    """PR 17: `--remote-map prefix=URL` — requested paths under a mapped
+    prefix resolve to object-store URLs and scan through the ordinary
+    remote read path, while everything else keeps the root confinement
+    (escapes through a mapping still die with the typed 403)."""
+
+    def test_mapped_prefix_plans_and_scans_from_the_stub(self, corpus):
+        from parquet_tpu.testing.httpstub import RangeHttpStub
+
+        data = (corpus / "a.parquet").read_bytes()
+        with RangeHttpStub(files={"a.parquet": data}) as stub:
+            with ScanServer(
+                ServeConfig(
+                    port=0,
+                    root=str(corpus),
+                    remote_map={"warm": stub.base_url},
+                )
+            ) as server:
+                server.start_background()
+                status, _, body = _request(
+                    server,
+                    "POST",
+                    "/v1/plan",
+                    {"paths": "warm/a.parquet"},
+                )
+                assert status == 200, body
+                assert json.loads(body)["rows"] == ROWS_A
+                status, _, body = _scan(
+                    server, {"paths": "warm/a.parquet", "columns": ["id"]}
+                )
+                assert status == 200, body
+                assert body == _expected_jsonl(
+                    corpus, ["a.parquet"], columns=["id"]
+                )
+                assert stub.requests > 0  # the bytes really came remotely
+                # local paths still work side by side with the mapping
+                status, _, _ = _scan(server, {"paths": "b.parquet", "limit": 1})
+                assert status == 200
+
+    def test_escape_through_a_mapping_is_typed_403(self, corpus):
+        from parquet_tpu.testing.httpstub import RangeHttpStub
+
+        with RangeHttpStub(files={"x": b"irrelevant"}) as stub:
+            with ScanServer(
+                ServeConfig(
+                    port=0,
+                    root=str(corpus),
+                    remote_map={"warm": stub.base_url},
+                )
+            ) as server:
+                server.start_background()
+                status, _, body = _scan(
+                    server, {"paths": "warm/../../../etc/passwd"}
+                )
+                assert status == 403
+                assert _error_code(body) == "path_outside_root"
+
+    def test_cli_rejects_malformed_remote_map_spec(self, capsys):
+        from parquet_tpu.tools.parquet_tool import main as tool_main
+
+        rc = tool_main(
+            ["serve", "--port", "0", "--remote-map", "no-equals-here"]
+        )
+        assert rc == 2
+        assert "remote-map" in capsys.readouterr().err
+        rc = tool_main(
+            ["serve", "--port", "0", "--remote-map", "p=ftp://nope"]
+        )
+        assert rc == 2
+
+
 class TestRequestHygiene:
     """Connection-level contracts: bounded body buffering, keep-alive
     integrity after typed errors, and config validation at startup."""
