@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-io-write remote-write-smoke bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-io-write remote-write-smoke bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke fleet-smoke profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
@@ -11,8 +11,11 @@
 # device-smoke pins the device query/write paths byte-identical to the
 # host engines (fast subset of tests/test_device_query.py);
 # remote-write-smoke pins the multipart sink's zero-torn-object contract
-# over real loopback HTTP (fast subset of tests/test_remote_sink.py)
-check: native lint chaos-smoke obs-smoke encode-smoke device-smoke remote-write-smoke
+# over real loopback HTTP (fast subset of tests/test_remote_sink.py);
+# fleet-smoke pins the mesh telemetry plane (fast subset of
+# tests/test_mesh.py): two in-process daemons -> federated /metrics
+# scrape (counters summed exactly) -> cross-process trace-merge round trip
+check: native lint chaos-smoke obs-smoke encode-smoke device-smoke remote-write-smoke fleet-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -148,6 +151,13 @@ bench-trend:
 obs-smoke: native
 	python bench.py --trend > /dev/null
 	JAX_PLATFORMS=cpu python -m pytest tests/test_prof.py -q -k overhead
+
+# the make-check-sized mesh-telemetry gate: two in-process daemons, a
+# federated /metrics scrape whose merged counters equal the arithmetic
+# sum of the replica scrapes, and a client trace-id ridden through two
+# daemons' remote GETs then stitched by `parquet-tool trace-merge`
+fleet-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_mesh.py -q -k 'fleet_smoke or round_trip or Exactness'
 
 # live-profile a RUNNING daemon (flamegraph-compatible collapsed stacks,
 # lane-attributed to the pqt-* pools): make profile-live URL=host:port

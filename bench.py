@@ -1631,6 +1631,14 @@ def _phase_serve() -> None:
                 f"p99 {sweep[str(conc)]['p99_ms']} ms"
             )
     out["concurrency_sweep"] = sweep
+    # headline latency/throughput at the widest sweep level, hoisted to a
+    # stable dotted path (serve.p99_ms / serve.rps) so the trend store and
+    # the --compare gate track serve latency regressions like throughput —
+    # independent of which concurrency levels the sweep happens to run
+    top = sweep[max(sweep, key=int)]
+    out["p99_ms"] = top["p99_ms"]
+    out["p50_ms"] = top["p50_ms"]
+    out["rps"] = top["rps"]
     out["plan_cold_ms"] = round(cold_ms, 3)
     out["plan_warm_ms"] = round(warm_ms, 3)
     out["plan_cold_vs_warm"] = round(cold_ms / warm_ms, 2) if warm_ms else None

@@ -56,6 +56,7 @@ from dataclasses import dataclass, field
 
 from ..obs.log import log_event as _log_event
 from ..utils import metrics as _metrics
+from ..utils import trace as _trace
 from .source import ByteSource, RetryingSource, SourceError
 
 __all__ = [
@@ -215,6 +216,9 @@ class HedgedSource(ByteSource):
         )
         self.hedges_launched += 1
         _metrics.inc("io_hedges_total", outcome="launched")
+        # per-request attribution beside the process-wide counter: the
+        # hedge launch is visible in this request's merged trace
+        _trace.count("io.hedge")
         _log_event(
             "hedged_read", delay_ms=round(delay * 1e3, 3), offset=offset,
             nbytes=n, source=self.inner.source_id,

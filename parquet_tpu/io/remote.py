@@ -53,8 +53,10 @@ import threading
 import time
 from urllib.parse import urlsplit
 
+from ..obs import propagate as _propagate
 from ..obs.log import log_event as _log_event
 from ..utils import metrics as _metrics
+from ..utils import trace as _trace
 from .source import ByteSource, SourceError, _count_read
 
 __all__ = [
@@ -298,6 +300,11 @@ class HttpSource(ByteSource):
             hdrs.update(extra_headers)
         if self._signer is not None:
             hdrs.update(self._signer.headers(method, self.url, b""))
+        tp = _propagate.outbound_traceparent("get")
+        if tp is not None:
+            # every call gets its own child span-id under the request's
+            # trace — a store-side access log lines up per attempt
+            hdrs["traceparent"] = tp
         return pooled_roundtrip(
             self._pool, method, self._target, hdrs, timeout_s=self.timeout_s
         )
@@ -371,9 +378,15 @@ class HttpSource(ByteSource):
             # surfaces the rewrite as a typed source_changed rather than
             # silently mis-slicing the new generation
             hdrs["If-Range"] = self._etag
-        t0 = time.perf_counter()
-        status, reason, headers, body = self._request("GET", hdrs)
-        dt = time.perf_counter() - t0
+        # remote.get rides the request's DecodeTrace as a child span; the
+        # args dict is committed by reference, so the status lands on the
+        # span once the response is in
+        span_args = {"offset": offset, "nbytes": n}
+        with _trace.span("remote.get", args=span_args):
+            t0 = time.perf_counter()
+            status, reason, headers, body = self._request("GET", hdrs)
+            dt = time.perf_counter() - t0
+            span_args["status"] = status
         if status == 206:
             self._validate_generation(headers, context)
             if len(body) != n:

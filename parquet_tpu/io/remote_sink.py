@@ -66,9 +66,11 @@ import time
 import zlib
 from urllib.parse import urlsplit
 
+from ..obs import propagate as _propagate
 from ..obs.log import log_event as _log_event
 from ..sink.sink import ByteSink, SinkError, _count_write
 from ..utils import metrics as _metrics
+from ..utils import trace as _trace
 from .remote import (
     TransientSourceError,
     _default_port,
@@ -240,16 +242,24 @@ class HttpSink(ByteSink):
             hdrs = dict(self.headers)
             if self._signer is not None:
                 hdrs.update(self._signer.headers(method, url, body or b""))
+            tp = _propagate.outbound_traceparent("put")
+            if tp is not None:
+                # fresh child span-id per ATTEMPT: a retried part is two
+                # distinct spans in the store's access log, one trace-id
+                hdrs["traceparent"] = tp
             try:
-                status, reason_s, resp_headers, resp_body = pooled_roundtrip(
-                    self._pool,
-                    method,
-                    target,
-                    hdrs,
-                    body=body,
-                    timeout_s=self.timeout_s,
-                    counter="io_put_requests_total",
-                )
+                span_args = {"attempt": attempt + 1, "nbytes": len(body or b"")}
+                with _trace.span("remote.put", args=span_args):
+                    status, reason_s, resp_headers, resp_body = pooled_roundtrip(
+                        self._pool,
+                        method,
+                        target,
+                        hdrs,
+                        body=body,
+                        timeout_s=self.timeout_s,
+                        counter="io_put_requests_total",
+                    )
+                    span_args["status"] = status
                 if status >= 300:
                     raise _put_status_error(status, reason_s, context)
             except TransientSourceError as e:

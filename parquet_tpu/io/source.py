@@ -43,6 +43,7 @@ from pathlib import Path
 
 from ..obs.log import log_event as _log_event
 from ..utils import metrics as _metrics
+from ..utils import trace as _trace
 
 __all__ = [
     "ByteSource",
@@ -368,6 +369,10 @@ class RetryingSource(ByteSource):
                 )
                 reason = "short_read"
             _metrics.inc("io_retries_total", reason=reason)
+            # per-request attribution: the retry shows in this request's
+            # trace (and merged multi-process view), not just the process
+            # counter — a remote.get followed by io.retry reads as one story
+            _trace.count("io.retry")
             # structured mirror of the counter: rate-limited per event key,
             # so a retry storm costs counters (exact) not disk (sampled)
             _log_event(

@@ -26,6 +26,18 @@ long-running daemon can actually use at 14:02 when request X was slow:
             around executor units), decoded/source bytes and cache
             outcomes (from the request trace), charged to the
             admission-resolved tenant. Served at /v1/debug/tenants.
+  propagate cross-process trace propagation: a W3C-traceparent-shaped
+            context minted/adopted per request scope, injected into every
+            outbound HTTP call, and merge_chrome_traces() — the
+            `parquet-tool trace-merge` engine that stitches per-process
+            Perfetto documents on the shared trace-id.
+  fleet     metrics federation: scrape N replicas' /metrics and merge
+            families exactly (counters sum, histogram buckets add, gauges
+            keep a replica= label). Served at /v1/debug/fleet and
+            `parquet-tool debug --fleet`.
+  slo       multi-window burn-rate health engine over the daemon's own
+            request outcomes; verdict at /v1/debug/slo, folded into
+            /healthz as "degraded".
 
 See each module's docstring for the contracts and bounds.
 """
@@ -36,6 +48,12 @@ from .cost import (  # noqa: F401
     charged_tenant,
     cost_context,
     unit_clock,
+)
+from .fleet import (  # noqa: F401
+    federate,
+    merge_expositions,
+    parse_exposition,
+    scrape_fleet,
 )
 from .log import (  # noqa: F401
     JsonLinesFormatter,
@@ -51,6 +69,16 @@ from .prof import (  # noqa: F401
     capture,
     lane_of,
 )
+from .propagate import (  # noqa: F401
+    TraceContext,
+    current_context,
+    merge_chrome_traces,
+    mint,
+    outbound_traceparent,
+    parse_traceparent,
+    propagation_scope,
+    resolve_inbound,
+)
 from .recorder import (  # noqa: F401
     RECORDER,
     FlightRecorder,
@@ -59,6 +87,10 @@ from .recorder import (  # noqa: F401
     configure,
     recorder,
     sanitize_request_id,
+)
+from .slo import (  # noqa: F401
+    BurnRateEngine,
+    SLOObjective,
 )
 
 __all__ = [
@@ -85,4 +117,18 @@ __all__ = [
     "cost_context",
     "charged_tenant",
     "unit_clock",
+    "TraceContext",
+    "mint",
+    "parse_traceparent",
+    "current_context",
+    "propagation_scope",
+    "outbound_traceparent",
+    "resolve_inbound",
+    "merge_chrome_traces",
+    "federate",
+    "merge_expositions",
+    "parse_exposition",
+    "scrape_fleet",
+    "BurnRateEngine",
+    "SLOObjective",
 ]

@@ -55,12 +55,14 @@ __all__ = [
     "TokenBucketLimiter",
     "request_id",
     "tenant",
+    "trace_id",
 ]
 
 LOGGER_NAME = "parquet_tpu"
 
 _request_id_var: ContextVar = ContextVar("pqt_log_request_id", default=None)
 _tenant_var: ContextVar = ContextVar("pqt_log_tenant", default=None)
+_trace_id_var: ContextVar = ContextVar("pqt_log_trace_id", default=None)
 
 _LEVELS = {
     "debug": logging.DEBUG,
@@ -86,18 +88,32 @@ def tenant() -> str | None:
     return _tenant_var.get()
 
 
+def trace_id() -> str | None:
+    """The propagation trace-id bound to this context (None outside a
+    propagated request) — the cross-PROCESS join key, where request_id
+    joins within one daemon."""
+    return _trace_id_var.get()
+
+
 @contextmanager
-def log_context(request_id: str | None = None, tenant: str | None = None):
-    """Bind request_id/tenant for every log_event in the enclosed block —
-    including pool workers the block submits through instrumented_submit
-    (contextvars carry, exactly like the decode trace)."""
+def log_context(
+    request_id: str | None = None,
+    tenant: str | None = None,
+    trace_id: str | None = None,
+):
+    """Bind request_id/tenant/trace_id for every log_event in the enclosed
+    block — including pool workers the block submits through
+    instrumented_submit (contextvars carry, exactly like the decode
+    trace)."""
     tok_r = _request_id_var.set(request_id)
     tok_t = _tenant_var.set(tenant)
+    tok_tr = _trace_id_var.set(trace_id)
     try:
         yield
     finally:
         _request_id_var.reset(tok_r)
         _tenant_var.reset(tok_t)
+        _trace_id_var.reset(tok_tr)
 
 
 class TokenBucketLimiter:
@@ -164,6 +180,9 @@ class JsonLinesFormatter(logging.Formatter):
         ten = getattr(record, "pqt_tenant", None)
         if ten is not None:
             doc["tenant"] = ten
+        tid = getattr(record, "pqt_trace_id", None)
+        if tid is not None:
+            doc["trace_id"] = tid
         fields = getattr(record, "pqt_fields", None)
         if fields:
             for k, v in fields.items():
@@ -207,6 +226,7 @@ def log_event(event: str, *, level: str = "info", **fields) -> bool:
             "pqt_fields": fields,
             "pqt_request_id": _request_id_var.get(),
             "pqt_tenant": _tenant_var.get(),
+            "pqt_trace_id": _trace_id_var.get(),
         },
     )
     return True

@@ -76,6 +76,12 @@ POOL_LANES = (
     "pqt-encode",
     "pqt-hedge",
     "pqt-dispatch",
+    # PR 18 lane audit: every pqt-* pool spawned since PR 11, so no
+    # worker thread folds into "other"
+    "pqt-host",  # reader prepare pool (core/reader.py)
+    "pqt-flush",  # writer background flush pool (sink/encoder.py)
+    "pqt-prof",  # the profiler's own sampler thread
+    "pqt-httpstub",  # the testing stub's serve thread
 )
 
 _OVERFLOW_FRAME = "~overflow~"

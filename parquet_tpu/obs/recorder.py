@@ -114,6 +114,7 @@ class RequestRecord:
         "detail",
         "error",
         "trace_kind",
+        "trace_id",
         "open",
         "_trace",
         "_t0",
@@ -136,6 +137,9 @@ class RequestRecord:
         self.trace_kind = None  # None | "sampled" | "slow" | "error" — why
         #   the span tree was KEPT; persists after max_traces evicts the
         #   tree itself (has_trace False + trace_kind set = evicted)
+        self.trace_id = None  # cross-process propagation key (32 hex),
+        #   set by the server from the resolved traceparent
+
         self.open = True
         self._trace = None  # the Chrome-trace doc, when retained
         self._t0 = time.perf_counter()
@@ -152,6 +156,7 @@ class RequestRecord:
             "queue_wait_ms": self.queue_wait_ms,
             "has_trace": self._trace is not None,
             "trace_kind": self.trace_kind,
+            "trace_id": self.trace_id,
             "open": self.open,
         }
 
@@ -244,11 +249,14 @@ class FlightRecorder:
                 kind = "sampled"
             if kind is not None and cfg.max_traces > 0:
                 doc = trace.to_chrome_trace()
-                doc.setdefault("otherData", {})["request"] = {
+                req_meta = {
                     "id": rec.id,
                     "endpoint": rec.endpoint,
                     "tenant": rec.tenant,
                 }
+                if rec.trace_id is not None:
+                    req_meta["trace_id"] = rec.trace_id
+                doc.setdefault("otherData", {})["request"] = req_meta
                 with self._lock:
                     rec._trace = doc
                     rec.trace_kind = kind

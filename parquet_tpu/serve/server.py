@@ -30,7 +30,22 @@ the bounded pqt-serve pool), four endpoints:
   GET  /v1/debug/tenants  per-tenant cost table (CPU seconds, decoded/
                     source bytes, cache outcomes) + cross-tenant totals.
   GET  /v1/debug/vars  process snapshot: uptime, pid, version, pool
-                    sizes, resilience policy, cache/admission budgets.
+                    sizes, resilience policy, cache/admission budgets,
+                    process self-stats (rss/fds/threads).
+  GET  /v1/debug/slo  the burn-rate engine's verdict (ok/warn/burning)
+                    + per-window math (obs/slo.py); the same verdict
+                    folds into /healthz as "degraded" at 200.
+  GET  /v1/debug/fleet?peers=host:port,...  scrape the named replicas'
+                    /metrics and answer the exactly-merged exposition
+                    (obs/fleet.py: counters sum, histogram buckets add,
+                    gauges keep a replica= label).
+
+Every request resolves an inbound `traceparent` header (malformed ones
+are replaced, never echoed) into a propagation context that is injected
+into EVERY outbound HTTP call the request makes (remote range GETs,
+multipart PUTs), echoed on responses, and carried on error bodies,
+flight-recorder records and structured log lines as `trace_id` — the
+cross-process join key `parquet-tool trace-merge` stitches on.
 
 Error discipline: EVERY failure renders as a structured JSON body
 ({"error": {code, message, status}}) — never a traceback. Failures after
@@ -57,9 +72,12 @@ from ..io.cache import BlockCache
 from ..obs import cost as _cost
 from ..obs import log as _obslog
 from ..obs import prof as _prof
+from ..obs import propagate as _propagate
 from ..obs.recorder import ObsConfig as _ObsConfig
 from ..obs.recorder import configure as _obs_configure
 from ..obs.recorder import sanitize_request_id as _sanitize_request_id
+from ..obs.slo import BurnRateEngine as _BurnRateEngine
+from ..obs.slo import SLOObjective as _SLOObjective
 from ..utils import metrics as _metrics
 from ..utils.trace import decode_trace
 from .admission import AdmissionController
@@ -150,6 +168,15 @@ class ServeConfig:
     slow_ms: float = _OBS_DEFAULTS.slow_ms  # serve_slow_requests_total bar
     debug_ring_size: int = _OBS_DEFAULTS.ring_size  # /v1/debug retention
     debug_max_traces: int = _OBS_DEFAULTS.max_traces  # trees kept (~MBs each)
+    # the SLO this replica promises (obs/slo.py burn-rate engine): the
+    # availability objective over server-side failures (5xx), and an
+    # optional latency bar — None disables the latency SLI. The verdict
+    # serves /v1/debug/slo and folds into /healthz as "degraded".
+    slo_availability: float = 0.999
+    slo_p99_ms: float | None = None
+    # test/chaos seam (like source_factory): a pre-built BurnRateEngine —
+    # how fake-clock tests replay a fault schedule deterministically
+    slo_engine: object = None
 
     def __post_init__(self):
         if self.window < 1:
@@ -185,6 +212,11 @@ class ServeConfig:
             slow_ms=self.slow_ms,
             max_traces=self.debug_max_traces,
         )
+        # likewise the SLO knobs: SLOObjective owns their invariants
+        if self.slo_engine is None:
+            _SLOObjective(
+                availability=self.slo_availability, p99_ms=self.slo_p99_ms
+            )
 
 
 class ScanService:
@@ -254,6 +286,18 @@ class ScanService:
         # the recorder) and the daemon's start instant for /v1/debug/vars
         self.ledger = _cost.LEDGER
         self.started_at = time.time()
+        # the burn-rate health engine: fed one sample per finished
+        # recorded request (_Handler._finish), read by /v1/debug/slo and
+        # /healthz. A config-passed engine (fake clock) wins.
+        if config.slo_engine is not None:
+            self.slo = config.slo_engine
+        else:
+            self.slo = _BurnRateEngine(
+                _SLOObjective(
+                    availability=config.slo_availability,
+                    p99_ms=config.slo_p99_ms,
+                )
+            )
 
     # -- request entry points (raise ServeError; HTTP layer renders) -----------
 
@@ -359,9 +403,21 @@ class ScanService:
 
     def healthz(self) -> tuple[int, dict]:
         draining = self.admission.draining
+        verdict = self.slo.evaluate()["verdict"]
+        # draining wins (the replica must not be routed to AT ALL, 503);
+        # burning degrades at 200 — still serving, a router may merely
+        # deprioritize it. "warn" stays "ok": /healthz is a routing
+        # signal, not a pager (the full math lives at /v1/debug/slo).
+        if draining:
+            status_str = "draining"
+        elif verdict == "burning":
+            status_str = "degraded"
+        else:
+            status_str = "ok"
         body = {
-            "status": "draining" if draining else "ok",
+            "status": status_str,
             "in_flight": self.admission.in_flight,
+            "slo": verdict,
         }
         return (503 if draining else 200), body
 
@@ -417,6 +473,19 @@ class ScanService:
                 "slow_ms to keep more)",
             )
         return doc
+
+    def debug_slo(self) -> dict:
+        """GET /v1/debug/slo: the burn-rate engine's full verdict + window
+        math (and, as a side effect, a refresh of the slo_* gauges)."""
+        return self.slo.evaluate()
+
+    def debug_fleet(self, urls, *, timeout_s: float = 5.0) -> dict:
+        """GET /v1/debug/fleet: scrape `urls` and merge their expositions
+        (obs/fleet.py). Raises ValueError when no peer answers — the HTTP
+        layer renders that as a typed 502."""
+        from ..obs import fleet as _fleet
+
+        return _fleet.federate(urls, timeout_s=timeout_s)
 
     def debug_tenants(self) -> dict:
         """The /v1/debug/tenants usage table: per-tenant CPU seconds,
@@ -489,6 +558,13 @@ class ScanService:
                 "debug_ring_size": cfg.debug_ring_size,
                 "debug_max_traces": cfg.debug_max_traces,
             },
+            "slo": {
+                "availability": self.slo.objective.availability,
+                "p99_ms": self.slo.objective.p99_ms,
+            },
+            # process self-stats (same /proc read the exposition gauges
+            # refresh from; empty on platforms without procfs)
+            "process": _metrics.process_stats(),
             "resilience": {
                 "breaker": res.breaker,
                 "retry": res.retry,
@@ -530,6 +606,14 @@ class ScanService:
 
 def _count_request(tenant: str, status: int) -> None:
     _metrics.inc("serve_requests_total", status=str(status), tenant=tenant)
+
+
+def _normalize_peer(peer: str) -> str:
+    """A fleet peer spec as a scrape URL — shared with the CLI's --fleet
+    so `?peers=127.0.0.1:8081` and a full URL both work either way."""
+    from ..obs.fleet import normalize_peer
+
+    return normalize_peer(peer)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -618,6 +702,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(payload)))
         if getattr(self, "_rid", None):
             self.send_header("X-Request-Id", self._rid)
+        tp = getattr(self, "_tp", None)
+        if tp is not None:
+            # echo the RESOLVED context (daemon's own span-id under the
+            # adopted trace-id) — never the client's raw header
+            self.send_header("traceparent", tp.header())
         if retry_after is not None:
             self.send_header("Retry-After", str(retry_after))
         self.end_headers()
@@ -632,6 +721,11 @@ class _Handler(BaseHTTPRequestHandler):
             # the correlation key rides the error body too, so a client
             # that logs only bodies can still quote the id to an operator
             body["error"]["request_id"] = self._rid
+        tp = getattr(self, "_tp", None)
+        if tp is not None:
+            # and the cross-process key: a failed request is exactly the
+            # one an operator wants to trace-merge across the fleet
+            body["error"]["trace_id"] = tp.trace_id
         try:
             self._send_json(e.status, body, retry_after=e.retry_after_s)
         except OSError:
@@ -663,6 +757,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Transfer-Encoding", "chunked")
             if getattr(self, "_rid", None):
                 self.send_header("X-Request-Id", self._rid)
+            tp = getattr(self, "_tp", None)
+            if tp is not None:
+                self.send_header("traceparent", tp.header())
             self.end_headers()
             started = True
             if first:
@@ -715,6 +812,9 @@ class _Handler(BaseHTTPRequestHandler):
     ) -> None:
         dt = time.perf_counter() - t0
         _count_request(tenant, status)
+        # one SLI sample per finished recorded request: the burn-rate
+        # engine sees exactly what serve_request_seconds sees
+        self.service.slo.record(status, dt)
         # endpoint labels are the matched-route constants, never the raw
         # client path — a 404 probe flood cannot grow the label set. The
         # request id rides the histogram bucket as an OpenMetrics exemplar
@@ -758,11 +858,19 @@ class _Handler(BaseHTTPRequestHandler):
         svc = self.service
         rec = svc.recorder.begin(endpoint, tenant, request_id=self._rid)
         self._rid = rec.id
+        ctx = getattr(self, "_tp", None)
+        if ctx is not None:
+            rec.trace_id = ctx.trace_id
         status, nbytes, err, trace = 500, 0, None, None
-        with _obslog.log_context(request_id=rec.id, tenant=tenant), \
-                _cost.cost_context(tenant):
+        with _obslog.log_context(
+            request_id=rec.id,
+            tenant=tenant,
+            trace_id=ctx.trace_id if ctx is not None else None,
+        ), _cost.cost_context(tenant), _propagate.propagation_scope(ctx):
             try:
                 with decode_trace() as trace:
+                    if ctx is not None:
+                        trace.trace_id = ctx.trace_id
                     try:
                         status, nbytes, err = run(rec)
                     except ServeError as e:
@@ -908,6 +1016,7 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         self._body_read = False  # per-request: the handler serves many
         self._rid = self._request_id()
+        self._tp = self._trace_context()
         tenant = self._tenant()
         try:
             if route == "/healthz":
@@ -958,6 +1067,12 @@ class _Handler(BaseHTTPRequestHandler):
             if route == "/v1/debug/profile":
                 self._profile_request(parse_qs(split.query))
                 return
+            if route == "/v1/debug/slo":
+                self._send_json(200, self.service.debug_slo())
+                return
+            if route == "/v1/debug/fleet":
+                self._fleet_request(parse_qs(split.query))
+                return
             raise ServeError(404, "no_such_route", f"unknown path {route!r}")
         except ServeError as e:
             self._send_error_body(e)
@@ -971,6 +1086,57 @@ class _Handler(BaseHTTPRequestHandler):
         at record-begin time). Bounded exactly like tenant keys: a hostile
         header cannot grow the ring, the index, or the debug JSON."""
         return _sanitize_request_id(self.headers.get("X-Request-Id"))
+
+    def _trace_context(self):
+        """Resolve the inbound traceparent header into this request's
+        propagation context (the X-Request-Id discipline applied to trace
+        context: a malformed header is counted and REPLACED by a mint,
+        never echoed). Every request — including /metrics scrapes — gets
+        a context, so every outbound call a request makes is traceable."""
+        ctx, _ = _propagate.resolve_inbound(self.headers.get("traceparent"))
+        return ctx
+
+    _MAX_FLEET_PEERS = 32
+
+    def _fleet_request(self, qs: dict) -> None:
+        """GET /v1/debug/fleet?peers=host:port[,host:port...] — scrape the
+        named replicas' /metrics and answer the MERGED exposition (plus
+        `# fleet:` comment lines naming merged/failed replicas — comments
+        are legal exposition content). Bounded peer count: a hostile query
+        cannot fan this daemon out unboundedly."""
+        raw = qs.get("peers", [None])[-1]
+        if not raw:
+            raise ServeError(
+                400, "bad_request",
+                "'peers' query parameter required: "
+                "peers=host:port[,host:port...]",
+            )
+        peers = [p.strip() for p in raw.split(",") if p.strip()]
+        if not peers:
+            raise ServeError(400, "bad_request", "'peers' names no replica")
+        if len(peers) > self._MAX_FLEET_PEERS:
+            raise ServeError(
+                400, "bad_request",
+                f"at most {self._MAX_FLEET_PEERS} peers per fleet scrape "
+                f"(got {len(peers)})",
+            )
+        urls = [_normalize_peer(p) for p in peers]
+        try:
+            view = self.service.debug_fleet(urls)
+        except ValueError as e:
+            raise ServeError(502, "fleet_unreachable", str(e)) from None
+        lines = [
+            "# fleet: merged "
+            + f"{len(view['replicas'])} replica(s): "
+            + ", ".join(view["replicas"])
+        ]
+        for replica, err in view["errors"].items():
+            lines.append(f"# fleet: {replica} failed: {err}")
+        payload = ("\n".join(lines) + "\n" + view["text"]).encode()
+        self._send_payload(
+            200, payload,
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+        )
 
     def _send_internal_error(self, e) -> None:
         """Best-effort typed 500: never let a dead socket turn a handler
@@ -987,6 +1153,7 @@ class _Handler(BaseHTTPRequestHandler):
         t0 = time.perf_counter()
         self._body_read = False  # per-request: the handler serves many
         self._rid = self._request_id()
+        self._tp = self._trace_context()
         tenant = self._tenant()
         try:
             if route == "/v1/scan":

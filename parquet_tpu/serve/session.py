@@ -26,6 +26,7 @@ from ..data.plan import ScanPlan, build_plan, expand_paths
 from ..io.cache import BlockCache, FooterCache
 from ..io.source import SourceError
 from ..utils import metrics as _metrics
+from ..utils.trace import count as _trace_count
 from ..utils.trace import span
 from .protocol import ScanRequest, ServeError
 
@@ -147,6 +148,10 @@ class ScanSession:
         for p in paths:
             mapped = self._map_remote(p)
             if mapped is not None:
+                # per-request attribution: the trace shows how many paths
+                # went remote (their GETs then carry the request's
+                # traceparent — the sources read under the request scope)
+                _trace_count("remote.mapped")
                 specs.append(mapped)
                 continue
             if self.root is not None and not os.path.isabs(p):

@@ -142,6 +142,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve(self, head_only: bool) -> None:
         stub = self.stub
+        stub._record_traceparent(self.headers.get("traceparent"))
         p = stub._draw_and_wait()
         if p is None:  # drop was drawn
             self._drop()
@@ -275,6 +276,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _write_op(self, method: str) -> None:
         stub = self.stub
+        stub._record_traceparent(self.headers.get("traceparent"))
         # the body is read BEFORE the fault draw: a dropped connection
         # must model an ack lost in flight, not a request never sent
         body = self._read_body()
@@ -529,6 +531,10 @@ class RangeHttpStub:
         self.requests = 0
         self.faults_injected = 0
         self.bytes_served = 0
+        # every traceparent header received, in arrival order — the
+        # store-side half of the end-to-end propagation pin (recorded
+        # BEFORE the fault draw: a faulted request was still received)
+        self.traceparents: list = []
         self.put_requests = 0
         self.objects_put = 0
         self.auth_rejects = 0
@@ -737,6 +743,13 @@ class RangeHttpStub:
                 self.faults_injected += 1
                 return int(self._rng.integers(0, declared))
         return None
+
+    def _record_traceparent(self, raw) -> None:
+        """Keep every traceparent header received (None headers skipped):
+        the store-side record the end-to-end propagation pin asserts on."""
+        if raw is not None:
+            with self._lock:
+                self.traceparents.append(str(raw))
 
     def _count_fault(self) -> None:
         pass  # counted at draw time (one lock acquisition per request)

@@ -1221,6 +1221,8 @@ def cmd_serve(args) -> int:
         window=args.window,
         socket_timeout_s=args.socket_timeout_s,
         shard=_parse_shard(args.shard),
+        slo_availability=args.slo_availability,
+        slo_p99_ms=args.slo_p99_ms,
         # obs flags default to None so ObsConfig (via ServeConfig) stays
         # the single owner of the numbers
         **{
@@ -1273,7 +1275,24 @@ def cmd_debug(args) -> int:
     With --id + --trace: the Perfetto-loadable Chrome-trace JSON, written
     to -o (or stdout) for ui.perfetto.dev / chrome://tracing. --vars
     snapshots the daemon's configuration (/v1/debug/vars); --tenants
-    prints the per-tenant cost table (/v1/debug/tenants)."""
+    prints the per-tenant cost table (/v1/debug/tenants); --fleet scrapes
+    every listed replica's /metrics and prints ONE merged exposition
+    (counters summed, histogram buckets added, gauges kept per replica)."""
+    if args.fleet:
+        from ..obs import fleet as _fleet
+
+        urls = [_fleet.normalize_peer(p) for p in args.fleet]
+        view = _fleet.federate(urls)  # ValueError -> main()'s exit-1 path
+        print(
+            f"# fleet: merged {len(view['replicas'])} replica(s): "
+            + ", ".join(view["replicas"])
+        )
+        for replica, why in sorted(view["errors"].items()):
+            print(f"# fleet: {replica} failed: {why}")
+        sys.stdout.write(view["text"])
+        return 1 if view["errors"] else 0
+    if not args.url:
+        raise ValueError("debug: a daemon URL (or --fleet URL...) is required")
     base = args.url.rstrip("/")
     if not base.startswith(("http://", "https://")):
         base = "http://" + base
@@ -1370,6 +1389,42 @@ def cmd_debug(args) -> int:
             f"{r['queue_wait_ms']:>8} "
             f"{r.get('trace_kind') or '-'}{' (open)' if r.get('open') else ''}"
         )
+    return 0
+
+
+def cmd_trace_merge(args) -> int:
+    """Stitch per-process Chrome-trace documents (each exported by
+    `debug --id X --trace -o`) into ONE Perfetto document on their shared
+    trace-id: every input becomes its own named process lane, so the
+    daemon's spans and the object store's spans of the same request sit
+    on one timeline."""
+    from ..obs.propagate import merge_chrome_traces
+
+    docs = []
+    for path in args.files:
+        with open(path) as f:
+            try:
+                docs.append(json.load(f))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"trace-merge: {path}: {e}") from None
+    if args.label and len(args.label) != len(args.files):
+        raise ValueError(
+            "trace-merge: one --label per input file "
+            f"(got {len(args.label)} labels for {len(args.files)} files)"
+        )
+    merged = merge_chrome_traces(docs, labels=args.label)
+    text = json.dumps(merged)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        trace_id = merged["otherData"]["propagation"]["trace_id"]
+        print(
+            f"trace-merge: stitched {len(docs)} process(es), "
+            f"{len(merged['traceEvents'])} events on trace {trace_id} "
+            f"-> {args.out}"
+        )
+    else:
+        print(text)
     return 0
 
 
@@ -1777,6 +1832,22 @@ def main(argv=None) -> int:
         "(each can be MBs; sampled/slow/errored requests compete for "
         "these slots, newest win; default from ObsConfig)",
     )
+    pe.add_argument(
+        "--slo-availability",
+        type=float,
+        default=0.999,
+        help="the availability objective the burn-rate engine evaluates "
+        "(share of requests that must not 5xx; /healthz reports "
+        "'degraded' while the error budget burns at page rate on both "
+        "the 5m and 1h windows; full math at /v1/debug/slo)",
+    )
+    pe.add_argument(
+        "--slo-p99-ms",
+        type=float,
+        default=None,
+        help="optional p99 latency objective (ms): enables the latency "
+        "SLI — at most 1%% of requests may run over this bar",
+    )
     pe.set_defaults(fn=cmd_serve)
 
     pd = sub.add_parser(
@@ -1784,7 +1855,13 @@ def main(argv=None) -> int:
         help="query a running daemon's flight recorder: list recent "
         "requests, fetch one by id, or export its Perfetto trace",
     )
-    pd.add_argument("url", help="daemon base URL, e.g. http://127.0.0.1:8080")
+    pd.add_argument(
+        "url",
+        nargs="?",
+        default=None,
+        help="daemon base URL, e.g. http://127.0.0.1:8080 "
+        "(not needed with --fleet)",
+    )
     pd.add_argument("--id", help="one request id (the X-Request-Id echo)")
     pd.add_argument(
         "--trace",
@@ -1814,7 +1891,37 @@ def main(argv=None) -> int:
         help="print the per-tenant cost table (/v1/debug/tenants): CPU "
         "seconds, decoded/source bytes, cache outcomes, hottest first",
     )
+    pd.add_argument(
+        "--fleet",
+        nargs="+",
+        metavar="URL",
+        help="scrape these replicas' /metrics (bare host:port works) and "
+        "print one merged exposition: counters summed, histogram buckets "
+        "added, gauges kept per replica under a replica= label",
+    )
     pd.set_defaults(fn=cmd_debug)
+
+    pt = sub.add_parser(
+        "trace-merge",
+        help="stitch per-process Chrome traces of ONE request (shared "
+        "traceparent trace-id) into a single Perfetto document",
+    )
+    pt.add_argument(
+        "files",
+        nargs="+",
+        help="input Chrome-trace JSON documents (from debug --trace -o); "
+        "all must carry the same propagation trace-id",
+    )
+    pt.add_argument(
+        "-o", "--out", default=None, help="merged output file (default: stdout)"
+    )
+    pt.add_argument(
+        "--label",
+        action="append",
+        help="process lane name, one per input in order (default: each "
+        "document's recorded endpoint)",
+    )
+    pt.set_defaults(fn=cmd_trace_merge)
 
     pp = sub.add_parser("split", help="split into parts by rows or file size")
     pp.add_argument("-n", type=int, help="rows per part")

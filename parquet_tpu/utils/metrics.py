@@ -265,6 +265,7 @@ monotonic, so `delta()` skips them.
 
 from __future__ import annotations
 
+import os
 import re
 import threading
 import time
@@ -280,6 +281,7 @@ __all__ = [
     "delta",
     "render_prometheus",
     "render_openmetrics",
+    "process_stats",
     "report",
     "event",
     "page_decoded",
@@ -420,6 +422,30 @@ _HELP = {
     "io_autotune_latency_ms": (
         "EWMA per-request read latency, per transport profile"
     ),
+    # mesh telemetry plane (PR 18): propagation + federation + SLO
+    "io_traceparent_injected_total": (
+        "traceparent headers injected into outbound HTTP calls, per "
+        "transport (get/put)"
+    ),
+    "io_traceparent_inbound_total": (
+        "inbound traceparent resolution outcomes "
+        "(accepted/minted/invalid)"
+    ),
+    "fleet_scrapes_total": "fleet federation peer scrapes, per outcome",
+    "fleet_replicas": "replicas merged into the last fleet view",
+    "slo_burn_rate": (
+        "error-budget burn rate per SLI and window (1.0 spends the "
+        "budget exactly at sustainable speed)"
+    ),
+    "slo_error_budget_remaining": (
+        "fraction of the error budget left in the slow window, per SLI"
+    ),
+    "slo_verdict": "SLO health verdict (0 ok, 1 warn, 2 burning)",
+    # process self-metrics, refreshed at exposition render (stdlib /proc
+    # reads; absent on platforms without procfs)
+    "process_resident_memory_bytes": "resident set size of this process",
+    "process_open_fds": "open file descriptors held by this process",
+    "process_threads_total": "OS threads in this process",
 }
 
 
@@ -707,6 +733,49 @@ def _refresh_uptime(registry: MetricsRegistry) -> None:
     )
 
 
+def process_stats() -> dict:
+    """Best-effort process self-stats from /proc (stdlib only): rss bytes,
+    open fd count, OS thread count. Keys are present only when their
+    source is readable — on platforms without procfs the dict is simply
+    empty, and the gauges never appear in the exposition."""
+    out: dict = {}
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            rss_pages = int(f.read().split()[1])
+        out["rss_bytes"] = rss_pages * (os.sysconf("SC_PAGE_SIZE") or 4096)
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        out["open_fds"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    try:
+        with open("/proc/self/status", "rb") as f:
+            for line in f:
+                if line.startswith(b"Threads:"):
+                    out["threads"] = int(line.split()[1])
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
+    if "threads" not in out:
+        # portable fallback: Python-visible threads (misses non-Python
+        # OS threads, but beats absence on non-procfs platforms)
+        out["threads"] = threading.active_count()
+    return out
+
+
+def _refresh_process_metrics(registry: MetricsRegistry) -> None:
+    """Refresh the process self-gauges at exposition render, so every
+    scrape sees current values without a background sampler thread."""
+    stats = process_stats()
+    if "rss_bytes" in stats:
+        registry.set("process_resident_memory_bytes", stats["rss_bytes"])
+    if "open_fds" in stats:
+        registry.set("process_open_fds", stats["open_fds"])
+    if "threads" in stats:
+        registry.set("process_threads_total", stats["threads"])
+
+
 # -- module-level convenience (the registry everyone means) --------------------
 
 
@@ -738,11 +807,13 @@ def delta(previous: dict) -> dict:
 
 def render_prometheus() -> str:
     _refresh_uptime(REGISTRY)
+    _refresh_process_metrics(REGISTRY)
     return REGISTRY.render_prometheus()
 
 
 def render_openmetrics() -> str:
     _refresh_uptime(REGISTRY)
+    _refresh_process_metrics(REGISTRY)
     return REGISTRY.render_openmetrics()
 
 

@@ -103,6 +103,11 @@ class DecodeTrace:
     def __init__(self):
         self.stages: dict[str, StageStats] = {}
         self.events_dropped = 0
+        # cross-process propagation key (obs/propagate.py): an opaque
+        # 32-hex trace-id set by whoever opened the request scope, or None
+        # for library reads outside any scope. Carried into the Chrome
+        # export so trace-merge can stitch multi-process documents.
+        self.trace_id: str | None = None
         self._lock = threading.Lock()
         self._t0 = time.perf_counter_ns()
         # finished spans: (name, tid, start_ns rel to _t0, dur_ns, args|None)
@@ -290,7 +295,7 @@ class DecodeTrace:
             if args:
                 ev["args"] = dict(args)
             out.append(ev)
-        return {
+        doc = {
             "traceEvents": out,
             "displayTimeUnit": "ms",
             "otherData": {
@@ -299,6 +304,9 @@ class DecodeTrace:
                 "events_dropped": dropped,
             },
         }
+        if self.trace_id is not None:
+            doc["otherData"]["propagation"] = {"trace_id": self.trace_id}
+        return doc
 
     def write_chrome_trace(self, path) -> None:
         with open(path, "w") as f:

@@ -133,16 +133,47 @@ def _expected_jsonl(corpus, names, columns=None, filters=None, limit=None):
     return "".join(out).encode()
 
 
+def _settled_delta(snap, key: str, *, want: int = 1, timeout_s: float = 5.0):
+    """metrics.delta(snap) once `key` reaches `want`. The handler finishes
+    a request (counter + SLI sample + flight-record close) AFTER the
+    response bytes flush, so a delta taken the instant the client reads
+    the body can race it — poll briefly, then assert on the settled view."""
+    deadline = time.time() + timeout_s
+    while True:
+        d = metrics.delta(snap)
+        if d.get(key, 0) >= want or time.time() >= deadline:
+            return d
+        time.sleep(0.002)
+
+
+def _settled_record(server, rid: str, *, timeout_s: float = 5.0):
+    """GET /v1/debug/requests/<rid> once the record has CLOSED — the
+    recorder's finish step runs after the response flushes, the same race
+    _settled_delta absorbs. Returns (status, doc)."""
+    deadline = time.time() + timeout_s
+    while True:
+        s, _h, b = _request(server, "GET", f"/v1/debug/requests/{rid}")
+        doc = json.loads(b)
+        if s != 200 or doc.get("open") is False or time.time() >= deadline:
+            return s, doc
+        time.sleep(0.002)
+
+
 def _error_code(body: bytes) -> str:
     doc = json.loads(body)
     assert set(doc) == {"error"}, doc
-    # request_id rides every error body produced inside a recorded request
-    # (the correlation key for /v1/debug/requests); pre-record errors
-    # (bad route, oversized body) legitimately lack it
-    assert set(doc["error"]) - {"request_id"} == {"code", "message", "status"}, doc
+    # request_id and trace_id ride every error body produced inside a
+    # recorded request (the correlation keys for /v1/debug/requests and
+    # cross-process trace-merge); pre-record errors (bad route, oversized
+    # body) legitimately lack them
+    extra = {"request_id", "trace_id"}
+    assert set(doc["error"]) - extra == {"code", "message", "status"}, doc
     rid = doc["error"].get("request_id")
     if rid is not None:
         assert isinstance(rid, str) and 0 < len(rid) <= 64, doc
+    tid = doc["error"].get("trace_id")
+    if tid is not None:
+        assert isinstance(tid, str) and len(tid) == 32, doc
     return doc["error"]["code"]
 
 
@@ -602,7 +633,9 @@ class TestScanCorrectness:
         try:
             s0 = metrics.snapshot()
             status, _h, body = _scan(server, {"paths": "a.parquet"})
-            d = metrics.delta(s0)
+            d = _settled_delta(
+                s0, 'serve_requests_total{status="500",tenant="default"}'
+            )
             assert status == 500 and _error_code(body) == "internal"
             assert b"Traceback" not in body
             counted = [
@@ -1213,9 +1246,8 @@ class TestFlightRecorder:
         assert status2 == 200 and body2 == body
         assert headers2.get("X-Request-Id")  # generated when not supplied
 
-        s, _h, b = _request(server, "GET", "/v1/debug/requests/demo")
+        s, doc = _settled_record(server, "demo")
         assert s == 200
-        doc = json.loads(b)
         assert doc["id"] == "demo"
         assert doc["endpoint"] == "/v1/scan"
         assert doc["tenant"] == "default"
@@ -1246,7 +1278,12 @@ class TestFlightRecorder:
         assert tr["traceEvents"]
         for ev in tr["traceEvents"]:
             assert "ph" in ev and "name" in ev and "pid" in ev
-        assert tr["otherData"]["request"] == {
+        req_meta = dict(tr["otherData"]["request"])
+        # the cross-process join key rides the debug trace (trace-merge
+        # stitches per-process dumps on it); 32-hex, never the raw header
+        tid = req_meta.pop("trace_id")
+        assert isinstance(tid, str) and len(tid) == 32
+        assert req_meta == {
             "id": "demo", "endpoint": "/v1/scan", "tenant": "default",
         }
 
@@ -1284,8 +1321,7 @@ class TestFlightRecorder:
             )
             assert status == 404
             assert json.loads(body)["error"]["request_id"] == "whoops"
-            s, _h, b = _request(server, "GET", "/v1/debug/requests/whoops")
-            doc = json.loads(b)
+            s, doc = _settled_record(server, "whoops")
             assert doc["status"] == 404
             assert doc["error"]  # the truncated message, retrievable later
             assert doc["has_trace"] and doc["trace_kind"] == "error"
@@ -1309,10 +1345,11 @@ class TestFlightRecorder:
                 headers={"X-Request-Id": "tortoise"},
             )
             assert status == 200
-            d = metrics.delta(snap)
+            d = _settled_delta(
+                snap, 'serve_slow_requests_total{endpoint="/v1/scan"}'
+            )
             assert d.get('serve_slow_requests_total{endpoint="/v1/scan"}', 0) >= 1
-            s, _h, b = _request(server, "GET", "/v1/debug/requests/tortoise")
-            doc = json.loads(b)
+            s, doc = _settled_record(server, "tortoise")
             assert doc["trace_kind"] == "slow" and doc["has_trace"]
 
     def test_unsampled_fast_request_has_no_trace(self, corpus):
@@ -1353,10 +1390,11 @@ class TestFlightRecorder:
             {"X-Request-Id": "dry-run"},
         )
         assert s == 200 and h.get("X-Request-Id") == "dry-run"
-        s, _h, b = _request(server, "GET", "/v1/debug/requests/dry-run")
-        doc = json.loads(b)
+        s, doc = _settled_record(server, "dry-run")
         assert doc["endpoint"] == "/v1/plan" and doc["plan"]["files"] == 1
-        d = metrics.delta(snap)
+        d = _settled_delta(
+            snap, 'serve_request_seconds_count{endpoint="/v1/plan"}'
+        )
         assert d.get('serve_request_seconds_count{endpoint="/v1/plan"}', 0) >= 1
 
     def test_ring_stays_bounded_under_http_requests(self, corpus):
@@ -1532,8 +1570,10 @@ class TestTraceEviction:
                     server, {"paths": "missing.parquet"},
                     headers={"X-Request-Id": f"e{i:02d}"},
                 )
-            s, _h, b = _request(server, "GET", "/v1/debug/requests/e00")
-            doc = json.loads(b)
+            # settle the LAST qualifier first: its finish step (which
+            # attaches the tree and evicts e00's) runs post-flush
+            _settled_record(server, "e16")
+            s, doc = _settled_record(server, "e00")
             assert s == 200
             assert doc["trace_kind"] == "error" and doc["has_trace"] is False
             s, _h, b = _request(server, "GET", "/v1/debug/requests/e00/trace")
