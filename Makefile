@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-io-write remote-write-smoke bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke fleet-smoke profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-io-write remote-write-smoke bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke fleet-smoke mesh-smoke bench-serve-mesh profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
@@ -14,8 +14,11 @@
 # over real loopback HTTP (fast subset of tests/test_remote_sink.py);
 # fleet-smoke pins the mesh telemetry plane (fast subset of
 # tests/test_mesh.py): two in-process daemons -> federated /metrics
-# scrape (counters summed exactly) -> cross-process trace-merge round trip
-check: native lint chaos-smoke obs-smoke encode-smoke device-smoke remote-write-smoke fleet-smoke
+# scrape (counters summed exactly) -> cross-process trace-merge round trip;
+# mesh-smoke pins the sharded-serve router (fast subset of
+# tests/test_mesh_router.py): routed scan/query byte-identical to one
+# daemon + a replica killed mid-hammer costing typed retries only
+check: native lint chaos-smoke obs-smoke encode-smoke device-smoke remote-write-smoke fleet-smoke mesh-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -158,6 +161,16 @@ obs-smoke: native
 # daemons' remote GETs then stitched by `parquet-tool trace-merge`
 fleet-smoke: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_mesh.py -q -k 'fleet_smoke or round_trip or Exactness'
+
+# sharded-serve smoke: replicas + router in-process, routed results
+# byte-identical to a single daemon, one replica killed mid-hammer
+mesh-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_router.py -q -k 'mesh_smoke or byte_identical or killed'
+
+# router scaling + chaos benchmark (writes the "mesh" artifact section)
+bench-serve-mesh:
+	python bench.py --serve-mesh
+
 
 # live-profile a RUNNING daemon (flamegraph-compatible collapsed stacks,
 # lane-attributed to the pqt-* pools): make profile-live URL=host:port

@@ -94,6 +94,17 @@ daemon, plus the cold-vs-warm /v1/plan latency ratio the footer/block
 caches buy. PQT_BENCH_SERVE=0 skips it in a full run; the result rides
 the --json artifact under "serve".
 
+`--serve-mesh` benchmarks the sharded-serve router (parquet_tpu.serve.mesh)
+over REAL subprocess replica daemons: routed req/s at replica counts 1 and
+4 under fixed client concurrency (the `mesh.rps_1r`/`mesh.rps_4r` trend
+pins — read the scaling ratio against the fingerprint's nproc), every
+routed response checked byte-identical against a direct replica answer,
+plus a chaos leg that SIGKILLs one replica mid-hammer and pins typed
+retries only (no torn streams, no untyped errors).
+PQT_SERVE_MESH_REQUESTS / PQT_SERVE_MESH_CONC size it;
+PQT_BENCH_SERVE_MESH=0 skips it in a full run; the result rides the
+--json artifact under "mesh".
+
 `--chaos` benchmarks graceful degradation under the scripted fault schedule
 (testing/chaos.py: latency spike -> error burst -> blackout -> recovery,
 driven through every source the process opens): the SLO-controlled dataset
@@ -1649,6 +1660,222 @@ def _phase_serve() -> None:
     _emit(out)
 
 
+# -- the mesh-router benchmark (--serve-mesh / phase "serve_mesh") -------------
+
+SERVE_MESH_REQUESTS = int(os.environ.get("PQT_SERVE_MESH_REQUESTS", 32))
+SERVE_MESH_CONC = int(os.environ.get("PQT_SERVE_MESH_CONC", 8))
+
+
+def _phase_serve_mesh() -> None:
+    """Mesh-router benchmark (`bench.py --serve-mesh` / `make
+    bench-serve-mesh`).
+
+    Spawns REAL replica daemons as subprocesses (each its own process =
+    its own GIL, the deployment shape) plus an in-process MeshRouter, and
+    measures routed req/s at replica counts 1 and 4 under a fixed client
+    concurrency — rps_1r/rps_4r are the trend-store scaling pins (read
+    them against the fingerprint's nproc: a 1-core box cannot scale).
+    Then the chaos leg: the same hammer with one replica SIGKILLed
+    mid-run — every response must be byte-identical or a typed error
+    record, never torn; the router's mesh_retries_total counters report
+    what the kill actually cost."""
+    import http.client
+    import re as _re
+    import subprocess
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from parquet_tpu.serve.mesh import MeshConfig, MeshRouter
+
+    d = _serve_dir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn_replica():
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "parquet_tpu.tools.parquet_tool",
+                "serve", "--port", "0", "--root", str(d),
+                "--cache-mb", "256", "--max-inflight", "64",
+                "--tenant-concurrent", "64",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+        )
+        for line in proc.stdout:
+            m = _re.search(r"listening on (http://\S+)", line)
+            if m:
+                return proc, m.group(1)
+        raise SystemExit("bench: replica daemon never reported its port")
+
+    def one_request(host, port, body):
+        t0 = time.perf_counter()
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        try:
+            conn.request("POST", "/v1/scan", body=body)
+            resp = conn.getresponse()
+            payload = resp.read()
+            return time.perf_counter() - t0, resp.status, payload
+        finally:
+            conn.close()
+
+    bodies = [
+        json.dumps({"paths": f"shard-{i % SERVE_FILES:03d}.parquet"}).encode()
+        for i in range(SERVE_MESH_REQUESTS)
+    ]
+
+    def hammer(host, port, on_result):
+        lock = threading.Lock()
+        idx = iter(range(SERVE_MESH_REQUESTS))
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                try:
+                    t, status, payload = one_request(host, port, bodies[i])
+                except http.client.HTTPException as e:
+                    with lock:
+                        on_result(i, "torn", repr(e), None)
+                    continue
+                with lock:
+                    on_result(i, "ok" if status == 200 else "error",
+                              status, payload)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker) for _ in range(SERVE_MESH_CONC)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0
+
+    procs = []
+    out = {
+        "config": "serve_mesh",
+        "requests_per_level": SERVE_MESH_REQUESTS,
+        "concurrency": SERVE_MESH_CONC,
+        "stat": "wall-clock req/s",
+    }
+    try:
+        for _ in range(4):
+            procs.append(spawn_replica())
+        urls = [u for _p, u in procs]
+        # reference payloads straight from a replica: the byte-identity
+        # oracle every routed response is judged against
+        rhost, rport = urls[0].split("//")[1].rsplit(":", 1)
+        expect = {}
+        for i, body in enumerate(bodies):
+            _t, status, payload = one_request(rhost, int(rport), body)
+            assert status == 200, payload[:200]
+            expect[i] = payload
+        for n_replicas in (1, 4):
+            router = MeshRouter(
+                MeshConfig(
+                    port=0, replicas=tuple(urls[:n_replicas]),
+                    max_inflight=64, tenant_concurrent=64,
+                )
+            ).start_background()
+            try:
+                # warm each file through the routed path before timing
+                for i in range(SERVE_FILES):
+                    one_request(router.host, router.port, bodies[i])
+                lat, bad = [], []
+
+                def on_result(i, kind, detail, payload):
+                    if kind != "ok" or payload != expect[i]:
+                        bad.append((i, kind, detail))
+
+                wall = hammer(router.host, router.port, on_result)
+                assert not bad, f"mesh bench: non-identical responses: {bad[:4]}"
+                rps = round(SERVE_MESH_REQUESTS / wall, 2)
+                out[f"rps_{n_replicas}r"] = rps
+                log(f"bench: serve-mesh {n_replicas} replica(s): {rps} req/s")
+            finally:
+                router.close()
+        out["scaling_ratio"] = (
+            round(out["rps_4r"] / out["rps_1r"], 2) if out["rps_1r"] else None
+        )
+        # chaos leg: SIGKILL one replica mid-hammer; typed retries only
+        router = MeshRouter(
+            MeshConfig(
+                port=0, replicas=tuple(urls),
+                max_inflight=64, tenant_concurrent=64,
+            )
+        ).start_background()
+        try:
+            for i in range(SERVE_FILES):
+                one_request(router.host, router.port, bodies[i])
+            outcomes = {"ok": 0, "typed": 0, "untyped": 0, "torn": 0}
+            killed = threading.Event()
+
+            def on_chaos_result(i, kind, detail, payload):
+                if outcomes["ok"] >= SERVE_MESH_REQUESTS // 4:
+                    if not killed.is_set():
+                        procs[2][0].kill()  # mid-hammer, requests in flight
+                        killed.set()
+                if kind == "ok" and payload == expect[i]:
+                    outcomes["ok"] += 1
+                elif kind == "torn":
+                    outcomes["torn"] += 1
+                elif kind == "error":
+                    try:
+                        json.loads(payload)["error"]["code"]
+                        outcomes["typed"] += 1
+                    except (ValueError, KeyError):
+                        outcomes["untyped"] += 1
+                else:
+                    outcomes["untyped"] += 1
+
+            hammer(router.host, router.port, on_chaos_result)
+            if not killed.is_set():
+                procs[2][0].kill()
+            status, retries = 0, {}
+            conn = http.client.HTTPConnection(
+                router.host, router.port, timeout=30
+            )
+            try:
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                text = resp.read().decode()
+            finally:
+                conn.close()
+            for m in _re.finditer(
+                r'parquet_tpu_mesh_retries_total\{reason="([a-z0-9_]+)"\} (\d+)',
+                text,
+            ):
+                retries[m.group(1)] = int(m.group(2))
+            out["chaos"] = {
+                "replica_killed": killed.is_set(),
+                "responses": dict(outcomes),
+                "typed_only": outcomes["untyped"] == 0
+                and outcomes["torn"] == 0,
+                "retries": retries,
+            }
+            log(
+                f"bench: serve-mesh chaos: {outcomes}, retries {retries}, "
+                f"typed_only={out['chaos']['typed_only']}"
+            )
+        finally:
+            router.close()
+    finally:
+        for proc, _u in procs:
+            proc.terminate()
+        for proc, _u in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    log(
+        f"bench: serve-mesh scaling {out['rps_1r']} -> {out['rps_4r']} req/s "
+        f"(x{out['scaling_ratio']}, nproc={os.cpu_count()})"
+    )
+    _emit(out)
+
+
 # -- the query push-down benchmark (--query / phase "query") ------------------
 
 QUERY_ROWS = int(os.environ.get("PQT_QUERY_ROWS", 1_000_000))
@@ -2855,6 +3082,19 @@ def main() -> None:
                 f"warm plan {r_serve['plan_cold_vs_warm']}x faster than cold"
             )
 
+    # mesh-router scaling + chaos (PQT_BENCH_SERVE_MESH=0 to skip):
+    # routed req/s at 1 vs 4 subprocess replicas + kill-one-replica leg
+    r_mesh = None
+    if os.environ.get("PQT_BENCH_SERVE_MESH", "1") != "0":
+        r_mesh = _run_phase("serve_mesh")
+        if r_mesh:
+            log(
+                f"bench: serve-mesh {r_mesh['rps_1r']} -> "
+                f"{r_mesh['rps_4r']} req/s at 1->4 replicas "
+                f"(x{r_mesh['scaling_ratio']}), chaos typed_only = "
+                f"{r_mesh['chaos']['typed_only']}"
+            )
+
     # query push-down sweep (PQT_BENCH_QUERY=0 to skip): vec-vs-scalar
     # residual filtering + filtered-aggregate vs row-streaming req/s
     r_query = None
@@ -2957,6 +3197,8 @@ def main() -> None:
         artifact["io_write"] = r_io_write
     if r_serve:
         artifact["serve"] = r_serve
+    if r_mesh:
+        artifact["mesh"] = r_mesh
     if r_query:
         artifact["query"] = r_query
     if r_chaos:
@@ -3008,7 +3250,7 @@ def _metric_direction(key: str) -> int:
     if (
         "rows_s" in k
         or "req_s" in k
-        or k == "rps"  # the serve sweep's requests/s headline
+        or k.startswith("rps")  # serve "rps", mesh "rps_1r"/"rps_4r"
         or "speedup" in k
         or k.startswith("vs_")
         or k.endswith("_ratio")
@@ -3427,6 +3669,8 @@ if __name__ == "__main__":
         _phase_encode()
     elif argv and argv[0] == "--serve":
         _phase_serve()
+    elif argv and argv[0] == "--serve-mesh":
+        _phase_serve_mesh()
     elif argv and argv[0] == "--query":
         _phase_query()
     elif argv and argv[0] == "--device":
@@ -3455,6 +3699,8 @@ if __name__ == "__main__":
             _phase_io_write()
         elif name == "serve":
             _phase_serve()
+        elif name == "serve_mesh":
+            _phase_serve_mesh()
         elif name == "query":
             _phase_query()
         elif name == "device_query":

@@ -78,10 +78,13 @@ POOL_LANES = (
     "pqt-dispatch",
     # PR 18 lane audit: every pqt-* pool spawned since PR 11, so no
     # worker thread folds into "other"
+    "pqt-mesh-http",  # the mesh router's accept loop (serve/mesh/router.py)
+    "pqt-mesh",  # the router's scatter fan-out pool
     "pqt-host",  # reader prepare pool (core/reader.py)
     "pqt-flush",  # writer background flush pool (sink/encoder.py)
     "pqt-prof",  # the profiler's own sampler thread
     "pqt-httpstub",  # the testing stub's serve thread
+    "pqt-flaky-replica",  # the chaos proxy's serve thread (testing/)
 )
 
 _OVERFLOW_FRAME = "~overflow~"

@@ -1,0 +1,41 @@
+"""parquet_tpu.serve.mesh — the multi-host sharded serve layer.
+
+Three layers over the single-daemon stack:
+
+- ring.py    consistent hashing over plan units / shard keys, so repeated
+             requests keep landing the same unit on the same replica (its
+             footer/block caches stay warm) and adding or removing one
+             replica only moves that replica's share of the keyspace.
+- table.py   the router's replica table: static `--replica URL` list, one
+             circuit breaker + latency window per replica, passive state
+             (up/degraded/draining/down/open-breaker) mirrored on the
+             mesh_replica_state gauge family and GET /v1/debug/mesh.
+- client.py  the resilient mesh client: breaker-gated attempts in ring
+             preference order, Retry-After/brownout-aware retry, drain-
+             aware failover, hedged duplicates past the observed p95, and
+             a traceparent child span injected on EVERY router->replica
+             hop (the join key `parquet-tool trace-merge` stitches on).
+- router.py  the HTTP front door (`parquet-tool serve --mesh`): the same
+             /v1/scan, /v1/query, /v1/plan, /metrics, /healthz surface as
+             one daemon, scatter-gathering a request's plan units across
+             the fleet and merging /v1/query partials with the exact
+             pyarrow merge — responses are byte-identical to a single
+             daemon serving the whole corpus (the acceptance oracle the
+             differential tests pin).
+"""
+
+from .client import MeshClient, MeshError
+from .ring import HashRing
+from .router import MeshConfig, MeshRouter, MeshService
+from .table import Replica, ReplicaTable
+
+__all__ = [
+    "HashRing",
+    "MeshClient",
+    "MeshConfig",
+    "MeshError",
+    "MeshRouter",
+    "MeshService",
+    "Replica",
+    "ReplicaTable",
+]

@@ -205,6 +205,23 @@ class ServeConfig:
             )
         if self.brownout_window_s <= 0:
             raise ValueError("serve: brownout_window_s must be positive")
+        # validate the shard assignment AT STARTUP: a daemon silently
+        # serving the wrong stripe (shard index past the count) would
+        # answer every request with a plausible-looking empty subset —
+        # the one misconfiguration a mesh cannot detect from outside
+        if self.shard is not None:
+            try:
+                i, n = (int(x) for x in tuple(self.shard))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"serve: shard must be (index, count), got {self.shard!r}"
+                ) from None
+            if n < 1 or not 0 <= i < n:
+                raise ValueError(
+                    f"serve: shard index {i} out of range for count {n} "
+                    "(need n >= 1 and 0 <= index < count)"
+                )
+            self.shard = (i, n)
         # delegate the obs-knob validation to the one place that owns it
         _ObsConfig(
             ring_size=self.debug_ring_size,
@@ -414,11 +431,17 @@ class ScanService:
             status_str = "degraded"
         else:
             status_str = "ok"
+        in_flight = self.admission.in_flight
         body = {
             "status": status_str,
-            "in_flight": self.admission.in_flight,
+            "in_flight": in_flight,
             "slo": verdict,
         }
+        if draining:
+            # the mesh client's failover reads this to tell "drains in a
+            # couple seconds, come back" from "gone" — the remaining
+            # in-flight count above says how much work is still leaving
+            body["retry_after_s"] = min(30, 1 + in_flight)
         return (503 if draining else 200), body
 
     # -- the /v1/debug bodies (HTTP-free, like plan/scan) ----------------------
@@ -1021,7 +1044,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if route == "/healthz":
                 status, body = self.service.healthz()
-                self._send_json(status, body)
+                self._send_json(
+                    status, body, retry_after=body.get("retry_after_s")
+                )
                 return
             if route == "/metrics":
                 self._drain_body()
@@ -1181,13 +1206,22 @@ class _Handler(BaseHTTPRequestHandler):
 
 class ScanServer:
     """Lifecycle wrapper: bind, serve (foreground or background thread),
-    drain, stop. `port=0` binds an ephemeral port (tests/bench)."""
+    drain, stop. `port=0` binds an ephemeral port (tests/bench).
+
+    Subclass seams (the mesh router rides the whole lifecycle — bind,
+    background serve, drain, signal handlers — with its own brain):
+    `service_cls` builds the request brain from the config, `handler_cls`
+    is the per-connection handler, `thread_name` names the accept loop."""
+
+    service_cls = ScanService
+    handler_cls = _Handler
+    thread_name = "pqt-serve-http"
 
     def __init__(self, config: ServeConfig, *, verbose: bool = False):
         self.config = config
-        self.service = ScanService(config)
+        self.service = type(self).service_cls(config)
         self._httpd = ThreadingHTTPServer(
-            (config.host, config.port), _Handler
+            (config.host, config.port), type(self).handler_cls
         )
         self._httpd.daemon_threads = True
         self._httpd.service = self.service
@@ -1215,7 +1249,8 @@ class ScanServer:
 
     def start_background(self) -> "ScanServer":
         self._thread = threading.Thread(
-            target=self.serve_forever, name="pqt-serve-http", daemon=True
+            target=self.serve_forever, name=type(self).thread_name,
+            daemon=True,
         )
         self._thread.start()
         return self
@@ -1252,8 +1287,10 @@ class ScanServer:
             self._httpd.server_close()
             # a tiered cache the SERVICE built owns spill files/fds; a
             # config-passed block_cache belongs to the caller (it may be
-            # shared with live dataset workers). BlockCache has no close.
-            cache = self.service.session.block_cache
+            # shared with live dataset workers). BlockCache has no close;
+            # a sessionless service (the mesh router) has no cache at all.
+            session = getattr(self.service, "session", None)
+            cache = getattr(session, "block_cache", None)
             if getattr(self.service, "_owns_cache", True) and hasattr(
                 cache, "close"
             ):

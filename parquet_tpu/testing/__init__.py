@@ -1,4 +1,5 @@
 """Test-support tooling shipped with the package: byte-level fault
-injection (faults.py) and transport-level fault injection (flaky.py)."""
+injection (faults.py), transport-level fault injection (flaky.py), and
+wire-level chaos for serve-mesh replicas (flaky_replica.py)."""
 
 from .flaky import FlakySource  # noqa: F401

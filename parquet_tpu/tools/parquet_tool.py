@@ -1201,15 +1201,11 @@ def cmd_serve(args) -> int:
             )
             return 2
         remote_map[prefix] = url
-    config = ServeConfig(
+    mesh = getattr(args, "mesh", False)
+    # the knobs both roles share: admission, deadlines, obs, SLO
+    common = dict(
         host=args.host,
         port=args.port,
-        root=args.root,
-        remote_map=remote_map or None,
-        cache_mb=args.cache_mb,
-        cache_disk_mb=args.cache_disk_mb,
-        cache_dir=args.cache_dir,
-        io_autotune=args.io_autotune,
         max_inflight=args.max_inflight,
         tenant_concurrent=args.tenant_concurrent,
         tenant_budget_mb=args.tenant_budget_mb,
@@ -1218,9 +1214,7 @@ def cmd_serve(args) -> int:
         max_timeout_s=args.max_timeout_s,
         brownout_wait_ms=args.brownout_wait_ms,
         brownout_depth=args.brownout_depth,
-        window=args.window,
         socket_timeout_s=args.socket_timeout_s,
-        shard=_parse_shard(args.shard),
         slo_availability=args.slo_availability,
         slo_p99_ms=args.slo_p99_ms,
         # obs flags default to None so ObsConfig (via ServeConfig) stays
@@ -1236,11 +1230,61 @@ def cmd_serve(args) -> int:
             if v is not None
         },
     )
-    server = ScanServer(config, verbose=args.verbose)
+    if mesh:
+        from ..serve.mesh import MeshConfig, MeshRouter
+
+        if not args.replica:
+            print(
+                "error: mesh mode needs at least one --replica URL",
+                file=sys.stderr,
+            )
+            return 2
+        for val, name in (
+            (args.root, "--root"),
+            (args.shard, "--shard"),
+            (remote_map, "--remote-map"),
+        ):
+            if val:
+                print(
+                    f"error: {name} belongs on the replica daemons, not "
+                    "the router (the router owns no corpus)",
+                    file=sys.stderr,
+                )
+                return 2
+        config = MeshConfig(
+            replicas=tuple(args.replica),
+            vnodes=args.vnodes,
+            scatter=not args.no_scatter,
+            scatter_window=args.scatter_window,
+            backend_timeout_s=args.backend_timeout_s,
+            hedge=not args.no_hedge,
+            breaker_failures=args.breaker_failures,
+            breaker_open_s=args.breaker_open_s,
+            **common,
+        )
+        server = MeshRouter(config, verbose=args.verbose)
+    else:
+        config = ServeConfig(
+            root=args.root,
+            remote_map=remote_map or None,
+            cache_mb=args.cache_mb,
+            cache_disk_mb=args.cache_disk_mb,
+            cache_dir=args.cache_dir,
+            io_autotune=args.io_autotune,
+            window=args.window,
+            shard=_parse_shard(args.shard),
+            **common,
+        )
+        server = ScanServer(config, verbose=args.verbose)
     server.install_signal_handlers()
     # the exact line tests/scripts parse for the ephemeral --port 0 case
     print(f"serve: listening on {server.url}", flush=True)
-    if server.config.root:
+    if mesh:
+        print(
+            f"serve: mesh router over {len(config.replicas)} replica(s)",
+            flush=True,
+        )
+    elif server.config.root:
         print(f"serve: root {server.config.root}", flush=True)
     try:
         server.serve_forever()
@@ -1680,11 +1724,9 @@ def main(argv=None) -> int:
     )
     pn.set_defaults(fn=cmd_scan)
 
-    pe = sub.add_parser(
-        "serve",
-        help="run the concurrent scan/query daemon (POST /v1/scan, "
-        "GET /v1/plan, /metrics, /healthz); SIGTERM drains gracefully",
-    )
+    # serve and route share one flag set: `route` IS `serve --mesh`, so a
+    # parent parser keeps the two surfaces from drifting apart
+    pe = argparse.ArgumentParser(add_help=False)
     pe.add_argument("--host", default="127.0.0.1")
     pe.add_argument(
         "--port", type=int, default=8080, help="0 binds an ephemeral port"
@@ -1848,7 +1890,83 @@ def main(argv=None) -> int:
         help="optional p99 latency objective (ms): enables the latency "
         "SLI — at most 1%% of requests may run over this bar",
     )
-    pe.set_defaults(fn=cmd_serve)
+    pe.add_argument(
+        "--replica",
+        action="append",
+        metavar="URL",
+        help="a backend daemon's base URL (repeatable; mesh mode needs "
+        "at least one) — the router consistent-hashes plan units over "
+        "these and merges answers byte-identically",
+    )
+    pe.add_argument(
+        "--vnodes",
+        type=int,
+        default=64,
+        help="virtual nodes per replica on the hash ring (more = "
+        "smoother unit spread, slower table rebuilds)",
+    )
+    pe.add_argument(
+        "--no-scatter",
+        action="store_true",
+        help="mesh: forward each request whole to its owning replica "
+        "instead of scattering per plan unit",
+    )
+    pe.add_argument(
+        "--scatter-window",
+        type=int,
+        default=8,
+        help="mesh: per-request bound on in-flight unit fetches (the "
+        "scatter backpressure window)",
+    )
+    pe.add_argument(
+        "--backend-timeout-s",
+        type=float,
+        default=30.0,
+        help="mesh: per-hop timeout for one router->replica round trip "
+        "(the request deadline still bounds the whole fan-out)",
+    )
+    pe.add_argument(
+        "--no-hedge",
+        action="store_true",
+        help="mesh: disable the p95-armed duplicate attempt on the "
+        "next-preference replica",
+    )
+    pe.add_argument(
+        "--breaker-failures",
+        type=int,
+        default=3,
+        help="mesh: consecutive failures before a replica's circuit "
+        "breaker opens",
+    )
+    pe.add_argument(
+        "--breaker-open-s",
+        type=float,
+        default=2.0,
+        help="mesh: how long an open replica breaker rejects before "
+        "half-opening one probe",
+    )
+    ps = sub.add_parser(
+        "serve",
+        parents=[pe],
+        help="run the concurrent scan/query daemon (POST /v1/scan, "
+        "GET /v1/plan, /metrics, /healthz); SIGTERM drains gracefully; "
+        "--mesh turns it into the fleet router",
+    )
+    ps.add_argument(
+        "--mesh",
+        action="store_true",
+        help="serve as the mesh router over --replica daemons instead "
+        "of scanning locally (same as the `route` subcommand)",
+    )
+    ps.set_defaults(fn=cmd_serve)
+    pr = sub.add_parser(
+        "route",
+        parents=[pe],
+        help="run the mesh router over --replica daemons (alias for "
+        "`serve --mesh`): consistent-hash scatter/gather for /v1/scan "
+        "and /v1/query with byte-identical merged results",
+    )
+    pr.set_defaults(fn=cmd_serve, mesh=True)
 
     pd = sub.add_parser(
         "debug",

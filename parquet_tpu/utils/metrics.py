@@ -242,6 +242,35 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   query_device_unavailable_total    units that wanted the device path but
                                     jax was not importable (device=
                                     misconfiguration made visible)
+  mesh_requests_total{endpoint=,mode=}  requests the mesh router routed,
+                                    "scatter" = fanned out per plan unit,
+                                    "passthrough" = forwarded whole to
+                                    one replica (limit/shard-pinned and
+                                    0/1-unit requests)
+  mesh_backend_requests_total{status=}  router->replica HTTP round trips,
+                                    per response status (the router-side
+                                    twin of io_http_requests_total)
+  mesh_retries_total{reason=}       backend attempts the mesh client
+                                    failed over: "transport" (reset/
+                                    truncated/refused), "5xx", "draining"
+                                    (clean shed, breaker untouched),
+                                    "shed" (brownout/queue_full/429),
+                                    "breaker_open" (fast-fail, no
+                                    transport touch)
+  mesh_hedges_total{outcome=}       hedged duplicates to a second
+                                    replica: "launched" when the first
+                                    attempt outlives its replica's p95,
+                                    then "won_primary"/"won_hedge"
+  mesh_replica_state{replica=}      gauge: composite routing state per
+                                    replica (0 up, 1 degraded, 2
+                                    draining, 3 open-breaker, 4 down);
+                                    label set bounded by the static
+                                    --replica list
+  mesh_scatter_units_total{endpoint=}  plan units fanned out by
+                                    scatter-gather execution
+  mesh_partial_failures_total{target=}  requests that exhausted EVERY
+                                    replica and surfaced the typed
+                                    partial_failure error
 
 Exposition variants: render_prometheus() is the classic text format every
 scraper understands; render_openmetrics() is the content-negotiated
@@ -441,6 +470,33 @@ _HELP = {
         "fraction of the error budget left in the slow window, per SLI"
     ),
     "slo_verdict": "SLO health verdict (0 ok, 1 warn, 2 burning)",
+    # mesh routing plane (PR 19): the sharded-serve router + mesh client
+    "mesh_requests_total": (
+        "requests routed by the mesh router, per endpoint and mode "
+        "(scatter/passthrough)"
+    ),
+    "mesh_backend_requests_total": (
+        "router->replica HTTP round trips, per response status"
+    ),
+    "mesh_retries_total": (
+        "backend attempts the mesh client failed over, per reason "
+        "(transport/5xx/draining/shed/breaker_open)"
+    ),
+    "mesh_hedges_total": (
+        "hedged backend duplicates: launched past the replica p95, then "
+        "won_primary/won_hedge for how the race resolved"
+    ),
+    "mesh_replica_state": (
+        "gauge: composite replica routing state (0 up, 1 degraded, "
+        "2 draining, 3 open-breaker, 4 down); one series per --replica"
+    ),
+    "mesh_scatter_units_total": (
+        "plan units fanned out by scatter-gather, per endpoint"
+    ),
+    "mesh_partial_failures_total": (
+        "requests that exhausted every replica (typed partial_failure), "
+        "per target route"
+    ),
     # process self-metrics, refreshed at exposition render (stdlib /proc
     # reads; absent on platforms without procfs)
     "process_resident_memory_bytes": "resident set size of this process",

@@ -724,6 +724,94 @@ class TestServeFleet:
         assert "daemon URL" in capsys.readouterr().err
 
 
+# -- routed trace stitching over a live mesh -----------------------------------
+
+
+class TestRoutedTraceMerge:
+    def test_router_hop_spans_stitch_into_one_timeline(self, corpus, tmp_path):
+        """The PR 19 acceptance pin: a client traceparent through the mesh
+        ROUTER rides every router->replica hop as a fresh child span (the
+        wire proxy records the received headers), lands in both the
+        router's and the replica's flight-recorder docs, and trace-merge
+        stitches the multi-process timeline on the shared trace-id."""
+        from parquet_tpu.serve.mesh import MeshConfig, MeshRouter
+        from parquet_tpu.testing.flaky_replica import FlakyReplica
+
+        client_tid = "beef" * 8
+        client_tp = "00-" + client_tid + "-" + "ab" * 8 + "-01"
+        backend = ScanServer(
+            ServeConfig(port=0, root=str(corpus))
+        ).start_background()
+        proxy = FlakyReplica(backend.url, seed=0).start()  # a clean wire tap
+        other = ScanServer(
+            ServeConfig(port=0, root=str(corpus))
+        ).start_background()
+        router = MeshRouter(
+            MeshConfig(
+                port=0,
+                replicas=(proxy.url, other.url),
+                trace_sample_rate=1.0,  # keep every span tree
+            )
+        ).start_background()
+        try:
+            status, headers, body = _request(
+                router,
+                "POST",
+                "/v1/scan",
+                {"paths": "a.parquet"},
+                headers={"traceparent": client_tp},
+            )
+            assert status == 200, body
+            echoed = propagate.parse_traceparent(headers["traceparent"])
+            assert echoed.trace_id == client_tid
+            rid_router = headers["X-Request-Id"]
+            # every hop the wire tap saw is OUR trace with a FRESH span
+            assert proxy.traceparents, "no hop reached the tapped replica"
+            spans = set()
+            for raw in proxy.traceparents:
+                got = propagate.parse_traceparent(raw)
+                assert got is not None, raw
+                assert got.trace_id == client_tid
+                assert got.span_id != "ab" * 8
+                spans.add(got.span_id)
+            assert len(spans) == len(proxy.traceparents)
+            # the shared in-process recorder holds BOTH sides' request
+            # docs under the one trace-id; pick one per side and merge
+            status, _, body = _request(router, "GET", "/v1/debug/requests")
+            assert status == 200
+            listed = json.loads(body)["requests"]
+            rids = [r["id"] for r in listed if r.get("trace_id") == client_tid]
+            assert rid_router in rids
+            rid_replica = next(r for r in rids if r != rid_router)
+            docs = []
+            for rid in (rid_router, rid_replica):
+                status, _, body = _request(
+                    router, "GET", f"/v1/debug/requests/{rid}/trace"
+                )
+                assert status == 200, body
+                doc = json.loads(body)
+                assert (
+                    doc["otherData"]["propagation"]["trace_id"] == client_tid
+                )
+                docs.append(doc)
+            pa_, pb = tmp_path / "router.json", tmp_path / "replica.json"
+            po = tmp_path / "merged.json"
+            pa_.write_text(json.dumps(docs[0]))
+            pb.write_text(json.dumps(docs[1]))
+            rc = tool_main(["trace-merge", str(pa_), str(pb), "-o", str(po)])
+            assert rc == 0
+            merged = json.loads(po.read_text())
+            assert (
+                merged["otherData"]["propagation"]["trace_id"] == client_tid
+            )
+            assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+        finally:
+            router.close()
+            proxy.close()
+            backend.close()
+            other.close()
+
+
 # -- lane audit ----------------------------------------------------------------
 
 

@@ -1,0 +1,598 @@
+"""PR 19: parquet_tpu.serve.mesh — multi-host sharded serve.
+
+Pinned here:
+  * byte-identity: a routed /v1/scan (jsonl AND arrow-ipc, with columns,
+    filters, limits) and a routed /v1/query (aggregates, group_by) over a
+    3-replica mesh answer byte-for-byte what ONE daemon over the same
+    corpus answers — the scatter/merge is exact, floats included, because
+    the router replays the daemon's own per-unit merge sequence;
+  * resilience: a replica killed mid-hammer, a draining replica, and a
+    breaker-opened replica cost typed retries only — every client-visible
+    response is byte-identical or a typed error record, never a torn
+    stream, never a splice;
+  * chaos: a FlakyReplica proxy injecting seeded 503s, connection resets,
+    and TORN payloads between router and replica changes nothing the
+    client can see;
+  * consistent hashing: the ring is deterministic across instances and
+    its preference walk visits every node exactly once;
+  * satellites: --shard validation is typed at config time and visible in
+    /v1/debug/vars; /healthz while draining carries the remaining
+    in-flight count and a Retry-After hint; every mesh_* metric family
+    renders with HELP + TYPE; /v1/debug/mesh answers the fleet's state.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from parquet_tpu.serve import ScanServer, ServeConfig
+from parquet_tpu.serve.mesh import (
+    HashRing,
+    MeshConfig,
+    MeshRouter,
+    ReplicaTable,
+)
+from parquet_tpu.testing.flaky_replica import FlakyReplica
+
+WATCHDOG_S = 30.0
+
+ROWS_PER_FILE = 800
+ROW_GROUP = 200
+FILES = ("a.parquet", "b.parquet", "c.parquet")
+GROUPS = ("alpha", "beta", "gamma", "delta", "epsilon")
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    d = tmp_path_factory.mktemp("mesh_router_corpus")
+    rng = np.random.default_rng(19)
+    for f, name in enumerate(FILES):
+        base = f * ROWS_PER_FILE
+        t = pa.table(
+            {
+                "id": pa.array(
+                    np.arange(base, base + ROWS_PER_FILE, dtype=np.int64)
+                ),
+                "v": pa.array(
+                    rng.standard_normal(ROWS_PER_FILE).astype(np.float64)
+                ),
+                "g": pa.array(
+                    [GROUPS[i % len(GROUPS)] for i in range(ROWS_PER_FILE)]
+                ),
+            }
+        )
+        pq.write_table(t, str(d / name), row_group_size=ROW_GROUP)
+    return d
+
+
+@pytest.fixture(scope="module")
+def fleet(corpus):
+    """One reference daemon, three replicas, one router — all over the
+    same corpus. The router is constructed LAST so its obs config owns
+    the process-wide recorder."""
+    direct = ScanServer(
+        ServeConfig(port=0, root=str(corpus))
+    ).start_background()
+    replicas = [
+        ScanServer(ServeConfig(port=0, root=str(corpus))).start_background()
+        for _ in range(3)
+    ]
+    router = MeshRouter(
+        MeshConfig(
+            port=0,
+            replicas=tuple(r.url for r in replicas),
+            trace_sample_rate=1.0,
+        )
+    ).start_background()
+    try:
+        yield direct, replicas, router
+    finally:
+        router.close()
+        for s in replicas + [direct]:
+            s.close()
+
+
+def _request(server, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection(
+        server.host, server.port, timeout=WATCHDOG_S
+    )
+    try:
+        conn.request(
+            method,
+            path,
+            body=json.dumps(body).encode() if body is not None else None,
+            headers=headers or {},
+        )
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _error_code(body: bytes) -> str:
+    return json.loads(body)["error"]["code"]
+
+
+def _differential(direct, router, method, path, body=None):
+    """One request to the single daemon and to the router: both 200, both
+    byte-identical. Returns the (shared) payload."""
+    s1, _h1, b1 = _request(direct, method, path, body)
+    s2, _h2, b2 = _request(router, method, path, body)
+    assert s1 == 200, b1
+    assert s2 == 200, b2
+    assert b1 == b2, (len(b1), len(b2))
+    return b1
+
+
+# -- consistent hashing --------------------------------------------------------
+
+
+class TestHashRing:
+    NODES = ("http://h0:1", "http://h1:1", "http://h2:1", "http://h3:1")
+
+    def test_lookup_deterministic_across_instances(self):
+        a = HashRing(self.NODES, vnodes=64)
+        b = HashRing(self.NODES, vnodes=64)
+        for k in range(200):
+            assert a.lookup(f"sig#{k}") == b.lookup(f"sig#{k}")
+
+    def test_preference_visits_every_node_once(self):
+        ring = HashRing(self.NODES, vnodes=64)
+        for k in range(50):
+            pref = ring.preference(f"unit#{k}")
+            assert sorted(pref) == sorted(self.NODES)
+            assert pref[0] == ring.lookup(f"unit#{k}")
+
+    def test_keys_spread_over_all_nodes(self):
+        ring = HashRing(self.NODES, vnodes=64)
+        owners = {ring.lookup(f"plan#{k}") for k in range(500)}
+        assert owners == set(self.NODES)
+
+    def test_empty_and_bad_vnodes_are_typed(self):
+        with pytest.raises(ValueError):
+            HashRing((), vnodes=64)
+        with pytest.raises(ValueError):
+            HashRing(self.NODES, vnodes=0)
+
+    def test_table_rejects_empty_and_bad_urls(self):
+        with pytest.raises(ValueError):
+            ReplicaTable(())
+        with pytest.raises(ValueError):
+            ReplicaTable(("ftp://nope:1",))
+        with pytest.raises(ValueError):
+            ReplicaTable(("http://host:1/path",))
+
+
+# -- satellite: --shard validation at startup ----------------------------------
+
+
+class TestShardValidation:
+    def test_out_of_range_index_is_typed(self):
+        with pytest.raises(ValueError, match="out of range"):
+            ServeConfig(port=0, shard=(5, 2))
+
+    def test_zero_count_is_typed(self):
+        with pytest.raises(ValueError, match="out of range"):
+            ServeConfig(port=0, shard=(0, 0))
+
+    def test_negative_index_is_typed(self):
+        with pytest.raises(ValueError, match="out of range"):
+            ServeConfig(port=0, shard=(-1, 2))
+
+    def test_malformed_shard_is_typed(self):
+        with pytest.raises(ValueError, match="shard"):
+            ServeConfig(port=0, shard=("x", "y"))
+
+    def test_valid_shard_normalizes_and_rides_debug_vars(self, corpus):
+        cfg = ServeConfig(port=0, root=str(corpus), shard=("1", "3"))
+        assert cfg.shard == (1, 3)
+        with ScanServer(cfg) as server:
+            server.start_background()
+            status, _h, body = _request(server, "GET", "/v1/debug/vars")
+            assert status == 200
+            assert json.loads(body)["serve"]["shard"] == [1, 3]
+
+
+# -- satellite: /healthz while draining ----------------------------------------
+
+
+class TestHealthzDraining:
+    def test_draining_healthz_reports_inflight_and_retry_after(self, corpus):
+        with ScanServer(ServeConfig(port=0, root=str(corpus))) as server:
+            server.start_background()
+            ticket = server.service.admission.admit("held")
+            t = threading.Thread(
+                target=server.drain, kwargs={"timeout": WATCHDOG_S}
+            )
+            t.start()
+            try:
+                deadline = time.monotonic() + WATCHDOG_S
+                while not server.service.admission.draining:
+                    assert time.monotonic() < deadline, "drain never started"
+                    time.sleep(0.005)
+                status, headers, body = _request(server, "GET", "/healthz")
+                assert status == 503
+                doc = json.loads(body)
+                assert doc["status"] == "draining"
+                assert doc["in_flight"] == 1
+                assert doc["retry_after_s"] == 2  # min(30, 1 + in_flight)
+                assert headers["Retry-After"] == "2"
+            finally:
+                ticket.release()
+                t.join(WATCHDOG_S)
+            assert not t.is_alive()
+
+
+# -- byte-identity: routed == direct -------------------------------------------
+
+
+class TestRoutedByteIdentity:
+    def test_mesh_smoke_routed_equals_direct(self, fleet):
+        """The make mesh-smoke headline: scan (both formats) and query
+        through the router answer byte-for-byte what one daemon answers."""
+        direct, _replicas, router = fleet
+        _differential(direct, router, "POST", "/v1/scan",
+                      {"paths": "*.parquet"})
+        _differential(direct, router, "POST", "/v1/scan",
+                      {"paths": "*.parquet", "format": "arrow-ipc"})
+        _differential(
+            direct, router, "POST", "/v1/query",
+            {"paths": "*.parquet",
+             "aggregates": [["count"], ["sum", "v"]]},
+        )
+
+    def test_scan_jsonl_columns_filters_byte_identical(self, fleet):
+        direct, _replicas, router = fleet
+        body = _differential(
+            direct, router, "POST", "/v1/scan",
+            {"paths": "*.parquet", "columns": ["id", "g"],
+             "filters": [["id", ">=", 700], ["id", "<", 1900]]},
+        )
+        rows = [json.loads(line) for line in body.splitlines()]
+        assert [r["id"] for r in rows] == list(range(700, 1900))
+
+    def test_scan_arrow_filtered_byte_identical(self, fleet):
+        direct, _replicas, router = fleet
+        body = _differential(
+            direct, router, "POST", "/v1/scan",
+            {"paths": "*.parquet", "format": "arrow-ipc",
+             "filters": [["v", ">", 0.25]]},
+        )
+        # the merged frame is one VALID IPC stream, not a concatenation
+        table = pa.ipc.open_stream(pa.py_buffer(body)).read_all()
+        assert table.num_rows > 0
+        assert np.all(table.column("v").to_numpy() > 0.25)
+
+    def test_query_group_by_float_sums_byte_identical(self, fleet):
+        direct, _replicas, router = fleet
+        body = _differential(
+            direct, router, "POST", "/v1/query",
+            {"paths": "*.parquet", "group_by": ["g"],
+             "aggregates": [["count"], ["sum", "v"], ["min", "id"],
+                            ["max", "v"]]},
+        )
+        doc = json.loads(body)
+        assert doc["rows_scanned"] == ROWS_PER_FILE * len(FILES)
+        assert len(doc["groups"]) == len(GROUPS)
+
+    def test_scan_limit_passthrough_byte_identical(self, fleet):
+        direct, _replicas, router = fleet
+        body = _differential(
+            direct, router, "POST", "/v1/scan",
+            {"paths": "*.parquet", "limit": 123},
+        )
+        assert len(body.splitlines()) == 123
+
+    def test_scan_explicit_shard_passthrough_byte_identical(self, fleet):
+        direct, _replicas, router = fleet
+        stripes = [
+            _differential(direct, router, "POST", "/v1/scan",
+                          {"paths": "*.parquet", "shard": [i, 3]})
+            for i in range(3)
+        ]
+        whole = _differential(direct, router, "POST", "/v1/scan",
+                              {"paths": "*.parquet"})
+        assert sum(len(s.splitlines()) for s in stripes) == len(
+            whole.splitlines()
+        )
+
+    def test_plan_matches_single_daemon(self, fleet):
+        direct, _replicas, router = fleet
+        s1, _h, b1 = _request(direct, "POST", "/v1/plan",
+                              {"paths": "*.parquet"})
+        s2, _h, b2 = _request(router, "POST", "/v1/plan",
+                              {"paths": "*.parquet"})
+        assert s1 == s2 == 200
+        p1, p2 = json.loads(b1), json.loads(b2)
+        assert p1["units"] == p2["units"]
+        assert p1["files"] == p2["files"]
+
+    def test_typed_errors_forward_through_the_router(self, fleet):
+        _direct, _replicas, router = fleet
+        status, _h, body = _request(
+            router, "POST", "/v1/scan", {"paths": "../escape.parquet"}
+        )
+        assert status == 403
+        assert _error_code(body) == "path_outside_root"
+        status, _h, body = _request(
+            router, "POST", "/v1/scan", {"paths": "missing.parquet"}
+        )
+        assert status == 404
+
+
+# -- resilience: kill / drain / breaker ----------------------------------------
+
+
+def _mini_fleet(corpus, n=3, **mesh_kw):
+    replicas = [
+        ScanServer(ServeConfig(port=0, root=str(corpus))).start_background()
+        for _ in range(n)
+    ]
+    router = MeshRouter(
+        MeshConfig(
+            port=0, replicas=tuple(r.url for r in replicas), **mesh_kw
+        )
+    ).start_background()
+    return replicas, router
+
+
+class TestMeshResilience:
+    def test_replica_killed_mid_hammer_typed_retries_only(self, corpus):
+        replicas, router = _mini_fleet(corpus)
+        try:
+            want = _request(router, "POST", "/v1/scan",
+                            {"paths": "*.parquet"})[2]
+            results: list = []
+            stop = threading.Event()
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        st, _h, body = _request(
+                            router, "POST", "/v1/scan",
+                            {"paths": "*.parquet"},
+                        )
+                    except http.client.HTTPException as e:
+                        results.append(("torn", repr(e)))
+                        continue
+                    if st == 200:
+                        results.append(
+                            ("ok", None) if body == want
+                            else ("mismatch", len(body))
+                        )
+                    else:
+                        # a typed error body or nothing at all
+                        results.append(("typed", _error_code(body)))
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.4)
+            replicas[1].close()  # hard kill, requests in flight
+            time.sleep(1.2)
+            stop.set()
+            for t in threads:
+                t.join(WATCHDOG_S)
+                assert not t.is_alive(), "hammer thread hung"
+            kinds = {k for k, _ in results}
+            assert "mismatch" not in kinds, results
+            assert "torn" not in kinds, results
+            assert ("ok", None) in results
+            # the fleet keeps answering byte-identically after the kill
+            st, _h, body = _request(router, "POST", "/v1/scan",
+                                    {"paths": "*.parquet"})
+            assert st == 200 and body == want
+        finally:
+            router.close()
+            for s in replicas:
+                s.close()
+
+    def test_replica_draining_mid_scan_and_query_byte_identical(self, corpus):
+        replicas, router = _mini_fleet(corpus)
+        try:
+            want_scan = _request(router, "POST", "/v1/scan",
+                                 {"paths": "*.parquet"})[2]
+            want_query = _request(
+                router, "POST", "/v1/query",
+                {"paths": "*.parquet", "group_by": ["g"],
+                 "aggregates": [["sum", "v"]]},
+            )[2]
+            t = threading.Thread(
+                target=replicas[0].drain, kwargs={"timeout": WATCHDOG_S}
+            )
+            t.start()
+            deadline = time.monotonic() + WATCHDOG_S
+            while not replicas[0].service.admission.draining:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            for _ in range(3):
+                st, _h, body = _request(router, "POST", "/v1/scan",
+                                        {"paths": "*.parquet"})
+                assert st == 200 and body == want_scan
+                st, _h, body = _request(
+                    router, "POST", "/v1/query",
+                    {"paths": "*.parquet", "group_by": ["g"],
+                     "aggregates": [["sum", "v"]]},
+                )
+                assert st == 200 and body == want_query
+            # the fleet page knows who is draining
+            st, _h, body = _request(router, "GET", "/v1/debug/mesh")
+            assert st == 200
+            states = [r["state"] for r in json.loads(body)["replicas"]]
+            assert states.count("draining") == 1, states
+            t.join(WATCHDOG_S)
+        finally:
+            router.close()
+            for s in replicas:
+                s.close()
+
+    def test_dead_replica_opens_its_breaker(self, corpus):
+        replicas, router = _mini_fleet(
+            corpus, breaker_failures=2, breaker_open_s=WATCHDOG_S
+        )
+        try:
+            replicas[2].close()
+            dead = router.service.table.by_url[replicas[2].url.rstrip("/")]
+            # distinct signatures spread distinct unit keys over the ring,
+            # so the dead replica keeps getting (and failing) attempts
+            for i in range(12):
+                st, _h, _b = _request(
+                    router, "POST", "/v1/scan",
+                    {"paths": "*.parquet", "filters": [["id", ">=", i]]},
+                )
+                assert st == 200
+                if dead.breaker.state == "open":
+                    break
+            assert dead.breaker.state == "open"
+            assert dead.state() == "open-breaker"
+            # an open breaker is a dict lookup, not a connect timeout
+            t0 = time.monotonic()
+            st, _h, _b = _request(router, "POST", "/v1/scan",
+                                  {"paths": "*.parquet"})
+            assert st == 200
+            assert time.monotonic() - t0 < WATCHDOG_S / 2
+        finally:
+            router.close()
+            for s in replicas[:2]:
+                s.close()
+
+
+# -- chaos: the flaky wire -----------------------------------------------------
+
+
+class TestFlakyReplicaChaos:
+    def test_seeded_wire_faults_change_nothing_visible(self, corpus):
+        """503s, connection resets, and torn payloads between router and
+        one replica: every routed answer stays byte-identical."""
+        backend = ScanServer(
+            ServeConfig(port=0, root=str(corpus))
+        ).start_background()
+        clean = ScanServer(
+            ServeConfig(port=0, root=str(corpus))
+        ).start_background()
+        proxy = FlakyReplica(
+            backend.url, seed=23,
+            error_rate=0.2, drop_rate=0.15, short_rate=0.15,
+        ).start()
+        router = MeshRouter(
+            MeshConfig(port=0, replicas=(proxy.url, clean.url))
+        ).start_background()
+        try:
+            want_scan = _request(clean, "POST", "/v1/scan",
+                                 {"paths": "*.parquet"})[2]
+            want_query = _request(
+                clean, "POST", "/v1/query",
+                {"paths": "*.parquet", "aggregates": [["sum", "v"]]},
+            )[2]
+            for _ in range(8):
+                st, _h, body = _request(router, "POST", "/v1/scan",
+                                        {"paths": "*.parquet"})
+                assert st == 200 and body == want_scan
+                st, _h, body = _request(
+                    router, "POST", "/v1/query",
+                    {"paths": "*.parquet", "aggregates": [["sum", "v"]]},
+                )
+                assert st == 200 and body == want_query
+            assert proxy.faults_injected > 0  # the chaos actually fired
+        finally:
+            router.close()
+            proxy.close()
+            backend.close()
+            clean.close()
+
+    def test_torn_replica_payload_is_refetched_never_spliced(self, corpus):
+        """A truncated replica answer (declared N, delivered < N) must be
+        re-fetched whole from another replica — a splice would show up as
+        a byte-level mismatch."""
+        backend = ScanServer(
+            ServeConfig(port=0, root=str(corpus))
+        ).start_background()
+        clean = ScanServer(
+            ServeConfig(port=0, root=str(corpus))
+        ).start_background()
+        proxy = FlakyReplica(backend.url, seed=5, short_rate=0.5).start()
+        router = MeshRouter(
+            MeshConfig(port=0, replicas=(proxy.url, clean.url))
+        ).start_background()
+        try:
+            want = _request(clean, "POST", "/v1/scan",
+                            {"paths": "*.parquet"})[2]
+            for _ in range(6):
+                st, _h, body = _request(router, "POST", "/v1/scan",
+                                        {"paths": "*.parquet"})
+                assert st == 200 and body == want
+            assert proxy.faults_injected > 0
+        finally:
+            router.close()
+            proxy.close()
+            backend.close()
+            clean.close()
+
+
+# -- the fleet's debug and metrics surface -------------------------------------
+
+
+class TestMeshDebugSurface:
+    def test_debug_mesh_shape(self, fleet):
+        _direct, replicas, router = fleet
+        status, _h, body = _request(router, "GET", "/v1/debug/mesh")
+        assert status == 200
+        doc = json.loads(body)
+        assert len(doc["replicas"]) == 3
+        for row in doc["replicas"]:
+            assert row["state"] in (
+                "up", "degraded", "draining", "open-breaker", "down"
+            )
+            assert row["healthz"]["status"] == "ok"
+        assert doc["counts"]["up"] == 3
+        assert sorted(doc["ring"]["nodes"]) == sorted(
+            r.url.rstrip("/") for r in replicas
+        )
+        assert doc["scatter"]["enabled"] is True
+        assert doc["hedge"]["enabled"] is True
+
+    def test_debug_vars_mesh_mode(self, fleet):
+        _direct, replicas, router = fleet
+        status, _h, body = _request(router, "GET", "/v1/debug/vars")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["mode"] == "mesh"
+        assert len(doc["replicas"]) == 3
+        assert doc["mesh"]["scatter"] is True
+        assert doc["mesh"]["vnodes"] == 64
+
+    def test_mesh_metric_families_render_with_help_and_type(self, fleet):
+        direct, _replicas, router = fleet
+        # drive at least one scattered request so the counters exist
+        _differential(direct, router, "POST", "/v1/scan",
+                      {"paths": "a.parquet"})
+        status, _h, body = _request(router, "GET", "/metrics")
+        assert status == 200
+        text = body.decode()
+        for family in (
+            "mesh_requests_total",
+            "mesh_backend_requests_total",
+            "mesh_scatter_units_total",
+            "mesh_replica_state",
+        ):
+            name = f"parquet_tpu_{family}"
+            assert f"# HELP {name} " in text, family
+            assert f"# TYPE {name} " in text, family
+        # state is a GAUGE keyed per replica, never summed
+        assert "# TYPE parquet_tpu_mesh_replica_state gauge" in text
+
+    def test_healthz_reports_replica_counts(self, fleet):
+        _direct, _replicas, router = fleet
+        status, _h, body = _request(router, "GET", "/healthz")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["status"] in ("ok", "degraded")
+        assert doc["replicas"]["up"] + doc["replicas"]["degraded"] >= 1

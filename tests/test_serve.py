@@ -866,6 +866,61 @@ class TestTornStream:
             status, _h, body = _scan(server, {"paths": "a.parquet", "limit": 1})
             assert status == 200
 
+    def test_routed_mid_stream_corruption_tears_the_same_way(self, tmp_path):
+        """The PR 19 extension of the pin above: through the mesh ROUTER,
+        a corrupt second file still yields a detectably torn stream whose
+        terminal record is the replica's typed error — the healthy file's
+        units stream first, the corrupt unit's typed 422 (sent by every
+        replica BEFORE its 200) surfaces mid-stream, and the router never
+        fabricates a clean end-of-stream."""
+        from parquet_tpu.serve.mesh import MeshConfig, MeshRouter
+
+        d = _write_corpus(tmp_path)
+        bad = d / "b.parquet"
+        raw = bytearray(bad.read_bytes())
+        raw[4:2048] = b"\xde" * 2044
+        bad.write_bytes(bytes(raw))
+        replicas = [
+            ScanServer(
+                ServeConfig(port=0, root=str(d), window=1)
+            ).start_background()
+            for _ in range(3)
+        ]
+        router = MeshRouter(
+            MeshConfig(port=0, replicas=tuple(r.url for r in replicas))
+        ).start_background()
+        try:
+            conn = http.client.HTTPConnection(
+                router.host, router.port, timeout=WATCHDOG_S
+            )
+            try:
+                conn.request(
+                    "POST",
+                    "/v1/scan",
+                    body=json.dumps({"paths": "*.parquet"}).encode(),
+                )
+                resp = conn.getresponse()
+                assert resp.status == 200
+                with pytest.raises(http.client.IncompleteRead) as ei:
+                    resp.read()
+                partial = ei.value.partial
+            finally:
+                conn.close()
+            lines = partial.decode().splitlines()
+            # the healthy file streamed before the tear...
+            assert len(lines) > 1
+            # ...and the terminal record is the replica's typed error
+            assert json.loads(lines[-1])["error"]["code"] == "unreadable_file"
+            # the router survives and still serves the healthy file
+            status, _h, body = _request(
+                router, "POST", "/v1/scan", {"paths": "a.parquet", "limit": 1}
+            )
+            assert status == 200
+        finally:
+            router.close()
+            for s in replicas:
+                s.close()
+
 
 # -- chaos: the latency-spiked source ------------------------------------------
 
