@@ -1,7 +1,7 @@
 # Developer entry points. `make check` is the local quality gate mirrored by
 # .github/workflows/ci.yml.
 
-.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-io-write remote-write-smoke bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke fleet-smoke mesh-smoke bench-serve-mesh profile-live dryrun fuzz profile
+.PHONY: check test lint native bench bench-prepare bench-dataset bench-io bench-io-remote bench-io-write remote-write-smoke bench-write bench-encode encode-smoke bench-assembly bench-serve bench-query bench-device device-smoke bench-chaos chaos-smoke bench-compare bench-record bench-trend obs-smoke fleet-smoke mesh-smoke ingest-smoke bench-ingest bench-serve-mesh profile-live dryrun fuzz profile
 
 # tier-1 excludes `slow` (extended fault sweeps); `make fuzz` includes them;
 # chaos-smoke runs the scripted fault schedule end to end at smoke scale;
@@ -17,8 +17,11 @@
 # scrape (counters summed exactly) -> cross-process trace-merge round trip;
 # mesh-smoke pins the sharded-serve router (fast subset of
 # tests/test_mesh_router.py): routed scan/query byte-identical to one
-# daemon + a replica killed mid-hammer costing typed retries only
-check: native lint chaos-smoke obs-smoke encode-smoke device-smoke remote-write-smoke fleet-smoke mesh-smoke
+# daemon + a replica killed mid-hammer costing typed retries only;
+# ingest-smoke pins the data-lake write loop (fast subset of
+# tests/test_lake.py): the append/scan/compact concurrency hammer,
+# crash-mid-compact zero-loss, and time-travel byte-identity
+check: native lint chaos-smoke obs-smoke encode-smoke device-smoke remote-write-smoke fleet-smoke mesh-smoke ingest-smoke
 	python -m pytest tests/ -q -m 'not slow'
 
 # ruff (config in ruff.toml) when installed; images without it fall back to
@@ -166,6 +169,19 @@ fleet-smoke: native
 # byte-identical to a single daemon, one replica killed mid-hammer
 mesh-smoke: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_router.py -q -k 'mesh_smoke or byte_identical or killed'
+
+# the make-check-sized data-lake gate: concurrent append/scan/compact
+# with every scan pinning exactly one generation, a crash-mid-compact
+# losing nothing, and open_snapshot(gen=k) byte-identical across later
+# compactions
+ingest-smoke: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_lake.py -q -k 'hammer or exactly_one or crash or time_travel or byte_identical'
+
+# data-lake loop benchmark (writes the "ingest" artifact section):
+# sustained append rows/s + the compaction payoff (pruned-ratio gain,
+# filtered-scan speedup)
+bench-ingest: native
+	python bench.py --ingest
 
 # router scaling + chaos benchmark (writes the "mesh" artifact section)
 bench-serve-mesh:

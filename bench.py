@@ -117,6 +117,16 @@ PQT_CHAOS_ROWS / PQT_CHAOS_FILES / PQT_CHAOS_PHASE_S size it;
 PQT_CHAOS_SMOKE=1 is the make-check-sized smoke; PQT_BENCH_CHAOS=0 skips
 it in a full run. The result rides the --json artifact under "chaos".
 
+`--ingest` benchmarks the data-lake write loop (parquet_tpu.lake):
+sustained append rows/s into a sort-keyed table with every batch flushed
+(each flush a real sort+encode+manifest generation), then the compaction
+payoff — a sort-key point probe's pruned-unit ratio and filtered-scan
+wall before vs after one compaction folds the overlapping ingest files
+into clustered row groups. Tracked pins: ingest.append_rows_s,
+ingest.pruned_ratio_gain, ingest.scan_speedup. PQT_INGEST_ROWS /
+PQT_INGEST_BATCH size it; PQT_BENCH_INGEST=0 skips it in a full run.
+The result rides the --json artifact under "ingest".
+
 `--json out.json` (or PQT_BENCH_JSON=out.json) additionally writes the
 final structured result — headline + per-stage prepare breakdown + matrix —
 to a file, so the BENCH_* trajectory artifacts are produced by the harness
@@ -2776,6 +2786,109 @@ def _phase_chaos() -> None:
     _emit(out)
 
 
+# -- the data-lake ingest benchmark (--ingest / phase "ingest") ----------------
+
+INGEST_ROWS = int(os.environ.get("PQT_INGEST_ROWS", 150_000))
+INGEST_BATCH = int(os.environ.get("PQT_INGEST_BATCH", 5_000))
+
+
+def _phase_ingest() -> None:
+    """Data-lake loop benchmark (`bench.py --ingest` / `make bench-ingest`).
+
+    Sustained append throughput into a lake table (every batch flushed:
+    each commit is a real sort+encode+manifest-publish), then the
+    compaction payoff: a sort-key point probe's pruned-unit ratio and the
+    filtered-scan wall, before vs after ONE compaction pass folds the
+    overlapping ingest files into clustered row groups. Batches
+    interleave keys so pre-compaction files ALL overlap — the worst case
+    compaction exists to fix. Tracked pins: ingest.append_rows_s (+),
+    ingest.pruned_ratio_gain (+), ingest.scan_speedup (+). Host-only;
+    the result rides the --json artifact as "ingest"."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    from parquet_tpu.core.reader import FileReader
+    from parquet_tpu.lake import Compactor, IngestWriter, LakeTable, pruned_ratio
+
+    batches = max(INGEST_ROWS // INGEST_BATCH, 4)
+    rows_total = batches * INGEST_BATCH
+
+    def filtered_scan_s(paths, filters):
+        t0 = time.perf_counter()
+        n = 0
+        for p in paths:
+            with FileReader(p) as r:
+                for _row in r.iter_rows(filters=filters):
+                    n += 1
+        return time.perf_counter() - t0, n
+
+    with tempfile.TemporaryDirectory(prefix="pqt_bench_lake_") as d:
+        table = LakeTable.create(
+            os.path.join(d, "tbl"),
+            "message m { required int64 k; optional binary v (STRING); }",
+            sort_key="k",
+        )
+        writer = IngestWriter(table)
+        t0 = time.perf_counter()
+        for b in range(batches):
+            # batch b holds keys b, b+B, b+2B, ... — every flushed file
+            # spans the whole key range, so nothing prunes until compaction
+            writer.append(
+                [
+                    {"k": i * batches + b, "v": f"row-{b}-{i}"}
+                    for i in range(INGEST_BATCH)
+                ],
+                flush=True,
+            )
+        append_s = time.perf_counter() - t0
+        snap = table.manifest.open_snapshot()
+        assert snap.total_rows == rows_total, snap.total_rows
+        paths_before = table.snapshot_paths()
+        probe = [("k", "==", rows_total // 2)]
+        ratio_before = pruned_ratio(paths_before, probe)
+        scan_before_s, hits_before = filtered_scan_s(paths_before, probe)
+
+        t0 = time.perf_counter()
+        result = Compactor(
+            table, max_files=batches + 1, row_group_size=INGEST_BATCH
+        ).compact_once()
+        compact_s = time.perf_counter() - t0
+        assert result is not None and result.rows == rows_total
+        paths_after = table.snapshot_paths()
+        ratio_after = pruned_ratio(paths_after, probe)
+        scan_after_s, hits_after = filtered_scan_s(paths_after, probe)
+        assert hits_after == hits_before, (hits_before, hits_after)
+
+    out = {
+        "config": "ingest",
+        "rows": rows_total,
+        "batch_rows": INGEST_BATCH,
+        "flushes": batches,
+        "append_rows_s": round(rows_total / append_s, 1),
+        "append_wall_s": round(append_s, 4),
+        "compact_wall_s": round(compact_s, 4),
+        "files_before": len(paths_before),
+        "files_after": len(paths_after),
+        "pruned_ratio_before": round(ratio_before, 4),
+        "pruned_ratio_after": round(ratio_after, 4),
+        # the compaction payoff, as one trend-store-tracked leaf: how much
+        # MORE of the table a sort-key point probe prunes after the fold
+        "pruned_ratio_gain": round(ratio_after - ratio_before, 4),
+        "scan_rows_s_before": round(rows_total / scan_before_s, 1),
+        "scan_rows_s_after": round(rows_total / scan_after_s, 1),
+        "scan_speedup": round(scan_before_s / scan_after_s, 3),
+    }
+    log(
+        f"bench: ingest: {out['append_rows_s']:,} rows/s appended over "
+        f"{batches} flushed generations; compaction folded "
+        f"{out['files_before']} files -> {out['files_after']}, probe "
+        f"pruned ratio {ratio_before:.2f} -> {ratio_after:.2f} "
+        f"(gain {out['pruned_ratio_gain']:.2f}), filtered scan "
+        f"{out['scan_speedup']}x faster"
+    )
+    _emit(out)
+
+
 _PHASE_FNS = {
     "host": decode_all_host,
     "tpu_host": decode_all_tpu_to_host,
@@ -3095,6 +3208,20 @@ def main() -> None:
                 f"{r_mesh['chaos']['typed_only']}"
             )
 
+    # data-lake ingest loop (PQT_BENCH_INGEST=0 to skip): sustained append
+    # rows/s + the compaction payoff (pruned-ratio gain, filtered-scan
+    # speedup) over one table
+    r_ingest = None
+    if os.environ.get("PQT_BENCH_INGEST", "1") != "0":
+        r_ingest = _run_phase("ingest")
+        if r_ingest:
+            log(
+                f"bench: ingest {r_ingest['append_rows_s']:,} rows/s "
+                f"appended; compaction pruned-ratio gain "
+                f"{r_ingest['pruned_ratio_gain']} and filtered-scan "
+                f"speedup {r_ingest['scan_speedup']}x"
+            )
+
     # query push-down sweep (PQT_BENCH_QUERY=0 to skip): vec-vs-scalar
     # residual filtering + filtered-aggregate vs row-streaming req/s
     r_query = None
@@ -3201,6 +3328,8 @@ def main() -> None:
         artifact["mesh"] = r_mesh
     if r_query:
         artifact["query"] = r_query
+    if r_ingest:
+        artifact["ingest"] = r_ingest
     if r_chaos:
         artifact["chaos"] = r_chaos
     if r_asm:
@@ -3254,6 +3383,7 @@ def _metric_direction(key: str) -> int:
         or "speedup" in k
         or k.startswith("vs_")
         or k.endswith("_ratio")
+        or k.endswith("_gain")  # ingest.pruned_ratio_gain and kin
         or k == "value"
     ):
         return +1
@@ -3677,6 +3807,8 @@ if __name__ == "__main__":
         _phase_device()
     elif argv and argv[0] == "--chaos":
         _phase_chaos()
+    elif argv and argv[0] == "--ingest":
+        _phase_ingest()
     elif len(argv) >= 2 and argv[0] == "--phase":
         name = argv[1]
         if name.startswith("matrix"):
@@ -3707,6 +3839,8 @@ if __name__ == "__main__":
             _phase_device()
         elif name == "chaos":
             _phase_chaos()
+        elif name == "ingest":
+            _phase_ingest()
         elif name == "assembly":
             _phase_assembly()
         else:

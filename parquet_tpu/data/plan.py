@@ -48,19 +48,49 @@ class Unit(NamedTuple):
     num_rows: int
 
 
+def _expand_lake_ref(s: str):
+    """A lake-table reference expands to ONE pinned snapshot's file list:
+    a table directory (holding _lake/CURRENT) pins the current
+    generation, a manifest file path (table/_lake/gen-N.json) pins
+    generation N — time travel for scans. Returns None when `s` is not a
+    lake reference; the file list preserves MANIFEST order (it is a
+    consistent snapshot, not a directory listing to be re-sorted —
+    callers that sort sort deterministically anyway)."""
+    from ..lake.manifest import is_lake_table, manifest_ref_root
+
+    ref = manifest_ref_root(s)
+    if ref is not None:
+        root, gen = ref
+    elif os.path.isdir(s) and is_lake_table(s):
+        root, gen = s, None
+    else:
+        return None
+    from ..lake.manifest import LakeManifest
+
+    return LakeManifest(root).open_snapshot(gen).paths(
+        os.path.realpath(root)
+    )
+
+
 def expand_paths(paths_or_glob) -> list[str]:
     """Resolve the dataset's input spec into a deterministic file list.
 
     A string (or Path) is treated as a glob pattern when it contains magic
     characters, otherwise as a single file; a list/tuple passes through.
     http(s):// URLs pass through verbatim (remote objects don't glob or
-    stat — existence surfaces as the open's typed error). The result is
-    lexicographically sorted — glob order is filesystem-dependent, and the
-    shard/shuffle math needs every process to see the SAME file indices."""
+    stat — existence surfaces as the open's typed error). A lake-table
+    directory or manifest file expands to that snapshot's file list (see
+    _expand_lake_ref) — every scan plans against exactly one generation.
+    The result is lexicographically sorted — glob order is
+    filesystem-dependent, and the shard/shuffle math needs every process
+    to see the SAME file indices."""
     if isinstance(paths_or_glob, (str, Path)):
         s = str(paths_or_glob)
         if s.startswith(("http://", "https://")):
             return [s]
+        lake = _expand_lake_ref(s)
+        if lake is not None:
+            return lake
         if _glob.has_magic(s):
             hits = _glob.glob(s)
             if not hits:
@@ -69,7 +99,10 @@ def expand_paths(paths_or_glob) -> list[str]:
         if not os.path.exists(s):
             raise FileNotFoundError(f"dataset: no such file {s!r}")
         return [s]
-    out = [str(p) for p in paths_or_glob]
+    out: list[str] = []
+    for p in paths_or_glob:
+        lake = _expand_lake_ref(str(p))
+        out.extend(lake if lake is not None else [str(p)])
     if not out:
         raise ValueError("dataset: empty path list")
     return sorted(out)

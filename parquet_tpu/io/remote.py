@@ -49,6 +49,7 @@ Metrics: io_http_requests_total{status=}, io_http_connections_total
 from __future__ import annotations
 
 import http.client
+import re
 import threading
 import time
 from urllib.parse import urlsplit
@@ -258,6 +259,9 @@ class HttpSource(ByteSource):
 
             signer = signer_for(url)
         self._signer = signer
+        # one-shot multi-range batches until the server proves it only
+        # speaks single-range (read_ranges latches this False)
+        self._multirange = True
         if size is None:
             self._size, self._etag = self._stat()
         else:
@@ -437,11 +441,25 @@ class HttpSource(ByteSource):
         io_tuner().observe(self._id, nbytes, seconds, 1)
 
     def read_ranges(self, ranges) -> list:
-        """Concurrent in-flight range GETs on the pqt-io pool (one pooled
-        connection each). From INSIDE a pqt-io worker (readahead tasks run
-        there) the fan-out degrades to sequential — a bounded pool that
-        submits to itself and waits is a deadlock."""
+        """N coalesced runs in ONE round trip when the server speaks
+        multi-range (`Range: bytes=a-b,c-d` -> 206 multipart/byteranges),
+        else concurrent per-range GETs on the pqt-io pool (one pooled
+        connection each). The first response proving the server doesn't
+        do multi-range (single-part 206, or a 416 on the comma form)
+        latches the fallback for this source's lifetime; transport faults
+        fall back for THIS call without latching. From INSIDE a pqt-io
+        worker (readahead tasks run there) the fan-out degrades to
+        sequential — a bounded pool that submits to itself and waits is a
+        deadlock."""
         ranges = list(ranges)
+        if (
+            len(ranges) > 1
+            and self._multirange
+            and sum(n for _, n in ranges) > 0
+        ):
+            got = self._read_multirange(ranges)
+            if got is not None:
+                return got
         if (
             len(ranges) <= 1
             or threading.current_thread().name.startswith("pqt-io")
@@ -466,8 +484,129 @@ class HttpSource(ByteSource):
             raise first_err
         return out
 
+    # -- multi-range: N runs, one round trip -----------------------------------
+
+    def _read_multirange(self, ranges):
+        """One `Range: bytes=a-b,c-d` GET for every run. Returns the
+        payload list on success, None to fall back to per-range GETs —
+        never raises for "the server doesn't do multi-range" (that is
+        the expected legacy shape, not a fault). Terminal generation
+        mismatches and transport faults DO raise, exactly like read_at
+        (the retry/validation ladder above owns those)."""
+        for off, n in ranges:
+            if off < 0 or n < 0 or off + n > self._size:
+                raise SourceError(
+                    f"read past end of {self.url}: "
+                    f"[{off}, {off + n}) > {self._size}"
+                )
+        spec = ",".join(f"{off}-{off + n - 1}" for off, n in ranges if n)
+        hdrs = {"Range": f"bytes={spec}"}
+        if self._etag:
+            hdrs["If-Range"] = self._etag
+        context = f"GET {self.url} [{len(ranges)} ranges]"
+        span_args = {"ranges": len(ranges), "nbytes": sum(n for _, n in ranges)}
+        with _trace.span("remote.multirange", args=span_args):
+            t0 = time.perf_counter()
+            try:
+                status, reason, headers, body = self._request("GET", hdrs)
+            except TransientSourceError:
+                # a transport fault says nothing about multi-range
+                # support: fall back THIS call, try again next time
+                _count_multirange("transport_fallback")
+                return None
+            dt = time.perf_counter() - t0
+            span_args["status"] = status
+        ctype = (headers.get("Content-Type") or "").lower()
+        if status == 206 and ctype.startswith("multipart/byteranges"):
+            self._validate_generation(headers, context)
+            parts = _parse_multipart_byteranges(body, ctype)
+            if parts is None:
+                _count_multirange("parse_fallback")
+                return None
+            out = []
+            for off, n in ranges:
+                if n == 0:
+                    out.append(b"")
+                    continue
+                payload = parts.get((off, off + n - 1))
+                if payload is None or len(payload) != n:
+                    _count_multirange("parse_fallback")
+                    return None
+                out.append(payload)
+            nbytes = sum(len(p) for p in out)
+            _count_read(nbytes)
+            _metrics.inc("io_multirange_parts_total", len(parts))
+            _count_multirange("ok")
+            self._observe(nbytes, dt)
+            return out
+        if status == 200:
+            # a Range-blind server ships the whole CURRENT object: one
+            # transfer still answers every run — slice locally (and bill
+            # the full body, like read_at's 200 path)
+            self._validate_generation(headers, context)
+            if len(body) < self._size:
+                raise TransientSourceError(
+                    f"{context}: truncated body "
+                    f"({len(body)}/{self._size} bytes of a full-object 200)",
+                    code="truncated_body",
+                )
+            _count_read(len(body))
+            _count_multirange("full_body")
+            self._observe(len(body), dt)
+            return [body[off : off + n] for off, n in ranges]
+        if status in (206, 416):
+            # single-part 206 (the server honored ONE range) or a 416 on
+            # the comma form: a legacy server — latch per-range forever
+            self._multirange = False
+            _count_multirange("unsupported")
+            return None
+        raise _status_error(status, reason, context)
+
     def close(self) -> None:
         pass  # connections belong to the shared per-host pool
+
+
+def _count_multirange(outcome: str) -> None:
+    _metrics.inc("io_multirange_requests_total", outcome=outcome)
+
+
+def _parse_multipart_byteranges(body: bytes, content_type: str):
+    """multipart/byteranges -> {(first, last): payload}. None on any
+    structural surprise (missing boundary, malformed part headers, a
+    Content-Range that doesn't parse) — the caller falls back to
+    per-range GETs rather than guessing."""
+    m = re.search(r'boundary="?([^";,\s]+)"?', content_type)
+    if m is None:
+        return None
+    delim = b"--" + m.group(1).encode("ascii", "replace")
+    parts: dict = {}
+    segments = body.split(delim)
+    # segments[0] is the preamble; the last begins with "--" (the close)
+    for seg in segments[1:]:
+        if seg.startswith(b"--"):
+            break
+        seg = seg.lstrip(b"\r\n")
+        head, sep, payload = seg.partition(b"\r\n\r\n")
+        if not sep:
+            return None
+        content_range = None
+        for line in head.split(b"\r\n"):
+            name, _, value = line.partition(b":")
+            if name.strip().lower() == b"content-range":
+                content_range = value.strip()
+        if content_range is None:
+            return None
+        cm = re.match(rb"bytes (\d+)-(\d+)/(\d+|\*)", content_range)
+        if cm is None:
+            return None
+        first, last = int(cm.group(1)), int(cm.group(2))
+        # each part ends with the CRLF that precedes the next delimiter
+        if payload.endswith(b"\r\n"):
+            payload = payload[:-2]
+        if len(payload) != last - first + 1:
+            return None
+        parts[(first, last)] = payload
+    return parts or None
 
 
 class ObjectStoreSource(ByteSource):

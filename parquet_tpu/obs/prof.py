@@ -85,6 +85,7 @@ POOL_LANES = (
     "pqt-prof",  # the profiler's own sampler thread
     "pqt-httpstub",  # the testing stub's serve thread
     "pqt-flaky-replica",  # the chaos proxy's serve thread (testing/)
+    "pqt-compact",  # the lake compactor's background fold loop (lake/compactor.py)
 )
 
 _OVERFLOW_FRAME = "~overflow~"

@@ -64,7 +64,13 @@ from ...utils import metrics as _metrics
 from ..admission import AdmissionController
 from ..aggregate import QueryState, agg_name, result_dict
 from ..protocol import QueryRequest, ScanRequest, ServeError
-from ..server import ScanServer, ScanService, ServeConfig, _Handler
+from ..server import (
+    ScanServer,
+    ScanService,
+    ServeConfig,
+    _count_request,
+    _Handler,
+)
 from .client import MeshClient, MeshResponse
 from .table import ReplicaTable
 
@@ -711,6 +717,29 @@ class _RouterHandler(_Handler):
             self.close_connection = True
         except Exception as e:  # noqa: BLE001 - the no-traceback contract
             self._send_internal_error(e)
+
+    def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        route = urlsplit(self.path).path
+        if route != "/v1/append":
+            super().do_POST()
+            return
+        # Ingest is replica-pinned, not routable: an append must land on
+        # exactly the replica that owns the lake table's manifest (one
+        # writer per table), and the router has no write-routing table
+        # yet. A typed 501 says "the route exists, target a replica"
+        # instead of a bare 404's "no such thing".
+        self._body_read = False
+        self._rid = self._request_id()
+        self._tp = self._trace_context()
+        tenant = self._tenant()
+        e = ServeError(
+            501, "not_routable",
+            "/v1/append is not routable: ingest targets one replica's "
+            "lake table (POST to that replica directly; mesh "
+            "write-routing is not implemented)",
+        )
+        self._send_error_body(e)
+        _count_request(tenant, e.status)
 
 
 class MeshRouter(ScanServer):

@@ -68,6 +68,7 @@ from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
+from ..core.reader import PARQUET_ERRORS
 from ..io.cache import BlockCache
 from ..obs import cost as _cost
 from ..obs import log as _obslog
@@ -135,6 +136,19 @@ class ServeConfig:
     # request bodies are small JSON specs; a client-declared Content-Length
     # is rejected with a typed 413 past this, BEFORE any bytes are buffered
     max_body_bytes: int = 1 << 20
+    # the write path: a lake-table directory (lake/manifest.py) arms
+    # POST /v1/append on this replica — batches buffer in the ingest
+    # writer and commit one manifest generation per flush. None keeps the
+    # daemon read-only (/v1/append answers a typed 503 ingest_disabled).
+    # The table is created on demand with lake_schema (DSL text) when the
+    # directory is not yet a table; lake_sort_key orders flushed files'
+    # row groups (and drives compaction's sort stage).
+    lake_root: str | None = None
+    lake_schema: str | None = None
+    lake_sort_key: str | None = None
+    lake_flush_mb: int = 4  # ingest buffer bound; a flush commits a generation
+    # append bodies are DATA, not specs: they get their own, larger cap
+    max_append_bytes: int = 32 << 20
     # per-socket-op timeout: a client that stalls (stops sending its body,
     # or accepts the 200 and stops reading) would otherwise pin its handler
     # thread AND its admission ticket forever — the cooperative deadline
@@ -189,6 +203,10 @@ class ServeConfig:
             raise ValueError("serve: socket_timeout_s must be positive")
         if self.max_body_bytes < 1:
             raise ValueError("serve: max_body_bytes must be >= 1")
+        if self.max_append_bytes < 1:
+            raise ValueError("serve: max_append_bytes must be >= 1")
+        if self.lake_flush_mb < 1:
+            raise ValueError("serve: lake_flush_mb must be >= 1")
         if self.default_timeout_s is not None and self.default_timeout_s <= 0:
             raise ValueError(
                 "serve: default_timeout_s must be positive (None disables)"
@@ -315,6 +333,27 @@ class ScanService:
                     p99_ms=config.slo_p99_ms,
                 )
             )
+        # the write path (lake/): /v1/append buffers into this writer and
+        # commits one manifest generation per flush. Built at startup so
+        # a misconfigured lake root fails the daemon, not the first append.
+        self.lake = None
+        self.ingest = None
+        if config.lake_root is not None:
+            from ..lake.ingest import IngestWriter
+            from ..lake.manifest import LakeError, LakeTable
+
+            try:
+                self.lake = LakeTable.open(config.lake_root)
+            except LakeError:
+                if config.lake_schema is None:
+                    raise
+                self.lake = LakeTable.create(
+                    config.lake_root, config.lake_schema,
+                    sort_key=config.lake_sort_key,
+                )
+            self.ingest = IngestWriter(
+                self.lake, flush_bytes=config.lake_flush_mb << 20
+            )
 
     # -- request entry points (raise ServeError; HTTP layer renders) -----------
 
@@ -417,6 +456,55 @@ class ScanService:
                 },
             }
         return ticket, body
+
+    def append(self, body: bytes, content_type, tenant: str, *,
+               flush: bool = False, record=None):
+        """POST /v1/append: one row batch into the lake table's ingest
+        buffer. Admission is the scan discipline — same ticket, and the
+        tenant byte budget is charged the BODY size up front (ingest work
+        scales with payload exactly the way scans scale with plan bytes).
+        Returns (ticket, ack dict); the caller releases the ticket."""
+        if self.ingest is None:
+            raise ServeError(
+                503, "ingest_disabled",
+                "this replica serves no lake table (start it with a "
+                "--lake root to accept appends)",
+            )
+        from ..lake.ingest import rows_from_payload
+        from ..lake.manifest import LakeError
+
+        ticket = self.admission.admit(tenant)
+        try:
+            self.admission.charge(ticket.tenant, len(body))
+            try:
+                rows = rows_from_payload(body, content_type)
+                if not rows:
+                    raise ServeError(
+                        400, "bad_request", "append body holds no rows"
+                    )
+                ack = self.ingest.append(rows, flush=flush)
+            except LakeError as e:
+                raise _lake_serve_error(e) from None
+            except ServeError:
+                # ServeError subclasses ValueError: already typed, keep it
+                raise
+            except PARQUET_ERRORS + (ValueError,) as e:
+                # schema-shaped failures (a row that doesn't shred:
+                # ShredError/WriterError are ValueErrors) are the
+                # CLIENT's rows being wrong, not the daemon
+                raise ServeError(
+                    422, "bad_rows", f"{type(e).__name__}: {e}"
+                ) from None
+            if record is not None:
+                record.plan = {
+                    "rows": ack["rows"],
+                    "flushed": ack["flushed"],
+                    "generation": ack["generation"],
+                }
+        except BaseException:
+            ticket.release()
+            raise
+        return ticket, ack
 
     def healthz(self) -> tuple[int, dict]:
         draining = self.admission.draining
@@ -575,6 +663,22 @@ class ScanService:
                 "socket_timeout_s": cfg.socket_timeout_s,
                 "shard": list(cfg.shard) if cfg.shard else None,
             },
+            "lake": (
+                {
+                    "root": self.lake.root,
+                    "sort_key": self.lake.sort_key,
+                    "generation": self.lake.manifest.current_generation(),
+                    "flush_mb": cfg.lake_flush_mb,
+                    "max_append_bytes": cfg.max_append_bytes,
+                    "buffered_rows": (
+                        self.ingest.buffered_rows
+                        if self.ingest is not None
+                        else 0
+                    ),
+                }
+                if self.lake is not None
+                else None
+            ),
             "obs": {
                 "trace_sample_rate": cfg.trace_sample_rate,
                 "slow_ms": cfg.slow_ms,
@@ -631,6 +735,24 @@ def _count_request(tenant: str, status: int) -> None:
     _metrics.inc("serve_requests_total", status=str(status), tenant=tenant)
 
 
+# the LakeError -> ServeError taxonomy map: lake codes stay the error
+# currency end to end, the HTTP layer only picks the status
+_LAKE_STATUS = {
+    "unsupported_format": 415,
+    "bad_payload": 400,
+    "bad_manifest": 500,
+    "no_such_generation": 404,
+    "no_such_table": 503,
+    "commit_conflict": 409,
+    "closed": 503,
+}
+
+
+def _lake_serve_error(e) -> "ServeError":
+    code = getattr(e, "code", "lake_error")
+    return ServeError(_LAKE_STATUS.get(code, 500), code, str(e))
+
+
 def _normalize_peer(peer: str) -> str:
     """A fleet peer spec as a scrape URL — shared with the CLI's --fleet
     so `?peers=127.0.0.1:8081` and a full URL both work either way."""
@@ -670,12 +792,13 @@ class _Handler(BaseHTTPRequestHandler):
     def _timeout_ms(self):
         return self.headers.get("X-Timeout-Ms")
 
-    def _read_body(self) -> bytes:
+    def _read_body(self, cap: int | None = None) -> bytes:
         try:
             n = int(self.headers.get("Content-Length") or 0)
         except ValueError:
             raise ServeError(400, "bad_request", "bad Content-Length") from None
-        cap = getattr(self.server, "max_body_bytes", 1 << 20)
+        if cap is None:
+            cap = getattr(self.server, "max_body_bytes", 1 << 20)
         if n > cap:
             # reject on the DECLARED length, before buffering a byte — one
             # request must not be able to exhaust daemon memory ahead of
@@ -945,6 +1068,32 @@ class _Handler(BaseHTTPRequestHandler):
 
         self._recorded_request("/v1/query", tenant, t0, run)
 
+    def _append_request(self, tenant: str, t0: float) -> None:
+        """POST /v1/append under the record discipline: one row batch
+        into the lake ingest buffer. `?flush=1` forces the buffer to
+        commit a generation before the ack (the durability handshake)."""
+
+        def run(rec):
+            flush = (
+                parse_qs(urlsplit(self.path).query).get("flush", ["0"])[0]
+                in ("1", "true")
+            )
+            body = self._read_body(
+                cap=getattr(self.server, "max_append_bytes", 32 << 20)
+            )
+            ticket, ack = self.service.append(
+                body,
+                self.headers.get("Content-Type"),
+                tenant,
+                flush=flush,
+                record=rec,
+            )
+            with ticket:
+                self._send_json(200, ack)
+                return 200, 0, None
+
+        self._recorded_request("/v1/append", tenant, t0, run)
+
     def _plan_request(self, tenant: str, t0: float, request_fn) -> None:
         """GET/POST /v1/plan under the same record discipline."""
 
@@ -1187,6 +1336,9 @@ class _Handler(BaseHTTPRequestHandler):
             if route == "/v1/query":
                 self._query_request(tenant, t0)
                 return
+            if route == "/v1/append":
+                self._append_request(tenant, t0)
+                return
             if route == "/v1/plan":
                 self._plan_request(
                     tenant, t0, lambda: parse_scan_request(self._read_body())
@@ -1228,6 +1380,7 @@ class ScanServer:
         self._httpd.verbose = verbose
         self._httpd.socket_timeout = config.socket_timeout_s
         self._httpd.max_body_bytes = config.max_body_bytes
+        self._httpd.max_append_bytes = config.max_append_bytes
         self._thread: threading.Thread | None = None
 
     @property
@@ -1284,6 +1437,14 @@ class ScanServer:
         try:
             self.shutdown()
         finally:
+            # the ingest buffer's tail commits one last generation (rows
+            # a client appended without ?flush=1 survive a clean stop)
+            ingest = getattr(self.service, "ingest", None)
+            if ingest is not None:
+                try:
+                    ingest.close()
+                except Exception:  # noqa: BLE001 — close() must not raise
+                    pass
             self._httpd.server_close()
             # a tiered cache the SERVICE built owns spill files/fds; a
             # config-passed block_cache belongs to the caller (it may be

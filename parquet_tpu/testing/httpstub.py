@@ -204,6 +204,25 @@ class _Handler(BaseHTTPRequestHandler):
             rng_header = None
         if rng_header is None or stub.ignore_range:
             status, start, end = 200, 0, size - 1
+        elif "," in rng_header and not head_only:
+            # multi-range: served as multipart/byteranges, or — with
+            # reject_multirange — refused with the 416 a single-range
+            # server answers (pins HttpSource's per-range fallback)
+            spans = (
+                None
+                if stub.reject_multirange
+                else stub._parse_ranges(rng_header, size)
+            )
+            if spans is None:
+                self.send_response(416)
+                self.send_header("Content-Range", f"bytes */{size}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            with stub._lock:
+                stub.multirange_requests += 1
+            self._send_multipart(data, spans, etag)
+            return
         else:
             span = stub._parse_range(rng_header, size)
             if span is None:
@@ -248,6 +267,42 @@ class _Handler(BaseHTTPRequestHandler):
                 self.connection.shutdown(socket.SHUT_RDWR)
             except (OSError, ValueError):
                 pass
+
+    _MR_BOUNDARY = "pqt_stub_byteranges"
+
+    def _send_multipart(self, data: bytes, spans, etag: str) -> None:
+        """One 206 multipart/byteranges response: a part per span, each
+        with its own Content-Range — exactly the RFC 7233 shape
+        HttpSource._read_multirange parses."""
+        size = len(data)
+        b = self._MR_BOUNDARY
+        chunks = []
+        for start, end in spans:
+            chunks.append(
+                (
+                    f"--{b}\r\n"
+                    "Content-Type: application/octet-stream\r\n"
+                    f"Content-Range: bytes {start}-{end}/{size}\r\n\r\n"
+                ).encode()
+                + data[start : end + 1]
+                + b"\r\n"
+            )
+        chunks.append(f"--{b}--\r\n".encode())
+        body = b"".join(chunks)
+        self.send_response(206)
+        self.send_header(
+            "Content-Type", f"multipart/byteranges; boundary={b}"
+        )
+        self.send_header("Accept-Ranges", "bytes")
+        if self.stub.send_etag:
+            self.send_header("ETag", etag)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+            self.stub._count_sent(len(body))
+        except OSError:
+            self.close_connection = True
 
     def do_GET(self):
         self._serve(head_only=False)
@@ -460,6 +515,10 @@ class RangeHttpStub:
     seed          the fault rng seed (one stream across all draws)
     ignore_range  serve 200 + the FULL object even for ranged GETs (the
                   misbehaving-server shape HttpSource must slice through)
+    reject_multirange  416 every comma-form Range header (the
+                  single-range-only server shape: HttpSource must latch
+                  its per-range fallback); default False serves RFC 7233
+                  multipart/byteranges (counted in multirange_requests)
     reject_head   405 every HEAD (forces HttpSource's range-GET stat
                   fallback)
     send_etag     False omits the ETag header entirely (the validator-less
@@ -484,6 +543,7 @@ class RangeHttpStub:
         spike_s: float = 0.0,
         permanent: bool = False,
         ignore_range: bool = False,
+        reject_multirange: bool = False,
         reject_head: bool = False,
         send_etag: bool = True,
         require_token: str | None = None,
@@ -512,6 +572,7 @@ class RangeHttpStub:
         self.spike_s = float(spike_s)
         self.permanent = bool(permanent)
         self.ignore_range = bool(ignore_range)
+        self.reject_multirange = bool(reject_multirange)
         self.reject_head = bool(reject_head)
         self.send_etag = bool(send_etag)
         self.require_token = require_token
@@ -531,6 +592,7 @@ class RangeHttpStub:
         self.requests = 0
         self.faults_injected = 0
         self.bytes_served = 0
+        self.multirange_requests = 0  # comma-form Range GETs served multipart
         # every traceparent header received, in arrival order — the
         # store-side half of the end-to-end propagation pin (recorded
         # BEFORE the fault draw: a faulted request was still received)
@@ -653,7 +715,7 @@ class RangeHttpStub:
         """`bytes=a-b` / `bytes=a-` / `bytes=-n` -> (start, end) clamped
         inclusive, or None for unsatisfiable/malformed (-> 416)."""
         if not header.startswith("bytes=") or "," in header:
-            return None
+            return None  # multi-range is _parse_ranges' job
         spec = header[len("bytes="):].strip()
         first, _, last = spec.partition("-")
         try:
@@ -669,6 +731,20 @@ class RangeHttpStub:
         if start >= size or end < start:
             return None
         return (start, min(end, size - 1))
+
+    @classmethod
+    def _parse_ranges(cls, header: str, size: int):
+        """`bytes=a-b,c-d,...` -> [(start, end), ...] in request order,
+        or None when any piece is unsatisfiable (-> 416)."""
+        if not header.startswith("bytes="):
+            return None
+        spans = []
+        for piece in header[len("bytes="):].split(","):
+            span = cls._parse_range(f"bytes={piece.strip()}", size)
+            if span is None:
+                return None
+            spans.append(span)
+        return spans or None
 
     def _entry(self, name: str):
         with self._lock:

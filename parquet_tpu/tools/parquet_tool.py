@@ -414,6 +414,102 @@ def cmd_merge(args) -> int:
     return 0
 
 
+def cmd_lake(args) -> int:
+    """Operate on a lake table from the shell — the offline twins of the
+    daemon's ingest/compaction loop, all through the same manifest commit
+    protocol (a shell append and a daemon append are indistinguishable in
+    the generation log):
+
+        lake init     create the table (schema DSL + optional sort key)
+        lake append   buffer rows from a jsonl file (or stdin) and commit
+                      them as ONE generation
+        lake compact  run one compaction pass (and optionally reap
+                      crash-orphaned files)
+        lake manifest print the snapshot a scan of this table would pin
+                      (--gen N time-travels; --json for machines)
+    """
+    from ..lake import Compactor, IngestWriter, LakeError, LakeTable
+    from ..lake.ingest import rows_from_payload
+
+    try:
+        if args.lake_cmd == "init":
+            table = LakeTable.create(
+                args.table,
+                args.schema,
+                sort_key=args.sort_key,
+                retain=args.retain,
+            )
+            print(
+                f"lake: created {table.root} "
+                f"(sort_key={table.sort_key or '-'}, retain={args.retain})"
+            )
+            return 0
+        table = LakeTable.open(args.table)
+        if args.lake_cmd == "append":
+            if args.file == "-":
+                body = sys.stdin.buffer.read()
+            else:
+                with open(args.file, "rb") as f:
+                    body = f.read()
+            rows = rows_from_payload(body, "application/x-ndjson")
+            if not rows:
+                raise LakeError("lake: no rows in input", code="bad_payload")
+            writer = IngestWriter(table)
+            try:
+                ack = writer.append(rows, flush=True)
+            finally:
+                writer.close()
+            print(json.dumps(ack, sort_keys=True))
+            return 0
+        if args.lake_cmd == "compact":
+            compactor = Compactor(
+                table,
+                min_files=args.min_files,
+                max_files=args.max_files,
+                small_file_bytes=args.small_file_mb << 20,
+            )
+            result = compactor.compact_once()
+            if args.reap:
+                reaped = table.manifest.reap_orphans(
+                    grace_s=args.reap_grace_s
+                )
+                if reaped:
+                    print(f"lake: reaped {reaped} orphan file(s)")
+            if result is None:
+                print("lake: nothing to compact")
+                return 0
+            print(json.dumps(result.to_dict(), sort_keys=True))
+            return 0
+        # manifest: the snapshot view (current or pinned)
+        snap = table.manifest.open_snapshot(args.gen)
+        if args.json:
+            doc = snap.to_dict()
+            doc["retained"] = table.manifest.generations()
+            print(json.dumps(doc, sort_keys=True))
+            return 0
+        gens = table.manifest.generations()
+        span = f"[{gens[0]}..{gens[-1]}]" if gens else "[]"
+        print(f"table: {table.root} (sort_key={table.sort_key or '-'})")
+        print(f"generation: {snap.generation} (retained {span})")
+        print(
+            f"files: {len(snap.files)}  rows: {snap.total_rows}  "
+            f"bytes: {snap.total_bytes}"
+        )
+        for entry in snap.files:
+            key = (
+                f"  key=[{entry.min_key!r}..{entry.max_key!r}]"
+                if entry.min_key is not None
+                else ""
+            )
+            print(
+                f"  {entry.path}  rows={entry.rows} bytes={entry.bytes}{key}"
+            )
+        return 0
+    except LakeError as e:
+        print(f"parquet-tool: lake: {e}", file=sys.stderr)
+        return 1
+
+
 def verify_file(path, validate_crc: bool = True) -> list[dict]:
     """Scan every page of every column chunk; return one report dict per
     problem found: {group, column, page, offset, stage, error, message}.
@@ -1243,6 +1339,7 @@ def cmd_serve(args) -> int:
             (args.root, "--root"),
             (args.shard, "--shard"),
             (remote_map, "--remote-map"),
+            (args.lake, "--lake"),
         ):
             if val:
                 print(
@@ -1273,6 +1370,10 @@ def cmd_serve(args) -> int:
             io_autotune=args.io_autotune,
             window=args.window,
             shard=_parse_shard(args.shard),
+            lake_root=args.lake,
+            lake_schema=args.lake_schema,
+            lake_sort_key=args.lake_sort_key,
+            lake_flush_mb=args.lake_flush_mb,
             **common,
         )
         server = ScanServer(config, verbose=args.verbose)
@@ -1286,6 +1387,8 @@ def cmd_serve(args) -> int:
         )
     elif server.config.root:
         print(f"serve: root {server.config.root}", flush=True)
+    if not mesh and server.config.lake_root:
+        print(f"serve: lake {server.config.lake_root}", flush=True)
     try:
         server.serve_forever()
     finally:
@@ -1737,6 +1840,28 @@ def main(argv=None) -> int:
         "recommended; escapes get typed 403s)",
     )
     pe.add_argument(
+        "--lake",
+        help="serve a lake table rooted at this directory: POST /v1/append "
+        "ingests rows into it (flushes publish manifest generations); "
+        "pair with --root so scans can read the table back",
+    )
+    pe.add_argument(
+        "--lake-schema",
+        help="schema DSL used to CREATE the lake table when --lake does "
+        "not exist yet (an existing table ignores this and keeps its own)",
+    )
+    pe.add_argument(
+        "--lake-sort-key",
+        help="leaf column new tables sort/cluster by (with --lake-schema)",
+    )
+    pe.add_argument(
+        "--lake-flush-mb",
+        type=int,
+        default=4,
+        help="ingest buffer size in MiB; reaching it (or ?flush=1) "
+        "commits the buffered rows as one generation",
+    )
+    pe.add_argument(
         "--cache-mb",
         type=int,
         default=64,
@@ -2081,6 +2206,83 @@ def main(argv=None) -> int:
         "is taken as the output — deprecated legacy form)",
     )
     pm.set_defaults(fn=cmd_merge)
+
+    pl = sub.add_parser(
+        "lake",
+        help="operate on a lake table: init, append rows, compact small "
+        "files, or print the snapshot manifest (time travel with --gen)",
+    )
+    lsub = pl.add_subparsers(dest="lake_cmd", required=True)
+    li = lsub.add_parser(
+        "init", help="create a lake table (schema DSL + optional sort key)"
+    )
+    li.add_argument("table", help="table directory (created if missing)")
+    li.add_argument(
+        "--schema",
+        required=True,
+        help="schema DSL, e.g. 'message m { required int64 k; "
+        "optional binary v (STRING); }'",
+    )
+    li.add_argument(
+        "--sort-key", help="leaf column ingest/compaction cluster by"
+    )
+    li.add_argument(
+        "--retain",
+        type=int,
+        default=64,
+        help="generations kept for time travel before files are unlinked",
+    )
+    li.set_defaults(fn=cmd_lake)
+    la = lsub.add_parser(
+        "append",
+        help="append jsonl rows from FILE (or stdin with '-') and commit "
+        "them as one manifest generation",
+    )
+    la.add_argument("table", help="lake table directory")
+    la.add_argument(
+        "file",
+        nargs="?",
+        default="-",
+        help="jsonl input file; '-' (default) reads stdin",
+    )
+    la.set_defaults(fn=cmd_lake)
+    lc = lsub.add_parser(
+        "compact",
+        help="fold the snapshot's small files into sort-keyed row groups "
+        "and commit the rewrite as one generation",
+    )
+    lc.add_argument("table", help="lake table directory")
+    lc.add_argument("--min-files", type=int, default=2)
+    lc.add_argument("--max-files", type=int, default=32)
+    lc.add_argument(
+        "--small-file-mb",
+        type=int,
+        default=64,
+        help="files under this size are compaction candidates",
+    )
+    lc.add_argument(
+        "--reap",
+        action="store_true",
+        help="also remove crash-orphaned tmp/data files past --reap-grace-s",
+    )
+    lc.add_argument(
+        "--reap-grace-s",
+        type=float,
+        default=300.0,
+        help="minimum age before an unreferenced file counts as an orphan",
+    )
+    lc.set_defaults(fn=cmd_lake)
+    lm = lsub.add_parser(
+        "manifest",
+        help="print the snapshot a scan of this table pins "
+        "(--gen N time-travels to a retained generation)",
+    )
+    lm.add_argument("table", help="lake table directory")
+    lm.add_argument(
+        "--gen", type=int, default=None, help="pin this generation"
+    )
+    lm.add_argument("--json", action="store_true", help="machine output")
+    lm.set_defaults(fn=cmd_lake)
 
     args = p.parse_args(argv)
     try:

@@ -271,6 +271,44 @@ Key families (all under the `parquet_tpu_` prefix in exposition):
   mesh_partial_failures_total{target=}  requests that exhausted EVERY
                                     replica and surfaced the typed
                                     partial_failure error
+  lake_manifest_commits_total       generations committed to a lake
+                                    manifest (ingest flushes + compactor
+                                    rewrites)
+  lake_generation                   gauge: the current generation number
+                                    of the last-touched lake table
+  lake_files / lake_rows            gauges: file and row counts of the
+                                    current snapshot after a commit
+  lake_files_unlinked_total         data files deleted once no retained
+                                    generation referenced them
+  lake_orphans_reaped_total         crash leftovers (unreferenced tmp/
+                                    parquet past the grace window)
+                                    removed by reap_orphans
+  lake_append_rows_total            rows accepted by ingest append
+  lake_append_bytes_total           request payload bytes accepted by
+                                    ingest append
+  lake_flushes_total                ingest buffer flushes (each publishes
+                                    exactly one generation)
+  lake_flush_seconds                histogram: sort+encode+commit latency
+                                    of one ingest flush
+  lake_compactions_total            background compaction passes that
+                                    committed a rewrite
+  lake_compact_files_total          small input files folded away by
+                                    compaction
+  lake_compact_rows_total           rows rewritten into sort-keyed row
+                                    groups by compaction
+  lake_compact_seconds              histogram: wall time of one
+                                    merge+rewrite+commit pass
+  io_multirange_requests_total{outcome=}  coalesced multi-range HTTP
+                                    attempts: "ok" (one multipart round
+                                    trip served every range),
+                                    "full_body" (200 — sliced locally),
+                                    "unsupported" (server collapsed the
+                                    set; per-range latched on),
+                                    "transport_fallback" /
+                                    "parse_fallback" (this call fell
+                                    back, next call tries again)
+  io_multirange_parts_total         byterange parts parsed out of
+                                    multipart/byteranges responses
 
 Exposition variants: render_prometheus() is the classic text format every
 scraper understands; render_openmetrics() is the content-negotiated
@@ -496,6 +534,53 @@ _HELP = {
     "mesh_partial_failures_total": (
         "requests that exhausted every replica (typed partial_failure), "
         "per target route"
+    ),
+    # the lake write path (PR 20): streaming ingest, snapshot manifest,
+    # background compaction
+    "lake_manifest_commits_total": (
+        "generations committed to a lake manifest (ingest flushes + "
+        "compactor rewrites)"
+    ),
+    "lake_generation": (
+        "gauge: current generation number of the last-touched lake table"
+    ),
+    "lake_files": "gauge: file count of the current snapshot after a commit",
+    "lake_rows": "gauge: row count of the current snapshot after a commit",
+    "lake_files_unlinked_total": (
+        "data files deleted once no retained generation referenced them"
+    ),
+    "lake_orphans_reaped_total": (
+        "crash leftovers (unreferenced tmp/parquet past the grace window) "
+        "removed by reap_orphans"
+    ),
+    "lake_append_rows_total": "rows accepted by ingest append",
+    "lake_append_bytes_total": (
+        "request payload bytes accepted by ingest append"
+    ),
+    "lake_flushes_total": (
+        "ingest buffer flushes; each publishes exactly one generation"
+    ),
+    "lake_flush_seconds": (
+        "sort+encode+commit latency of one ingest flush"
+    ),
+    "lake_compactions_total": (
+        "background compaction passes that committed a rewrite"
+    ),
+    "lake_compact_files_total": (
+        "small input files folded away by compaction"
+    ),
+    "lake_compact_rows_total": (
+        "rows rewritten into sort-keyed row groups by compaction"
+    ),
+    "lake_compact_seconds": (
+        "wall time of one merge+rewrite+commit compaction pass"
+    ),
+    "io_multirange_requests_total": (
+        "coalesced multi-range HTTP attempts, per outcome "
+        "(ok/full_body/unsupported/transport_fallback/parse_fallback)"
+    ),
+    "io_multirange_parts_total": (
+        "byterange parts parsed out of multipart/byteranges responses"
     ),
     # process self-metrics, refreshed at exposition render (stdlib /proc
     # reads; absent on platforms without procfs)

@@ -1,0 +1,639 @@
+"""parquet_tpu.lake: the snapshot manifest, streaming ingest, and the
+background compactor — plus their serve (/v1/append) and CLI surfaces.
+
+Pinned here, per the issue's acceptance list:
+
+  * atomicity: the append+scan+compact concurrency hammer — every scan
+    pins EXACTLY ONE generation (the manifest's internal row/file counts
+    always match what the files on disk hold; no torn file list ever);
+  * crash-mid-compact: a rewrite that died before its manifest commit
+    loses nothing — the orphan output (and the sink's tmp debris) is
+    reaped, every committed row still scans;
+  * time travel: open_snapshot(gen=k) is byte-identical across later
+    ingest flushes AND compactions for every retained k;
+  * the serve surface: /v1/append accepts both wire formats, answers
+    typed errors from the lake taxonomy, and a daemon scan of the table
+    directory reads the committed generation.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from parquet_tpu.core.reader import FileReader
+from parquet_tpu.data.plan import build_plan, expand_paths
+from parquet_tpu.lake import (
+    Compactor,
+    FileEntry,
+    IngestWriter,
+    LakeError,
+    LakeManifest,
+    LakeTable,
+    is_lake_table,
+    manifest_ref_root,
+    rows_from_payload,
+)
+from parquet_tpu.serve import ScanServer, ServeConfig
+
+WATCHDOG_S = 30.0
+
+SCHEMA = "message m { required int64 k; optional binary v (STRING); }"
+
+
+def _mk_table(d, **kw):
+    return LakeTable.create(str(d), SCHEMA, sort_key="k", **kw)
+
+
+def _rows(base, n):
+    return [{"k": base + i, "v": f"v{base + i}"} for i in range(n)]
+
+
+def _scan_rows(paths):
+    out = []
+    for p in paths:
+        with FileReader(p) as r:
+            out.extend(r.iter_rows())
+    return out
+
+
+# -- manifest ------------------------------------------------------------------
+
+
+class TestManifest:
+    def test_empty_table_is_generation_zero(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        assert t.manifest.current_generation() == 0
+        snap = t.manifest.open_snapshot()
+        assert snap.generation == 0 and snap.files == ()
+        assert t.snapshot_paths() == []
+
+    def test_commit_points_and_time_travel(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        w = IngestWriter(t, parallel=False)
+        for base in (0, 100, 200):
+            w.append(_rows(base, 10), flush=True)
+        m = t.manifest
+        assert m.current_generation() == 3
+        assert m.generations() == [1, 2, 3]
+        # each generation is a strict superset of its parent
+        for g in (1, 2, 3):
+            snap = m.open_snapshot(g)
+            assert snap.generation == g
+            assert len(snap.files) == g
+            assert snap.total_rows == 10 * g
+        # a never-committed generation is a typed error, not a KeyError
+        with pytest.raises(LakeError) as ei:
+            m.open_snapshot(9)
+        assert ei.value.code == "no_such_generation"
+
+    def test_expect_generation_conflict_is_typed(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        IngestWriter(t, parallel=False).append(_rows(0, 5), flush=True)
+        with pytest.raises(LakeError) as ei:
+            t.manifest.commit(add=[], expect_generation=0)
+        assert ei.value.code == "commit_conflict"
+
+    def test_remove_unreferenced_and_double_add_are_typed(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        IngestWriter(t, parallel=False).append(_rows(0, 5), flush=True)
+        rel = t.manifest.open_snapshot().files[0].path
+        with pytest.raises(LakeError) as ei:
+            t.manifest.commit(remove=["data/never-was.parquet"])
+        assert ei.value.code == "commit_conflict"
+        with pytest.raises(LakeError) as ei:
+            t.manifest.commit(add=[FileEntry(rel, 1, 1)])
+        assert ei.value.code == "commit_conflict"
+
+    def test_manifest_paths_are_confined(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        for bad in ("/etc/passwd", "../outside.parquet", "a/../../b"):
+            with pytest.raises(LakeError) as ei:
+                t.manifest.commit(add=[FileEntry(bad, 1, 1)])
+            assert ei.value.code == "bad_manifest"
+
+    def test_retention_drops_old_gens_but_keeps_referenced_files(
+        self, tmp_path
+    ):
+        t = LakeTable.create(
+            str(tmp_path / "t"), SCHEMA, sort_key="k", retain=2
+        )
+        w = IngestWriter(t, parallel=False)
+        for base in range(0, 50, 10):
+            w.append(_rows(base, 10), flush=True)
+        m = t.manifest
+        assert m.generations() == [4, 5]
+        # time travel inside the window still works; outside is typed
+        assert m.open_snapshot(4).total_rows == 40
+        with pytest.raises(LakeError):
+            m.open_snapshot(1)
+        # on-disk data files == union of the retained generations' refs
+        referenced = {
+            os.path.basename(f.path)
+            for g in m.generations()
+            for f in m.open_snapshot(g).files
+        }
+        on_disk = {
+            n for n in os.listdir(m.data_dir) if n.endswith(".parquet")
+        }
+        assert on_disk == referenced
+
+    def test_open_bad_table_is_typed(self, tmp_path):
+        with pytest.raises(LakeError) as ei:
+            LakeTable.open(str(tmp_path / "nope"))
+        assert ei.value.code == "no_such_table"
+        with pytest.raises(LakeError) as ei:
+            LakeTable.create(str(tmp_path / "t"), SCHEMA, sort_key="zz")
+        assert ei.value.code == "bad_schema"
+        _mk_table(tmp_path / "t")
+        with pytest.raises(LakeError) as ei:
+            _mk_table(tmp_path / "t")
+        assert ei.value.code == "table_exists"
+
+
+# -- ingest --------------------------------------------------------------------
+
+
+class TestIngest:
+    def test_buffer_then_flush_publishes_one_generation(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        w = IngestWriter(t, parallel=False)
+        ack = w.append(_rows(0, 7))
+        assert ack == {
+            "rows": 7, "buffered_rows": 7,
+            "flushed": False, "generation": None,
+        }
+        ack = w.append(_rows(100, 3), flush=True)
+        assert ack["flushed"] is True and ack["generation"] == 1
+        assert ack["buffered_rows"] == 0
+        snap = t.manifest.open_snapshot()
+        assert snap.total_rows == 10 and len(snap.files) == 1
+
+    def test_size_bound_triggers_flush(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        w = IngestWriter(t, flush_bytes=64, parallel=False)
+        ack = w.append(_rows(0, 50))
+        assert ack["flushed"] is True and ack["generation"] == 1
+
+    def test_flushed_file_is_key_sorted_with_minmax(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        w = IngestWriter(t, parallel=False)
+        w.append(
+            [{"k": 9, "v": "a"}, {"k": 1, "v": "b"}, {"k": 5, "v": None}],
+            flush=True,
+        )
+        entry = t.manifest.open_snapshot().files[0]
+        assert (entry.min_key, entry.max_key) == (1, 9)
+        rows = _scan_rows(t.snapshot_paths())
+        assert [r["k"] for r in rows] == [1, 5, 9]
+
+    def test_close_flushes_the_tail_then_refuses(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        w = IngestWriter(t, parallel=False)
+        w.append(_rows(0, 4))
+        snap = w.close()
+        assert snap is not None and snap.total_rows == 4
+        with pytest.raises(LakeError) as ei:
+            w.append(_rows(9, 1))
+        assert ei.value.code == "closed"
+
+    def test_payload_decoding(self):
+        jsonl = b'{"k": 1}\n\n{"k": 2, "v": "x"}\n'
+        assert rows_from_payload(jsonl, "application/x-ndjson") == [
+            {"k": 1}, {"k": 2, "v": "x"},
+        ]
+        pa = pytest.importorskip("pyarrow")
+        table = pa.table({"k": [3, 4]})
+        import io as _io
+
+        buf = _io.BytesIO()
+        with pa.ipc.new_stream(buf, table.schema) as wr:
+            wr.write_table(table)
+        got = rows_from_payload(
+            buf.getvalue(), "application/vnd.apache.arrow.stream"
+        )
+        assert got == [{"k": 3}, {"k": 4}]
+        with pytest.raises(LakeError) as ei:
+            rows_from_payload(b"not json\n", "application/json")
+        assert ei.value.code == "bad_payload"
+        with pytest.raises(LakeError) as ei:
+            rows_from_payload(b"[1, 2]", "application/json")
+        assert ei.value.code == "bad_payload"
+        with pytest.raises(LakeError) as ei:
+            rows_from_payload(b"k\n1\n", "text/csv")
+        assert ei.value.code == "unsupported_format"
+
+
+# -- plan/dataset integration --------------------------------------------------
+
+
+class TestLakeRefs:
+    def test_expand_paths_pins_current_and_named_generations(self, tmp_path):
+        t = _mk_table(tmp_path / "t")
+        w = IngestWriter(t, parallel=False)
+        w.append(_rows(0, 10), flush=True)
+        w.append(_rows(100, 10), flush=True)
+        root = str(tmp_path / "t")
+        assert is_lake_table(root)
+        cur = expand_paths(root)
+        assert len(cur) == 2 and all(p.endswith(".parquet") for p in cur)
+        pinned = expand_paths(os.path.join(root, "_lake", "gen-1.json"))
+        assert pinned == cur[:1]
+        assert manifest_ref_root(os.path.join(root, "_lake", "gen-1.json"))
+        # build_plan sees the committed rows through the same ref
+        assert build_plan(root).total_rows == 20
+        assert build_plan([root]).total_rows == 20
+
+    def test_non_lake_paths_are_untouched(self, tmp_path):
+        f = tmp_path / "plain.txt"
+        f.write_text("x")
+        assert expand_paths(str(f)) == [str(f)]
+
+
+# -- compaction ----------------------------------------------------------------
+
+
+class TestCompactor:
+    def _fill(self, d, *, flushes=5, rows_per=200):
+        t = _mk_table(d)
+        w = IngestWriter(t, parallel=False)
+        # interleave key ranges so pre-compaction files all overlap and a
+        # point probe prunes nothing
+        for i in range(flushes):
+            w.append(
+                [
+                    {"k": j * flushes + i, "v": f"r{i}.{j}"}
+                    for j in range(rows_per)
+                ],
+                flush=True,
+            )
+        return t
+
+    def test_fold_preserves_rows_and_improves_pruning(self, tmp_path):
+        t = self._fill(tmp_path / "t", flushes=6, rows_per=300)
+        before_rows = sorted(
+            r["k"] for r in _scan_rows(t.snapshot_paths())
+        )
+        c = Compactor(t, row_group_size=256)
+        result = c.compact_once()
+        assert result is not None
+        assert result.files_in == 6 and result.rows == 1800
+        snap = t.manifest.open_snapshot()
+        assert snap.generation == result.generation
+        assert len(snap.files) == 1 and snap.total_rows == 1800
+        after_rows = sorted(r["k"] for r in _scan_rows(t.snapshot_paths()))
+        assert after_rows == before_rows
+        # the point of the exercise: the sorted rewrite prunes where the
+        # overlapping ingest files could not
+        assert result.pruned_ratio_before is not None
+        assert result.pruned_ratio_after > result.pruned_ratio_before
+        # nothing left worth folding
+        assert c.compact_once() is None
+
+    def test_time_travel_is_byte_identical_across_compaction(self, tmp_path):
+        t = self._fill(tmp_path / "t", flushes=4, rows_per=100)
+        pin = 3  # a pre-compaction generation
+        paths = t.snapshot_paths(pin)
+        before = [open(p, "rb").read() for p in paths]
+        Compactor(t).compact_once()
+        IngestWriter(t, parallel=False).append(_rows(10_000, 5), flush=True)
+        snap = t.manifest.open_snapshot(pin)
+        assert snap.generation == pin
+        assert t.snapshot_paths(pin) == paths
+        assert [open(p, "rb").read() for p in paths] == before
+
+    def test_crash_mid_compact_loses_nothing(self, tmp_path):
+        t = self._fill(tmp_path / "t", flushes=3, rows_per=50)
+        committed = sorted(r["k"] for r in _scan_rows(t.snapshot_paths()))
+        m = t.manifest
+        # simulate the crash: the rewrite landed, the commit never ran —
+        # plus the sink's tmp debris from a writer killed mid-write
+        orphan = os.path.join(m.data_dir, "compact-99999-000001.parquet")
+        with open(t.snapshot_paths()[0], "rb") as f:
+            payload = f.read()
+        with open(orphan, "wb") as f:
+            f.write(payload)
+        tmp_debris = os.path.join(m.data_dir, ".dead.1234.0.tmp")
+        with open(tmp_debris, "wb") as f:
+            f.write(b"partial")
+        reaped = m.reap_orphans(grace_s=0.0)
+        assert reaped == 2
+        assert not os.path.exists(orphan)
+        assert not os.path.exists(tmp_debris)
+        # zero data loss: every committed row still scans
+        assert (
+            sorted(r["k"] for r in _scan_rows(t.snapshot_paths()))
+            == committed
+        )
+
+    def test_reap_respects_the_grace_window(self, tmp_path):
+        t = self._fill(tmp_path / "t", flushes=2, rows_per=10)
+        fresh = os.path.join(t.manifest.data_dir, "inflight.parquet")
+        with open(fresh, "wb") as f:
+            f.write(b"x")
+        assert t.manifest.reap_orphans(grace_s=3600.0) == 0
+        assert os.path.exists(fresh)
+
+    def test_no_sort_key_falls_back_to_rowgroup_fold(self, tmp_path):
+        t = LakeTable.create(str(tmp_path / "t"), SCHEMA)
+        w = IngestWriter(t, parallel=False)
+        w.append(_rows(0, 100), flush=True)
+        w.append(_rows(100, 100), flush=True)
+        result = Compactor(t).compact_once()
+        assert result is not None and result.rows == 200
+        assert result.pruned_ratio_before is None
+        assert len(t.manifest.open_snapshot().files) == 1
+        got = sorted(r["k"] for r in _scan_rows(t.snapshot_paths()))
+        assert got == list(range(200))
+
+    def test_background_thread_runs_on_its_own_lane(self, tmp_path):
+        from parquet_tpu.obs.prof import POOL_LANES, lane_of
+
+        assert "pqt-compact" in POOL_LANES
+        assert lane_of("pqt-compact") == "pqt-compact"
+        t = self._fill(tmp_path / "t", flushes=3, rows_per=20)
+        c = Compactor(t, interval_s=0.01)
+        c.start()
+        try:
+            deadline = time.time() + WATCHDOG_S
+            while c.compactions == 0 and time.time() < deadline:
+                time.sleep(0.01)
+        finally:
+            c.stop()
+        assert c.compactions >= 1
+        assert len(t.manifest.open_snapshot().files) == 1
+
+
+# -- the concurrency hammer ----------------------------------------------------
+
+
+class TestHammer:
+    def test_every_scan_pins_exactly_one_generation(self, tmp_path):
+        """Appends, flushes, and compactions race while scanners replan
+        continuously; every scan must see an internally consistent
+        snapshot — the planned row total equals the manifest's claim for
+        that generation, and every referenced file opens. A torn commit
+        (file list without its data, CURRENT ahead of its gen file)
+        fails here."""
+        t = _mk_table(tmp_path / "t")
+        w = IngestWriter(t, parallel=False)
+        c = Compactor(t, min_files=2, max_files=8)
+        stop = threading.Event()
+        failures: list[str] = []
+        gen_rows: dict[int, int] = {}
+        gen_lock = threading.Lock()
+
+        def writer():
+            total = 0
+            try:
+                for i in range(24):
+                    ack = w.append(_rows(i * 50, 50), flush=(i % 3 == 2))
+                    total += 50
+                    if ack["flushed"]:
+                        with gen_lock:
+                            gen_rows[ack["generation"]] = total
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"writer: {type(e).__name__}: {e}")
+            finally:
+                stop.set()
+
+        def compactor():
+            while not stop.wait(0.002):
+                try:
+                    r = c.compact_once()
+                    if r is not None:
+                        with gen_lock:
+                            # compaction rewrites, never changes totals
+                            gen_rows[r.generation] = r.rows
+                except LakeError:
+                    continue  # lost a commit race; re-plan next tick
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"compactor: {type(e).__name__}: {e}")
+                    return
+
+        def scanner():
+            last_gen = 0
+            while not stop.is_set() or last_gen == 0:
+                try:
+                    snap = t.manifest.open_snapshot()
+                    if snap.generation == 0:
+                        continue
+                    plan = build_plan(t.snapshot_paths(snap.generation))
+                    if plan.total_rows != snap.total_rows:
+                        failures.append(
+                            f"scanner: gen {snap.generation} planned "
+                            f"{plan.total_rows} rows, manifest says "
+                            f"{snap.total_rows}"
+                        )
+                        return
+                    if snap.generation < last_gen:
+                        failures.append("scanner: generation went backward")
+                        return
+                    last_gen = snap.generation
+                except LakeError:
+                    continue  # pinned gen aged out mid-scan: retry
+                except Exception as e:  # noqa: BLE001
+                    failures.append(f"scanner: {type(e).__name__}: {e}")
+                    return
+
+        threads = [
+            threading.Thread(target=writer),
+            threading.Thread(target=compactor),
+            threading.Thread(target=scanner),
+            threading.Thread(target=scanner),
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(WATCHDOG_S)
+            assert not th.is_alive(), "hammer thread wedged"
+        assert not failures, failures
+        # quiesce: one final fold, then the table holds every row exactly
+        # once in key order per file
+        w.close()
+        while c.compact_once() is not None:
+            pass
+        rows = sorted(r["k"] for r in _scan_rows(t.snapshot_paths()))
+        assert rows == list(range(0, 24 * 50))
+        with gen_lock:
+            final = t.manifest.open_snapshot()
+            assert final.total_rows == 24 * 50
+
+
+# -- the serve surface ---------------------------------------------------------
+
+
+def _request(server, method, path, body=b"", headers=None):
+    conn = http.client.HTTPConnection(
+        server.host, server.port, timeout=WATCHDOG_S
+    )
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _append(server, body, content_type="application/x-ndjson", flush=False):
+    return _request(
+        server,
+        "POST",
+        "/v1/append" + ("?flush=1" if flush else ""),
+        body=body,
+        headers={"Content-Type": content_type},
+    )
+
+
+@pytest.fixture()
+def lake_server(tmp_path):
+    cfg = ServeConfig(
+        port=0,
+        root=str(tmp_path),
+        lake_root=str(tmp_path / "tbl"),
+        lake_schema=SCHEMA,
+        lake_sort_key="k",
+        max_append_bytes=4096,
+    )
+    with ScanServer(cfg) as s:
+        s.start_background()
+        yield s
+
+
+class TestAppendHTTP:
+    def test_buffered_then_flushed_acks(self, lake_server):
+        body = b'{"k": 1, "v": "a"}\n{"k": 2, "v": "b"}\n'
+        status, raw = _append(lake_server, body)
+        assert status == 200
+        ack = json.loads(raw)
+        assert ack["rows"] == 2 and ack["flushed"] is False
+        assert ack["generation"] is None
+        status, raw = _append(lake_server, b'{"k": 3}\n', flush=True)
+        ack = json.loads(raw)
+        assert status == 200 and ack["flushed"] is True
+        assert ack["generation"] == 1
+
+    def test_arrow_ipc_append(self, lake_server):
+        pa = pytest.importorskip("pyarrow")
+        import io as _io
+
+        table = pa.table({"k": [7, 8], "v": ["x", None]})
+        buf = _io.BytesIO()
+        with pa.ipc.new_stream(buf, table.schema) as wr:
+            wr.write_table(table)
+        status, raw = _append(
+            lake_server,
+            buf.getvalue(),
+            content_type="application/vnd.apache.arrow.stream",
+            flush=True,
+        )
+        assert status == 200
+        assert json.loads(raw)["generation"] == 1
+
+    def test_typed_errors(self, lake_server):
+        cases = [
+            (b"k,v\n1,a\n", "text/csv", 415, "unsupported_format"),
+            (b"not json\n", "application/x-ndjson", 400, "bad_payload"),
+            (b"", "application/x-ndjson", 400, "bad_request"),
+            (b'{"k": "not-an-int"}\n', "application/x-ndjson", 422,
+             "bad_rows"),
+        ]
+        for body, ct, want_status, want_code in cases:
+            status, raw = _append(
+                lake_server, body, content_type=ct, flush=True
+            )
+            assert status == want_status, (body, status, raw)
+            assert json.loads(raw)["error"]["code"] == want_code
+
+    def test_oversized_body_is_413(self, lake_server):
+        big = b'{"k": 1}\n' * 1000  # over the 4096-byte test cap
+        status, raw = _append(lake_server, big)
+        assert status == 413
+        assert json.loads(raw)["error"]["code"] == "body_too_large"
+
+    def test_append_disabled_without_a_lake(self, tmp_path):
+        with ScanServer(
+            ServeConfig(port=0, root=str(tmp_path))
+        ) as s:
+            s.start_background()
+            status, raw = _append(s, b'{"k": 1}\n')
+        assert status == 503
+        assert json.loads(raw)["error"]["code"] == "ingest_disabled"
+
+    def test_daemon_scan_reads_the_committed_generation(self, lake_server):
+        lines = b"".join(
+            json.dumps({"k": i, "v": f"v{i}"}).encode() + b"\n"
+            for i in (5, 3, 1, 4, 2)
+        )
+        status, _ = _append(lake_server, lines, flush=True)
+        assert status == 200
+        status, raw = _request(
+            lake_server,
+            "POST",
+            "/v1/scan",
+            body=json.dumps({"paths": ["tbl"]}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        assert status == 200, raw
+        rows = [json.loads(ln) for ln in raw.splitlines() if ln]
+        assert [r["k"] for r in rows] == [1, 2, 3, 4, 5]
+
+    def test_close_flushes_the_tail(self, tmp_path):
+        cfg = ServeConfig(
+            port=0,
+            root=str(tmp_path),
+            lake_root=str(tmp_path / "tbl"),
+            lake_schema=SCHEMA,
+            lake_sort_key="k",
+        )
+        with ScanServer(cfg) as s:
+            s.start_background()
+            status, raw = _append(s, b'{"k": 1}\n')
+            assert status == 200
+            assert json.loads(raw)["flushed"] is False
+        t = LakeTable.open(str(tmp_path / "tbl"))
+        assert t.manifest.open_snapshot().total_rows == 1
+
+
+# -- the CLI -------------------------------------------------------------------
+
+
+class TestLakeCLI:
+    def test_init_append_manifest_compact(self, tmp_path, capsys, monkeypatch):
+        from parquet_tpu.tools.parquet_tool import main
+
+        tbl = str(tmp_path / "t")
+        assert (
+            main(["lake", "init", tbl, "--schema", SCHEMA, "--sort-key", "k"])
+            == 0
+        )
+        src = tmp_path / "rows.jsonl"
+        src.write_text('{"k": 2}\n{"k": 1}\n')
+        assert main(["lake", "append", tbl, str(src)]) == 0
+        src.write_text('{"k": 4}\n{"k": 3}\n')
+        assert main(["lake", "append", tbl, str(src)]) == 0
+        capsys.readouterr()
+        assert main(["lake", "manifest", tbl, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["generation"] == 2 and len(doc["files"]) == 2
+        assert (
+            main(["lake", "compact", tbl, "--reap", "--reap-grace-s", "0"])
+            == 0
+        )
+        capsys.readouterr()
+        assert main(["lake", "manifest", tbl, "--gen", "3", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["files"]) == 1
+        assert doc["files"][0]["rows"] == 4
+
+    def test_errors_are_messages_not_tracebacks(self, tmp_path, capsys):
+        from parquet_tpu.tools.parquet_tool import main
+
+        assert main(["lake", "manifest", str(tmp_path / "nope")]) == 1
+        err = capsys.readouterr().err
+        assert "no table" in err and "Traceback" not in err

@@ -327,6 +327,21 @@ class TestRoutedByteIdentity:
         )
         assert status == 404
 
+    def test_append_is_typed_not_routable(self, fleet):
+        """Ingest targets ONE replica's lake table; the router refuses
+        /v1/append with a typed 501 instead of hashing rows somewhere."""
+        _direct, _replicas, router = fleet
+        status, _h, body = _request(
+            router,
+            "POST",
+            "/v1/append",
+            {"k": 1},
+            headers={"Content-Type": "application/x-ndjson"},
+        )
+        assert status == 501
+        assert _error_code(body) == "not_routable"
+        assert "replica" in json.loads(body)["error"]["message"]
+
 
 # -- resilience: kill / drain / breaker ----------------------------------------
 

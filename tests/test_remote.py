@@ -299,6 +299,96 @@ class TestHttpSource:
                 configure_resilience(prev)
 
 
+class TestMultiRange:
+    """read_ranges coalesces N ranges into ONE `Range: bytes=a-b,c-d`
+    round trip (multipart/byteranges), with per-range fallback pinned for
+    servers that collapse or reject the set."""
+
+    SPANS = [(0, 128), (50_000, 256), (9, 0), (130_000, 64)]
+
+    def _expected(self, blob):
+        return [blob[o : o + n] for o, n in self.SPANS]
+
+    def test_one_round_trip_byte_identical(self, blob):
+        with RangeHttpStub(files={"a.bin": blob}) as stub:
+            src = HttpSource(stub.url_for("a.bin"))
+            reqs = stub.requests
+            s0 = metrics.snapshot()
+            got = src.read_ranges(self.SPANS)
+            assert [bytes(b) for b in got] == self._expected(blob)
+            # THE pin: every range in one request (the zero-length range
+            # rides for free — it never reaches the wire)
+            assert stub.requests == reqs + 1
+            assert stub.multirange_requests == 1
+            d = metrics.delta(s0)
+            assert d.get('io_multirange_requests_total{outcome="ok"}') == 1
+            assert d.get("io_multirange_parts_total") == 3
+
+    def test_rejecting_server_latches_per_range_fallback(self, blob):
+        with RangeHttpStub(
+            files={"a.bin": blob}, reject_multirange=True
+        ) as stub:
+            src = HttpSource(stub.url_for("a.bin"))
+            s0 = metrics.snapshot()
+            got = src.read_ranges(self.SPANS)
+            assert [bytes(b) for b in got] == self._expected(blob)
+            assert src._multirange is False  # latched for good
+            d = metrics.delta(s0)
+            assert (
+                d.get('io_multirange_requests_total{outcome="unsupported"}')
+                == 1
+            )
+            # the latch holds: the next call goes straight to per-range
+            reqs = stub.requests
+            got = src.read_ranges(self.SPANS[:2])
+            assert [bytes(b) for b in got] == self._expected(blob)[:2]
+            assert stub.requests == reqs + 2
+
+    def test_range_ignoring_server_slices_the_full_body(self, blob):
+        with RangeHttpStub(
+            files={"a.bin": blob}, ignore_range=True
+        ) as stub:
+            src = HttpSource(stub.url_for("a.bin"))
+            s0 = metrics.snapshot()
+            got = src.read_ranges(self.SPANS)
+            assert [bytes(b) for b in got] == self._expected(blob)
+            d = metrics.delta(s0)
+            assert (
+                d.get('io_multirange_requests_total{outcome="full_body"}')
+                == 1
+            )
+            # a 200 is the server's choice, not an incapability: the
+            # multipart attempt is NOT latched off
+            assert src._multirange is True
+
+    def test_single_range_skips_the_multipart_path(self, blob):
+        with RangeHttpStub(files={"a.bin": blob}) as stub:
+            src = HttpSource(stub.url_for("a.bin"))
+            src.read_ranges([(10, 20)])
+            assert stub.multirange_requests == 0
+
+    def test_past_eof_is_typed_without_a_round_trip(self, blob):
+        with RangeHttpStub(files={"a.bin": blob}) as stub:
+            src = HttpSource(stub.url_for("a.bin"))
+            reqs = stub.requests
+            with pytest.raises(SourceError):
+                src.read_ranges([(0, 16), (len(blob) - 4, 64)])
+            assert stub.requests == reqs
+
+    def test_reader_over_multirange_stub_byte_identical(self, corpus):
+        data, table = corpus
+        with RangeHttpStub(files={"c.parquet": data}) as stub:
+            # the projection skips the wide middle column, so each row
+            # group needs two non-adjacent runs — the multi-range shape
+            with FileReader(
+                stub.url_for("c.parquet"), columns=["id", "tag"]
+            ) as r:
+                ids = [row["id"] for row in r.iter_rows()]
+            assert ids == table["id"].to_pylist()
+            # the coalesced path actually ran for this scan
+            assert stub.multirange_requests >= 1
+
+
 class TestObjectStoreSource:
     def test_reads_and_initial_sign(self, blob):
         with RangeHttpStub(files={"a.bin": blob}) as stub:
